@@ -1,0 +1,112 @@
+"""h2o3_tpu — a TPU-native, JAX/XLA/Pallas re-design of the H2O-3 distributed
+ML platform (reference: lorentzbao/h2o-3, surveyed in SURVEY.md).
+
+This is NOT a port: where H2O-3 runs a cloud of JVMs with a custom UDP/TCP
+RPC layer, a distributed K/V chunk store and fork/join MRTasks
+(reference: h2o-core/src/main/java/water/H2O.java, MRTask.java, DKV.java),
+this framework pins columnar data into TPU HBM as `jax.Array`s sharded over a
+`jax.sharding.Mesh`, expresses every distributed computation as jitted XLA
+programs with collectives over ICI, and keeps only light metadata / model
+objects in a host-side key/value store.
+
+Public API mirrors the h2o-py module surface (reference: h2o-py/h2o/h2o.py)
+so users of the reference find the same entry points.
+"""
+
+__version__ = "0.1.0"
+
+from h2o3_tpu.core.runtime import init, cluster, shutdown, cluster_info
+from h2o3_tpu.core.dkv import DKV, Key, Scope
+from h2o3_tpu.core.frame import Frame, Column
+from h2o3_tpu.core.job import Job
+from h2o3_tpu.ingest.parser import import_file, parse_setup, upload_file
+from h2o3_tpu.frame_factory import H2OFrame, create_frame
+
+# estimator surface (mirrors h2o-py/h2o/estimators/*) — loaded lazily so the
+# core package imports fast and partial installs stay importable
+_ESTIMATORS = {
+    "H2OGeneralizedLinearEstimator": "h2o3_tpu.models.glm",
+    "H2OGradientBoostingEstimator": "h2o3_tpu.models.gbm",
+    "H2ORandomForestEstimator": "h2o3_tpu.models.drf",
+    "H2OIsolationForestEstimator": "h2o3_tpu.models.isofor",
+    "H2OExtendedIsolationForestEstimator": "h2o3_tpu.models.extended_isolation_forest",
+    "H2ODeepLearningEstimator": "h2o3_tpu.models.deeplearning",
+    "H2OAutoEncoderEstimator": "h2o3_tpu.models.deeplearning",
+    "H2OKMeansEstimator": "h2o3_tpu.models.kmeans",
+    "H2OPrincipalComponentAnalysisEstimator": "h2o3_tpu.models.pca",
+    "H2OSingularValueDecompositionEstimator": "h2o3_tpu.models.svd",
+    "H2ONaiveBayesEstimator": "h2o3_tpu.models.naive_bayes",
+    "H2OGeneralizedLowRankEstimator": "h2o3_tpu.models.glrm",
+    "H2OWord2vecEstimator": "h2o3_tpu.models.word2vec",
+    "H2OXGBoostEstimator": "h2o3_tpu.models.xgboost",
+    "H2OStackedEnsembleEstimator": "h2o3_tpu.models.stacked_ensemble",
+    "H2ORuleFitEstimator": "h2o3_tpu.models.rulefit",
+    "H2OGeneralizedAdditiveEstimator": "h2o3_tpu.models.gam",
+    "H2OCoxProportionalHazardsEstimator": "h2o3_tpu.models.coxph",
+    "H2OAggregatorEstimator": "h2o3_tpu.models.aggregator",
+    "H2OTargetEncoderEstimator": "h2o3_tpu.models.target_encoder",
+    "H2OGenericEstimator": "h2o3_tpu.models.generic",
+    "H2OSupportVectorMachineEstimator": "h2o3_tpu.models.psvm",
+    "H2OGridSearch": "h2o3_tpu.models.grid",
+    "H2OAutoML": "h2o3_tpu.automl.automl",
+    "rapids_exec": "h2o3_tpu.ops.rapids.rapids",
+}
+
+
+def __getattr__(name):
+    mod = _ESTIMATORS.get(name)
+    if mod is None:
+        raise AttributeError(f"module 'h2o3_tpu' has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_ESTIMATORS))
+
+
+def no_progress():
+    """Disable progress-bar output (h2o.no_progress parity)."""
+    from h2o3_tpu.utils import log
+    log.PROGRESS = False
+
+
+def show_progress():
+    from h2o3_tpu.utils import log
+    log.PROGRESS = True
+
+
+def ls():
+    """List keys in the DKV (h2o.ls parity)."""
+    return sorted(DKV.keys())
+
+
+def get_frame(key):
+    fr = DKV.get(key)
+    if fr is None:
+        raise KeyError(f"No frame under key {key!r}")
+    return fr
+
+
+def get_model(key):
+    m = DKV.get(key)
+    if m is None:
+        raise KeyError(f"No model under key {key!r}")
+    return m
+
+
+def remove(key):
+    DKV.remove(key)
+
+
+def remove_all():
+    DKV.clear()
+
+
+def frame(frame_id):
+    return get_frame(frame_id)
+
+
+def flow():
+    raise NotImplementedError("Flow UI is not bundled; use the REST API (h2o3_tpu.api)")

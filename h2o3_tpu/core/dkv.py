@@ -1,0 +1,136 @@
+"""DKV — the key/value control plane.
+
+Reference design: H2O-3 stores ALL data (chunks, frames, models, jobs) in a
+distributed hash map with keys homed by hash (water/DKV.java, water/Key.java:47,
+water/Value.java) and atomic updates shipped to the home node
+(water/Atomic.java).
+
+TPU-native inversion (SURVEY.md §7): big data lives in HBM as sharded
+jax.Arrays referenced BY Python objects; the DKV holds only metadata, frames
+(which wrap device arrays), models and jobs. In a multi-host deployment every
+process holds the same metadata (control-plane replication via the REST
+leader); device data is sharded by XLA, not by key hash. Hence this store is
+an in-process, thread-safe map with the same API verbs (get/put/remove) and
+the same supporting cast: Scope (RAII key cleanup, water/Scope.java),
+Lockable (read/write locks, water/Lockable.java) and atomic updates
+(water/TAtomic.java)."""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+
+class Key(str):
+    """A DKV key. H2O keys are ≤512-byte strings with embedded homing bytes
+    (water/Key.java:47); here a key is just a unique name — homing is the
+    mesh sharding rule, not the key."""
+
+    __slots__ = ()
+
+    @staticmethod
+    def make(prefix: str = "key") -> "Key":
+        return Key(f"{prefix}_{uuid.uuid4().hex[:12]}")
+
+
+class _DKV:
+    def __init__(self) -> None:
+        self._store: Dict[str, Any] = {}
+        self._lock = threading.RLock()
+        self._rw: Dict[str, threading.RLock] = {}
+
+    # H2O verbs: DKV.put / DKV.get / DKV.remove (water/DKV.java)
+    def put(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._store[str(key)] = value
+            Scope._track(str(key))
+
+    def get(self, key: str) -> Any:
+        with self._lock:
+            return self._store.get(str(key))
+
+    def remove(self, key: str) -> None:
+        with self._lock:
+            self._store.pop(str(key), None)
+            self._rw.pop(str(key), None)
+
+    def contains(self, key: str) -> bool:
+        with self._lock:
+            return str(key) in self._store
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._store.keys())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._store.clear()
+            self._rw.clear()
+
+    def atomic(self, key: str, fn: Callable[[Any], Any]) -> Any:
+        """Compare-and-set style update on the stored value
+        (water/TAtomic.java): fn runs under the store lock."""
+        with self._lock:
+            old = self._store.get(str(key))
+            new = fn(old)
+            self._store[str(key)] = new
+            return new
+
+    def write_lock(self, key: str) -> threading.RLock:
+        """Per-key lock (water/Lockable.java write_lock)."""
+        with self._lock:
+            return self._rw.setdefault(str(key), threading.RLock())
+
+
+DKV = _DKV()
+
+
+class Scope:
+    """RAII key tracking (water/Scope.java): keys put while a scope is open
+    are removed when it exits, unless untracked."""
+
+    _stack: List[set] = []
+    _slock = threading.RLock()
+
+    def __init__(self) -> None:
+        self._keys: set = set()
+
+    def __enter__(self) -> "Scope":
+        with Scope._slock:
+            Scope._stack.append(self._keys)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        with Scope._slock:
+            Scope._stack.remove(self._keys)
+        for k in self._keys:
+            DKV.remove(k)
+
+    @classmethod
+    def _track(cls, key: str) -> None:
+        with cls._slock:
+            if cls._stack:
+                cls._stack[-1].add(key)
+
+    def untrack(self, key: str) -> None:
+        self._keys.discard(str(key))
+
+
+class Keyed:
+    """Base for DKV-resident objects (water/Keyed.java): has a _key, can
+    install/remove itself."""
+
+    def __init__(self, key: Optional[str] = None):
+        self._key: Key = Key(key) if key else Key.make(type(self).__name__)
+
+    @property
+    def key(self) -> Key:
+        return self._key
+
+    def install(self) -> "Keyed":
+        DKV.put(self._key, self)
+        return self
+
+    def delete(self) -> None:
+        DKV.remove(self._key)

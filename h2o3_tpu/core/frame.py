@@ -1,0 +1,368 @@
+"""Columnar store: Frame / Column.
+
+Reference design: Frame -> Vec -> Chunk with 19 compression codecs and
+inflate-on-write (water/fvec/Frame.java:64, Vec.java:157, Chunk.java:113,
+NewChunk.java:22), ragged ESPC row layout, lazily-computed RollupStats
+(water/fvec/RollupStats.java:30).
+
+TPU-native design (SURVEY.md §7):
+- One dense device array per column, row-sharded over the mesh 'rows' axis
+  (`NamedSharding(P('rows'))`) — chunk homing becomes the sharding rule.
+- Static shapes: rows padded to a multiple of (shards * row_align); the pad
+  sentinel doubles as the NA sentinel, so masked reductions skip both.
+- NA encoding replaces the codec zoo + mask machinery: numeric = NaN,
+  categorical/int = -1. XLA's fusion makes narrow-dtype compression moot in
+  HBM terms for f32; categoricals are int32 codes with a host-side domain
+  (strings NEVER go to device).
+- Columns are immutable: Rapids assign becomes copy-on-write version chains
+  instead of Chunk inflate-on-write (Chunk.java:427-451).
+- RollupStats = one fused jitted reduction, cached on the (immutable) column.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from h2o3_tpu.core.dkv import DKV, Key, Keyed
+
+# Column logical types (water/fvec/Vec.java:160 BAD/UUID/STR/NUM/CAT/TIME)
+T_NUM = "real"
+T_INT = "int"
+T_CAT = "enum"
+T_TIME = "time"
+T_STR = "string"
+T_UUID = "uuid"
+T_BAD = "bad"
+
+NA_CAT = np.int32(-1)
+
+
+def _cluster():
+    from h2o3_tpu.core.runtime import cluster
+
+    return cluster()
+
+
+class Column:
+    """A distributed column (Vec analog, water/fvec/Vec.java:157).
+
+    data: jax.Array (padded_rows,) row-sharded; float32 for real/int/time
+    (NaN = NA/pad) or int32 for enum (-1 = NA/pad). For string/uuid columns
+    the data lives host-side in `host_data` (object ndarray) and `data` is
+    None — TPUs never touch strings (SURVEY.md §7).
+    """
+
+    __slots__ = ("data", "ctype", "domain", "host_data", "nrows", "_rollups", "_chunks")
+
+    def __init__(self, data, ctype: str, nrows: int,
+                 domain: Optional[List[str]] = None,
+                 host_data: Optional[np.ndarray] = None):
+        self.data = data
+        self.ctype = ctype
+        self.domain = domain
+        self.host_data = host_data
+        self.nrows = int(nrows)
+        self._rollups = None
+
+    # -- constructors -----------------------------------------------------
+    @staticmethod
+    def from_numpy(arr: np.ndarray, ctype: Optional[str] = None,
+                   domain: Optional[List[str]] = None) -> "Column":
+        """Build a device column from host data; pads + shards + pins to HBM."""
+        import jax
+        import jax.numpy as jnp
+
+        cl = _cluster()
+        n = len(arr)
+        padded = cl.pad_rows(n)
+
+        if ctype is None:
+            if arr.dtype.kind in "OUS":
+                return Column._from_strings(arr)
+            elif arr.dtype.kind in "fiub":
+                ctype = T_INT if arr.dtype.kind in "iub" else T_NUM
+            elif arr.dtype.kind == "M":
+                ctype = T_TIME
+            else:
+                raise TypeError(f"unsupported dtype {arr.dtype}")
+
+        if ctype == T_CAT:
+            buf = np.full(padded, NA_CAT, np.int32)
+            a = np.asarray(arr)
+            if a.dtype.kind in "OUS":
+                dom, codes = _intern_domain(a)
+                domain = dom
+                buf[:n] = codes
+            else:
+                buf[:n] = np.where(np.isnan(a.astype(np.float64)), NA_CAT,
+                                   a.astype(np.float64)).astype(np.int32) if a.dtype.kind == "f" else a.astype(np.int32)
+        elif ctype in (T_NUM, T_INT, T_TIME):
+            buf = np.full(padded, np.nan, np.float32)
+            a = np.asarray(arr, np.float64)
+            buf[:n] = a.astype(np.float32)
+        else:
+            raise TypeError(f"cannot device-store ctype {ctype}")
+
+        data = jax.device_put(buf, cl.row_sharding())
+        host = None
+        if ctype == T_TIME and np.asarray(arr).dtype.kind in "Mi":
+            host = np.asarray(arr)  # exact epoch-millis kept host-side
+        return Column(data, ctype, n, domain=domain, host_data=host)
+
+    @staticmethod
+    def _from_strings(arr: np.ndarray) -> "Column":
+        a = np.asarray(arr, dtype=object)
+        return Column(None, T_STR, len(a), host_data=a)
+
+    @staticmethod
+    def from_device(data, ctype: str, nrows: int,
+                    domain: Optional[List[str]] = None) -> "Column":
+        return Column(data, ctype, nrows, domain=domain)
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def is_numeric(self) -> bool:
+        return self.ctype in (T_NUM, T_INT)
+
+    @property
+    def is_categorical(self) -> bool:
+        return self.ctype == T_CAT
+
+    @property
+    def is_string(self) -> bool:
+        return self.ctype == T_STR
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.domain) if self.domain else 0
+
+    @property
+    def padded_rows(self) -> int:
+        return int(self.data.shape[0]) if self.data is not None else len(self.host_data)
+
+    def to_numpy(self) -> np.ndarray:
+        """Gather the logical (unpadded) rows back to host."""
+        if self.data is None:
+            return self.host_data[: self.nrows]
+        arr = np.asarray(self.data)[: self.nrows]
+        if self.ctype == T_CAT:
+            return arr
+        return arr
+
+    def values(self) -> np.ndarray:
+        """Decode to user-facing values (enum codes -> labels)."""
+        arr = self.to_numpy()
+        if self.ctype == T_CAT and self.domain is not None:
+            dom = np.asarray(self.domain, dtype=object)
+            out = np.empty(len(arr), dtype=object)
+            valid = arr >= 0
+            out[valid] = dom[arr[valid]]
+            out[~valid] = None
+            return out
+        return arr
+
+    # -- rollups ----------------------------------------------------------
+    @property
+    def rollups(self):
+        """Lazy fused min/max/mean/sigma/naCnt/nzCnt (RollupStats.java:30)."""
+        if self._rollups is None:
+            from h2o3_tpu.ops.rollups import compute_rollups
+
+            self._rollups = compute_rollups(self)
+        return self._rollups
+
+    def min(self):
+        return self.rollups.min
+
+    def max(self):
+        return self.rollups.max
+
+    def mean(self):
+        return self.rollups.mean
+
+    def sigma(self):
+        return self.rollups.sigma
+
+    def na_count(self):
+        return self.rollups.na_count
+
+    # -- transforms (copy-on-write) --------------------------------------
+    def with_data(self, data, ctype: Optional[str] = None,
+                  domain: Optional[List[str]] = None) -> "Column":
+        return Column(data, ctype or self.ctype, self.nrows,
+                      domain=domain if domain is not None else self.domain)
+
+    def valid_mask(self):
+        """Device bool mask of valid (non-NA, non-pad) rows."""
+        import jax.numpy as jnp
+
+        if self.ctype == T_CAT:
+            return self.data >= 0
+        return ~jnp.isnan(self.data)
+
+
+def _intern_domain(a: np.ndarray) -> Tuple[List[str], np.ndarray]:
+    """Global categorical interning (water/parser/Categorical.java): string
+    labels -> dense int codes, domain sorted lexicographically (H2O sorts
+    domains, water/parser/ParseDataset.java:518 GatherCategoricalDomainsTask)."""
+    mask_na = np.array([x is None or (isinstance(x, float) and math.isnan(x)) or x == "" for x in a])
+    vals = np.asarray([("" if m else str(x)) for x, m in zip(a, mask_na)])
+    dom = sorted(set(vals[~mask_na].tolist()))
+    lookup = {v: i for i, v in enumerate(dom)}
+    codes = np.array([NA_CAT if m else lookup[v] for v, m in zip(vals, mask_na)], np.int32)
+    return dom, codes
+
+
+class Frame(Keyed):
+    """Named, ordered collection of equal-length Columns
+    (water/fvec/Frame.java:64). Lockable via DKV per-key locks."""
+
+    def __init__(self, columns: Optional[Dict[str, Column]] = None,
+                 key: Optional[str] = None):
+        super().__init__(key or Key.make("Frame"))
+        self._names: List[str] = []
+        self._cols: Dict[str, Column] = {}
+        if columns:
+            for name, col in columns.items():
+                self.add(name, col)
+
+    # -- structure --------------------------------------------------------
+    @property
+    def names(self) -> List[str]:
+        return list(self._names)
+
+    @property
+    def columns(self) -> List[Column]:
+        return [self._cols[n] for n in self._names]
+
+    @property
+    def ncols(self) -> int:
+        return len(self._names)
+
+    @property
+    def nrows(self) -> int:
+        return self._cols[self._names[0]].nrows if self._names else 0
+
+    nrow = nrows  # h2o-py alias
+    ncol = ncols
+
+    @property
+    def types(self) -> Dict[str, str]:
+        return {n: self._cols[n].ctype for n in self._names}
+
+    def col(self, name_or_idx: Union[str, int]) -> Column:
+        if isinstance(name_or_idx, int):
+            return self._cols[self._names[name_or_idx]]
+        return self._cols[name_or_idx]
+
+    def __getitem__(self, sel):
+        if isinstance(sel, (str, int)):
+            return self.col(sel)
+        if isinstance(sel, (list, tuple)):
+            return self.subframe(sel)
+        raise TypeError(f"bad frame selector {sel!r}")
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._cols
+
+    def add(self, name: str, col: Column) -> "Frame":
+        if self._names and col.nrows != self.nrows:
+            raise ValueError(f"column {name!r} has {col.nrows} rows, frame has {self.nrows}")
+        if name in self._cols:
+            raise ValueError(f"duplicate column {name!r}")
+        self._names.append(name)
+        self._cols[name] = col
+        return self
+
+    def replace(self, name: str, col: Column) -> "Frame":
+        """Copy-on-write column replacement (vs H2O inflate-on-write)."""
+        if name not in self._cols:
+            return self.add(name, col)
+        if col.nrows != self.nrows:
+            raise ValueError("row mismatch")
+        self._cols[name] = col
+        return self
+
+    def drop(self, name: str) -> "Frame":
+        self._names.remove(name)
+        self._cols.pop(name)
+        return self
+
+    def rename(self, old: str, new: str) -> "Frame":
+        i = self._names.index(old)
+        self._names[i] = new
+        self._cols[new] = self._cols.pop(old)
+        return self
+
+    def subframe(self, names: Sequence[Union[str, int]], key: Optional[str] = None) -> "Frame":
+        fr = Frame(key=key)
+        for n in names:
+            nm = self._names[n] if isinstance(n, int) else n
+            fr.add(nm, self._cols[nm])
+        return fr
+
+    def cbind(self, other: "Frame") -> "Frame":
+        fr = Frame()
+        for n in self._names:
+            fr.add(n, self._cols[n])
+        for n in other._names:
+            nm = n
+            while nm in fr._cols:
+                nm = nm + "0"  # H2O dedup suffix behavior
+            fr.add(nm, other._cols[n])
+        return fr
+
+    # -- materialization --------------------------------------------------
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame({n: self._cols[n].values() for n in self._names})
+
+    def to_numpy(self) -> np.ndarray:
+        return np.column_stack([self._cols[n].to_numpy() for n in self._names])
+
+    @staticmethod
+    def from_numpy(arr: np.ndarray, names: Optional[Sequence[str]] = None,
+                   key: Optional[str] = None) -> "Frame":
+        arr = np.atleast_2d(arr)
+        names = list(names) if names else [f"C{i+1}" for i in range(arr.shape[1])]
+        fr = Frame(key=key)
+        for i, n in enumerate(names):
+            fr.add(n, Column.from_numpy(arr[:, i]))
+        return fr
+
+    @staticmethod
+    def from_pandas(df, key: Optional[str] = None,
+                    column_types: Optional[Dict[str, str]] = None) -> "Frame":
+        fr = Frame(key=key)
+        for n in df.columns:
+            s = df[n]
+            ctype = (column_types or {}).get(n)
+            if ctype is None and (s.dtype.name == "category" or s.dtype.kind in "OUS"):
+                # strings with low-ish cardinality -> enum, like ParseSetup guessing
+                ctype = T_CAT
+            fr.add(str(n), Column.from_numpy(s.to_numpy(), ctype=ctype))
+        return fr
+
+    # -- stats ------------------------------------------------------------
+    def summary(self) -> Dict[str, dict]:
+        out = {}
+        for n in self._names:
+            c = self._cols[n]
+            if c.is_numeric or c.ctype == T_TIME:
+                r = c.rollups
+                out[n] = {"type": c.ctype, "min": r.min, "max": r.max,
+                          "mean": r.mean, "sigma": r.sigma, "na_count": r.na_count}
+            elif c.is_categorical:
+                r = c.rollups
+                out[n] = {"type": c.ctype, "cardinality": c.cardinality,
+                          "na_count": r.na_count}
+            else:
+                out[n] = {"type": c.ctype}
+        return out
+
+    def __repr__(self) -> str:
+        return f"<Frame {self._key} {self.nrows}x{self.ncols} {self._names[:8]}>"

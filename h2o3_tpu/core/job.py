@@ -1,0 +1,102 @@
+"""Jobs: async work units with progress/cancel, resident in DKV.
+
+Reference: water/Job.java:23 (progress :184-203), polled by clients via
+GET /3/Jobs/{id}. Same lifecycle here: CREATED -> RUNNING -> DONE/FAILED/
+CANCELLED, with a progress fraction and message, running on a host thread
+(the device work inside is async XLA dispatch anyway)."""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Callable, Optional
+
+from h2o3_tpu.core.dkv import DKV, Key, Keyed
+
+
+class JobCancelled(Exception):
+    pass
+
+
+class Job(Keyed):
+    CREATED, RUNNING, DONE, FAILED, CANCELLED = "CREATED", "RUNNING", "DONE", "FAILED", "CANCELLED"
+
+    def __init__(self, description: str = "", dest: Optional[str] = None):
+        super().__init__(Key.make("Job"))
+        self.description = description
+        self.dest = dest  # key of the result object
+        self.status = Job.CREATED
+        self.progress = 0.0
+        self.progress_msg = ""
+        self.exception: Optional[str] = None
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self._cancel_requested = False
+        self._thread: Optional[threading.Thread] = None
+        self.result: Any = None
+        self.install()
+
+    # -- driver side ------------------------------------------------------
+    def start(self, fn: Callable[["Job"], Any], background: bool = True) -> "Job":
+        """Run fn(job) (the Driver.computeImpl analog, hex/ModelBuilder.java:224)."""
+
+        def run():
+            self.status = Job.RUNNING
+            self.start_time = time.time()
+            try:
+                self.result = fn(self)
+                if self.dest and self.result is not None:
+                    DKV.put(self.dest, self.result)
+                self.status = Job.DONE
+                self.progress = 1.0
+            except JobCancelled:
+                self.status = Job.CANCELLED
+            except Exception:
+                self.exception = traceback.format_exc()
+                self.status = Job.FAILED
+            finally:
+                self.end_time = time.time()
+
+        if background:
+            self._thread = threading.Thread(target=run, daemon=True)
+            self._thread.start()
+        else:
+            run()
+        return self
+
+    def update(self, progress: float, msg: str = "") -> None:
+        """Progress tick; raises if a cancel was requested (cooperative)."""
+        if self._cancel_requested:
+            raise JobCancelled()
+        self.progress = float(progress)
+        if msg:
+            self.progress_msg = msg
+
+    # -- client side ------------------------------------------------------
+    def cancel(self) -> None:
+        self._cancel_requested = True
+
+    def join(self, timeout: Optional[float] = None) -> "Job":
+        if self._thread is not None:
+            self._thread.join(timeout)
+        if self.status == Job.FAILED:
+            raise RuntimeError(f"Job {self.key} failed:\n{self.exception}")
+        return self
+
+    @property
+    def is_running(self) -> bool:
+        return self.status in (Job.CREATED, Job.RUNNING)
+
+    def to_dict(self) -> dict:
+        return {
+            "key": str(self.key),
+            "description": self.description,
+            "status": self.status,
+            "progress": self.progress,
+            "progress_msg": self.progress_msg,
+            "dest": self.dest,
+            "exception": self.exception,
+            "start_time": self.start_time,
+            "end_time": self.end_time,
+        }

@@ -1,0 +1,184 @@
+"""Cluster runtime: mesh bootstrap, config, node info.
+
+Replaces H2O-3's cloud-of-JVMs boot (reference: h2o-core/src/main/java/water/
+H2O.java:1776 startLocalNode, :1811 startNetworkServices, water/Paxos.java:27
+heartbeat-gossip membership). TPU-native design: membership is the set of JAX
+processes/devices — static per job, which matches H2O's locked-cloud
+semantics (water/Paxos.java:144 lockCloud: no elastic join after first job).
+There is no Paxos to run: `jax.distributed.initialize()` (multi-host) or the
+local device list (single-host) IS the cloud.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+@dataclass
+class OptArgs:
+    """Config/flag system (reference: water/H2O.java:316 OptArgs).
+
+    Values may be overridden by environment variables H2O_TPU_<NAME>,
+    mirroring H2O's -Dai.h2o.X=Y system-property pass-through
+    (water/H2O.java:321 SYSTEM_PROP_PREFIX)."""
+
+    name: str = "h2o3-tpu"
+    # mesh shape: rows axis = data parallel over devices; model axis for TP.
+    mesh_shape: Optional[Sequence[int]] = None
+    mesh_axes: Sequence[str] = ("rows", "model")
+    # row shard padding multiple (static shapes: ESPC replaced by padding,
+    # SURVEY.md §7 "ESPC ragged chunks -> equal shard sizes with tail padding")
+    row_align: int = 8
+    log_level: str = "INFO"
+    ice_root: str = field(default_factory=lambda: os.environ.get("H2O_TPU_ICE_ROOT", "/tmp/h2o3_tpu"))
+    # multi-host
+    coordinator_address: Optional[str] = None
+    num_processes: int = 1
+    process_id: int = 0
+
+    @staticmethod
+    def from_env() -> "OptArgs":
+        args = OptArgs()
+        for f in ("name", "log_level", "ice_root", "coordinator_address"):
+            v = os.environ.get("H2O_TPU_" + f.upper())
+            if v is not None:
+                setattr(args, f, v)
+        for f in ("num_processes", "process_id", "row_align"):
+            v = os.environ.get("H2O_TPU_" + f.upper())
+            if v is not None:
+                setattr(args, f, int(v))
+        return args
+
+
+class Cluster:
+    """The booted runtime: device mesh + per-node info.
+
+    H2O parity: `GET /3/Cloud` surface (water/api/CloudHandler.java) maps to
+    :meth:`info`; the boot-time hardware probes (water/init/Linpack.java,
+    MemoryBandwidth.java) map to :meth:`self_benchmark`."""
+
+    def __init__(self, args: OptArgs):
+        import jax
+
+        self.args = args
+        self.start_time = time.time()
+        self._jax = jax
+        if args.coordinator_address and args.num_processes > 1:
+            jax.distributed.initialize(
+                coordinator_address=args.coordinator_address,
+                num_processes=args.num_processes,
+                process_id=args.process_id,
+            )
+        self.devices = jax.devices()
+        n = len(self.devices)
+        if args.mesh_shape is None:
+            shape = (n, 1)
+        else:
+            shape = tuple(args.mesh_shape)
+        dev_grid = np.array(self.devices).reshape(shape)
+        self.mesh = jax.sharding.Mesh(dev_grid, tuple(args.mesh_axes[: dev_grid.ndim]))
+        self.n_devices = n
+        self.locked = False  # parity flag; membership is always static here
+
+    # -- sharding helpers -------------------------------------------------
+    def row_sharding(self):
+        """NamedSharding placing axis 0 over the 'rows' mesh axis — the
+        TPU-native replacement for chunk homing by Key hash
+        (water/Key.java:88-107)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P("rows"))
+
+    def replicated_sharding(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P())
+
+    @property
+    def row_shards(self) -> int:
+        return int(self.mesh.shape["rows"])
+
+    def pad_rows(self, n: int) -> int:
+        """Smallest padded length >= n divisible by (row_shards * row_align)."""
+        m = self.row_shards * self.args.row_align
+        return max(int(-(-n // m) * m), m)
+
+    # -- info / observability --------------------------------------------
+    def info(self) -> dict:
+        import jax
+
+        return {
+            "cloud_name": self.args.name,
+            "version": "h2o3_tpu",
+            "cloud_size": self.n_devices,
+            "cloud_uptime_millis": int((time.time() - self.start_time) * 1000),
+            "cloud_healthy": True,
+            "locked": self.locked,
+            "platform": jax.default_backend(),
+            "nodes": [
+                {"name": str(d), "platform": d.platform, "id": d.id}
+                for d in self.devices
+            ],
+        }
+
+    def self_benchmark(self, size: int = 1024) -> dict:
+        """Boot-probe analog of water/init/Linpack.java — measures device
+        matmul GFLOPs and HBM copy bandwidth."""
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.ones((size, size), jnp.float32)
+        f = jax.jit(lambda a: a @ a)
+        f(x).block_until_ready()  # compile
+        t0 = time.perf_counter()
+        reps = 10
+        y = x
+        for _ in range(reps):
+            y = f(y)
+        y.block_until_ready()
+        dt = time.perf_counter() - t0
+        gflops = 2 * size**3 * reps / dt / 1e9
+        return {"matmul_gflops": gflops, "size": size}
+
+
+_LOCK = threading.Lock()
+_CLUSTER: Optional[Cluster] = None
+
+
+def init(args: Optional[OptArgs] = None, **kw) -> Cluster:
+    """Boot (or return) the runtime. h2o.init() parity
+    (reference: h2o-py/h2o/h2o.py h2o.init)."""
+    global _CLUSTER
+    with _LOCK:
+        if _CLUSTER is None:
+            a = args or OptArgs.from_env()
+            for k, v in kw.items():
+                setattr(a, k, v)
+            _CLUSTER = Cluster(a)
+        return _CLUSTER
+
+
+def cluster() -> Cluster:
+    return init()
+
+
+def cluster_info() -> dict:
+    return cluster().info()
+
+
+def shutdown() -> None:
+    """Drop the runtime and all stored keys (h2o.cluster().shutdown())."""
+    global _CLUSTER
+    from h2o3_tpu.core.dkv import DKV
+
+    with _LOCK:
+        DKV.clear()
+        _CLUSTER = None

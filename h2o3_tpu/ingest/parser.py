@@ -1,0 +1,115 @@
+"""Parse orchestration: files -> typed host buffers -> sharded device Frame.
+
+Reference: water/parser/ParseDataset.java:127 forkParseDataset — an MRTask
+over the byte-chunks of FileVecs where each map parses one 4MB chunk to
+NewChunks, then two more distributed rounds union + renumber categorical
+domains (:518 GatherCategoricalDomainsTask, :475 UpdateCategoricalChunksTask).
+
+TPU-native: the host parses (optionally via the C++ fast parser in
+h2o3_tpu/native, else numpy), producing typed columns; categorical interning
+happens in one host pass (single-process) or one gather at the coordinator
+(multi-host); the result is device_put row-sharded straight into HBM —
+overlap of parse and H2D transfer is the multi-host input-pipeline hot path
+(SURVEY.md §7 hard part 7)."""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Column, Frame, NA_CAT, T_CAT, T_NUM, T_STR, T_TIME
+from h2o3_tpu.frame_factory import H2OFrame
+from h2o3_tpu.ingest.parse_setup import ParseSetup, guess_setup, open_stream
+from h2o3_tpu.utils import log
+
+
+def parse_setup(paths, **kw) -> ParseSetup:
+    p = paths[0] if isinstance(paths, (list, tuple)) else paths
+    return guess_setup(p, **kw)
+
+
+def _parse_csv_host(path: str, setup: ParseSetup) -> Dict[str, np.ndarray]:
+    """Parse one file into host columns. Tries the native C++ parser first
+    (h2o3_tpu/native/csv_parser.cpp), falls back to pandas/numpy."""
+    from h2o3_tpu.native.loader import native_parse_csv
+
+    cols = native_parse_csv(path, setup)
+    if cols is not None:
+        return cols
+    import pandas as pd
+
+    na = [s for s in setup.na_strings if s != ""]
+    df = pd.read_csv(
+        path, sep=setup.separator,
+        header=0 if setup.check_header == 1 else None,
+        names=setup.column_names,
+        na_values=na, keep_default_na=True, skipinitialspace=True,
+        dtype={n: (str if t in (T_CAT, T_STR) else np.float64)
+               for n, t in zip(setup.column_names, setup.column_types) if t != T_TIME},
+        engine="c",
+    )
+    out = {}
+    for name, t in zip(setup.column_names, setup.column_types):
+        s = df[name]
+        if t in (T_CAT, T_STR):
+            out[name] = s.to_numpy(dtype=object)
+        elif t == T_TIME:
+            out[name] = pd.to_datetime(s, errors="coerce").astype("int64").to_numpy()
+        else:
+            out[name] = s.to_numpy(dtype=np.float64)
+    return out
+
+
+def parse(paths: Sequence[str], setup: ParseSetup,
+          destination_frame: Optional[str] = None) -> H2OFrame:
+    host_cols: Dict[str, List[np.ndarray]] = {n: [] for n in setup.column_names}
+    for p in paths:
+        parsed = _parse_csv_host(p, setup)
+        for n in setup.column_names:
+            host_cols[n].append(parsed[n])
+    fr = H2OFrame(destination_frame=destination_frame)
+    for name, t in zip(setup.column_names, setup.column_types):
+        arr = np.concatenate(host_cols[name]) if len(host_cols[name]) > 1 else host_cols[name][0]
+        if t == T_CAT:
+            fr.add(name, Column.from_numpy(arr, ctype=T_CAT))
+        elif t == T_STR:
+            fr.add(name, Column.from_numpy(arr.astype(object)))
+        elif t == T_TIME:
+            fr.add(name, Column.from_numpy(arr, ctype=T_TIME))
+        else:
+            fr.add(name, Column.from_numpy(arr))
+    log.info(f"parsed {len(paths)} file(s) -> {fr.nrows}x{fr.ncols} [{fr.frame_id}]")
+    return fr
+
+
+def import_file(path: str, destination_frame: Optional[str] = None,
+                header: int = 0, sep: Optional[str] = None,
+                col_names: Optional[List[str]] = None,
+                col_types=None, na_strings=None, **kw) -> H2OFrame:
+    """h2o.import_file parity (h2o-py/h2o/h2o.py import_file): resolves
+    globs/dirs, guesses setup, parses."""
+    paths = sorted(_glob.glob(path)) if any(ch in path for ch in "*?[") else [path]
+    if len(paths) == 1 and os.path.isdir(paths[0]):
+        paths = sorted(
+            os.path.join(paths[0], f) for f in os.listdir(paths[0])
+            if not f.startswith(".")
+        )
+    if not paths:
+        raise FileNotFoundError(path)
+    ct = None
+    if isinstance(col_types, dict):
+        ct = col_types
+    elif isinstance(col_types, (list, tuple)):
+        ct = {i: t for i, t in enumerate(col_types)}
+    setup = guess_setup(paths[0], column_types=ct, na_strings=na_strings,
+                        header=(1 if header == 1 else (-1 if header == -1 else None)),
+                        separator=sep)
+    if col_names:
+        setup.column_names = list(col_names)
+    return parse(paths, setup, destination_frame=destination_frame)
+
+
+upload_file = import_file  # same machinery in-process

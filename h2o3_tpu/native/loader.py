@@ -1,0 +1,97 @@
+"""Loader for the native C++ fast CSV parser (built lazily via make).
+
+The reference's ingest hot loop is Java (water/parser/CsvParser.java:16
+parseChunk); its only native code arrives via the XGBoost JNI channel
+(SURVEY.md §2.10). Here the data-loader IS native: csv_parser.cpp exposes a
+C ABI consumed via ctypes, parsing file chunks in parallel threads into
+typed column buffers that are handed straight to device_put. Falls back to
+the pandas path in ingest/parser.py when the shared lib isn't built."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(__file__)
+_LIB_PATH = os.path.join(_HERE, "libh2o3tpu.so")
+_LOCK = threading.Lock()
+_LIB = None
+_TRIED = False
+
+
+def _build() -> bool:
+    src = os.path.join(_HERE, "csv_parser.cpp")
+    if not os.path.exists(src):
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+             "-pthread", "-o", _LIB_PATH, src],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def get_lib():
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.h2o_parse_csv.restype = ctypes.c_longlong
+            lib.h2o_parse_csv.argtypes = [
+                ctypes.c_char_p,          # path
+                ctypes.c_char,            # sep
+                ctypes.c_int,             # has_header
+                ctypes.c_int,             # ncols
+                ctypes.POINTER(ctypes.c_int),  # col kinds (0=num,1=str)
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),  # out numeric bufs
+                ctypes.c_longlong,        # capacity rows
+                ctypes.c_int,             # nthreads
+            ]
+            lib.h2o_count_rows.restype = ctypes.c_longlong
+            lib.h2o_count_rows.argtypes = [ctypes.c_char_p]
+            _LIB = lib
+        except OSError:
+            _LIB = None
+        return _LIB
+
+
+def native_parse_csv(path: str, setup) -> Optional[Dict[str, np.ndarray]]:
+    """Parse numerics with the native lib; returns None to fall back when the
+    lib is unavailable, the file is compressed, or any column is non-numeric
+    (string/enum/time columns need host interning anyway)."""
+    from h2o3_tpu.core.frame import T_NUM
+
+    if path.endswith((".gz", ".zip")):
+        return None
+    if any(t != T_NUM for t in setup.column_types):
+        return None
+    lib = get_lib()
+    if lib is None:
+        return None
+    nrows_cap = lib.h2o_count_rows(path.encode())
+    if nrows_cap < 0:
+        return None
+    ncols = len(setup.column_names)
+    bufs = [np.empty(nrows_cap, np.float64) for _ in range(ncols)]
+    ptrs = (ctypes.POINTER(ctypes.c_double) * ncols)(
+        *[b.ctypes.data_as(ctypes.POINTER(ctypes.c_double)) for b in bufs])
+    kinds = (ctypes.c_int * ncols)(*([0] * ncols))
+    n = lib.h2o_parse_csv(
+        path.encode(), setup.separator.encode(), 1 if setup.check_header == 1 else 0,
+        ncols, kinds, ptrs, nrows_cap, min(os.cpu_count() or 4, 16))
+    if n < 0:
+        return None
+    return {name: bufs[i][:n] for i, name in enumerate(setup.column_names)}

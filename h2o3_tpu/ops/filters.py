@@ -1,0 +1,157 @@
+"""Row selection / compaction / binding ops.
+
+Reference: row filters are MRTasks emitting variable-length NewChunks
+(water/rapids/ast/prims/filters/, mungers/AstRowSlice). TPU-native: static
+shapes force a different plan — build a device permutation that moves
+selected rows to the front (stable argsort of the negated mask, an O(n log n)
+XLA sort that tiles well), gather, then re-pad to the new logical length.
+The permutation is computed ONCE and applied to every column (the analog of
+H2O's row-aligned VectorGroup guarantee, water/fvec/Vec.java:120-126)."""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.core.frame import Column, Frame, NA_CAT, T_CAT
+
+
+def _cluster():
+    from h2o3_tpu.core.runtime import cluster
+
+    return cluster()
+
+
+@jax.jit
+def _selection_order(mask):
+    """Stable permutation putting selected rows first; returns (order, count)."""
+    keep = mask.astype(jnp.int32)
+    order = jnp.argsort(-keep, stable=True)
+    return order, jnp.sum(keep)
+
+
+@functools.lru_cache(maxsize=32)
+def _gather_fn(is_cat: bool, out_len: int):
+    @jax.jit
+    def run(data, order, k):
+        g = jnp.take(data, order[:out_len], axis=0)
+        idx = jnp.arange(out_len)
+        if is_cat:
+            return jnp.where(idx < k, g, NA_CAT)
+        return jnp.where(idx < k, g, jnp.nan)
+
+    return run
+
+
+def _apply_order(frame: Frame, order, k: int, key: Optional[str] = None) -> Frame:
+    cl = _cluster()
+    out_len = min(cl.pad_rows(k), int(order.shape[0]))
+    out = Frame(key=key)
+    for name in frame.names:
+        c = frame.col(name)
+        if c.data is None:
+            host = np.asarray(order)[:k]
+            host = host[host < c.nrows]
+            out.add(name, Column(None, c.ctype, k, host_data=c.host_data[host]))
+            continue
+        g = _gather_fn(c.ctype == T_CAT, out_len)(c.data, order, jnp.int32(k))
+        g = jax.device_put(g, cl.row_sharding())
+        out.add(name, Column(g, c.ctype, k, domain=c.domain))
+    return out
+
+
+def filter_rows(frame: Frame, mask_col: Column, key: Optional[str] = None) -> Frame:
+    """fr[mask, :] — keep rows where mask != 0 (NA mask rows are dropped,
+    matching H2O filter semantics)."""
+    m = mask_col.data
+    mask = jnp.where(jnp.isnan(m), False, m != 0)
+    # exclude pad rows beyond logical nrows
+    mask = mask & (jnp.arange(mask.shape[0]) < frame.nrows)
+    order, k = _selection_order(mask)
+    return _apply_order(frame, order, int(k), key=key)
+
+
+def slice_rows(frame: Frame, start: int, stop: int, key: Optional[str] = None) -> Frame:
+    n = frame.nrows
+    start = max(0, min(start, n))
+    stop = max(start, min(stop, n))
+    idx = jnp.arange(frame.col(0).padded_rows if frame.ncols else 0)
+    mask = (idx >= start) & (idx < stop)
+    order, k = _selection_order(mask)
+    return _apply_order(frame, order, int(k), key=key)
+
+
+def take_rows(frame: Frame, rows: np.ndarray, key: Optional[str] = None) -> Frame:
+    """Gather arbitrary row indices (host-provided)."""
+    cl = _cluster()
+    rows = np.asarray(rows, np.int64)
+    k = len(rows)
+    out_len = cl.pad_rows(k)
+    order = np.zeros(max(out_len, k), np.int32)
+    order[:k] = rows
+    order_dev = jnp.asarray(order[:out_len])
+    out = Frame(key=key)
+    for name in frame.names:
+        c = frame.col(name)
+        if c.data is None:
+            out.add(name, Column(None, c.ctype, k, host_data=c.host_data[rows]))
+            continue
+        g = _gather_fn(c.ctype == T_CAT, out_len)(c.data, order_dev, jnp.int32(k))
+        g = jax.device_put(g, cl.row_sharding())
+        out.add(name, Column(g, c.ctype, k, domain=c.domain))
+    return out
+
+
+def rbind(frames: Sequence[Frame], key: Optional[str] = None) -> Frame:
+    """Stack frames by rows (water/rapids/ast/prims/mungers/AstRBind)."""
+    cl = _cluster()
+    total = sum(f.nrows for f in frames)
+    out = Frame(key=key)
+    f0 = frames[0]
+    for ci, name in enumerate(f0.names):
+        cols = [f.col(ci) for f in frames]
+        ctype = cols[0].ctype
+        if ctype == T_CAT:
+            # re-union domains
+            dom = sorted(set().union(*[set(c.domain or []) for c in cols]))
+            lut = {v: i for i, v in enumerate(dom)}
+            parts = []
+            for c in cols:
+                codes = c.to_numpy()
+                remap = np.array([lut[v] for v in (c.domain or [])], np.int32)
+                parts.append(np.where(codes >= 0, remap[np.maximum(codes, 0)], NA_CAT))
+            buf = np.full(cl.pad_rows(total), NA_CAT, np.int32)
+            buf[:total] = np.concatenate(parts)
+            out.add(name, Column(jax.device_put(buf, cl.row_sharding()), T_CAT, total, domain=dom))
+        elif cols[0].data is None:
+            host = np.concatenate([c.host_data[: c.nrows] for c in cols])
+            out.add(name, Column(None, ctype, total, host_data=host))
+        else:
+            buf = np.full(cl.pad_rows(total), np.nan, np.float32)
+            buf[:total] = np.concatenate([c.to_numpy() for c in cols])
+            out.add(name, Column(jax.device_put(buf, cl.row_sharding()), ctype, total))
+    return out
+
+
+def split_frame(frame: Frame, ratios: Sequence[float], seed: Optional[int] = None,
+                destination_frames: Optional[Sequence[str]] = None) -> List[Frame]:
+    """Random row split (water/rapids/ast/prims/mungers via h2o.split_frame /
+    hex/SplitFrame.java): assign each row a uniform draw, threshold by
+    cumulative ratios."""
+    rng = np.random.default_rng(seed)
+    n = frame.nrows
+    u = rng.random(n)
+    cuts = np.cumsum(list(ratios))
+    if len(cuts) == 0 or cuts[-1] < 1.0:
+        cuts = np.append(cuts, 1.0)
+    assign = np.searchsorted(cuts, u, side="right")
+    out = []
+    for i in range(len(cuts)):
+        rows = np.nonzero(assign == i)[0]
+        k = destination_frames[i] if destination_frames else None
+        out.append(take_rows(frame, rows, key=k))
+    return out

@@ -1,0 +1,174 @@
+"""Distributed group-by aggregation.
+
+Reference: water/rapids/ast/prims/mungers/AstGroup.java — MRTask building
+per-group accumulators keyed by the group columns' value tuple.
+
+TPU-native: group columns are (or are factorized to) int codes; multiple
+group columns combine into one flat code; aggregates are device segment
+reductions (`.at[seg].add/min/max`) over the row-sharded data — XLA lowers
+these to efficient sorted-scatter on TPU, and the (groups × aggregates)
+result is tiny and replicated."""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.core.frame import Column, Frame, T_CAT, T_NUM
+
+
+def _codes_and_levels(frame: Frame, by: Sequence[str]) -> Tuple[jnp.ndarray, List[np.ndarray], int]:
+    """Flatten the by-columns into one int32 code per row (-1 where any NA)."""
+    sizes = []
+    code_arrays = []
+    levels = []
+    for name in by:
+        c = frame.col(name)
+        if c.is_categorical:
+            code_arrays.append(c.data)
+            sizes.append(max(c.cardinality, 1))
+            levels.append(np.asarray(c.domain, dtype=object))
+        else:
+            vals = c.to_numpy()
+            uniq, codes = np.unique(vals[~np.isnan(vals)], return_inverse=True)
+            full = np.full(c.padded_rows, -1, np.int32)
+            full[: c.nrows][~np.isnan(vals)] = codes.astype(np.int32)
+            code_arrays.append(jnp.asarray(full))
+            sizes.append(max(len(uniq), 1))
+            levels.append(uniq)
+    flat = jnp.zeros_like(code_arrays[0])
+    any_na = jnp.zeros(code_arrays[0].shape, bool)
+    for arr, size in zip(code_arrays, sizes):
+        any_na = any_na | (arr < 0)
+        flat = flat * size + jnp.maximum(arr, 0)
+    flat = jnp.where(any_na, -1, flat)
+    total = int(np.prod(sizes))
+    return flat, levels, total
+
+
+@functools.lru_cache(maxsize=64)
+def _agg_fn(ngroups: int):
+    @jax.jit
+    def run(codes, x):
+        valid = (codes >= 0) & ~jnp.isnan(x)
+        seg = jnp.where(valid, codes, ngroups)  # NA rows -> overflow slot
+        xv = jnp.where(valid, x, 0.0)
+        w = valid.astype(jnp.float32)
+        cnt = jnp.zeros(ngroups + 1, jnp.float32).at[seg].add(w)
+        s = jnp.zeros(ngroups + 1, jnp.float32).at[seg].add(xv)
+        ss = jnp.zeros(ngroups + 1, jnp.float32).at[seg].add(xv * xv)
+        mn = jnp.full(ngroups + 1, jnp.inf, jnp.float32).at[seg].min(jnp.where(valid, x, jnp.inf))
+        mx = jnp.full(ngroups + 1, -jnp.inf, jnp.float32).at[seg].max(jnp.where(valid, x, -jnp.inf))
+        return cnt, s, ss, mn, mx
+
+    return run
+
+
+@functools.lru_cache(maxsize=64)
+def _count_fn(ngroups: int):
+    @jax.jit
+    def run(codes):
+        valid = codes >= 0
+        seg = jnp.where(valid, codes, ngroups)
+        return jnp.zeros(ngroups + 1, jnp.float32).at[seg].add(valid.astype(jnp.float32))
+
+    return run
+
+
+class GroupBy:
+    """h2o-py GroupBy surface: chained agg methods then .get_frame()."""
+
+    def __init__(self, frame: Frame, by: Union[str, Sequence[str]]):
+        self._frame = frame
+        self._by = [by] if isinstance(by, str) else [frame.names[b] if isinstance(b, int) else b for b in by]
+        self._aggs: List[Tuple[str, str]] = []  # (op, col)
+
+    def _add(self, op: str, col) -> "GroupBy":
+        cols = ([c for c in self._frame.names if c not in self._by]
+                if col is None or col == [] else ([col] if isinstance(col, str) else list(col)))
+        for c in cols:
+            if self._frame.col(c).is_numeric:
+                self._aggs.append((op, c))
+        return self
+
+    def count(self, na="all"):
+        self._aggs.append(("count", self._by[0]))
+        return self
+
+    def sum(self, col=None, na="all"):
+        return self._add("sum", col)
+
+    def mean(self, col=None, na="all"):
+        return self._add("mean", col)
+
+    def min(self, col=None, na="all"):
+        return self._add("min", col)
+
+    def max(self, col=None, na="all"):
+        return self._add("max", col)
+
+    def sd(self, col=None, na="all"):
+        return self._add("sd", col)
+
+    def var(self, col=None, na="all"):
+        return self._add("var", col)
+
+    def get_frame(self):
+        from h2o3_tpu.frame_factory import H2OFrame
+
+        codes, levels, ngroups = _codes_and_levels(self._frame, self._by)
+        cnt_all = np.asarray(_count_fn(ngroups)(codes))[:ngroups]
+        present = np.nonzero(cnt_all > 0)[0]
+        out = Frame()
+        # reconstruct by-column values from flat codes
+        sizes = [len(l) for l in levels]
+        rem = present.copy()
+        decoded = []
+        for size in reversed(sizes):
+            decoded.append(rem % size)
+            rem = rem // size
+        decoded = list(reversed(decoded))
+        for name, lev, codes_i in zip(self._by, levels, decoded):
+            vals = lev[codes_i]
+            c = self._frame.col(name)
+            out.add(name, Column.from_numpy(np.asarray(vals, dtype=object if lev.dtype == object else None),
+                                            ctype=T_CAT if c.is_categorical else None))
+        done = set()
+        for op, cname in self._aggs:
+            key = f"{op}_{cname}"
+            if key in done:
+                continue
+            done.add(key)
+            if op == "count":
+                out.add("nrow", Column.from_numpy(cnt_all[present]))
+                continue
+            x = self._frame.col(cname).data
+            cnt, s, ss, mn, mx = [np.asarray(a)[:ngroups] for a in _agg_fn(ngroups)(codes, x)]
+            cnt_g, s_g = cnt[present], s[present]
+            with np.errstate(invalid="ignore", divide="ignore"):
+                if op == "sum":
+                    v = s_g
+                elif op == "mean":
+                    v = s_g / cnt_g
+                elif op == "min":
+                    v = mn[present]
+                elif op == "max":
+                    v = mx[present]
+                elif op in ("sd", "var"):
+                    m = s_g / cnt_g
+                    var = np.maximum(ss[present] / cnt_g - m * m, 0.0) * cnt_g / np.maximum(cnt_g - 1, 1)
+                    v = np.sqrt(var) if op == "sd" else var
+                else:
+                    raise ValueError(op)
+            out.add(key, Column.from_numpy(v))
+        return H2OFrame._wrap(out)
+
+
+def table(frame: Frame) -> Frame:
+    """(table fr) — counts of value combinations (ast/prims/mungers/AstTable)."""
+    gb = GroupBy(frame, frame.names[: min(2, frame.ncols)])
+    return gb.count().get_frame()

@@ -1,0 +1,59 @@
+"""NA imputation (water/rapids/ast/prims/advmath/AstImpute parity)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.core.frame import Column, Frame, NA_CAT, T_CAT
+
+
+@jax.jit
+def _fill_num(data, value, n):
+    idx = jnp.arange(data.shape[0])
+    keep_pad = idx >= n  # padding stays NaN
+    return jnp.where(jnp.isnan(data) & ~keep_pad, value, data)
+
+
+def impute(frame: Frame, column=-1, method: str = "mean"):
+    cols = frame.names if (column == -1 or column is None) else \
+        [frame.names[column] if isinstance(column, int) else column]
+    values = []
+    for name in cols:
+        c = frame.col(name)
+        if c.is_categorical:
+            if method not in ("mode",):
+                values.append(None)
+                continue
+            codes = c.to_numpy()
+            valid = codes[codes >= 0]
+            if len(valid) == 0:
+                values.append(None)
+                continue
+            mode = np.bincount(valid).argmax()
+            filled = np.where(codes >= 0, codes, mode).astype(np.int32)
+            frame.replace(name, Column.from_numpy(filled, ctype=T_CAT, domain=c.domain))
+            values.append(float(mode))
+        elif c.is_numeric or c.ctype == "time":
+            if method == "mean":
+                v = c.mean()
+            elif method == "median":
+                from h2o3_tpu.ops.quantile import quantile_column
+
+                v = quantile_column(c, [0.5])[0]
+            elif method == "mode":
+                vals = c.to_numpy()
+                vals = vals[~np.isnan(vals)]
+                u, cnts = np.unique(vals, return_counts=True)
+                v = float(u[cnts.argmax()]) if len(u) else np.nan
+            else:
+                raise ValueError(f"method {method!r}")
+            out = _fill_num(c.data, jnp.float32(v), c.nrows)
+            frame.replace(name, Column.from_device(out, c.ctype, c.nrows))
+            values.append(float(v))
+        else:
+            values.append(None)
+    return values

@@ -1,0 +1,89 @@
+"""Frame joins.
+
+Reference: distributed radix-order + BinaryMerge
+(water/rapids/BinaryMerge.java, Merge.java).
+
+Round-1 design: join keys are categorical codes or numerics — equality joins
+are executed host-side with a hash join over key tuples (keys are typically
+low-cardinality relative to rows), then both sides are gathered on device via
+the shared permutation path. A device merge path (sort + searchsorted) is the
+planned upgrade for billion-row joins."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Column, Frame, T_CAT
+from h2o3_tpu.ops.filters import take_rows
+
+
+def _key_tuples(frame: Frame, names: Sequence[str]) -> np.ndarray:
+    cols = []
+    for n in names:
+        c = frame.col(n)
+        v = c.values() if c.is_categorical or c.is_string else c.to_numpy()
+        cols.append(np.asarray(v, dtype=object))
+    return np.array(list(zip(*cols)), dtype=object) if cols else np.empty((0,))
+
+
+def merge(left: Frame, right: Frame, all_x=False, all_y=False,
+          by_x: Optional[Sequence[str]] = None, by_y: Optional[Sequence[str]] = None) -> Frame:
+    common = [n for n in left.names if n in right.names]
+    bx = list(by_x) if by_x else common
+    by = list(by_y) if by_y else common
+    if not bx:
+        raise ValueError("no join columns")
+    lk = _key_tuples(left, bx)
+    rk = _key_tuples(right, by)
+    rindex = {}
+    for i, k in enumerate(map(tuple, rk)):
+        rindex.setdefault(k, []).append(i)
+    lrows, rrows = [], []
+    matched_r = set()
+    for i, k in enumerate(map(tuple, lk)):
+        hits = rindex.get(k)
+        if hits:
+            for j in hits:
+                lrows.append(i)
+                rrows.append(j)
+                matched_r.add(j)
+        elif all_x:
+            lrows.append(i)
+            rrows.append(-1)
+    if all_y:
+        for k, js in rindex.items():
+            for j in js:
+                if j not in matched_r:
+                    lrows.append(-1)
+                    rrows.append(j)
+    lrows = np.asarray(lrows, np.int64)
+    rrows = np.asarray(rrows, np.int64)
+
+    lpart = take_rows(left, np.maximum(lrows, 0))
+    rpart = take_rows(right, np.maximum(rrows, 0))
+    out = Frame()
+    for n in left.names:
+        col = lpart.col(n)
+        if (lrows < 0).any():
+            col = _mask_rows(col, lrows < 0)
+        out.add(n, col)
+    for n in right.names:
+        if n in by:
+            continue
+        nm = n if n not in out else n + "_y"
+        col = rpart.col(n)
+        if (rrows < 0).any():
+            col = _mask_rows(col, rrows < 0)
+        out.add(nm, col)
+    return out
+
+
+def _mask_rows(col: Column, na_mask: np.ndarray) -> Column:
+    vals = col.to_numpy().astype(np.float64) if not col.is_categorical else col.to_numpy().astype(np.float64)
+    vals[na_mask] = np.nan
+    if col.is_categorical:
+        codes = np.where(np.isnan(vals), -1, vals).astype(np.int32)
+        return Column.from_numpy(codes, ctype=T_CAT, domain=col.domain)
+    return Column.from_numpy(vals)

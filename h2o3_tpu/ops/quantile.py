@@ -1,0 +1,114 @@
+"""Exact distributed quantiles by iterative histogram refinement.
+
+Reference: hex/quantile/Quantile.java:100,165 refinePass — build a histogram
+over [lo,hi], find the bin containing the target rank, recurse into it until
+the bin holds few enough values; combine per H2O's interpolation type 7.
+
+TPU-native: each pass is one jitted masked histogram over the row-sharded
+column (device reduction + implicit psum); the host loop narrows the range.
+Converges in ~3-4 passes of 1024 bins for f32 data."""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NBINS = 1024
+
+
+@functools.lru_cache(maxsize=4)
+def _hist_pass(nbins: int):
+    @jax.jit
+    def run(data, lo, hi):
+        valid = ~jnp.isnan(data) & (data >= lo) & (data <= hi)
+        w = valid.astype(jnp.float32)
+        x = jnp.where(valid, data, lo)
+        scale = nbins / jnp.maximum(hi - lo, 1e-38)
+        idx = jnp.clip(((x - lo) * scale).astype(jnp.int32), 0, nbins - 1)
+        cnt = jnp.zeros(nbins, jnp.float32).at[idx].add(w)
+        below = jnp.sum((~jnp.isnan(data)) & (data < lo))
+        return cnt, below
+
+    return run
+
+
+@jax.jit
+def _minmax_in_bin(data, lo, hi):
+    valid = ~jnp.isnan(data) & (data >= lo) & (data <= hi)
+    mn = jnp.min(jnp.where(valid, data, jnp.inf))
+    mx = jnp.max(jnp.where(valid, data, -jnp.inf))
+    return mn, mx
+
+
+@functools.lru_cache(maxsize=4)
+def _exact_two(_):
+    @jax.jit
+    def run(data, lo, hi, rank_lo):
+        """Smallest value > lo within [lo,hi] plus count ≤ — used for the
+        final interpolation step."""
+        valid = ~jnp.isnan(data) & (data >= lo) & (data <= hi)
+        gt = valid & (data > lo)
+        nxt = jnp.min(jnp.where(gt, data, jnp.inf))
+        return nxt
+
+    return run
+
+
+def quantile_column(col, probs: Sequence[float]) -> List[float]:
+    r = col.rollups
+    n = r.rows
+    if n == 0:
+        return [float("nan")] * len(probs)
+    out = []
+    hist = _hist_pass(NBINS)
+    for p in probs:
+        # type-7 interpolation (H2O QuantileModel default, R default)
+        h = (n - 1) * float(p)
+        k = int(np.floor(h))
+        frac = h - k
+        lo, hi = r.min, r.max
+        if lo == hi:
+            out.append(lo)
+            continue
+        v_k = _select_kth(col.data, hist, lo, hi, k, n)
+        if frac == 0.0:
+            out.append(v_k)
+        else:
+            v_k1 = _select_kth(col.data, hist, lo, hi, k + 1, n)
+            out.append(v_k * (1 - frac) + v_k1 * frac)
+    return out
+
+
+def _select_kth(data, hist, lo, hi, k, n) -> float:
+    """Find the (0-based) k-th order statistic by histogram descent."""
+    lo = float(lo)
+    hi = float(hi)
+    base = 0  # count strictly below lo in the whole column
+    for _ in range(8):
+        cnt, below = hist(data, jnp.float32(lo), jnp.float32(hi))
+        cnt = np.asarray(cnt)
+        base = int(below)
+        cum = base + np.cumsum(cnt)
+        b = int(np.searchsorted(cum, k + 1))
+        b = min(b, len(cnt) - 1)
+        width = (hi - lo) / NBINS
+        blo = lo + b * width
+        bhi = blo + width
+        in_bin = cnt[b]
+        if in_bin <= 1 or width <= abs(blo) * 1e-7 + 1e-38:
+            mn, mx = _minmax_in_bin(data, jnp.float32(blo), jnp.float32(bhi))
+            mn = float(mn)
+            return mn if np.isfinite(mn) else blo
+        lo, hi = blo, bhi
+    mn, mx = _minmax_in_bin(data, jnp.float32(lo), jnp.float32(hi))
+    mn = float(mn)
+    return mn if np.isfinite(mn) else lo
+
+
+def quantile_frame(frame, probs: Sequence[float]):
+    return {n: quantile_column(frame.col(n), probs)
+            for n in frame.names if frame.col(n).is_numeric}

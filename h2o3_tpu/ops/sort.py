@@ -1,0 +1,51 @@
+"""Distributed sort.
+
+Reference: MSB radix sort (water/rapids/RadixOrder.java:20,
+SingleThreadRadixOrder.java, SortCombine.java).
+
+TPU-native: XLA's `sort` is a tiled bitonic/merge network that beats a
+hand-rolled radix on TPU for f32 keys; multi-key sorts use lexicographic
+composite keys. The permutation is computed on device and applied to all
+columns via the shared-gather path (ops/filters.take-style)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.ops.filters import take_rows
+
+
+@jax.jit
+def _order_single(key):
+    # NaN (NA + padding) sorts last: replace with +inf
+    k = jnp.where(jnp.isnan(key), jnp.inf, key)
+    return jnp.argsort(k, stable=True)
+
+
+def sort_frame(frame: Frame, by: Union[str, int, Sequence], ascending=True) -> Frame:
+    if isinstance(by, (str, int)):
+        by = [by]
+    names = [frame.names[b] if isinstance(b, int) else b for b in by]
+    asc = ascending if isinstance(ascending, (list, tuple)) else [ascending] * len(names)
+    # lexicographic: sort by last key first (stable), host-composed device sorts
+    order = None
+    for name, a in reversed(list(zip(names, asc))):
+        c = frame.col(name)
+        key = c.data.astype(jnp.float32) if c.is_categorical else c.data
+        if c.is_categorical:
+            key = jnp.where(c.data < 0, jnp.nan, key)
+        if not a:
+            key = -key
+        if order is None:
+            order = _order_single(key)
+        else:
+            key = jnp.take(key, order)
+            order = jnp.take(order, _order_single(key))
+    idx = np.asarray(order)
+    idx = idx[idx < frame.nrows][: frame.nrows]
+    return take_rows(frame, idx)

@@ -1,0 +1,51 @@
+"""Collective reductions over the device mesh.
+
+Replaces the reference's entire communication stack — custom UDP/TCP RPC
+with ACK/ACKACK (water/RPC.java:19-47), AutoBuffer framing
+(water/AutoBuffer.java), binary-tree reductions (water/MRTask.java:751
+reduce3) and the Rabit all-reduce rebuilt for XGBoost
+(h2o-extensions/xgboost/rabit/RabitTrackerH2O.java:14) — with XLA
+collectives compiled onto ICI links. Inside `shard_map` bodies use these
+thin wrappers; outside, just annotate shardings and let XLA insert them."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def all_reduce_sum(x, axis: str = "rows"):
+    return jax.lax.psum(x, axis)
+
+
+def all_reduce_max(x, axis: str = "rows"):
+    return jax.lax.pmax(x, axis)
+
+
+def all_reduce_min(x, axis: str = "rows"):
+    return jax.lax.pmin(x, axis)
+
+
+def all_reduce_mean(x, axis: str = "rows"):
+    return jax.lax.pmean(x, axis)
+
+
+def all_gather(x, axis: str = "rows", tiled: bool = False):
+    return jax.lax.all_gather(x, axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str = "rows"):
+    return jax.lax.psum_scatter(x, axis, tiled=True)
+
+
+def axis_index(axis: str = "rows"):
+    return jax.lax.axis_index(axis)
+
+
+def ppermute_ring(x, axis: str = "rows", shift: int = 1):
+    """Ring permute over the mesh axis — building block for ring-style
+    pipelined reductions (used by the ring histogram merge in ops/histogram
+    and available for sequence-parallel patterns)."""
+    n = jax.lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis, perm)

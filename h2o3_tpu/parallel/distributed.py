@@ -1,0 +1,45 @@
+"""Multi-host runtime bootstrap.
+
+Reference: cloud formation by UDP heartbeat gossip + Paxos-lite voting
+(water/Paxos.java:27, water/HeartBeatThread.java:16) with flatfile or
+multicast discovery (water/init/NetworkInit.java).
+
+TPU-native: `jax.distributed.initialize(coordinator, n, id)` — the JAX
+coordination service plays the Paxos/heartbeat role (barrier at startup,
+health checks, failure propagation), and the resulting global device list
+forms the mesh. Membership is static for the job's lifetime, which is
+exactly H2O's post-lock semantics (water/Paxos.java:144): H2O never
+supported elastic join after the first job either (SURVEY.md §5.3)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the multi-host cloud. No-op when single-process (local mode)."""
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get("H2O_TPU_COORDINATOR")
+    if coordinator_address is None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=int(num_processes or os.environ.get("H2O_TPU_NUM_PROCESSES", 1)),
+        process_id=int(process_id or os.environ.get("H2O_TPU_PROCESS_ID", 0)),
+    )
+
+
+def is_coordinator() -> bool:
+    import jax
+
+    return jax.process_index() == 0
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()

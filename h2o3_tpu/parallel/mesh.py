@@ -1,0 +1,54 @@
+"""Mesh construction and sharding rules.
+
+Replaces H2O's node membership + key homing (water/Key.java:88-107) with a
+`jax.sharding.Mesh`. Axes:
+- 'rows'  : data parallelism — every Frame column is sharded on this axis
+            (the chunk-scatter analog).
+- 'model' : model/tensor parallelism for wide linear algebra (Gram blocks,
+            wide MLP layers) — a capability the reference lacks (SURVEY.md
+            §2.11: "Pipeline/model parallelism: absent"); on TPU it is nearly
+            free to provide via PartitionSpec.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def make_mesh(devices=None, shape: Optional[Tuple[int, int]] = None,
+              axes: Sequence[str] = ("rows", "model")):
+    import jax
+    from jax.sharding import Mesh
+
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if shape is None:
+        shape = (n, 1)
+    grid = np.array(devices).reshape(shape)
+    return Mesh(grid, tuple(axes[: grid.ndim]))
+
+
+def row_spec():
+    from jax.sharding import PartitionSpec as P
+
+    return P("rows")
+
+
+def replicated_spec():
+    from jax.sharding import PartitionSpec as P
+
+    return P()
+
+
+def shard_rows(arr, mesh=None):
+    """Pin a host array into HBM row-sharded (device_put with NamedSharding)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    if mesh is None:
+        from h2o3_tpu.core.runtime import cluster
+
+        mesh = cluster().mesh
+    return jax.device_put(arr, NamedSharding(mesh, row_spec()))

@@ -1,0 +1,43 @@
+"""Logging (water/util/Log.java parity: leveled, per-node file under ice_root)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+PROGRESS = True
+_LOGGER = None
+
+
+def get_logger() -> logging.Logger:
+    global _LOGGER
+    if _LOGGER is None:
+        lg = logging.getLogger("h2o3_tpu")
+        lg.setLevel(os.environ.get("H2O_TPU_LOG_LEVEL", "INFO"))
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter("%(asctime)s %(levelname).1s h2o3_tpu: %(message)s"))
+        lg.addHandler(h)
+        try:
+            ice = os.environ.get("H2O_TPU_ICE_ROOT", "/tmp/h2o3_tpu")
+            os.makedirs(ice, exist_ok=True)
+            fh = logging.FileHandler(os.path.join(ice, "h2o3_tpu.log"))
+            fh.setFormatter(logging.Formatter("%(asctime)s %(levelname).1s %(message)s"))
+            lg.addHandler(fh)
+        except OSError:
+            pass
+        lg.propagate = False
+        _LOGGER = lg
+    return _LOGGER
+
+
+def info(msg: str) -> None:
+    get_logger().info(msg)
+
+
+def warn(msg: str) -> None:
+    get_logger().warning(msg)
+
+
+def debug(msg: str) -> None:
+    get_logger().debug(msg)

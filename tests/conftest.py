@@ -1,0 +1,61 @@
+"""Test harness: simulate an 8-device TPU pod on CPU.
+
+Mirrors the reference's multi-JVM localhost clouds (multiNodeUtils.sh,
+water.TestUtil.stall_till_cloudsize) — here the 'cloud' is a virtual
+8-device mesh forced onto the host CPU, so every distributed code path
+(shard_map, psum, sharded device_put) executes with real partitioning."""
+
+import os
+
+# jax may already be imported by the environment's sitecustomize, so set the
+# flag env AND update jax.config (effective until backend init, which is lazy)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cl():
+    import h2o3_tpu
+
+    return h2o3_tpu.init()
+
+
+@pytest.fixture()
+def leak_check():
+    """DKV key-leak guard (reference: water/runner/CheckKeysTask.java —
+    tests fail if they leak keys)."""
+    from h2o3_tpu.core.dkv import DKV
+
+    before = set(DKV.keys())
+    yield
+    after = set(DKV.keys())
+    leaked = after - before
+    # frames/models created inside the test body are expected; this fixture
+    # is opt-in for tests that promise cleanliness
+    assert not leaked, f"leaked DKV keys: {sorted(leaked)[:10]}"
+
+
+@pytest.fixture(scope="session")
+def airlines_csv(tmp_path_factory):
+    """Small airlines-like synthetic CSV for parse/train tests."""
+    rng = np.random.default_rng(42)
+    n = 2000
+    p = tmp_path_factory.mktemp("data") / "airlines.csv"
+    dows = np.array(["Mon", "Tue", "Wed", "Thu", "Fri", "Sat", "Sun"])
+    carriers = np.array(["AA", "UA", "DL", "WN"])
+    dist = rng.integers(50, 3000, n)
+    dep = rng.integers(0, 2400, n)
+    delay = (dist * 0.01 + (dep > 1800) * 30 + rng.normal(0, 20, n)) > 25
+    with open(p, "w") as f:
+        f.write("DayOfWeek,Carrier,Distance,DepTime,IsDepDelayed\n")
+        for i in range(n):
+            f.write(f"{dows[i % 7]},{carriers[i % 4]},{dist[i]},{dep[i]},{'YES' if delay[i] else 'NO'}\n")
+    return str(p)

@@ -1,0 +1,141 @@
+"""Core runtime tests: cluster boot, DKV, Frame/Column, rollups, MRTask.
+
+Mirrors the reference's h2o-core test families: KVTest/DKVTest (DKV verbs),
+MRTaskTest (map/reduce), RollupStats tests."""
+
+import numpy as np
+import pytest
+
+
+def test_cluster_boot(cl):
+    info = cl.info()
+    assert info["cloud_size"] == 8
+    assert info["cloud_healthy"]
+    assert cl.mesh.shape["rows"] == 8
+
+
+def test_dkv_verbs(cl):
+    from h2o3_tpu.core.dkv import DKV, Key, Scope
+
+    k = Key.make("t")
+    DKV.put(k, {"a": 1})
+    assert DKV.get(k) == {"a": 1}
+    DKV.atomic(k, lambda old: {**old, "b": 2})
+    assert DKV.get(k)["b"] == 2
+    DKV.remove(k)
+    assert DKV.get(k) is None
+
+    with Scope():
+        k2 = Key.make("scoped")
+        DKV.put(k2, 42)
+        assert DKV.get(k2) == 42
+    assert DKV.get(k2) is None  # RAII cleanup
+
+
+def test_column_roundtrip(cl):
+    from h2o3_tpu.core.frame import Column
+
+    v = np.array([1.0, 2.0, np.nan, 4.0, 5.0])
+    c = Column.from_numpy(v)
+    assert c.nrows == 5
+    assert c.padded_rows % 8 == 0
+    back = c.to_numpy()
+    np.testing.assert_allclose(back[[0, 1, 3, 4]], v[[0, 1, 3, 4]])
+    assert np.isnan(back[2])
+
+
+def test_rollups(cl):
+    from h2o3_tpu.core.frame import Column
+
+    v = np.array([1.0, 2.0, np.nan, 4.0, 0.0, -3.0])
+    c = Column.from_numpy(v)
+    r = c.rollups
+    assert r.min == -3.0
+    assert r.max == 4.0
+    assert r.na_count == 1
+    assert r.nz_count == 4
+    np.testing.assert_allclose(r.mean, np.nanmean(v), rtol=1e-6)
+    np.testing.assert_allclose(r.sigma, np.nanstd(v, ddof=1), rtol=1e-5)
+
+
+def test_categorical_column(cl):
+    from h2o3_tpu.core.frame import Column, T_CAT
+
+    v = np.array(["b", "a", "c", "a", None], dtype=object)
+    c = Column.from_numpy(v, ctype=T_CAT)
+    assert c.domain == ["a", "b", "c"]
+    codes = c.to_numpy()
+    assert list(codes) == [1, 0, 2, 0, -1]
+    vals = c.values()
+    assert list(vals[:4]) == ["b", "a", "c", "a"]
+    assert vals[4] is None
+    assert c.rollups.na_count == 1
+
+
+def test_map_reduce_sum(cl):
+    import jax.numpy as jnp
+    from h2o3_tpu.core.frame import Column
+    from h2o3_tpu.core import mrtask
+
+    v = np.arange(100, dtype=np.float64)
+    c = Column.from_numpy(v)
+
+    def partial_sum(x):
+        return jnp.nansum(x)
+
+    total = mrtask.map_reduce(partial_sum, [c])
+    assert float(total) == v.sum()
+
+
+def test_map_chunks_elementwise(cl):
+    from h2o3_tpu.core.frame import Column
+    from h2o3_tpu.core import mrtask
+
+    v = np.arange(10, dtype=np.float64)
+    c = Column.from_numpy(v)
+
+    def double(x):
+        return x * 2
+
+    out = mrtask.new_column(double, [c])
+    np.testing.assert_allclose(out.to_numpy(), v * 2)
+
+
+def test_frame_basic(cl):
+    from h2o3_tpu.core.frame import Frame
+
+    fr = Frame.from_numpy(np.arange(12, dtype=np.float64).reshape(4, 3), names=["a", "b", "c"])
+    assert fr.ncols == 3
+    assert fr.nrows == 4
+    assert fr.names == ["a", "b", "c"]
+    sub = fr.subframe(["a", "c"])
+    assert sub.names == ["a", "c"]
+    np.testing.assert_allclose(fr.col("b").to_numpy(), [1, 4, 7, 10])
+
+
+def test_job_lifecycle(cl):
+    from h2o3_tpu.core.job import Job
+
+    j = Job("test job")
+    j.start(lambda job: (job.update(0.5), 41 + 1)[-1])
+    j.join()
+    assert j.status == Job.DONE
+    assert j.result == 42
+    assert j.progress == 1.0
+
+
+def test_job_failure(cl):
+    from h2o3_tpu.core.job import Job
+
+    def boom(job):
+        raise ValueError("nope")
+
+    j = Job("failing").start(boom)
+    with pytest.raises(RuntimeError):
+        j.join()
+    assert j.status == Job.FAILED
+
+
+def test_self_benchmark(cl):
+    b = cl.self_benchmark(size=256)
+    assert b["matmul_gflops"] > 0

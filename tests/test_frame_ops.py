@@ -1,0 +1,151 @@
+"""H2OFrame munging surface tests (h2o-py frame.py semantics subset)."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def fr(cl):
+    from h2o3_tpu import H2OFrame
+
+    return H2OFrame({
+        "a": [1.0, 2.0, 3.0, 4.0, 5.0],
+        "b": [10.0, 20.0, np.nan, 40.0, 50.0],
+        "c": ["x", "y", "x", "z", "y"],
+    }, column_types={"c": "enum"})
+
+
+def test_arith(cl, fr):
+    out = fr["a"] + 5
+    np.testing.assert_allclose(out.col(0).to_numpy(), [6, 7, 8, 9, 10])
+    out = fr["a"] * fr["a"]
+    np.testing.assert_allclose(out.col(0).to_numpy(), [1, 4, 9, 16, 25])
+    out = 2 / fr["a"]
+    np.testing.assert_allclose(out.col(0).to_numpy(), [2, 1, 2 / 3, 0.5, 0.4], rtol=1e-6)
+
+
+def test_compare_and_filter(cl, fr):
+    mask = fr["a"] > 2
+    np.testing.assert_allclose(mask.col(0).to_numpy(), [0, 0, 1, 1, 1])
+    sub = fr[mask]
+    assert sub.nrows == 3
+    np.testing.assert_allclose(sub.col("a").to_numpy(), [3, 4, 5])
+    # enum column survives filtering with domain intact
+    assert sub.col("c").domain == ["x", "y", "z"]
+    assert list(sub.col("c").values()) == ["x", "z", "y"]
+
+
+def test_na_propagation(cl, fr):
+    out = fr["b"] + 1
+    v = out.col(0).to_numpy()
+    assert np.isnan(v[2])
+    np.testing.assert_allclose(v[[0, 1, 3, 4]], [11, 21, 41, 51])
+    assert int(fr["b"].isna().col(0).to_numpy().sum()) == 1
+
+
+def test_reductions(cl, fr):
+    assert fr["a"].mean() == 3.0
+    assert fr["a"].min() == 1.0
+    assert fr["a"].max() == 5.0
+    assert fr["a"].sum() == 15.0
+    np.testing.assert_allclose(fr["b"].mean(), 30.0)
+
+
+def test_slicing(cl, fr):
+    h = fr.head(2)
+    assert h.nrows == 2
+    t = fr.tail(2)
+    np.testing.assert_allclose(t.col("a").to_numpy(), [4, 5])
+    two = fr[["a", "c"]]
+    assert two.names == ["a", "c"]
+
+
+def test_split_frame(cl):
+    from h2o3_tpu import H2OFrame
+
+    fr = H2OFrame({"x": np.arange(1000.0)})
+    tr, te = fr.split_frame(ratios=[0.8], seed=7)
+    assert tr.nrows + te.nrows == 1000
+    assert 700 < tr.nrows < 900
+    # no overlap
+    s1 = set(tr.col(0).to_numpy().tolist())
+    s2 = set(te.col(0).to_numpy().tolist())
+    assert not (s1 & s2)
+
+
+def test_asfactor_levels(cl):
+    from h2o3_tpu import H2OFrame
+
+    fr = H2OFrame({"g": [1.0, 2.0, 1.0, 3.0]})
+    f = fr["g"].asfactor()
+    assert f.col(0).is_categorical
+    assert f.nlevels() == [3]
+
+
+def test_ifelse(cl, fr):
+    out = (fr["a"] > 3).ifelse(1.0, 0.0)
+    np.testing.assert_allclose(out.col(0).to_numpy(), [0, 0, 0, 1, 1])
+
+
+def test_cbind_rbind(cl, fr):
+    wide = fr.cbind(fr[["a"]])
+    assert wide.ncols == 4
+    tall = fr.rbind(fr)
+    assert tall.nrows == 10
+    assert tall.col("c").domain == ["x", "y", "z"]
+
+
+def test_quantile_median(cl):
+    from h2o3_tpu import H2OFrame
+
+    rng = np.random.default_rng(3)
+    v = rng.normal(size=5000)
+    fr = H2OFrame({"x": v})
+    med = fr["x"].median()
+    assert abs(med - np.median(v)) < 1e-3
+    q = fr["x"].quantile(prob=[0.25, 0.75])
+    got = q.col("xQuantiles").to_numpy()
+    np.testing.assert_allclose(got, np.quantile(v, [0.25, 0.75]), atol=2e-3)
+
+
+def test_groupby(cl, fr):
+    g = fr.group_by("c").count().sum("a").mean("a").get_frame()
+    rows = {v: (cnt, s, m) for v, cnt, s, m in zip(
+        g.col("c").values(), g.col("nrow").to_numpy(),
+        g.col("sum_a").to_numpy(), g.col("mean_a").to_numpy())}
+    assert rows["x"] == (2, 4.0, 2.0)
+    assert rows["y"] == (2, 7.0, 3.5)
+    assert rows["z"] == (1, 4.0, 4.0)
+
+
+def test_sort(cl, fr):
+    s = fr.sort("a", ascending=False)
+    np.testing.assert_allclose(s.col("a").to_numpy(), [5, 4, 3, 2, 1])
+    assert list(s.col("c").values()) == ["y", "z", "x", "y", "x"]
+
+
+def test_merge(cl):
+    from h2o3_tpu import H2OFrame
+
+    left = H2OFrame({"k": ["a", "b", "c"], "v": [1.0, 2.0, 3.0]}, column_types={"k": "enum"})
+    right = H2OFrame({"k": ["b", "c", "d"], "w": [20.0, 30.0, 40.0]}, column_types={"k": "enum"})
+    m = left.merge(right)
+    assert m.nrows == 2
+    ks = list(m.col("k").values())
+    assert sorted(ks) == ["b", "c"]
+
+
+def test_impute(cl, fr):
+    fr.impute("b", method="mean")
+    v = fr.col("b").to_numpy()
+    np.testing.assert_allclose(v[2], 30.0)
+
+
+def test_create_frame(cl):
+    from h2o3_tpu import create_frame
+
+    fr = create_frame(rows=100, cols=6, categorical_fraction=0.3, real_fraction=0.5,
+                      missing_fraction=0.05, seed=1, has_response=True)
+    assert fr.nrows == 100
+    assert fr.ncols >= 6
+    assert any(fr.col(n).is_categorical for n in fr.names)

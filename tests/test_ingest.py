@@ -1,0 +1,96 @@
+"""Parse/ingest tests (water/parser test family analog)."""
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+
+def test_parse_setup_guess(cl, airlines_csv):
+    from h2o3_tpu.ingest.parse_setup import guess_setup
+
+    s = guess_setup(airlines_csv)
+    assert s.separator == ","
+    assert s.check_header == 1
+    assert s.column_names == ["DayOfWeek", "Carrier", "Distance", "DepTime", "IsDepDelayed"]
+    assert s.column_types[0] == "enum"
+    assert s.column_types[2] == "real"
+
+
+def test_import_file(cl, airlines_csv):
+    import h2o3_tpu
+
+    fr = h2o3_tpu.import_file(airlines_csv)
+    assert fr.nrows == 2000
+    assert fr.ncols == 5
+    assert fr.col("Carrier").is_categorical
+    assert sorted(fr.col("Carrier").domain) == ["AA", "DL", "UA", "WN"]
+    assert fr.col("Distance").is_numeric
+    assert fr.col("Distance").min() >= 50
+    assert fr.col("IsDepDelayed").domain == ["NO", "YES"]
+
+
+def test_import_gzip(cl, airlines_csv, tmp_path):
+    import h2o3_tpu
+
+    gz = tmp_path / "airlines.csv.gz"
+    with open(airlines_csv, "rb") as f, gzip.open(gz, "wb") as g:
+        g.write(f.read())
+    fr = h2o3_tpu.import_file(str(gz))
+    assert fr.nrows == 2000
+    assert fr.ncols == 5
+
+
+def test_na_strings(cl, tmp_path):
+    import h2o3_tpu
+
+    p = tmp_path / "nas.csv"
+    p.write_text("a,b\n1,x\nNA,y\n3,NA\n")
+    fr = h2o3_tpu.import_file(str(p))
+    assert fr.col("a").na_count() == 1
+    assert fr.col("b").na_count() == 1
+
+
+def test_headerless(cl, tmp_path):
+    import h2o3_tpu
+
+    p = tmp_path / "nohdr.csv"
+    p.write_text("1,2.5\n3,4.5\n5,6.5\n")
+    fr = h2o3_tpu.import_file(str(p))
+    assert fr.nrows == 3
+    assert fr.names == ["C1", "C2"]
+    np.testing.assert_allclose(fr.col("C1").to_numpy(), [1, 3, 5])
+
+
+def test_multi_file_glob(cl, tmp_path):
+    import h2o3_tpu
+
+    for i in range(3):
+        (tmp_path / f"part{i}.csv").write_text("x,y\n" + "".join(
+            f"{j + i * 10},{j * 2.0}\n" for j in range(5)))
+    fr = h2o3_tpu.import_file(str(tmp_path / "part*.csv"))
+    assert fr.nrows == 15
+
+
+def test_native_parser_numeric(cl, tmp_path):
+    """Native C++ parser path (h2o3_tpu/native/csv_parser.cpp)."""
+    from h2o3_tpu.native.loader import get_lib, native_parse_csv
+    from h2o3_tpu.ingest.parse_setup import guess_setup
+
+    p = tmp_path / "num.csv"
+    n = 1000
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=n)
+    b = rng.integers(0, 100, n).astype(float)
+    with open(p, "w") as f:
+        f.write("a,b\n")
+        for i in range(n):
+            f.write(f"{a[i]:.6g},{b[i]:.1f}\n")
+    setup = guess_setup(str(p))
+    if get_lib() is None:
+        pytest.skip("native lib unavailable")
+    cols = native_parse_csv(str(p), setup)
+    assert cols is not None
+    np.testing.assert_allclose(cols["a"], a, rtol=1e-5)
+    np.testing.assert_allclose(cols["b"], b)
