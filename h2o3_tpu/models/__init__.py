@@ -1,0 +1,25 @@
+"""Model framework + algorithms (reference: hex/ in h2o-core and h2o-algos).
+
+Layer map (SURVEY.md §1 L3–L4): ModelBuilder/Model/metrics are the framework;
+one builder per algorithm mirrors the reference's `ModelBuilder` subclasses.
+"""
+
+from h2o3_tpu.models.model import Model, ModelCategory, ModelOutput  # noqa: F401
+from h2o3_tpu.models.model_builder import BUILDERS, ModelBuilder, register  # noqa: F401
+
+
+def _register_all():
+    """Import algo modules for their @register side effects (the analog of
+    water.api.RegisterV3Api's builder registration)."""
+    from h2o3_tpu.models import glm  # noqa: F401
+
+    for mod in ("gbm", "drf", "isofor", "deeplearning", "kmeans", "pca",
+                "naive_bayes", "svd", "glrm", "word2vec", "ensemble",
+                "rulefit", "coxph", "gam", "aggregator", "extended_isofor"):
+        try:
+            __import__(f"h2o3_tpu.models.{mod}")
+        except ImportError:
+            pass
+
+
+_register_all()

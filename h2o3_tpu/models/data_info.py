@@ -1,0 +1,174 @@
+"""DataInfo: columns → numeric design matrix for linear/NN algos.
+
+Reference: hex/DataInfo.java:23 — categorical one-hot offsets (_catOffsets
+:116), standardization, missing-value policy — plus hex/FrameTask.java which
+streams `Row` objects to the algo.
+
+TPU-native design: no row iterator. DataInfo precomputes host-side metadata
+(offsets, means, sigmas, domains) and exposes `expand(*shard_arrays)` — a
+pure jnp function used INSIDE jitted training steps that turns this shard's
+raw column slices into a dense (rows, p) float32 block: one-hot via
+jax.nn.one_hot (fused into the following matmul by XLA; the MXU eats dense
+one-hots far better than a CPU eats sparse rows), standardized numerics,
+mean/mode-imputed NAs, pad rows zero-weighted via the returned weight vector.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Column, Frame, T_CAT
+
+
+def _device_mode(col: Column) -> int:
+    """Most frequent level of a categorical column, via a device bincount
+    (DataInfo.imputeMissing mode imputation)."""
+    import functools
+
+    import jax
+
+    card = max(col.cardinality, 1)
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def _mode(codes, k):
+        import jax.numpy as jnp
+
+        valid = codes >= 0
+        counts = jnp.zeros(k, jnp.int32).at[jnp.maximum(codes, 0)].add(
+            valid.astype(jnp.int32))
+        return jnp.argmax(counts)
+
+    return int(_mode(col.data, card))
+
+
+class DataInfo:
+    """Expansion plan for a predictor set + response.
+
+    use_all_factor_levels: False drops the first level per categorical
+    (reference DataInfo 'useAllFactorLevels' — GLM drops, DL keeps).
+    """
+
+    def __init__(self, frame: Frame, response: Optional[str] = None,
+                 *, ignored: Sequence[str] = (),
+                 weights: Optional[str] = None, offset: Optional[str] = None,
+                 standardize: bool = True, use_all_factor_levels: bool = False,
+                 missing_values_handling: str = "MeanImputation"):
+        self.response_name = response
+        self.weights_name = weights
+        self.offset_name = offset
+        self.standardize = standardize
+        self.use_all_factor_levels = use_all_factor_levels
+        self.missing_values_handling = missing_values_handling
+
+        skip = set(ignored) | {response, weights, offset} - {None}
+        self.cat_names: List[str] = []
+        self.num_names: List[str] = []
+        for n in frame.names:
+            c = frame.col(n)
+            if n in skip or c.is_string:
+                continue
+            (self.cat_names if c.is_categorical else self.num_names).append(n)
+        # categoricals first, then numerics — reference column ordering
+        self.predictor_names = self.cat_names + self.num_names
+
+        self.domains = {n: list(frame.col(n).domain or []) for n in self.cat_names}
+        self.cards = [len(self.domains[n]) for n in self.cat_names]
+        base = 0 if use_all_factor_levels else 1
+        self.cat_widths = [max(c - base, 1) for c in self.cards]
+        # _catOffsets (DataInfo.java:116): running start index per categorical
+        self.cat_offsets = np.concatenate([[0], np.cumsum(self.cat_widths)]).astype(int)
+        self.num_offset = int(self.cat_offsets[-1])
+        self.fullN = self.num_offset + len(self.num_names)
+
+        # standardization moments from rollups (computed lazily, cached on col)
+        means, sigmas, modes = [], [], []
+        for n in self.num_names:
+            r = frame.col(n).rollups
+            means.append(r.mean)
+            s = r.sigma
+            sigmas.append(s if s and s > 0 else 1.0)
+        for n in self.cat_names:
+            modes.append(_device_mode(frame.col(n)))
+        self.num_means = np.asarray(means, np.float32) if means else np.zeros(0, np.float32)
+        self.num_sigmas = np.asarray(sigmas, np.float32) if sigmas else np.ones(0, np.float32)
+        self.cat_modes = np.asarray(modes, np.int32) if modes else np.zeros(0, np.int32)
+
+    # -- names of expanded coefficients (GLM coefficient table) -----------
+    def coef_names(self) -> List[str]:
+        out = []
+        base = 0 if self.use_all_factor_levels else 1
+        for n, card in zip(self.cat_names, self.cards):
+            dom = self.domains[n]
+            for lvl in range(base, max(card, base + 1)):
+                out.append(f"{n}.{dom[lvl] if lvl < len(dom) else lvl}")
+        out.extend(self.num_names)
+        return out
+
+    def cols(self, frame: Frame) -> List[Column]:
+        return [frame.col(n) for n in self.predictor_names]
+
+    # -- device-side expansion (traced inside jit) ------------------------
+    def expand(self, *arrays):
+        """Shard slices (one per predictor, cats first) → (rows, fullN) f32.
+
+        Pure jnp; NAs imputed (mean for numeric, mode for cat codes when
+        MeanImputation — matching DataInfo.imputeMissing), one-hot with
+        optional first-level drop, numerics standardized."""
+        import jax.numpy as jnp
+
+        ncat = len(self.cat_names)
+        parts = []
+        base = 0 if self.use_all_factor_levels else 1
+        for i in range(ncat):
+            codes = arrays[i].astype(jnp.int32)
+            codes = jnp.where(codes < 0, self.cat_modes[i], codes)
+            card = max(self.cards[i], base + 1)
+            oh = jnp.take(jnp.eye(card, dtype=jnp.float32), codes, axis=0)
+            parts.append(oh[:, base:] if base else oh)
+        if self.num_names:
+            nums = jnp.stack([arrays[ncat + j] for j in range(len(self.num_names))], axis=-1)
+            nums = jnp.where(jnp.isnan(nums), self.num_means[None, :], nums)
+            if self.standardize:
+                nums = (nums - self.num_means[None, :]) / self.num_sigmas[None, :]
+            parts.append(nums.astype(jnp.float32))
+        if not parts:
+            raise ValueError("no predictors")
+        return jnp.concatenate(parts, axis=-1) if len(parts) > 1 else parts[0]
+
+    def na_row_mask(self, *arrays):
+        """1.0 where ANY predictor is NA (for missing_values_handling='Skip':
+        those rows get weight 0, DataInfo.java Skip policy)."""
+        import jax.numpy as jnp
+
+        ncat = len(self.cat_names)
+        any_na = jnp.zeros(arrays[0].shape[0], bool)
+        for i in range(ncat):
+            any_na = any_na | (arrays[i] < 0)
+        for j in range(len(self.num_names)):
+            any_na = any_na | jnp.isnan(arrays[ncat + j])
+        return any_na.astype(jnp.float32)
+
+    @staticmethod
+    def response_weight(y, w=None):
+        """Effective row weight: user weights × response-valid mask. Pad rows
+        carry NA responses (NaN / -1 code), so they drop out here — the
+        TPU-static-shape replacement for H2O's skipped NA-response rows."""
+        import jax.numpy as jnp
+
+        valid = (y >= 0) if y.dtype in (jnp.int32, jnp.int64) else ~jnp.isnan(y)
+        base = jnp.where(valid, 1.0, 0.0).astype(jnp.float32)
+        if w is not None:
+            base = base * jnp.where(jnp.isnan(w), 0.0, w).astype(jnp.float32)
+        return base
+
+    @staticmethod
+    def clean_response(y):
+        """Replace NA/pad sentinel with 0 so math stays finite (weights are
+        already 0 there)."""
+        import jax.numpy as jnp
+
+        if y.dtype in (jnp.int32, jnp.int64):
+            return jnp.maximum(y, 0)
+        return jnp.where(jnp.isnan(y), 0.0, y)

@@ -1,0 +1,314 @@
+"""Model metrics: AUC, confusion matrix, logloss, regression deviances.
+
+Reference: hex/ModelMetrics*.java, hex/AUC2.java (400-bin approximate AUC,
+AUC2.java:36), hex/ConfusionMatrix.java, hex/GainsLift.java. In H2O metric
+builders run inside the scoring MRTask (map accumulates, reduce merges).
+
+TPU-native design: predictions and responses are row-sharded jax.Arrays, so
+every accumulation is one jitted masked reduction — XLA inserts the psum
+across shards. AUC keeps the reference's fixed-bin histogram trick (400 bins
+over [0,1]) because a static-shape histogram is exactly what the TPU wants:
+a segment-sum instead of a sort.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+NBINS = 400  # hex/AUC2.java:36 (MAX_AUC_BINS)
+
+
+# ---------------------------------------------------------------------------
+# jitted accumulation kernels (compiled once per shape)
+# ---------------------------------------------------------------------------
+
+@functools.partial(__import__("jax").jit, static_argnames=("nbins",))
+def _binomial_hist(y, p, w, nbins: int = NBINS):
+    """Per-bin (tp-candidate, fp-candidate) counts: histogram of predicted
+    P(class1) split by truth. Replaces AUC2's sorted-threshold builder."""
+    import jax.numpy as jnp
+
+    b = jnp.clip((p * nbins).astype(jnp.int32), 0, nbins - 1)
+    pos = jnp.zeros(nbins, jnp.float64 if y.dtype == jnp.float64 else jnp.float32)
+    pos = pos.at[b].add(w * y)
+    neg = jnp.zeros_like(pos).at[b].add(w * (1.0 - y))
+    return pos, neg
+
+
+def _jit(fn):
+    import jax
+
+    return jax.jit(fn)
+
+
+@_jit
+def _regression_partials(y, f, w):
+    import jax.numpy as jnp
+
+    d = y - f
+    wsum = jnp.sum(w)
+    se = jnp.sum(w * d * d)
+    ae = jnp.sum(w * jnp.abs(d))
+    ysum = jnp.sum(w * y)
+    y2sum = jnp.sum(w * y * y)
+    sle = jnp.sum(w * (jnp.log1p(jnp.maximum(f, 0)) - jnp.log1p(jnp.maximum(y, 0))) ** 2)
+    return {"wsum": wsum, "se": se, "ae": ae, "ysum": ysum, "y2sum": y2sum, "sle": sle}
+
+
+@_jit
+def _binomial_partials(y, p, w):
+    import jax.numpy as jnp
+
+    eps = 1e-15
+    pc = jnp.clip(p, eps, 1 - eps)
+    ll = -jnp.sum(w * (y * jnp.log(pc) + (1 - y) * jnp.log1p(-pc)))
+    se = jnp.sum(w * (y - p) ** 2)
+    wsum = jnp.sum(w)
+    return {"logloss": ll, "se": se, "wsum": wsum}
+
+
+@functools.partial(__import__("jax").jit, static_argnames=("nclasses",))
+def _multinomial_partials(y, probs, w, nclasses: int):
+    import jax.numpy as jnp
+
+    eps = 1e-15
+    yi = y.astype(jnp.int32)
+    pred = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+    rows = jnp.arange(y.shape[0])
+    py = jnp.clip(probs[rows, yi], eps, 1.0)
+    ll = -jnp.sum(w * jnp.log(py))
+    # confusion matrix via flat segment-sum (no atomics — SURVEY §2.10.3)
+    flat = yi * nclasses + pred
+    cm = jnp.zeros(nclasses * nclasses, w.dtype).at[flat].add(w)
+    se = jnp.sum(w * (1.0 - py) ** 2) + jnp.sum(
+        w[:, None] * jnp.where(jnp.arange(nclasses)[None, :] == yi[:, None], 0.0, probs) ** 2)
+    # top-k hit counts (hit_ratio_table, 10 like reference)
+    k = min(10, nclasses)
+    topk = jnp.argsort(-probs, axis=-1)[:, :k]
+    hits = (topk == yi[:, None])
+    hitk = jnp.cumsum(hits, axis=-1).astype(w.dtype) * w[:, None]
+    return {"logloss": ll, "cm": cm.reshape(nclasses, nclasses), "se": se,
+            "wsum": jnp.sum(w), "hitk": jnp.sum(hitk, axis=0)}
+
+
+# ---------------------------------------------------------------------------
+# metric result objects (host-side, JSON-able)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ConfusionMatrix:
+    """hex/ConfusionMatrix.java — rows = actual, cols = predicted."""
+
+    table: np.ndarray
+    domain: List[str]
+
+    def errors_per_class(self) -> np.ndarray:
+        tot = self.table.sum(axis=1)
+        correct = np.diag(self.table)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            return np.where(tot > 0, (tot - correct) / tot, 0.0)
+
+    @property
+    def error(self) -> float:
+        tot = self.table.sum()
+        return float((tot - np.diag(self.table).sum()) / tot) if tot else 0.0
+
+    def to_dict(self):
+        return {"matrix": self.table.tolist(), "domain": self.domain,
+                "error": self.error}
+
+
+@dataclass
+class AUCData:
+    """hex/AUC2.java outputs: ROC from the 400-bin histogram + threshold
+    criteria (max F1 etc.)."""
+
+    auc: float
+    pr_auc: float
+    gini: float
+    max_f1: float
+    max_f1_threshold: float
+    thresholds: np.ndarray = field(repr=False)
+    tps: np.ndarray = field(repr=False)
+    fps: np.ndarray = field(repr=False)
+    p: float = 0.0
+    n: float = 0.0
+
+    def confusion_matrix(self, threshold: Optional[float] = None,
+                         domain: Optional[List[str]] = None) -> ConfusionMatrix:
+        thr = self.max_f1_threshold if threshold is None else threshold
+        i = int(np.searchsorted(-self.thresholds, -thr))
+        i = min(i, len(self.thresholds) - 1)
+        tp, fp = self.tps[i], self.fps[i]
+        fn, tn = self.p - tp, self.n - fp
+        return ConfusionMatrix(np.array([[tn, fp], [fn, tp]]),
+                               domain or ["0", "1"])
+
+
+def compute_auc(pos_hist: np.ndarray, neg_hist: np.ndarray) -> AUCData:
+    """ROC sweep over descending-threshold bins (AUC2.java DEFAULT criteria)."""
+    # bin i covers predictions in [i/NBINS,(i+1)/NBINS); sweep from high to low
+    pos = pos_hist[::-1]
+    neg = neg_hist[::-1]
+    tps = np.cumsum(pos)   # predicted positive at threshold <= bin upper edge
+    fps = np.cumsum(neg)
+    p, n = float(tps[-1]), float(fps[-1])
+    if p == 0 or n == 0:
+        return AUCData(0.5, 0.0, 0.0, 0.0, 0.5,
+                       np.linspace(1, 0, NBINS), tps, fps, p, n)
+    tpr = tps / p
+    fpr = fps / n
+    auc = float(np.trapezoid(np.concatenate([[0.0], tpr]), np.concatenate([[0.0], fpr])))
+    with np.errstate(invalid="ignore", divide="ignore"):
+        precision = np.where(tps + fps > 0, tps / (tps + fps), 1.0)
+        recall = tpr
+        pr_auc = float(np.trapezoid(precision, recall))
+        f1 = np.where(precision + recall > 0,
+                      2 * precision * recall / (precision + recall), 0.0)
+    thresholds = (np.arange(NBINS, 0, -1) - 0.5) / NBINS
+    best = int(np.argmax(f1))
+    return AUCData(auc=auc, pr_auc=pr_auc, gini=2 * auc - 1,
+                   max_f1=float(f1[best]), max_f1_threshold=float(thresholds[best]),
+                   thresholds=thresholds, tps=tps, fps=fps, p=p, n=n)
+
+
+@dataclass
+class ModelMetrics:
+    """Base (hex/ModelMetrics.java): holds what every metric set shares."""
+
+    mse: float = float("nan")
+    rmse: float = float("nan")
+    nobs: float = 0.0
+    description: str = ""
+
+    def _base_dict(self):
+        return {"MSE": self.mse, "RMSE": self.rmse, "nobs": self.nobs}
+
+    def to_dict(self):
+        return self._base_dict()
+
+
+@dataclass
+class ModelMetricsRegression(ModelMetrics):
+    mae: float = float("nan")
+    rmsle: float = float("nan")
+    r2: float = float("nan")
+    mean_residual_deviance: float = float("nan")
+
+    def to_dict(self):
+        d = self._base_dict()
+        d.update({"mae": self.mae, "rmsle": self.rmsle, "r2": self.r2,
+                  "mean_residual_deviance": self.mean_residual_deviance})
+        return d
+
+
+@dataclass
+class ModelMetricsBinomial(ModelMetrics):
+    logloss: float = float("nan")
+    auc: float = float("nan")
+    pr_auc: float = float("nan")
+    gini: float = float("nan")
+    mean_per_class_error: float = float("nan")
+    cm: Optional[ConfusionMatrix] = None
+    auc_data: Optional[AUCData] = None
+
+    def to_dict(self):
+        d = self._base_dict()
+        d.update({"logloss": self.logloss, "AUC": self.auc, "pr_auc": self.pr_auc,
+                  "Gini": self.gini, "mean_per_class_error": self.mean_per_class_error,
+                  "cm": self.cm.to_dict() if self.cm else None})
+        return d
+
+
+@dataclass
+class ModelMetricsMultinomial(ModelMetrics):
+    logloss: float = float("nan")
+    mean_per_class_error: float = float("nan")
+    cm: Optional[ConfusionMatrix] = None
+    hit_ratios: Optional[List[float]] = None
+
+    def to_dict(self):
+        d = self._base_dict()
+        d.update({"logloss": self.logloss,
+                  "mean_per_class_error": self.mean_per_class_error,
+                  "cm": self.cm.to_dict() if self.cm else None,
+                  "hit_ratio_table": self.hit_ratios})
+        return d
+
+
+@dataclass
+class ModelMetricsClustering(ModelMetrics):
+    tot_withinss: float = float("nan")
+    betweenss: float = float("nan")
+    totss: float = float("nan")
+    within_cluster_sizes: Optional[List[float]] = None
+
+    def to_dict(self):
+        d = self._base_dict()
+        d.update({"tot_withinss": self.tot_withinss, "betweenss": self.betweenss,
+                  "totss": self.totss})
+        return d
+
+
+# ---------------------------------------------------------------------------
+# builders (called from Model.score / ModelBuilder scoring)
+# ---------------------------------------------------------------------------
+
+def make_regression_metrics(y, f, w, distribution=None) -> ModelMetricsRegression:
+    """y/f/w: row-sharded device arrays (pad rows carry w=0)."""
+    import jax.numpy as jnp
+
+    parts = {k: float(v) for k, v in _regression_partials(y, f, w).items()}
+    wsum = parts["wsum"]
+    if wsum == 0:
+        return ModelMetricsRegression()
+    mse = parts["se"] / wsum
+    ymean = parts["ysum"] / wsum
+    ss_tot = parts["y2sum"] / wsum - ymean * ymean
+    dev = mse
+    if distribution is not None and distribution.name != "gaussian":
+        dsum = float(jnp.sum(distribution.deviance(w, y, distribution.link(jnp.maximum(f, 1e-10))
+                                                   if distribution.name in ("poisson", "gamma", "tweedie") else f)))
+        dev = dsum / wsum
+    return ModelMetricsRegression(
+        mse=mse, rmse=float(np.sqrt(mse)), nobs=wsum,
+        mae=parts["ae"] / wsum,
+        rmsle=float(np.sqrt(parts["sle"] / wsum)),
+        r2=1.0 - mse / ss_tot if ss_tot > 0 else float("nan"),
+        mean_residual_deviance=dev)
+
+
+def make_binomial_metrics(y, p, w, domain: Optional[List[str]] = None) -> ModelMetricsBinomial:
+    """y in {0,1}, p = P(class 1); all row-sharded device arrays."""
+    parts = {k: float(v) for k, v in _binomial_partials(y, p, w).items()}
+    pos, neg = _binomial_hist(y, p, w)
+    auc = compute_auc(np.asarray(pos), np.asarray(neg))
+    wsum = parts["wsum"]
+    if wsum == 0:
+        return ModelMetricsBinomial()
+    cm = auc.confusion_matrix(domain=domain)
+    mpce = float(np.mean(cm.errors_per_class()))
+    mse = parts["se"] / wsum
+    return ModelMetricsBinomial(
+        mse=mse, rmse=float(np.sqrt(mse)), nobs=wsum,
+        logloss=parts["logloss"] / wsum, auc=auc.auc, pr_auc=auc.pr_auc,
+        gini=auc.gini, mean_per_class_error=mpce, cm=cm, auc_data=auc)
+
+
+def make_multinomial_metrics(y, probs, w, domain: List[str]) -> ModelMetricsMultinomial:
+    k = len(domain)
+    parts = _multinomial_partials(y, probs, w, k)
+    wsum = float(parts["wsum"])
+    if wsum == 0:
+        return ModelMetricsMultinomial()
+    cm = ConfusionMatrix(np.asarray(parts["cm"]), list(domain))
+    mse = float(parts["se"]) / wsum
+    return ModelMetricsMultinomial(
+        mse=mse, rmse=float(np.sqrt(mse)), nobs=wsum,
+        logloss=float(parts["logloss"]) / wsum,
+        mean_per_class_error=float(np.mean(cm.errors_per_class())),
+        cm=cm, hit_ratios=[float(h) / wsum for h in np.asarray(parts["hitk"])])

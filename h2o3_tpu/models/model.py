@@ -1,0 +1,252 @@
+"""Model base: trained artifact + distributed scoring harness.
+
+Reference: hex/Model.java — score() chain (Model.java:1592-1648) runs a
+BigScore MRTask over test chunks calling per-algo score0 per row;
+adaptTestForTrain (column/domain alignment, missing-col fills) precedes it.
+
+TPU-native design: score0's per-row virtual call becomes one jitted batch
+function per algo (`_predict_raw`) over row-sharded arrays — the MRTask and
+the metric builder collapse into the same fused XLA program. adaptTestForTrain
+stays host-side metadata work: domain remaps become int32 LUT gathers on
+device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from h2o3_tpu.core.dkv import DKV, Keyed
+from h2o3_tpu.core.frame import Column, Frame, NA_CAT, T_CAT, T_NUM
+from h2o3_tpu.models import metrics as M
+
+
+class ModelCategory:
+    Regression = "Regression"
+    Binomial = "Binomial"
+    Multinomial = "Multinomial"
+    Clustering = "Clustering"
+    DimReduction = "DimReduction"
+    AnomalyDetection = "AnomalyDetection"
+    AutoEncoder = "AutoEncoder"
+    WordEmbedding = "WordEmbedding"
+    CoxPH = "CoxPH"
+    Unknown = "Unknown"
+
+
+class ModelOutput:
+    """hex/Model.Output: everything the trained model knows about its world."""
+
+    def __init__(self):
+        self.names: List[str] = []          # predictor columns, training order
+        self.domains: Dict[str, List[str]] = {}
+        self.response_name: Optional[str] = None
+        self.response_domain: Optional[List[str]] = None
+        self.model_category: str = ModelCategory.Unknown
+        self.training_metrics: Optional[M.ModelMetrics] = None
+        self.validation_metrics: Optional[M.ModelMetrics] = None
+        self.cross_validation_metrics: Optional[M.ModelMetrics] = None
+        self.cv_fold_metrics: List[M.ModelMetrics] = []
+        self.variable_importances: Optional[Dict[str, float]] = None
+        self.scoring_history: List[dict] = []
+        self.run_time_ms: int = 0
+        self.start_time: float = 0.0
+
+    @property
+    def nclasses(self) -> int:
+        return len(self.response_domain) if self.response_domain else 1
+
+    def is_classifier(self) -> bool:
+        return self.model_category in (ModelCategory.Binomial, ModelCategory.Multinomial)
+
+
+class Model(Keyed):
+    """Base trained model. Subclasses implement `_predict_raw(frame)` →
+    device arrays and set `_output.model_category`."""
+
+    algo_name = "model"
+
+    def __init__(self, key: Optional[str] = None, parms: Optional[dict] = None):
+        super().__init__(key)
+        self._parms: dict = dict(parms or {})
+        self._output = ModelOutput()
+        self.install()
+
+    # -- per-algo hook ----------------------------------------------------
+    def _predict_raw(self, frame: Frame):
+        """Return dict of row-sharded device arrays:
+        Regression: {"value": (N,)}; Binomial/Multinomial: {"probs": (N,K)};
+        Clustering: {"cluster": (N,)}; AnomalyDetection: {"score": (N,)}."""
+        raise NotImplementedError
+
+    # -- adaptation (hex/Model.adaptTestForTrain) -------------------------
+    def adapt_test(self, test: Frame) -> Frame:
+        """Align test frame to training columns: reorder, fill missing
+        columns with NA, remap categorical codes onto training domains
+        (unseen level → NA)."""
+        import jax
+        import jax.numpy as jnp
+
+        from h2o3_tpu.core.runtime import cluster
+
+        cl = cluster()
+        out = Frame()
+        n = test.nrows
+        padded = cl.pad_rows(n)
+        for name in self._output.names:
+            train_dom = self._output.domains.get(name)
+            if name not in test:
+                # missing predictor: fill NA (Model.java adaptTestForTrain warning path)
+                if train_dom is not None:
+                    buf = np.full(padded, NA_CAT, np.int32)
+                    col = Column(jax.device_put(buf, cl.row_sharding()), T_CAT, n, domain=train_dom)
+                else:
+                    buf = np.full(padded, np.nan, np.float32)
+                    col = Column(jax.device_put(buf, cl.row_sharding()), T_NUM, n)
+                out.add(name, col)
+                continue
+            c = test.col(name)
+            if train_dom is not None:
+                if not c.is_categorical:
+                    raise ValueError(
+                        f"column {name} was categorical in training, numeric in test")
+                test_dom = c.domain or []
+                if test_dom == train_dom:
+                    out.add(name, c)
+                else:
+                    lut_map = {v: i for i, v in enumerate(train_dom)}
+                    lut = np.array([lut_map.get(v, NA_CAT) for v in test_dom] or [NA_CAT],
+                                   np.int32)
+                    codes = c.data if c.ctype == T_CAT else c.data.astype(jnp.int32)
+                    remapped = jnp.where(codes >= 0,
+                                         jnp.take(jnp.asarray(lut), jnp.maximum(codes, 0)),
+                                         NA_CAT)
+                    out.add(name, Column(remapped, T_CAT, n, domain=train_dom))
+            else:
+                if c.ctype == T_CAT:
+                    raise ValueError(f"column {name} was numeric in training, enum in test")
+                out.add(name, c)
+        # carry through special columns the scorer may need (offset/weights)
+        for pname in ("offset_column", "weights_column", "fold_column"):
+            cn = self._parms.get(pname)
+            if cn and cn in test and cn not in out:
+                out.add(cn, test.col(cn))
+        return out
+
+    # -- public scoring (hex/Model.score) ---------------------------------
+    def predict(self, frame: Frame, key: Optional[str] = None) -> Frame:
+        adapted = self.adapt_test(frame)
+        raw = self._predict_raw(adapted)
+        out = Frame(key=key)
+        n = frame.nrows
+        cat = self._output.model_category
+        if cat in (ModelCategory.Binomial, ModelCategory.Multinomial):
+            probs = raw["probs"]
+            dom = self._output.response_domain or []
+            import jax.numpy as jnp
+
+            if cat == ModelCategory.Binomial and self._output.training_metrics is not None \
+                    and getattr(self._output.training_metrics, "auc_data", None) is not None:
+                thr = self._output.training_metrics.auc_data.max_f1_threshold
+                label = (probs[:, 1] >= thr).astype(jnp.int32)
+            else:
+                label = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+            out.add("predict", Column(label, T_CAT, n, domain=list(dom)))
+            for k, lvl in enumerate(dom):
+                out.add(str(lvl), Column(probs[:, k], T_NUM, n))
+        elif cat == ModelCategory.Clustering:
+            out.add("predict", Column(raw["cluster"].astype(np.int32), T_CAT, n,
+                                      domain=[str(i) for i in range(int(self._parms.get("k", 0)) or
+                                                                    int(np.asarray(raw["cluster"]).max() + 1))]))
+        elif cat == ModelCategory.AnomalyDetection:
+            out.add("predict", Column(raw["score"], T_NUM, n))
+            if "mean_length" in raw:
+                out.add("mean_length", Column(raw["mean_length"], T_NUM, n))
+        else:
+            out.add("predict", Column(raw["value"], T_NUM, n))
+        return out
+
+    def model_performance(self, test_data: Optional[Frame] = None):
+        """h2o-py model_performance(): compute metrics on a frame."""
+        if test_data is None:
+            return self._output.training_metrics
+        adapted = self.adapt_test(test_data)
+        raw = self._predict_raw(adapted)
+        return self._make_metrics(test_data, raw)
+
+    def _make_metrics(self, frame: Frame, raw: Dict[str, Any]):
+        from h2o3_tpu.models.data_info import DataInfo
+
+        resp = self._output.response_name
+        cat = self._output.model_category
+        if resp is None or resp not in frame:
+            return None
+        y_col = frame.col(resp)
+        w = None
+        wname = self._parms.get("weights_column")
+        if wname and wname in frame:
+            w = frame.col(wname).data
+        if cat == ModelCategory.Binomial:
+            import jax.numpy as jnp
+
+            y = y_col.data
+            wts = DataInfo.response_weight(y, w)
+            yf = DataInfo.clean_response(y).astype(jnp.float32)
+            return M.make_binomial_metrics(yf, raw["probs"][:, 1], wts,
+                                           domain=self._output.response_domain)
+        if cat == ModelCategory.Multinomial:
+            y = y_col.data
+            wts = DataInfo.response_weight(y, w)
+            return M.make_multinomial_metrics(DataInfo.clean_response(y), raw["probs"], wts,
+                                              domain=self._output.response_domain)
+        if cat == ModelCategory.Regression:
+            y = y_col.data
+            wts = DataInfo.response_weight(y, w)
+            dist = getattr(self, "_distribution", None)
+            return M.make_regression_metrics(DataInfo.clean_response(y), raw["value"], wts,
+                                             distribution=dist)
+        return None
+
+    # -- persistence (binary save/load; MOJO analog in export.py) ---------
+    def save(self, path: str) -> str:
+        import pickle
+
+        state = self.__getstate__() if hasattr(self, "__getstate__") else self.__dict__
+        with open(path, "wb") as f:
+            pickle.dump((type(self), state), f)
+        return path
+
+    @staticmethod
+    def load(path: str) -> "Model":
+        import pickle
+
+        with open(path, "rb") as f:
+            cls, state = pickle.load(f)
+        obj = cls.__new__(cls)
+        obj.__dict__.update(state)
+        DKV.put(obj._key, obj)
+        return obj
+
+    # -- summaries --------------------------------------------------------
+    def varimp(self) -> Optional[Dict[str, float]]:
+        return self._output.variable_importances
+
+    def to_dict(self) -> dict:
+        o = self._output
+        return {
+            "model_id": str(self.key),
+            "algo": self.algo_name,
+            "model_category": o.model_category,
+            "response_column": o.response_name,
+            "names": o.names,
+            "training_metrics": o.training_metrics.to_dict() if o.training_metrics else None,
+            "validation_metrics": o.validation_metrics.to_dict() if o.validation_metrics else None,
+            "cross_validation_metrics": (o.cross_validation_metrics.to_dict()
+                                         if o.cross_validation_metrics else None),
+            "variable_importances": o.variable_importances,
+            "run_time_ms": o.run_time_ms,
+        }
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self._key} {self._output.model_category}>"

@@ -1,0 +1,234 @@
+"""ModelBuilder: param validation, train/valid adaptation, CV orchestration.
+
+Reference: hex/ModelBuilder.java — trainModel() (:359) launches a Job running
+the algo Driver; n-fold CV builds fold models then the main model
+(cv_computeAndSetOptimalParameters, CVModelBuilder.java); early stopping via
+hex/ScoreKeeper.java.
+
+TPU-native: the Driver is a host loop around jitted steps; fold models are
+trained sequentially on row-subset frames (device gathers); the "cloud" never
+changes shape so there is no work-stealing to schedule — XLA owns the chip.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.core.dkv import DKV
+from h2o3_tpu.core.frame import Frame, T_CAT
+from h2o3_tpu.core.job import Job
+from h2o3_tpu.models import metrics as M
+from h2o3_tpu.models.model import Model, ModelCategory
+
+
+class ModelBuilder:
+    """Base estimator. Subclass contract:
+    - class attrs: `algo_name`, `model_class`
+    - `_fit(train: Frame) -> Model` — train on the (already adapted) frame
+      using self.params; must set model._output.{names,domains,response_*,
+      model_category} (helper `_init_output` does the common part).
+    """
+
+    algo_name = "base"
+    model_class = Model
+    supervised = True
+
+    def __init__(self, **params):
+        self.params: Dict[str, Any] = self.default_params()
+        unknown = [k for k in params if k not in self.params]
+        if unknown:
+            raise ValueError(f"unknown {self.algo_name} parameters: {unknown}")
+        self.params.update({k: v for k, v in params.items() if v is not None})
+        self.job: Optional[Job] = None
+        self.model: Optional[Model] = None
+
+    # -- param surface ----------------------------------------------------
+    @classmethod
+    def default_params(cls) -> Dict[str, Any]:
+        return {
+            "response_column": None,
+            "ignored_columns": [],
+            "weights_column": None,
+            "offset_column": None,
+            "fold_column": None,
+            "nfolds": 0,
+            "fold_assignment": "AUTO",   # AUTO/Random/Modulo/Stratified
+            "keep_cross_validation_models": True,
+            "keep_cross_validation_predictions": False,
+            "seed": -1,
+            "max_runtime_secs": 0.0,
+            "stopping_rounds": 0,
+            "stopping_metric": "AUTO",
+            "stopping_tolerance": 1e-3,
+            "model_id": None,
+            "validation_frame": None,
+            "training_frame": None,
+            "categorical_encoding": "AUTO",
+        }
+
+    def _seed(self) -> int:
+        s = int(self.params.get("seed", -1) or -1)
+        return s if s >= 0 else np.random.SeedSequence().entropy % (2**31)
+
+    # -- h2o-py style entry ----------------------------------------------
+    def train(self, x: Optional[Sequence[str]] = None, y: Optional[str] = None,
+              training_frame: Optional[Frame] = None,
+              validation_frame: Optional[Frame] = None, **kw) -> Model:
+        """Synchronous train (reference trainModel().get()). x = predictor
+        names (default: all minus response/weights/fold)."""
+        unknown = [k for k in kw if k not in self.params]
+        if unknown:
+            raise ValueError(f"unknown {self.algo_name} parameters: {unknown}")
+        self.params.update({k: v for k, v in kw.items() if v is not None})
+        train = training_frame or self.params.get("training_frame")
+        if train is None:
+            raise ValueError("training_frame required")
+        if y is not None:
+            self.params["response_column"] = y
+        valid = validation_frame or self.params.get("validation_frame")
+        resp = self.params.get("response_column")
+        if self.supervised and not resp:
+            raise ValueError(f"{self.algo_name}: response_column required")
+        if self.supervised and resp not in train:
+            raise ValueError(f"response column {resp!r} not in training frame")
+
+        if x is not None:
+            keep = list(x) + [c for c in (resp, self.params.get("weights_column"),
+                                          self.params.get("offset_column"),
+                                          self.params.get("fold_column")) if c]
+            train = train.subframe([c for c in train.names if c in keep])
+
+        self.job = Job(description=f"{self.algo_name} train", dest=self.params.get("model_id"))
+        t0 = time.time()
+        self.job.status = Job.RUNNING
+        self.job.start_time = t0
+        try:
+            model = self._train_impl(train, valid)
+        except Exception:
+            self.job.status = Job.FAILED
+            import traceback
+
+            self.job.exception = traceback.format_exc()
+            raise
+        self.job.status = Job.DONE
+        self.job.progress = 1.0
+        self.job.end_time = time.time()
+        model._output.run_time_ms = int((time.time() - t0) * 1000)
+        self.model = model
+        return model
+
+    # -- orchestration ----------------------------------------------------
+    def _train_impl(self, train: Frame, valid: Optional[Frame]) -> Model:
+        nfolds = int(self.params.get("nfolds") or 0)
+        fold_col = self.params.get("fold_column")
+        cv_models: List[Model] = []
+        cv_metrics: List = []
+        if nfolds > 1 or fold_col:
+            cv_models, cv_metrics = self._cross_validate(train, nfolds, fold_col)
+
+        model = self._fit(train)
+        model._output.training_metrics = self._score_on(model, train)
+        if valid is not None:
+            model._output.validation_metrics = self._score_on(model, valid)
+        if cv_metrics:
+            model._output.cv_fold_metrics = cv_metrics
+            model._output.cross_validation_metrics = _mean_metrics(cv_metrics)
+            if not self.params.get("keep_cross_validation_models", True):
+                for m in cv_models:
+                    m.delete()
+        return model
+
+    def _cross_validate(self, train: Frame, nfolds: int, fold_col: Optional[str]):
+        """hex/ModelBuilder CV: assign folds, train N fold models on
+        out-of-fold rows, score each on its holdout."""
+        from h2o3_tpu.ops.filters import take_rows
+
+        n = train.nrows
+        if fold_col:
+            assign = train.col(fold_col).to_numpy().astype(int)
+            folds = sorted(set(assign.tolist()))
+        else:
+            scheme = (self.params.get("fold_assignment") or "AUTO").lower()
+            if scheme in ("auto", "random"):
+                rng = np.random.default_rng(self._seed())
+                assign = rng.integers(0, nfolds, n)
+            else:  # modulo
+                assign = np.arange(n) % nfolds
+            folds = list(range(nfolds))
+        models, mets = [], []
+        for fi, f in enumerate(folds):
+            tr = take_rows(train, np.nonzero(assign != f)[0])
+            ho = take_rows(train, np.nonzero(assign == f)[0])
+            sub = type(self)(**{k: v for k, v in self.params.items()
+                                if k not in ("nfolds", "fold_column", "training_frame",
+                                             "validation_frame", "model_id")})
+            m = sub._fit(tr)
+            mets.append(sub._score_on(m, ho))
+            models.append(m)
+            if self.job:
+                self.job.update(progress=0.5 * (fi + 1) / len(folds),
+                                msg=f"CV fold {fi + 1}/{len(folds)}")
+            tr.delete()
+            ho.delete()
+        return models, mets
+
+    def _score_on(self, model: Model, frame: Frame):
+        raw = model._predict_raw(model.adapt_test(frame))
+        return model._make_metrics(frame, raw)
+
+    # -- shared init ------------------------------------------------------
+    def _init_output(self, model: Model, train: Frame):
+        resp = self.params.get("response_column")
+        out = model._output
+        skip = {resp, self.params.get("weights_column"),
+                self.params.get("offset_column"), self.params.get("fold_column")}
+        skip |= set(self.params.get("ignored_columns") or [])
+        out.names = [c for c in train.names if c not in skip
+                     and not train.col(c).is_string]
+        out.domains = {c: list(train.col(c).domain) for c in out.names
+                       if train.col(c).is_categorical}
+        if resp:
+            rc = train.col(resp)
+            out.response_name = resp
+            if rc.is_categorical:
+                out.response_domain = list(rc.domain or [])
+                out.model_category = (ModelCategory.Binomial if len(out.response_domain) == 2
+                                      else ModelCategory.Multinomial)
+            else:
+                out.model_category = ModelCategory.Regression
+        return out
+
+    def _fit(self, train: Frame) -> Model:
+        raise NotImplementedError
+
+
+def _mean_metrics(mets: List):
+    """Combine fold metrics (reference computes CV metrics on pooled holdout
+    predictions; mean-of-folds is the documented approximation)."""
+    mets = [m for m in mets if m is not None]
+    if not mets:
+        return None
+    import copy
+    import dataclasses
+
+    out = copy.copy(mets[0])
+    for f in dataclasses.fields(type(mets[0])):
+        vals = [getattr(m, f.name) for m in mets]
+        if all(isinstance(v, (int, float)) for v in vals):
+            valid = [v for v in vals if v == v]
+            if valid:
+                setattr(out, f.name, float(np.mean(valid)))
+    out.description = f"{len(mets)}-fold cross-validation (mean of folds)"
+    return out
+
+
+# registry: algo name -> builder class (water/api ModelBuilders listing)
+BUILDERS: Dict[str, type] = {}
+
+
+def register(cls):
+    BUILDERS[cls.algo_name] = cls
+    return cls

@@ -1,0 +1,154 @@
+"""GLM tests: IRLS vs sklearn, families, elastic net, CV, metrics.
+
+Mirrors reference pyunits testdir_algos/glm (e.g. pyunit_glm_binomial.py)
+with sklearn as the golden-math oracle instead of R."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.models.glm import GLM
+
+
+def _reg_data(n=4000, p=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, p))
+    beta = np.arange(1, p + 1, dtype=float)
+    y = X @ beta + 2.5 + rng.normal(0, 0.1, n)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=[f"x{i}" for i in range(p)] + ["y"])
+    return fr, beta
+
+
+def _bin_data(n=4000, p=4, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, p))
+    beta = np.array([1.0, -2.0, 0.5, 0.0])
+    logits = X @ beta - 0.5
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(float)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=[f"x{i}" for i in range(p)] + ["y"])
+    return fr, beta
+
+
+def test_gaussian_matches_ols(cl):
+    fr, beta = _reg_data()
+    m = GLM(family="gaussian", lambda_=0.0, standardize=False).train(
+        y="y", training_frame=fr)
+    coef = m.coef()
+    for i, b in enumerate(beta):
+        assert abs(coef[f"x{i}"] - b) < 0.01
+    assert abs(coef["Intercept"] - 2.5) < 0.01
+    assert m._output.training_metrics.rmse < 0.15
+    assert m._output.training_metrics.r2 > 0.99
+
+
+def test_gaussian_standardized_same_predictions(cl):
+    fr, _ = _reg_data()
+    m1 = GLM(family="gaussian", lambda_=0.0, standardize=True).train(y="y", training_frame=fr)
+    m2 = GLM(family="gaussian", lambda_=0.0, standardize=False).train(y="y", training_frame=fr)
+    p1 = m1.predict(fr).col("predict").to_numpy()
+    p2 = m2.predict(fr).col("predict").to_numpy()
+    np.testing.assert_allclose(p1, p2, atol=1e-2)
+
+
+def test_binomial_vs_sklearn(cl):
+    from sklearn.linear_model import LogisticRegression
+
+    fr, _ = _bin_data()
+    m = GLM(family="binomial", lambda_=0.0, standardize=False).train(
+        y="y", training_frame=fr)
+    X = fr.subframe(["x0", "x1", "x2", "x3"]).to_numpy()
+    yv = fr.col("y").to_numpy()
+    sk = LogisticRegression(C=1e6, max_iter=1000).fit(X, yv)
+    coef = m.coef()
+    for i in range(4):
+        assert abs(coef[f"x{i}"] - sk.coef_[0][i]) < 0.05, (coef, sk.coef_)
+    mm = m._output.training_metrics
+    assert mm.auc > 0.85
+    assert mm.logloss < 0.5
+
+
+def test_binomial_enum_response(cl, airlines_csv):
+    import h2o3_tpu
+
+    fr = h2o3_tpu.import_file(airlines_csv)
+    m = GLM(family="binomial").train(y="IsDepDelayed", training_frame=fr)
+    mm = m._output.training_metrics
+    assert mm.auc > 0.60
+    pred = m.predict(fr)
+    assert pred.col("predict").domain == ["NO", "YES"]
+    assert {"NO", "YES"} <= set(pred.names)
+
+
+def test_elastic_net_shrinks(cl):
+    fr, beta = _bin_data()
+    dense = GLM(family="binomial", lambda_=0.0).train(y="y", training_frame=fr)
+    sparse = GLM(family="binomial", alpha=1.0, lambda_=0.05).train(y="y", training_frame=fr)
+    b_dense = np.array([v for k, v in sparse.coef_norm().items() if k != "Intercept"])
+    # the truly-zero coefficient x3 must be driven to (near) zero by L1
+    assert abs(sparse.coef_norm()["x3"]) < 1e-3
+    assert abs(dense.coef_norm()["x3"]) >= 0
+
+
+def test_poisson(cl):
+    rng = np.random.default_rng(3)
+    n = 3000
+    x = rng.normal(size=n)
+    mu = np.exp(0.3 * x + 1.0)
+    y = rng.poisson(mu).astype(float)
+    fr = Frame.from_numpy(np.column_stack([x, y]), names=["x", "y"])
+    m = GLM(family="poisson", lambda_=0.0, standardize=False).train(y="y", training_frame=fr)
+    c = m.coef()
+    assert abs(c["x"] - 0.3) < 0.05
+    assert abs(c["Intercept"] - 1.0) < 0.05
+
+
+def test_multinomial(cl):
+    rng = np.random.default_rng(4)
+    n = 3000
+    X = rng.normal(size=(n, 2))
+    logits = np.stack([X[:, 0], X[:, 1], -X[:, 0] - X[:, 1]], axis=1)
+    y = np.array([rng.choice(3, p=np.exp(l) / np.exp(l).sum()) for l in logits])
+    import pandas as pd
+
+    df = pd.DataFrame({"x0": X[:, 0], "x1": X[:, 1],
+                       "y": pd.Categorical.from_codes(y, ["a", "b", "c"])})
+    fr = Frame.from_pandas(df)
+    m = GLM(family="multinomial", lambda_=0.0).train(y="y", training_frame=fr)
+    mm = m._output.training_metrics
+    assert mm.logloss < 1.0
+    assert mm.cm.table.shape == (3, 3)
+    pred = m.predict(fr)
+    assert set(pred.names) == {"predict", "a", "b", "c"}
+    acc = (pred.col("predict").to_numpy() == y).mean()
+    assert acc > 0.55
+
+
+def test_cv_metrics(cl):
+    fr, _ = _bin_data(n=2000)
+    m = GLM(family="binomial", nfolds=3, seed=42).train(y="y", training_frame=fr)
+    assert m._output.cross_validation_metrics is not None
+    assert m._output.cross_validation_metrics.auc > 0.8
+    assert len(m._output.cv_fold_metrics) == 3
+
+
+def test_p_values(cl):
+    fr, beta = _bin_data()
+    m = GLM(family="binomial", lambda_=0.0, compute_p_values=True,
+            standardize=False).train(y="y", training_frame=fr)
+    assert m.p_values is not None
+    # x3 has true coefficient 0 -> insignificant; x1 strong -> significant
+    names = m.dinfo.coef_names()
+    pv = {n: m.p_values[i] for i, n in enumerate(names)}
+    assert pv["x1"] < 0.001
+    assert pv["x3"] > 0.01
+
+
+def test_weights_column(cl):
+    fr, _ = _reg_data(n=1000)
+    w = np.ones(1000)
+    w[:500] = 0.0  # first half ignored
+    fr.add("w", __import__("h2o3_tpu").core.frame.Column.from_numpy(w))
+    m = GLM(family="gaussian", lambda_=0.0, weights_column="w").train(y="y", training_frame=fr)
+    assert m._output.training_metrics.nobs == 500
