@@ -1,0 +1,70 @@
+"""Benchmark: flagship-model training throughput on the local accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no in-tree numbers (BASELINE.md) — vs_baseline is
+relative to the first recorded run of this implementation (RECORDED below);
+1.0 until a baseline exists.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# first recorded value of this metric on real TPU hardware (None = not yet)
+RECORDED = None
+METRIC = "glm_irls_rows_per_sec"
+
+
+def bench_glm(n_rows: int = 1_000_000, p: int = 32, iters: int = 20) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.standard_normal((n_rows, p)), jnp.float32)
+    true_b = jnp.asarray(rng.standard_normal(p), jnp.float32)
+    y = (jax.nn.sigmoid(X @ true_b) > 0.5).astype(jnp.float32)
+
+    @jax.jit
+    def irls_step(beta, _):
+        eta = X @ beta[:-1] + beta[-1]
+        mu = jax.nn.sigmoid(eta)
+        w = jnp.maximum(mu * (1 - mu), 1e-6)
+        z = eta + (y - mu) / w
+        Xa = jnp.concatenate([X, jnp.ones((X.shape[0], 1), X.dtype)], axis=1)
+        gram = (Xa * w[:, None]).T @ Xa + 1e-6 * jnp.eye(p + 1, dtype=X.dtype)
+        rhs = Xa.T @ (w * z)
+        return jnp.linalg.solve(gram, rhs), 0.0
+
+    import jax.lax as lax
+
+    @jax.jit
+    def run(beta):
+        beta, _ = lax.scan(irls_step, beta, None, length=iters)
+        return beta
+
+    beta0 = jnp.zeros(p + 1, jnp.float32)
+    run(beta0).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    run(beta0).block_until_ready()
+    dt = time.perf_counter() - t0
+    return n_rows * iters / dt
+
+
+def main():
+    try:
+        from h2o3_tpu.bench import run_flagship  # GBM bench once trees land
+
+        value, metric = run_flagship()
+    except Exception:
+        value, metric = bench_glm(), METRIC
+    vs = value / RECORDED if RECORDED else 1.0
+    print(json.dumps({"metric": metric, "value": round(value, 1),
+                      "unit": "rows/sec/chip", "vs_baseline": round(vs, 3)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -228,9 +228,11 @@ def _make_family(name: str, params: dict) -> _Family:
 # ---------------------------------------------------------------------------
 
 @functools.partial(__import__("jax").jit, static_argnames=("expand", "famname", "linkname",
-                                                           "max_iter", "var_power", "link_power"))
+                                                           "max_iter", "var_power", "link_power",
+                                                           "with_intercept", "non_negative"))
 def _irls_fit(arrays, y, w, offset, beta0, lam_l2, lam_l1, beta_eps, *, expand,
-              famname, linkname, max_iter, var_power=1.5, link_power=0.0):
+              famname, linkname, max_iter, var_power=1.5, link_power=0.0,
+              with_intercept=True, non_negative=False):
     """Full IRLS in one XLA program (lax.while_loop). Returns (beta, iters,
     deviance). X stays row-sharded; Gram/XtWz reduce over shards via the
     partitioner's all-reduce (the GLMIterationTask analog)."""
@@ -243,7 +245,9 @@ def _irls_fit(arrays, y, w, offset, beta0, lam_l2, lam_l1, beta_eps, *, expand,
 
     X = expand(*arrays)                       # (N, p) row-sharded
     N, p = X.shape
-    ones = jnp.ones((N, 1), X.dtype)
+    # intercept=False: zeroed ones-column ⇒ q[p]=0 and the ridge eps pins
+    # beta[p] to exactly 0, so downstream scoring needs no special case
+    ones = jnp.full((N, 1), 1.0 if with_intercept else 0.0, X.dtype)
     Xi = jnp.concatenate([X, ones], axis=1)   # intercept column last
     pi = p + 1
 
@@ -286,6 +290,10 @@ def _irls_fit(arrays, y, w, offset, beta0, lam_l2, lam_l1, beta_eps, *, expand,
             lambda: admm_solve(Greg, q, lam_l1),
             lambda: jsl.cho_solve(
                 jsl.cho_factor(Greg + 1e-7 * jnp.eye(pi, dtype=G.dtype)), q))
+        if non_negative:
+            # projected Newton: clip coefficients (not intercept) at 0 each
+            # sweep — the reference enforces the same bound inside ADMM
+            beta_new = beta_new.at[:p].set(jnp.maximum(beta_new[:p], 0.0))
         dev = dev_of(beta_new)
         return beta_new, it + 1, beta, dev
 
@@ -295,8 +303,9 @@ def _irls_fit(arrays, y, w, offset, beta0, lam_l2, lam_l1, beta_eps, *, expand,
         return (it < max_iter) & (delta > beta_eps)
 
     mu0 = fam.init_mu(y, w)
+    init_icpt = jnp.mean(link(mu0)) if with_intercept else 0.0
     b_init = jnp.where(jnp.any(beta0 != 0), beta0,
-                       jnp.zeros(pi).at[p].set(jnp.mean(link(mu0))))
+                       jnp.zeros(pi).at[p].set(init_icpt))
     beta, iters, _, dev = jax.lax.while_loop(
         cond, body, (b_init, jnp.int32(0), b_init + 1e3, jnp.float32(0)))
     return beta, iters, dev_of(beta)
@@ -458,6 +467,24 @@ class GLM(ModelBuilder):
 
         fam = self._resolve_family(train)
         resp = self.params["response_column"]
+        # validate BEFORE constructing the model (Keyed.__init__ installs it
+        # into the DKV; failing later would leak a half-built key)
+        resp_dom = train.col(resp).domain if train.col(resp).is_categorical else None
+        if (fam in ("binomial", "quasibinomial", "fractionalbinomial")
+                and resp_dom is not None and len(resp_dom) > 2):
+            raise ValueError(
+                f"family={fam} requires a binary response; "
+                f"{resp!r} has {len(resp_dom)} levels (use family='multinomial')")
+        lam_pre = self.params.get("lambda_")
+        if isinstance(lam_pre, (list, tuple)):
+            lam_pre = lam_pre[0]
+        if self.params.get("compute_p_values") and (
+                self.params.get("lambda_search") or (lam_pre or 0) != 0):
+            # reference forbids p-values on penalized fits (GLM.java
+            # compute_p_values validation): shrunken coefficients make the
+            # information-matrix std errors statistically invalid
+            raise ValueError("compute_p_values requires lambda=0 and no lambda_search")
+
         model = GLMModel(parms=dict(self.params))
         self._init_output(model, train)
         if fam == "multinomial":
@@ -468,12 +495,14 @@ class GLM(ModelBuilder):
             model._output.model_category = ModelCategory.Binomial
             if model._output.response_domain is None:
                 model._output.response_domain = ["0", "1"]
+        # no intercept ⇒ keep ALL factor levels, else the dropped baseline
+        # level is unfittable (GLM.java:540 forces useAllFactorLevels)
         dinfo = DataInfo(train, response=resp,
                          ignored=self.params.get("ignored_columns") or (),
                          weights=self.params.get("weights_column"),
                          offset=self.params.get("offset_column"),
                          standardize=bool(self.params.get("standardize", True)),
-                         use_all_factor_levels=False)
+                         use_all_factor_levels=not bool(self.params.get("intercept", True)))
         model.dinfo = dinfo
 
         cols = dinfo.cols(train)
@@ -532,7 +561,9 @@ class GLM(ModelBuilder):
                              expand=dinfo.expand, famname=fam, linkname=linkname,
                              max_iter=max_iter,
                              var_power=float(self.params["tweedie_variance_power"]),
-                             link_power=model.link_power)
+                             link_power=model.link_power,
+                             with_intercept=bool(self.params.get("intercept", True)),
+                             non_negative=bool(self.params.get("non_negative", False)))
 
         pi = dinfo.fullN + 1
         b0 = jnp.zeros(pi, jnp.float32)
@@ -566,12 +597,26 @@ class GLM(ModelBuilder):
 
         model.beta = beta
         model.residual_deviance = float(dev)
+        # regression metrics report mean_residual_deviance in the family's
+        # deviance, not MSE (hex/ModelMetricsRegression); Tweedie only where
+        # the shared Distribution supports the variance power
+        tvp = float(self.params["tweedie_variance_power"])
+        if fam in ("gaussian", "poisson", "gamma") or (fam == "tweedie" and 1.0 < tvp < 2.0):
+            from h2o3_tpu.models.distribution import get_distribution
+
+            model._distribution = get_distribution(fam, tweedie_power=tvp)
         # null deviance: intercept-only model — for every supported family the
         # MLE of a constant mean is the weighted response mean, so this is a
         # closed form (GLMModel nullDeviance), no second fit needed
         family = _make_family(fam, self.params)
-        ybar = jnp.sum(wts * y) / jnp.maximum(jnp.sum(wts), EPS)
-        model.null_deviance = float(jnp.sum(family.deviance(wts, y, jnp.broadcast_to(ybar, y.shape))))
+        if bool(self.params.get("intercept", True)):
+            null_mu = jnp.sum(wts * y) / jnp.maximum(jnp.sum(wts), EPS)
+        else:
+            # no-intercept null model predicts linkInv(0) (GLM.java:609 _ymu)
+            _, _linkinv, _ = _Link.of(linkname, model.link_power)
+            null_mu = _linkinv(jnp.float32(0.0))
+        model.null_deviance = float(jnp.sum(family.deviance(
+            wts, y, jnp.broadcast_to(null_mu, y.shape))))
         rank = int(np.sum(np.abs(np.asarray(beta)) > 1e-10))
         model.aic = model.residual_deviance + 2 * rank
 

@@ -61,6 +61,16 @@ class ModelOutput:
         return self.model_category in (ModelCategory.Binomial, ModelCategory.Multinomial)
 
 
+def _remap_to_domain(data, from_dom: List[str], to_dom: List[str]):
+    """Gather categorical codes from one domain's numbering onto another's;
+    levels absent from to_dom (and NAs) map to NA_CAT."""
+    import jax.numpy as jnp
+
+    lut_map = {v: i for i, v in enumerate(to_dom)}
+    lut = np.array([lut_map.get(v, NA_CAT) for v in from_dom] or [NA_CAT], np.int32)
+    return jnp.where(data >= 0, jnp.take(jnp.asarray(lut), jnp.maximum(data, 0)), NA_CAT)
+
+
 class Model(Keyed):
     """Base trained model. Subclasses implement `_predict_raw(frame)` →
     device arrays and set `_output.model_category`."""
@@ -115,14 +125,9 @@ class Model(Keyed):
                 if test_dom == train_dom:
                     out.add(name, c)
                 else:
-                    lut_map = {v: i for i, v in enumerate(train_dom)}
-                    lut = np.array([lut_map.get(v, NA_CAT) for v in test_dom] or [NA_CAT],
-                                   np.int32)
                     codes = c.data if c.ctype == T_CAT else c.data.astype(jnp.int32)
-                    remapped = jnp.where(codes >= 0,
-                                         jnp.take(jnp.asarray(lut), jnp.maximum(codes, 0)),
-                                         NA_CAT)
-                    out.add(name, Column(remapped, T_CAT, n, domain=train_dom))
+                    out.add(name, Column(_remap_to_domain(codes, test_dom, train_dom),
+                                         T_CAT, n, domain=train_dom))
             else:
                 if c.ctype == T_CAT:
                     raise ValueError(f"column {name} was numeric in training, enum in test")
@@ -133,6 +138,16 @@ class Model(Keyed):
             if cn and cn in test and cn not in out:
                 out.add(cn, test.col(cn))
         return out
+
+    def _adapt_response(self, c: Column) -> Column:
+        """Remap a categorical response's codes onto the TRAINING response
+        domain (adaptTestForTrain handles the response too, Model.java:1052 —
+        a test frame may intern the same labels in a different order)."""
+        train_dom = self._output.response_domain
+        if train_dom is None or not c.is_categorical or (c.domain or []) == train_dom:
+            return c
+        return Column(_remap_to_domain(c.data, c.domain or [], train_dom),
+                      T_CAT, c.nrows, domain=list(train_dom))
 
     # -- public scoring (hex/Model.score) ---------------------------------
     def predict(self, frame: Frame, key: Optional[str] = None) -> Frame:
@@ -182,7 +197,7 @@ class Model(Keyed):
         cat = self._output.model_category
         if resp is None or resp not in frame:
             return None
-        y_col = frame.col(resp)
+        y_col = self._adapt_response(frame.col(resp))
         w = None
         wname = self._parms.get("weights_column")
         if wname and wname in frame:

@@ -155,8 +155,26 @@ class ModelBuilder:
             if scheme in ("auto", "random"):
                 rng = np.random.default_rng(self._seed())
                 assign = rng.integers(0, nfolds, n)
-            else:  # modulo
+            elif scheme == "stratified":
+                # per-class round-robin over shuffled rows, so every fold sees
+                # every response level (hex/ModelBuilder StratifiedAssignment)
+                rng = np.random.default_rng(self._seed())
+                resp = self.params.get("response_column")
+                if not resp or not train.col(resp).is_categorical:
+                    raise ValueError("fold_assignment='Stratified' requires a "
+                                     "categorical response")
+                y = train.col(resp).to_numpy()
+                assign = rng.integers(0, nfolds, n)  # NA responses: random fold
+                for cls in np.unique(y[y >= 0]):
+                    idx = np.nonzero(y == cls)[0]
+                    rng.shuffle(idx)
+                    # random start offset so fold 0 doesn't collect every
+                    # class's round-robin remainder
+                    assign[idx] = (np.arange(len(idx)) + rng.integers(nfolds)) % nfolds
+            elif scheme == "modulo":
                 assign = np.arange(n) % nfolds
+            else:
+                raise ValueError(f"unknown fold_assignment {scheme!r}")
             folds = list(range(nfolds))
         models, mets = [], []
         for fi, f in enumerate(folds):
