@@ -1,0 +1,5 @@
+"""Estimator alias (h2o-py name parity: estimators/random_forest.py)."""
+
+from h2o3_tpu.models.tree.drf import DRF, DRFModel  # noqa: F401
+
+H2ORandomForestEstimator = DRF

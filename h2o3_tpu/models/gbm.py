@@ -1,0 +1,5 @@
+"""Estimator alias (h2o-py name parity: estimators/gbm.py)."""
+
+from h2o3_tpu.models.tree.gbm import GBM, GBMModel  # noqa: F401
+
+H2OGradientBoostingEstimator = GBM

@@ -257,8 +257,12 @@ def _irls_fit(arrays, y, w, offset, beta0, lam_l2, lam_l1, beta_eps, *, expand,
         return jnp.sum(fam.deviance(w, y, mu))
 
     def admm_solve(G, q, l1, rho=1.0, sweeps=50):
-        """min ½βᵀGβ - qᵀβ + l1·|β|₁ (no penalty on intercept) via ADMM
-        (optimization/ADMM.java): cached Cholesky of G+ρI, jitted sweeps."""
+        """min ½βᵀGβ - qᵀβ + l1·|β|₁ (+ β≥0 when non_negative; no penalty or
+        bound on the intercept) via ADMM (optimization/ADMM.java — the
+        reference handles the non-negative bound inside the same ADMM):
+        cached Cholesky of G+ρI, jitted sweeps. Unlike a coordinate clip of
+        the Newton step, the projection INSIDE ADMM converges to the true
+        constrained optimum."""
         Grho = G + rho * jnp.eye(pi, dtype=G.dtype)
         cf = jsl.cho_factor(Grho)
         pen = jnp.concatenate([jnp.full(p, l1), jnp.zeros(1)])
@@ -267,6 +271,8 @@ def _irls_fit(arrays, y, w, offset, beta0, lam_l2, lam_l1, beta_eps, *, expand,
             z, u = carry
             b = jsl.cho_solve(cf, q + rho * (z - u))
             z2 = jnp.sign(b + u) * jnp.maximum(jnp.abs(b + u) - pen / rho, 0.0)
+            if non_negative:
+                z2 = z2.at[:p].set(jnp.maximum(z2[:p], 0.0))
             return (z2, u + b - z2), None
 
         (z, _), _ = jax.lax.scan(sweep, (jnp.zeros(pi, G.dtype), jnp.zeros(pi, G.dtype)),
@@ -285,15 +291,12 @@ def _irls_fit(arrays, y, w, offset, beta0, lam_l2, lam_l1, beta_eps, *, expand,
         G = Xi.T @ Xw / 1.0
         q = Xw.T @ z
         Greg = G + lam_l2 * jnp.diag(jnp.concatenate([jnp.ones(p), jnp.zeros(1)]))
+        use_admm = (lam_l1 > 0) | non_negative
         beta_new = jax.lax.cond(
-            lam_l1 > 0,
+            use_admm,
             lambda: admm_solve(Greg, q, lam_l1),
             lambda: jsl.cho_solve(
                 jsl.cho_factor(Greg + 1e-7 * jnp.eye(pi, dtype=G.dtype)), q))
-        if non_negative:
-            # projected Newton: clip coefficients (not intercept) at 0 each
-            # sweep — the reference enforces the same bound inside ADMM
-            beta_new = beta_new.at[:p].set(jnp.maximum(beta_new[:p], 0.0))
         dev = dev_of(beta_new)
         return beta_new, it + 1, beta, dev
 
@@ -495,14 +498,18 @@ class GLM(ModelBuilder):
             model._output.model_category = ModelCategory.Binomial
             if model._output.response_domain is None:
                 model._output.response_domain = ["0", "1"]
-        # no intercept ⇒ keep ALL factor levels, else the dropped baseline
-        # level is unfittable (GLM.java:540 forces useAllFactorLevels)
+        # no intercept ⇒ keep ALL factor levels (GLM.java:540 forces
+        # useAllFactorLevels) and fit in RAW space: mean-centering would pin
+        # the prediction to linkInv(0) at the feature MEANS, a meaningless
+        # constraint that also breaks coef() de-standardization
+        with_icpt = bool(self.params.get("intercept", True))
         dinfo = DataInfo(train, response=resp,
                          ignored=self.params.get("ignored_columns") or (),
                          weights=self.params.get("weights_column"),
                          offset=self.params.get("offset_column"),
-                         standardize=bool(self.params.get("standardize", True)),
-                         use_all_factor_levels=not bool(self.params.get("intercept", True)))
+                         standardize=(bool(self.params.get("standardize", True))
+                                      and with_icpt),
+                         use_all_factor_levels=not with_icpt)
         model.dinfo = dinfo
 
         cols = dinfo.cols(train)
@@ -529,6 +536,10 @@ class GLM(ModelBuilder):
         nobs = float(jnp.sum(wts))
 
         if fam == "multinomial":
+            if not bool(self.params.get("intercept", True)) or \
+                    bool(self.params.get("non_negative")):
+                raise ValueError("intercept=False / non_negative are not "
+                                 "supported for family='multinomial'")
             K = len(y_col.domain or [])
             lam = 0.0 if lam is None else float(lam)
             B0 = jnp.zeros((dinfo.fullN + 1, K), jnp.float32)

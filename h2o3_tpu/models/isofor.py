@@ -1,0 +1,5 @@
+"""Estimator alias (h2o-py name parity: estimators/isolation_forest.py)."""
+
+from h2o3_tpu.models.tree.isofor import IsolationForest, IsolationForestModel  # noqa: F401
+
+H2OIsolationForestEstimator = IsolationForest

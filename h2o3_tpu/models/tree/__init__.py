@@ -1,0 +1,2 @@
+from h2o3_tpu.models.tree.binning import BinSpec
+from h2o3_tpu.models.tree.compressed import CompressedForest

@@ -1,0 +1,233 @@
+"""Compressed forest: stacked tree arrays + vectorized device scoring.
+
+Reference: hex/tree/CompressedTree.java — trees serialized to flat byte
+arrays, scored row-at-a-time by walking the bytes (score0); genmodel
+mirrors the walk for MOJOs.
+
+TPU-native design: the forest IS a pytree of dense arrays shaped
+(n_trees, max_nodes): feat / thresh_bin / na_left / left / right /
+leaf_val, plus one shared categorical-subset LUT. Scoring every row
+through every tree is a lax.scan over trees of a lax.fori_loop pointer
+chase — all rows advance one level per step in lockstep (SIMD traversal),
+bins replace raw feature comparisons so test data is binned once with the
+training edges and the traversal is pure int compares. Row-sharded input
+⇒ embarrassingly parallel over the mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import numpy as np
+
+
+class CompressedForest:
+    """Stacked per-node arrays; construction from HostTrees in builder code.
+
+    Arrays (T, M): feat int32 (-1 leaf), thresh_bin int32, na_left bool,
+    left/right int32, leaf_val f32, cat_split int32 (-1 numeric, else row in
+    cat_table). cat_table (C, maxB) bool. tree_class (T,) int32 for
+    multinomial tree→class mapping. na_bins (F,) int32 = NA bin per feature.
+    """
+
+    def __init__(self, feat, thresh_bin, na_left, left, right, leaf_val,
+                 cat_split, cat_table, tree_class, na_bins, max_depth: int,
+                 init_f: float = 0.0, nclasses: int = 1):
+        self.feat = feat
+        self.thresh_bin = thresh_bin
+        self.na_left = na_left
+        self.left = left
+        self.right = right
+        self.leaf_val = leaf_val
+        self.cat_split = cat_split
+        self.cat_table = cat_table
+        self.tree_class = tree_class
+        self.na_bins = na_bins
+        self.max_depth = int(max_depth)
+        self.init_f = float(init_f)
+        self.nclasses = int(nclasses)
+        self.init_class = None        # (K,) per-class prior margins (multinomial)
+
+    @property
+    def n_trees(self) -> int:
+        return int(self.feat.shape[0])
+
+    @staticmethod
+    def from_host_trees(trees: List, spec, *, tree_class=None,
+                        max_depth: int, init_f: float = 0.0, nclasses: int = 1
+                        ) -> "CompressedForest":
+        T = len(trees)
+        M = max(max(len(t.nodes) for t in trees), 1)
+        feat = np.full((T, M), -1, np.int32)
+        thresh = np.zeros((T, M), np.int32)
+        na_left = np.zeros((T, M), bool)
+        left = np.zeros((T, M), np.int32)
+        right = np.zeros((T, M), np.int32)
+        leaf_val = np.zeros((T, M), np.float32)
+        cat_split = np.full((T, M), -1, np.int32)
+        cat_rows = []
+        maxB = int(spec.nbins.max())
+        for ti, tree in enumerate(trees):
+            for n in tree.nodes:
+                if n.split is None:
+                    leaf_val[ti, n.nid] = n.leaf_value
+                    continue
+                s = n.split
+                feat[ti, n.nid] = s.feat
+                na_left[ti, n.nid] = s.na_left
+                left[ti, n.nid] = n.left
+                right[ti, n.nid] = n.right
+                if s.is_cat:
+                    row = np.zeros(maxB, bool)
+                    row[: len(s.left_bins)] = s.left_bins
+                    cat_split[ti, n.nid] = len(cat_rows)
+                    cat_rows.append(row)
+                else:
+                    thresh[ti, n.nid] = s.thresh_bin
+        cat_table = (np.stack(cat_rows) if cat_rows
+                     else np.zeros((1, maxB), bool))
+        tc = (np.asarray(tree_class, np.int32) if tree_class is not None
+              else np.zeros(T, np.int32))
+        return CompressedForest(feat, thresh, na_left, left, right, leaf_val,
+                                cat_split, cat_table, tc,
+                                (spec.nbins - 1).astype(np.int32),
+                                max_depth=max_depth, init_f=init_f,
+                                nclasses=nclasses)
+
+    # -- device scoring ----------------------------------------------------
+    def arrays(self):
+        import jax.numpy as jnp
+
+        return tuple(jnp.asarray(a) for a in (
+            self.feat, self.thresh_bin, self.na_left, self.left, self.right,
+            self.leaf_val, self.cat_split, self.cat_table, self.tree_class,
+            self.na_bins))
+
+    def predict_binned(self, binned):
+        """binned (N, F) int32 → (N,) sums (regression/binomial margin) or
+        (N, K) per-class margins (multinomial)."""
+        import jax.numpy as jnp
+
+        fn = _traverse_fn(self.max_depth, self.nclasses)
+        out = fn(binned, *self.arrays())
+        if self.init_class is not None:
+            return out + jnp.asarray(self.init_class)[None, :]
+        return out + self.init_f
+
+    def leaf_index(self, binned):
+        """(N, T) leaf node id per tree (used by RuleFit/TreeSHAP/partial)."""
+        fn = _leaf_fn(self.max_depth)
+        return fn(binned, *self.arrays())
+
+
+@functools.lru_cache(maxsize=32)
+def _traverse_fn(max_depth: int, nclasses: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(binned, feat, thresh, na_left, left, right, leaf_val,
+            cat_split, cat_table, tree_class, na_bins):
+        N = binned.shape[0]
+        K = nclasses if nclasses > 2 else 1
+
+        def walk_one_tree(carry, tree):
+            acc = carry
+            tf, tt, tnl, tl, tr, tlv, tcs, tcls = tree
+
+            def step(_, node):
+                f = tf[node]
+                leaf = f < 0
+                fi = jnp.maximum(f, 0)
+                b = jnp.take_along_axis(binned, fi[:, None], axis=1)[:, 0]
+                is_na = b == na_bins[fi]
+                csid = tcs[node]
+                cat_left = cat_table[jnp.maximum(csid, 0),
+                                     jnp.minimum(b, cat_table.shape[1] - 1)]
+                go_left = jnp.where(csid >= 0, cat_left, b <= tt[node])
+                go_left = jnp.where(is_na, tnl[node], go_left)
+                nxt = jnp.where(go_left, tl[node], tr[node])
+                return jnp.where(leaf, node, nxt)
+
+            node = jax.lax.fori_loop(0, max_depth + 1, step,
+                                     jnp.zeros(N, jnp.int32))
+            contrib = tlv[node]
+            if K > 1:
+                acc = acc.at[:, tcls].add(contrib)
+            else:
+                acc = acc + contrib
+            return acc, None
+
+        acc0 = jnp.zeros((N, K), jnp.float32) if K > 1 else jnp.zeros(N, jnp.float32)
+        acc, _ = jax.lax.scan(
+            walk_one_tree, acc0,
+            (feat, thresh, na_left, left, right, leaf_val, cat_split, tree_class))
+        return acc
+
+    return run
+
+
+@functools.lru_cache(maxsize=8)
+def _leaf_fn(max_depth: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(binned, feat, thresh, na_left, left, right, leaf_val,
+            cat_split, cat_table, tree_class, na_bins):
+        N = binned.shape[0]
+
+        def walk(carry, tree):
+            tf, tt, tnl, tl, tr, tcs = tree
+
+            def step(_, node):
+                f = tf[node]
+                leaf = f < 0
+                fi = jnp.maximum(f, 0)
+                b = jnp.take_along_axis(binned, fi[:, None], axis=1)[:, 0]
+                is_na = b == na_bins[fi]
+                csid = tcs[node]
+                cat_left = cat_table[jnp.maximum(csid, 0),
+                                     jnp.minimum(b, cat_table.shape[1] - 1)]
+                go_left = jnp.where(csid >= 0, cat_left, b <= tt[node])
+                go_left = jnp.where(is_na, tnl[node], go_left)
+                return jnp.where(leaf, node, jnp.where(go_left, tl[node], tr[node]))
+
+            node = jax.lax.fori_loop(0, max_depth + 1, step, jnp.zeros(N, jnp.int32))
+            return carry, node
+
+        _, leaves = jax.lax.scan(
+            walk, None, (feat, thresh, na_left, left, right, cat_split))
+        return jnp.transpose(leaves)       # (N, T)
+
+    return run
+
+
+def forest_predict_fn():
+    """(fn, example_args) for __graft_entry__: the flagship forward step —
+    a random-but-structurally-real compressed forest traversal."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    T, depth, F, B, N = 50, 5, 32, 20, 1024
+    M = 2 ** (depth + 1) - 1
+    feat = np.full((T, M), -1, np.int32)
+    inner = M // 2
+    feat[:, :inner] = rng.integers(0, F, (T, inner))
+    thresh = rng.integers(0, B - 1, (T, M)).astype(np.int32)
+    left = np.zeros((T, M), np.int32)
+    right = np.zeros((T, M), np.int32)
+    for m in range(inner):
+        left[:, m], right[:, m] = 2 * m + 1, 2 * m + 2
+    forest = CompressedForest(
+        feat, thresh, np.zeros((T, M), bool), left, right,
+        rng.standard_normal((T, M)).astype(np.float32),
+        np.full((T, M), -1, np.int32), np.zeros((1, B), bool),
+        np.zeros(T, np.int32), np.full(F, B - 1, np.int32), max_depth=depth)
+    binned = jnp.asarray(rng.integers(0, B - 1, (N, F)), jnp.int32)
+
+    def fwd(binned):
+        return forest.predict_binned(binned)
+
+    return fwd, (binned,)

@@ -1,0 +1,156 @@
+"""DRF — distributed random forest.
+
+Reference: hex/tree/drf/DRF.java — SharedTree with per-tree row
+subsampling (sample_rate 0.632), per-node feature subsampling (mtries),
+leaf = node mean, ensemble = average over trees, OOB scoring
+(doOOBScoring).
+
+TPU-native: trees are grown on the raw response (no boosting); sampled-out
+rows keep routing with w=0 so their leaf assignments give OOB predictions
+with no extra traversal. Averaging happens by scaling each tree's leaf
+values by 1/ntrees at compression time, so scoring reuses the same summed
+traversal as GBM.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from h2o3_tpu.models.distribution import auto_distribution, get_distribution
+from h2o3_tpu.models.model import ModelCategory
+from h2o3_tpu.models.model_builder import register
+from h2o3_tpu.models.tree.compressed import CompressedForest
+from h2o3_tpu.models.tree.histogram import leaf_stats
+from h2o3_tpu.models.tree.shared_tree import SharedTree, SharedTreeModel, grow_tree
+
+
+class DRFModel(SharedTreeModel):
+    algo_name = "drf"
+
+    def _predict_raw(self, frame):
+        import jax.numpy as jnp
+
+        f = self._margin(frame)      # mean leaf response across trees
+        cat = self._output.model_category
+        if cat == ModelCategory.Binomial:
+            p = jnp.clip(f, 0.0, 1.0)
+            return {"probs": jnp.stack([1 - p, p], axis=-1)}
+        if cat == ModelCategory.Multinomial:
+            p = jnp.clip(f, 0.0, 1.0)
+            p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-12)
+            return {"probs": p}
+        return {"value": f}
+
+
+@register
+class DRF(SharedTree):
+    algo_name = "drf"
+    model_class = DRFModel
+
+    @classmethod
+    def default_params(cls):
+        p = super().default_params()
+        p.update({
+            "ntrees": 50, "max_depth": 20, "min_rows": 1.0,
+            "sample_rate": 0.632, "mtries": -1,
+            "binomial_double_trees": False,
+        })
+        return p
+
+    def _mtries(self, F: int, classification: bool) -> int:
+        m = int(self.params.get("mtries", -1) or -1)
+        if m > 0:
+            return min(m, F)
+        # DRF.java defaults: sqrt(p) classification, p/3 regression
+        return max(1, int(np.sqrt(F)) if classification else F // 3)
+
+    def _fit_single(self, model, binned, y, w, offset, spec, dist, rng, ntrees):
+        """Bagged trees on the raw response: leaf = weighted mean of y."""
+        import jax.numpy as jnp
+
+        N = binned.shape[0]
+        classification = model._output.model_category == ModelCategory.Binomial
+        mtries = self._mtries(spec.F, classification)
+
+        def feat_mask_fn(S):
+            # fresh random feature subset PER NODE (DTree mtries semantics)
+            mask = np.zeros((S, spec.F), bool)
+            for s in range(S):
+                mask[s, rng.choice(spec.F, size=mtries, replace=False)] = True
+            return mask
+
+        max_depth = int(self.params["max_depth"])
+        trees, varimp, history = [], {}, []
+        # OOB accumulation: sum of oob predictions and counts per row
+        oob_sum = jnp.zeros(N, jnp.float32)
+        oob_cnt = jnp.zeros(N, jnp.float32)
+        for t in range(ntrees):
+            mask, w_t = self._sample_rows(rng, N, w)
+            tree, row_leaf = grow_tree(
+                binned, w_t, y, spec, max_depth=max_depth,
+                min_rows=float(self.params["min_rows"]),
+                min_split_improvement=float(self.params["min_split_improvement"]),
+                feat_mask_fn=feat_mask_fn)
+            ln, ld = leaf_stats(row_leaf, w_t * y, w_t, tree.n_leaves)
+            mean = np.where(ld > 1e-12, ln / np.maximum(ld, 1e-12), 0.0)
+            tree.set_leaf_values(mean / ntrees)   # scoring sums ⇒ average
+            trees.append(tree)
+            self._accumulate_varimp(tree, varimp, model)
+            if mask is not None:
+                leaf_arr = jnp.asarray(mean.astype(np.float32))
+                pred_t = jnp.where(row_leaf >= 0,
+                                   leaf_arr[jnp.maximum(row_leaf, 0)], 0.0)
+                oob = (~mask) & (w > 0)
+                oob_sum = oob_sum + jnp.where(oob, pred_t, 0.0)
+                oob_cnt = oob_cnt + oob.astype(jnp.float32)
+            if self.job:
+                self.job.update(progress=(t + 1) / ntrees, msg=f"tree {t + 1}")
+        f = jnp.where(oob_cnt > 0, oob_sum / jnp.maximum(oob_cnt, 1.0), 0.0)
+        model._output.scoring_history = history
+        self._finalize_varimp(model, varimp)
+        forest = CompressedForest.from_host_trees(
+            trees, spec, max_depth=max_depth, init_f=0.0, nclasses=1)
+        return forest, f
+
+    def _fit_multinomial(self, model, binned, y, w, offset, spec, K, rng, ntrees):
+        """One tree per class per iteration voting class indicator means."""
+        import jax
+        import jax.numpy as jnp
+
+        N = binned.shape[0]
+        yi = y.astype(jnp.int32)
+        onehot = jax.nn.one_hot(yi, K, dtype=jnp.float32)
+        mtries = self._mtries(spec.F, True)
+
+        def feat_mask_fn(S):
+            mask = np.zeros((S, spec.F), bool)
+            for s in range(S):
+                mask[s, rng.choice(spec.F, size=mtries, replace=False)] = True
+            return mask
+
+        max_depth = int(self.params["max_depth"])
+        trees, tree_class, varimp = [], [], {}
+        for t in range(ntrees):
+            mask, w_t = self._sample_rows(rng, N, w)
+            for k in range(K):
+                tree, row_leaf = grow_tree(
+                    binned, w_t, onehot[:, k], spec, max_depth=max_depth,
+                    min_rows=float(self.params["min_rows"]),
+                    min_split_improvement=float(self.params["min_split_improvement"]),
+                    feat_mask_fn=feat_mask_fn)
+                ln, ld = leaf_stats(row_leaf, w_t * onehot[:, k], w_t, tree.n_leaves)
+                mean = np.where(ld > 1e-12, ln / np.maximum(ld, 1e-12), 0.0)
+                tree.set_leaf_values(mean / ntrees)
+                trees.append(tree)
+                tree_class.append(k)
+                self._accumulate_varimp(tree, varimp, model)
+            if self.job:
+                self.job.update(progress=(t + 1) / ntrees, msg=f"iter {t + 1}")
+        self._finalize_varimp(model, varimp)
+        forest = CompressedForest.from_host_trees(
+            trees, spec, tree_class=tree_class, max_depth=max_depth,
+            nclasses=K)
+        f = None
+        return forest, f

@@ -1,0 +1,41 @@
+"""GBM — gradient boosting machine.
+
+Reference: hex/tree/gbm/GBM.java — buildNextKTrees (:365), growTrees
+(:484), leaf GammaPass (:416), fitBestConstants (:419-430), learn_rate
+annealing via learn_rate_annealing.
+
+The whole algorithm is SharedTree + distribution-specific residuals/leaf
+Newton steps (distribution.py); this class only contributes the GBM
+parameter surface and the learning-rate schedule.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from h2o3_tpu.models.model import ModelCategory
+from h2o3_tpu.models.model_builder import register
+from h2o3_tpu.models.tree.shared_tree import SharedTree, SharedTreeModel
+
+
+class GBMModel(SharedTreeModel):
+    algo_name = "gbm"
+
+
+@register
+class GBM(SharedTree):
+    algo_name = "gbm"
+    model_class = GBMModel
+
+    @classmethod
+    def default_params(cls):
+        p = super().default_params()
+        p.update({
+            "learn_rate": 0.1, "learn_rate_annealing": 1.0,
+            "sample_rate": 1.0, "col_sample_rate": 1.0,
+            "max_abs_leafnode_pred": 1e30,
+        })
+        return p
+
+    def _update_f_lr(self) -> float:
+        return float(self.params.get("learn_rate", 0.1))

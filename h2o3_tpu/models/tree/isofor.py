@@ -1,0 +1,183 @@
+"""Isolation Forest — anomaly detection via random isolation trees.
+
+Reference: hex/tree/isofor/IsolationForest.java — SharedTree subclass that
+splits on a RANDOM feature at a RANDOM threshold (no histogramming of
+response), scores by average path length (tree/isofor/PathTracker.java),
+anomaly score = 2^(-E[h]/c(sample_size)).
+
+TPU-native: the count histogram (one scatter-add) gives each node's row
+count and occupied bin range; the host picks the random (feature, bin)
+split; routing reuses the shared level-router. Leaves store
+depth + c(count) so the standard summed traversal returns total path
+length directly.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from h2o3_tpu.models.model import ModelCategory
+from h2o3_tpu.models.model_builder import register
+from h2o3_tpu.models.tree.compressed import CompressedForest
+from h2o3_tpu.models.tree.dtree import HostTree, Split, left_table_for
+from h2o3_tpu.models.tree.histogram import build_histogram, route_rows
+from h2o3_tpu.models.tree.shared_tree import SharedTree, SharedTreeModel
+
+
+def _avg_path(n: float) -> float:
+    """c(n): average unsuccessful-search path length in a BST of n nodes."""
+    if n <= 1:
+        return 0.0
+    h = np.log(n - 1) + 0.5772156649
+    return 2.0 * h - 2.0 * (n - 1) / n
+
+
+class IsolationForestModel(SharedTreeModel):
+    algo_name = "isolationforest"
+
+    def _predict_raw(self, frame):
+        import jax.numpy as jnp
+
+        total = self._margin(frame)          # Σ path lengths over trees
+        T = self.forest.n_trees
+        mean_len = total / T
+        c = max(self._parms.get("_cnorm", 1.0), 1e-9)
+        score = jnp.exp2(-mean_len / c)
+        return {"score": score, "mean_length": mean_len}
+
+
+@register
+class IsolationForest(SharedTree):
+    algo_name = "isolationforest"
+    model_class = IsolationForestModel
+    supervised = False
+
+    @classmethod
+    def default_params(cls):
+        p = super().default_params()
+        p.update({
+            "ntrees": 50, "max_depth": 8, "sample_size": 256,
+            "sample_rate": -1.0, "mtries": -1,
+        })
+        return p
+
+    def _fit(self, train):
+        import jax.numpy as jnp
+
+        model = IsolationForestModel(parms=dict(self.params))
+        out = self._init_output(model, train)
+        out.model_category = ModelCategory.AnomalyDetection
+
+        from h2o3_tpu.models.tree.binning import BinSpec
+
+        spec = BinSpec.build(train, out.names,
+                             nbins=max(int(self.params["nbins"]), 64),
+                             nbins_cats=int(self.params["nbins_cats"]),
+                             strategy="uniform")
+        model.spec = spec
+        binned = spec.bin_columns(train)
+        N = binned.shape[0]
+        n_real = train.nrows
+        rng = np.random.default_rng(self._seed())
+
+        rate = float(self.params.get("sample_rate", -1.0) or -1.0)
+        sample_size = int(self.params.get("sample_size", 256))
+        if rate > 0:
+            sample_size = max(int(rate * n_real), 2)
+        sample_size = min(sample_size, n_real)
+
+        ntrees = int(self.params["ntrees"])
+        max_depth = int(self.params["max_depth"])
+        trees: List[HostTree] = []
+        valid = np.zeros(N, bool)
+        valid[:n_real] = True          # pad rows never sampled
+        for t in range(ntrees):
+            pick = rng.choice(n_real, size=sample_size, replace=False)
+            w = np.zeros(N, np.float32)
+            w[pick] = 1.0
+            tree = self._grow_random_tree(binned, jnp.asarray(w), spec,
+                                          max_depth, rng)
+            trees.append(tree)
+            if self.job:
+                self.job.update(progress=(t + 1) / ntrees, msg=f"tree {t + 1}")
+
+        model._parms["_cnorm"] = _avg_path(sample_size)
+        model.forest = CompressedForest.from_host_trees(
+            trees, spec, max_depth=max_depth, init_f=0.0, nclasses=1)
+        return model
+
+    def _grow_random_tree(self, binned, w, spec, max_depth, rng) -> HostTree:
+        import jax.numpy as jnp
+
+        N = binned.shape[0]
+        tree = HostTree()
+        row_node = jnp.where(w > 0, 0, -1).astype(jnp.int32)
+        row_leaf = jnp.full(N, -1, jnp.int32)
+        slots = [0]
+        zeros = jnp.zeros(N, jnp.float32)
+        counts = {0: None}
+        for depth in range(max_depth + 1):
+            if not slots:
+                break
+            S = len(slots)
+            hist = build_histogram(binned, row_node, w, zeros, spec, S)
+            splits = [None] * S
+            for s in range(S):
+                nid = slots[s]
+                o0, B0 = int(spec.offsets[0]), int(spec.nbins[0])
+                cnt = float(hist[s, o0:o0 + B0, 0].sum())
+                tree.nodes[nid].weight = cnt
+                if depth == max_depth or cnt <= 1:
+                    continue
+                # random feature with >1 occupied value bin; few retries
+                for _ in range(5):
+                    f = int(rng.integers(spec.F))
+                    o, B = int(spec.offsets[f]), int(spec.nbins[f])
+                    occ = np.nonzero(hist[s, o:o + B - 1, 0] > 0)[0]
+                    if len(occ) >= 2:
+                        tbin = int(rng.integers(occ[0], occ[-1]))
+                        nw = float(hist[s, o:o + tbin + 1, 0].sum())
+                        splits[s] = Split(f, bool(spec.is_cat[f]), tbin,
+                                          self._cat_bins(spec, f, tbin),
+                                          bool(rng.random() < 0.5), 1.0,
+                                          (nw, 0.0), (cnt - nw, 0.0))
+                        break
+            split_feat = np.full(S, -1, np.int32)
+            left_slot = np.full(S, -1, np.int32)
+            right_slot = np.full(S, -1, np.int32)
+            leaf_id = np.full(S, -1, np.int32)
+            next_slots = []
+            for s, sp in enumerate(splits):
+                nid = slots[s]
+                node = tree.nodes[nid]
+                if sp is None:
+                    lid = tree.finalize_leaf(nid, node.weight, 0.0)
+                    leaf_id[s] = lid
+                    node.leaf_value = depth + _avg_path(node.weight)
+                    continue
+                node.split = sp
+                split_feat[s] = sp.feat
+                node.left = tree.new_node(depth + 1)
+                node.right = tree.new_node(depth + 1)
+                left_slot[s] = len(next_slots)
+                next_slots.append(node.left)
+                right_slot[s] = len(next_slots)
+                next_slots.append(node.right)
+            lt = left_table_for(splits, spec, int(spec.nbins.max()))
+            row_node, row_leaf = route_rows(
+                binned, row_node, row_leaf, split_feat=split_feat,
+                left_table=lt, left_slot=left_slot, right_slot=right_slot,
+                leaf_id=leaf_id)
+            slots = next_slots
+        return tree
+
+    @staticmethod
+    def _cat_bins(spec, f, tbin):
+        if not spec.is_cat[f]:
+            return None
+        nb = int(spec.nbins[f]) - 1
+        left = np.zeros(nb, bool)
+        left[: tbin + 1] = True
+        return left

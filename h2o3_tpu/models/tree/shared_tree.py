@@ -1,0 +1,390 @@
+"""SharedTree: the common driver for GBM / DRF / IsolationForest.
+
+Reference: hex/tree/SharedTree.java:29 — Driver.computeImpl (:187) loops
+scoreAndBuildTrees (:439): per tree-level a distributed histogram build
+(ScoreBuildHistogram2) then host-side best-split decisions (DTree), with
+early stopping via ScoreKeeper.
+
+TPU-native design: the per-level loop alternates ONE device program
+(scatter-add histogram + psum, histogram.py) with microseconds of host
+numpy (split search, dtree.py), then ONE device program routing every row
+to its next node (route_rows). Active nodes are renumbered densely per
+level (padded to powers of two so only O(log depth) programs compile).
+Row→leaf assignments stay on device for the whole tree; the GammaPass leaf
+Newton step is a segment-sum (leaf_stats). Sampled-out rows carry w=0 in
+the histogram but keep routing (OOB scoring reads their leaves for free).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.models.data_info import DataInfo
+from h2o3_tpu.models.distribution import get_distribution, auto_distribution
+from h2o3_tpu.models.model import Model, ModelCategory
+from h2o3_tpu.models.model_builder import ModelBuilder, register
+from h2o3_tpu.models.tree.binning import BinSpec
+from h2o3_tpu.models.tree.compressed import CompressedForest
+from h2o3_tpu.models.tree.dtree import (HostTree, Split, find_best_splits,
+                                        left_table_for)
+from h2o3_tpu.models.tree.histogram import (build_histogram, leaf_stats,
+                                            route_rows)
+
+
+def grow_tree(binned, hist_w, hist_y, spec, *, max_depth: int, min_rows: float,
+              min_split_improvement: float, row_active=None,
+              feat_mask_fn=None, rng: Optional[np.random.Generator] = None):
+    """Grow one tree level-wise. Returns (HostTree, row_leaf device array).
+
+    hist_w/hist_y: (N,) device — histogram weight and target (residual).
+    row_active: optional (N,) device bool — rows participating (sampling).
+    feat_mask_fn: fn(n_slots) -> (S, F) bool for per-node feature sampling.
+    """
+    import jax.numpy as jnp
+
+    N = binned.shape[0]
+    tree = HostTree()
+    row_node = jnp.zeros(N, jnp.int32)
+    if row_active is not None:
+        row_node = jnp.where(row_active, row_node, -1)
+    row_leaf = jnp.full(N, -1, jnp.int32)
+    slots = [0]                   # tree nid per active slot
+
+    for depth in range(max_depth + 1):
+        if not slots:
+            break
+        S = len(slots)
+        hist = build_histogram(binned, row_node, hist_w, hist_y, spec, S)
+        if depth == 0:
+            o, B = int(spec.offsets[0]), int(spec.nbins[0])
+            tree.nodes[0].weight = float(hist[0, o:o + B, 0].sum())
+            wy = float(hist[0, o:o + B, 1].sum())
+            tree.nodes[0].pred = wy / max(tree.nodes[0].weight, 1e-12)
+        if depth == max_depth:
+            splits = [None] * S
+        else:
+            feat_mask = feat_mask_fn(S) if feat_mask_fn else None
+            splits = find_best_splits(hist, spec, min_rows=min_rows,
+                                      min_split_improvement=min_split_improvement,
+                                      feat_mask=feat_mask)
+        split_feat = np.full(S, -1, np.int32)
+        left_slot = np.full(S, -1, np.int32)
+        right_slot = np.full(S, -1, np.int32)
+        leaf_id = np.full(S, -1, np.int32)
+        next_slots: List[int] = []
+        for s, sp in enumerate(splits):
+            nid = slots[s]
+            node = tree.nodes[nid]
+            if sp is None:
+                leaf_id[s] = tree.finalize_leaf(nid, node.weight, node.pred)
+                continue
+            node.split = sp
+            split_feat[s] = sp.feat
+            node.left = tree.new_node(depth + 1)
+            node.right = tree.new_node(depth + 1)
+            lw, lwy = sp.left_stats
+            rw, rwy = sp.right_stats
+            tree.nodes[node.left].weight = float(lw)
+            tree.nodes[node.left].pred = float(lwy) / max(float(lw), 1e-12)
+            tree.nodes[node.right].weight = float(rw)
+            tree.nodes[node.right].pred = float(rwy) / max(float(rw), 1e-12)
+            left_slot[s] = len(next_slots)
+            next_slots.append(node.left)
+            right_slot[s] = len(next_slots)
+            next_slots.append(node.right)
+        maxB = int(spec.nbins.max())
+        lt = left_table_for(splits, spec, maxB)
+        row_node, row_leaf = route_rows(
+            binned, row_node, row_leaf, split_feat=split_feat, left_table=lt,
+            left_slot=left_slot, right_slot=right_slot, leaf_id=leaf_id)
+        slots = next_slots
+    return tree, row_leaf
+
+
+class SharedTreeModel(Model):
+    """Trained forest; scoring bins the (adapted) frame with the training
+    BinSpec then runs the lockstep traversal."""
+
+    def __init__(self, parms=None):
+        super().__init__(parms=parms)
+        self.forest: Optional[CompressedForest] = None
+        self.spec: Optional[BinSpec] = None
+        self._distribution = None
+
+    def _margin(self, frame: Frame):
+        binned = self.spec.bin_columns(frame)
+        return self.forest.predict_binned(binned)
+
+    def _predict_raw(self, frame: Frame):
+        import jax.numpy as jnp
+
+        f = self._margin(frame)
+        cat = self._output.model_category
+        if cat == ModelCategory.Binomial:
+            p = self._distribution.linkinv(f)
+            return {"probs": jnp.stack([1 - p, p], axis=-1)}
+        if cat == ModelCategory.Multinomial:
+            import jax
+
+            return {"probs": jax.nn.softmax(f, axis=-1)}
+        if cat == ModelCategory.AnomalyDetection:
+            return {"score": f}
+        if self._distribution is not None:
+            return {"value": self._distribution.linkinv(f)}
+        return {"value": f}
+
+
+class SharedTree(ModelBuilder):
+    """Base builder: binning, sampling, tree loop, scoring history, early
+    stopping, variable importances."""
+
+    model_class = SharedTreeModel
+
+    @classmethod
+    def default_params(cls):
+        p = super().default_params()
+        p.update({
+            "ntrees": 50, "max_depth": 5, "min_rows": 10.0,
+            "nbins": 20, "nbins_cats": 1024,
+            "min_split_improvement": 1e-5,
+            "sample_rate": 1.0, "col_sample_rate_per_tree": 1.0,
+            "score_each_iteration": False, "score_tree_interval": 0,
+            "calibrate_model": False, "distribution": "AUTO",
+            "tweedie_power": 1.5, "quantile_alpha": 0.5,
+            "huber_alpha": 0.9,
+        })
+        return p
+
+    # subclass hooks ------------------------------------------------------
+    def _leaf_num_den(self, w, y, z, f, dist):
+        """Device (num, den) rows for the leaf-value segment sum."""
+        return dist.gamma_num(w, y, z, f), dist.gamma_denom(w, y, z, f)
+
+    def _update_f_lr(self) -> float:
+        return 1.0
+
+    # driver --------------------------------------------------------------
+    def _fit(self, train: Frame) -> SharedTreeModel:
+        import jax
+        import jax.numpy as jnp
+
+        model: SharedTreeModel = self.model_class(parms=dict(self.params))
+        out = self._init_output(model, train)
+        resp = self.params["response_column"]
+        y_col = train.col(resp)
+        nclasses = out.nclasses
+        dist_name = (self.params.get("distribution") or "AUTO").lower()
+        if dist_name == "auto":
+            dist_name = auto_distribution(y_col.ctype, nclasses)
+        multinomial = dist_name == "multinomial"
+        dist = get_distribution(dist_name,
+                                tweedie_power=float(self.params["tweedie_power"]),
+                                quantile_alpha=float(self.params["quantile_alpha"]))
+        model._distribution = dist
+
+        spec = BinSpec.build(train, out.names,
+                             nbins=int(self.params["nbins"]),
+                             nbins_cats=int(self.params["nbins_cats"]),
+                             seed=self._seed())
+        model.spec = spec
+        binned = spec.bin_columns(train)
+        N = binned.shape[0]
+
+        w_user = None
+        if self.params.get("weights_column"):
+            w_user = train.col(self.params["weights_column"]).data
+        w = DataInfo.response_weight(y_col.data, w_user)
+        y = DataInfo.clean_response(y_col.data).astype(jnp.float32)
+        offset = jnp.zeros(N, jnp.float32)
+        if self.params.get("offset_column"):
+            oc = train.col(self.params["offset_column"]).data
+            offset = jnp.where(jnp.isnan(oc), 0.0, oc).astype(jnp.float32)
+
+        rng = np.random.default_rng(self._seed())
+        ntrees = int(self.params["ntrees"])
+        t0 = time.time()
+        if multinomial:
+            forest, f = self._fit_multinomial(model, binned, y, w, offset,
+                                              spec, nclasses, rng, ntrees)
+        else:
+            forest, f = self._fit_single(model, binned, y, w, offset,
+                                         spec, dist, rng, ntrees)
+        model.forest = forest
+        model._output.run_time_ms = int((time.time() - t0) * 1000)
+        return model
+
+    # single-margin families (regression, bernoulli) ----------------------
+    def _fit_single(self, model, binned, y, w, offset, spec, dist, rng, ntrees):
+        import jax.numpy as jnp
+
+        N = binned.shape[0]
+        # init f0: weighted argmin of deviance at constant margin
+        num = float(jnp.sum(dist.init_f_num(w, y, offset)))
+        den = float(jnp.sum(dist.init_f_denom(w, y, offset)))
+        init_f = dist.link(jnp.float32(num / max(den, 1e-12)))
+        init_f = float(np.clip(float(init_f), -19, 19))
+        f = jnp.full(N, init_f, jnp.float32) + offset
+
+        lr = self._update_f_lr()
+        trees, varimp = [], {}
+        history = []
+        max_depth = int(self.params["max_depth"])
+        stop_metric: List[float] = []
+        for t in range(ntrees):
+            z = dist.neg_half_gradient(y, f)
+            row_active, w_t = self._sample_rows(rng, N, w)
+            feat_mask_fn = self._feat_mask_fn(rng, spec)
+            tree, row_leaf = grow_tree(
+                binned, w_t, z, spec, max_depth=max_depth,
+                min_rows=float(self.params["min_rows"]),
+                min_split_improvement=float(self.params["min_split_improvement"]),
+                row_active=None,     # keep all rows routed; sampling via w_t
+                feat_mask_fn=feat_mask_fn)
+            num_r, den_r = self._leaf_num_den(w_t, y, z, f, dist)
+            ln, ld = leaf_stats(row_leaf, num_r, den_r, tree.n_leaves)
+            gamma = np.where(ld > 1e-12, ln / np.maximum(ld, 1e-12), 0.0)
+            gamma = np.clip(gamma, -1e4, 1e4)
+            tree.set_leaf_values(gamma * lr)
+            leaf_arr = jnp.asarray((gamma * lr).astype(np.float32))
+            f = f + jnp.where(row_leaf >= 0, leaf_arr[jnp.maximum(row_leaf, 0)], 0.0)
+            trees.append(tree)
+            self._accumulate_varimp(tree, varimp, model)
+            dev = None
+            if self._should_score(t, ntrees):
+                dev = float(jnp.sum(dist.deviance(w, y, f)) /
+                            jnp.maximum(jnp.sum(w), 1e-12))
+                history.append({"tree": t + 1, "training_deviance": dev})
+                stop_metric.append(dev)
+                if self._early_stop(stop_metric):
+                    break
+            if self.job:
+                self.job.update(progress=(t + 1) / ntrees, msg=f"tree {t + 1}")
+        model._output.scoring_history = history
+        self._finalize_varimp(model, varimp)
+        forest = CompressedForest.from_host_trees(
+            trees, spec, max_depth=max_depth, init_f=init_f, nclasses=1)
+        return forest, f
+
+    # multinomial: K trees per iteration ----------------------------------
+    def _fit_multinomial(self, model, binned, y, w, offset, spec, K, rng, ntrees):
+        import jax
+        import jax.numpy as jnp
+
+        N = binned.shape[0]
+        yi = y.astype(jnp.int32)
+        # init: log class priors
+        pri = np.asarray(jax.jit(
+            lambda: jnp.zeros(K).at[yi].add(w, mode="drop"))())
+        pri = np.maximum(pri / max(pri.sum(), 1e-12), 1e-9)
+        init = np.log(pri).astype(np.float32)
+        f = jnp.broadcast_to(jnp.asarray(init), (N, K)).astype(jnp.float32)
+
+        lr = self._update_f_lr()
+        trees, tree_class, varimp, history = [], [], {}, []
+        max_depth = int(self.params["max_depth"])
+        stop_metric: List[float] = []
+        onehot = jax.nn.one_hot(yi, K, dtype=jnp.float32)
+        for t in range(ntrees):
+            P = jax.nn.softmax(f, axis=-1)
+            row_active, w_t = self._sample_rows(rng, N, w)
+            feat_mask_fn = self._feat_mask_fn(rng, spec)
+            for k in range(K):
+                z = onehot[:, k] - P[:, k]
+                tree, row_leaf = grow_tree(
+                    binned, w_t, z, spec, max_depth=max_depth,
+                    min_rows=float(self.params["min_rows"]),
+                    min_split_improvement=float(self.params["min_split_improvement"]),
+                    feat_mask_fn=feat_mask_fn)
+                # multinomial leaf gamma (GBM.java fitBestConstants, K-class):
+                # (K-1)/K * Σz / Σ|z|(1-|z|)
+                az = jnp.abs(z)
+                ln, ld = leaf_stats(row_leaf, w_t * z, w_t * az * (1 - az),
+                                    tree.n_leaves)
+                gamma = np.where(ld > 1e-12, (K - 1) / K * ln / np.maximum(ld, 1e-12), 0.0)
+                gamma = np.clip(gamma, -1e4, 1e4)
+                tree.set_leaf_values(gamma * lr)
+                leaf_arr = jnp.asarray((gamma * lr).astype(np.float32))
+                upd = jnp.where(row_leaf >= 0, leaf_arr[jnp.maximum(row_leaf, 0)], 0.0)
+                f = f.at[:, k].add(upd)
+                trees.append(tree)
+                tree_class.append(k)
+                self._accumulate_varimp(tree, varimp, model)
+            if self._should_score(t, ntrees):
+                ll = float(jnp.sum(-w * jnp.log(jnp.maximum(
+                    jax.nn.softmax(f, axis=-1)[jnp.arange(N), yi], 1e-15))) /
+                    jnp.maximum(jnp.sum(w), 1e-12))
+                history.append({"tree": t + 1, "training_logloss": ll})
+                stop_metric.append(ll)
+                if self._early_stop(stop_metric):
+                    break
+            if self.job:
+                self.job.update(progress=(t + 1) / ntrees, msg=f"iter {t + 1}")
+        model._output.scoring_history = history
+        self._finalize_varimp(model, varimp)
+        forest = CompressedForest.from_host_trees(
+            trees, spec, tree_class=tree_class, max_depth=max_depth,
+            init_f=0.0, nclasses=K)
+        forest.init_class = init          # added per-class at scoring
+        return forest, f
+
+    # sampling ------------------------------------------------------------
+    def _sample_rows(self, rng, N, w):
+        import jax.numpy as jnp
+
+        rate = float(self.params.get("sample_rate", 1.0))
+        if rate >= 1.0:
+            return None, w
+        mask = jnp.asarray(rng.random(N) < rate)
+        return mask, jnp.where(mask, w, 0.0)
+
+    def _feat_mask_fn(self, rng, spec):
+        rate = float(self.params.get("col_sample_rate_per_tree", 1.0))
+        if rate >= 1.0:
+            return None
+        keep = rng.random(spec.F) < rate
+        if not keep.any():
+            keep[rng.integers(spec.F)] = True
+
+        def fn(S):
+            return np.broadcast_to(keep, (S, spec.F))
+
+        return fn
+
+    # scoring cadence / early stop ----------------------------------------
+    def _should_score(self, t, ntrees):
+        if t == ntrees - 1 or self.params.get("score_each_iteration"):
+            return True
+        interval = int(self.params.get("score_tree_interval") or 0)
+        if interval > 0:
+            return (t + 1) % interval == 0
+        return bool(self.params.get("stopping_rounds"))
+
+    def _early_stop(self, series: List[float]) -> bool:
+        """ScoreKeeper.stopEarly: moving-average of the last k scores must
+        improve on the previous k by stopping_tolerance (relative)."""
+        k = int(self.params.get("stopping_rounds") or 0)
+        if k <= 0 or len(series) < 2 * k:
+            return False
+        tol = float(self.params.get("stopping_tolerance") or 1e-3)
+        recent = np.mean(series[-k:])
+        prev = np.mean(series[-2 * k:-k])
+        return recent >= prev * (1 - tol)
+
+    # varimp ---------------------------------------------------------------
+    def _accumulate_varimp(self, tree: HostTree, varimp: Dict[str, float], model):
+        names = model._output.names
+        for n in tree.nodes:
+            if n.split is not None:
+                nm = names[n.split.feat]
+                varimp[nm] = varimp.get(nm, 0.0) + max(n.split.gain, 0.0)
+
+    def _finalize_varimp(self, model, varimp: Dict[str, float]):
+        if varimp:
+            top = max(varimp.values()) or 1.0
+            model._output.variable_importances = {
+                k: v / top for k, v in sorted(varimp.items(),
+                                              key=lambda kv: -kv[1])}

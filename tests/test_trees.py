@@ -1,0 +1,141 @@
+"""SharedTree family tests (reference test model: h2o-algos/src/test/java
+hex/tree/gbm/GBMTest.java, drf/DRFTest.java, isofor/IsolationForestTest.java)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame
+
+
+def _friedman(n=3000, seed=7):
+    """Friedman #1 regression surface — standard tree benchmark."""
+    rng = np.random.default_rng(seed)
+    X = rng.random((n, 5))
+    y = (10 * np.sin(np.pi * X[:, 0] * X[:, 1]) + 20 * (X[:, 2] - 0.5) ** 2
+         + 10 * X[:, 3] + 5 * X[:, 4] + rng.normal(0, 1, n))
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=["x1", "x2", "x3", "x4", "x5", "y"])
+    return fr, y
+
+
+def _binary(n=3000, seed=11):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    g = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+    eff = {"a": 1.2, "b": -0.8, "c": 0.0}
+    logit = 1.3 * x1 - 0.9 * x2 + np.array([eff[v] for v in g])
+    y = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "YES", "NO")
+    fr = Frame()
+    fr.add("x1", Column.from_numpy(x1))
+    fr.add("x2", Column.from_numpy(x2))
+    fr.add("g", Column.from_numpy(g, ctype="enum"))
+    fr.add("y", Column.from_numpy(y, ctype="enum"))
+    return fr
+
+
+def test_gbm_regression_beats_constant(cl):
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    fr, y = _friedman()
+    m = GBM(ntrees=30, max_depth=4, learn_rate=0.2).train(y="y", training_frame=fr)
+    mm = m._output.training_metrics
+    assert mm.rmse < 0.5 * np.std(y)
+    pred = m.predict(fr).col("predict").to_numpy()
+    assert np.corrcoef(pred, y)[0, 1] > 0.9
+
+
+def test_gbm_binomial_auc(cl):
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    fr = _binary()
+    m = GBM(ntrees=25, max_depth=3).train(y="y", training_frame=fr)
+    assert m._output.training_metrics.auc > 0.80
+    pr = m.predict(fr)
+    assert pr.col("predict").domain == ["NO", "YES"]
+    p = pr.col("YES").to_numpy()
+    assert np.all((p >= 0) & (p <= 1))
+
+
+def test_gbm_varimp_finds_signal(cl):
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    fr, _ = _friedman()
+    m = GBM(ntrees=15, max_depth=4).train(y="y", training_frame=fr)
+    vi = m.varimp()
+    assert vi is not None
+    # x4 carries the strongest linear signal; x5 the weakest of the real ones
+    assert list(vi)[0] in ("x4", "x1", "x2")
+
+
+def test_gbm_multinomial(cl):
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    rng = np.random.default_rng(5)
+    n = 2400
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    cls = np.where(x1 + x2 > 0.8, "hi", np.where(x1 - x2 < -0.8, "lo", "mid"))
+    fr = Frame()
+    fr.add("x1", Column.from_numpy(x1))
+    fr.add("x2", Column.from_numpy(x2))
+    fr.add("y", Column.from_numpy(cls, ctype="enum"))
+    m = GBM(ntrees=10, max_depth=3).train(y="y", training_frame=fr)
+    mm = m._output.training_metrics
+    assert mm.mean_per_class_error < 0.2
+    probs = m.predict(fr)
+    assert set(probs.names) >= {"predict", "hi", "lo", "mid"}
+
+
+def test_gbm_early_stopping(cl):
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    fr, _ = _friedman(1500)
+    m = GBM(ntrees=200, max_depth=3, stopping_rounds=2, stopping_tolerance=0.5,
+            score_each_iteration=True).train(y="y", training_frame=fr)
+    assert len(m._output.scoring_history) < 200
+
+
+def test_gbm_weights_na_response(cl):
+    """NA responses drop out; zero-weight rows don't influence the fit."""
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    rng = np.random.default_rng(2)
+    n = 1000
+    x = rng.normal(size=n)
+    y = 2 * x + rng.normal(0, 0.1, n)
+    y[::10] = np.nan
+    fr = Frame.from_numpy(np.column_stack([x, y]), names=["x", "y"])
+    m = GBM(ntrees=10, max_depth=3).train(y="y", training_frame=fr)
+    assert np.isfinite(m._output.training_metrics.rmse)
+
+
+def test_drf_regression(cl):
+    from h2o3_tpu.models.tree.drf import DRF
+
+    fr, y = _friedman(2000)
+    m = DRF(ntrees=20, max_depth=10).train(y="y", training_frame=fr)
+    pred = m.predict(fr).col("predict").to_numpy()
+    assert np.corrcoef(pred, y)[0, 1] > 0.85
+
+
+def test_drf_binomial(cl):
+    from h2o3_tpu.models.tree.drf import DRF
+
+    fr = _binary(2000)
+    m = DRF(ntrees=20, max_depth=8).train(y="y", training_frame=fr)
+    assert m._output.training_metrics.auc > 0.75
+
+
+def test_isolation_forest_separates_outliers(cl):
+    from h2o3_tpu.models.tree.isofor import IsolationForest
+
+    rng = np.random.default_rng(9)
+    inliers = rng.normal(0, 1, (950, 2))
+    outliers = rng.uniform(6, 9, (50, 2))
+    X = np.vstack([inliers, outliers])
+    fr = Frame.from_numpy(X, names=["a", "b"])
+    m = IsolationForest(ntrees=40, sample_size=200).train(training_frame=fr)
+    sc = m.predict(fr)
+    s = sc.col("predict").to_numpy()
+    assert s[950:].mean() > s[:950].mean() + 0.1
+    assert "mean_length" in sc.names
