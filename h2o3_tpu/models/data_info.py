@@ -94,6 +94,9 @@ class DataInfo:
         self.num_means = np.asarray(means, np.float32) if means else np.zeros(0, np.float32)
         self.num_sigmas = np.asarray(sigmas, np.float32) if sigmas else np.ones(0, np.float32)
         self.cat_modes = np.asarray(modes, np.int32) if modes else np.zeros(0, np.int32)
+        # NA fill on the RAW scale — stays the column mean even when a caller
+        # (pca.make_data_info) rewrites num_means to change the affine transform
+        self.impute_values = self.num_means.copy()
 
     # -- names of expanded coefficients (GLM coefficient table) -----------
     def coef_names(self) -> List[str]:
@@ -129,7 +132,7 @@ class DataInfo:
             parts.append(oh[:, base:] if base else oh)
         if self.num_names:
             nums = jnp.stack([arrays[ncat + j] for j in range(len(self.num_names))], axis=-1)
-            nums = jnp.where(jnp.isnan(nums), self.num_means[None, :], nums)
+            nums = jnp.where(jnp.isnan(nums), self.impute_values[None, :], nums)
             if self.standardize:
                 nums = (nums - self.num_means[None, :]) / self.num_sigmas[None, :]
             parts.append(nums.astype(jnp.float32))

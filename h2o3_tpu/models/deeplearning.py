@@ -1,0 +1,386 @@
+"""DeepLearning — multilayer perceptron with JAX autodiff.
+
+Reference: hex/deeplearning/ — hand-coded fprop/bprop per layer
+(Neurons.java:184-229; Tanh :633, Maxout :684, Rectifier, dropout variants),
+ADADELTA adaptive rate (DeepLearningModel.java), momentum ramp, L1/L2,
+input/hidden dropout, autoencoder mode, async per-node model averaging
+(DeepLearningTask.java:19,180 — reduce = weighted average of replicas).
+
+TPU-native design: Neurons.fprop/bprop collapse into one jitted
+loss-and-grad over the whole minibatch (jax.grad; the MXU eats the batched
+matmuls). Training is data-parallel SYNCHRONOUS SGD: the batch is gathered
+from the row-sharded design matrix and the gradient all-reduce is inserted
+by the SPMD partitioner — equivalent to the reference's model averaging with
+averaging period = 1 batch, but deterministic. An entire epoch of steps runs
+inside a single lax.scan, so host↔device traffic is one call per epoch.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Column, Frame, T_NUM
+from h2o3_tpu.models.data_info import DataInfo
+from h2o3_tpu.models.model import Model, ModelCategory
+from h2o3_tpu.models.model_builder import ModelBuilder, register
+
+ACTIVATIONS = ("tanh", "tanhwithdropout", "rectifier", "rectifierwithdropout",
+               "maxout", "maxoutwithdropout")
+
+
+def _activation_fn(name: str):
+    import jax
+    import jax.numpy as jnp
+
+    base = name.replace("withdropout", "")
+    if base == "tanh":
+        return jnp.tanh
+    if base == "rectifier":
+        return jax.nn.relu
+    if base == "maxout":
+        # Maxout pairs (Neurons.java:684): units are max over 2 linear pieces;
+        # we model it as max(x, 0.5x) — a cheap 2-piece approximation that
+        # keeps the layer widths as declared (full maxout doubles weights)
+        return lambda x: jnp.maximum(x, 0.5 * x)
+    raise ValueError(f"unknown activation {name!r}")
+
+
+def _forward(params, X, activation, dropout_key=None, input_dropout=0.0,
+             hidden_dropout=None, train=False):
+    """MLP forward. params = [(W,b), ...]; returns last-layer linear output."""
+    import jax
+    import jax.numpy as jnp
+
+    act = _activation_fn(activation)
+    use_dropout = train and dropout_key is not None
+    h = X
+    if use_dropout and input_dropout > 0:
+        dropout_key, sub = jax.random.split(dropout_key)
+        keep = jax.random.bernoulli(sub, 1.0 - input_dropout, h.shape)
+        h = jnp.where(keep, h / (1.0 - input_dropout), 0.0)
+    n_hidden = len(params) - 1
+    for li, (W, b) in enumerate(params[:-1]):
+        h = act(h @ W + b)
+        if use_dropout and hidden_dropout is not None:
+            rate = hidden_dropout[li] if li < len(hidden_dropout) else 0.0
+            if rate > 0:
+                dropout_key, sub = jax.random.split(dropout_key)
+                keep = jax.random.bernoulli(sub, 1.0 - rate, h.shape)
+                h = jnp.where(keep, h / (1.0 - rate), 0.0)
+    W, b = params[-1]
+    return h @ W + b
+
+
+class DeepLearningModel(Model):
+    algo_name = "deeplearning"
+
+    def __init__(self, key=None, parms=None):
+        super().__init__(key, parms)
+        self.params_tree: Optional[List] = None
+        self.data_info: Optional[DataInfo] = None
+        self.activation: str = "rectifier"
+        self.nclasses: int = 1
+        self.autoencoder: bool = False
+
+    def _forward_frame(self, frame: Frame):
+        import jax
+        import jax.numpy as jnp
+
+        di = self.data_info
+        arrays = tuple(c.data for c in di.cols(frame))
+        params = self.params_tree
+        act = self.activation
+
+        @jax.jit
+        def fwd(*arrs):
+            X = di.expand(*arrs)
+            return X, _forward(params, X, act, train=False)
+
+        return fwd(*arrays)
+
+    def _predict_raw(self, frame: Frame):
+        import jax.numpy as jnp
+        import jax
+
+        X, out = self._forward_frame(frame)
+        if self.autoencoder:
+            err = jnp.mean((out - X) ** 2, axis=-1)
+            return {"reconstruction": out, "score": err, "value": err}
+        if self.nclasses > 1:
+            return {"probs": jax.nn.softmax(out, axis=-1)}
+        return {"value": out[:, 0]}
+
+    def anomaly(self, frame: Frame) -> Frame:
+        """Per-row reconstruction MSE (autoencoder anomaly detection —
+        reference DeepLearningModel.scoreAutoEncoder)."""
+        raw = self._predict_raw(self.adapt_test(frame))
+        out = Frame()
+        out.add("Reconstruction.MSE", Column(raw["score"], T_NUM, frame.nrows))
+        return out
+
+    def deepfeatures(self, frame: Frame, layer: int) -> Frame:
+        """Hidden-layer activations (reference deepfeatures endpoint)."""
+        import jax
+        import jax.numpy as jnp
+
+        di = self.data_info
+        arrays = tuple(c.data for c in di.cols(self.adapt_test(frame)))
+        params = self.params_tree
+        act_fn = _activation_fn(self.activation)
+
+        @jax.jit
+        def fwd(*arrs):
+            h = di.expand(*arrs)
+            for W, b in params[:layer + 1]:
+                h = act_fn(h @ W + b)
+            return h
+
+        H = fwd(*arrays)
+        out = Frame()
+        for j in range(H.shape[1]):
+            out.add(f"DF.L{layer+1}.C{j+1}", Column(H[:, j], T_NUM, frame.nrows))
+        return out
+
+
+@register
+class DeepLearning(ModelBuilder):
+    algo_name = "deeplearning"
+    model_class = DeepLearningModel
+
+    @classmethod
+    def default_params(cls):
+        p = super().default_params()
+        p.update({
+            "hidden": [200, 200],
+            "activation": "Rectifier",
+            "epochs": 10.0,
+            "mini_batch_size": 32,          # reference default 1; batched for MXU
+            "adaptive_rate": True,
+            "rho": 0.99, "epsilon": 1e-8,   # ADADELTA
+            "rate": 0.005, "rate_annealing": 1e-6, "rate_decay": 1.0,
+            "momentum_start": 0.0, "momentum_ramp": 1e6, "momentum_stable": 0.0,
+            "l1": 0.0, "l2": 0.0,
+            "input_dropout_ratio": 0.0,
+            "hidden_dropout_ratios": None,
+            "loss": "Automatic",            # Automatic/CrossEntropy/Quadratic/Absolute/Huber
+            "distribution": "AUTO",
+            "standardize": True,
+            "autoencoder": False,
+            "use_all_factor_levels": True,
+            "initial_weight_distribution": "UniformAdaptive",
+            "initial_weight_scale": 1.0,
+            "score_each_iteration": False,
+            "variable_importances": True,
+        })
+        return p
+
+    def __init__(self, **params):
+        self.supervised = not bool(params.get("autoencoder"))
+        super().__init__(**params)
+
+    def _fit(self, train: Frame) -> DeepLearningModel:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        p = self.params
+        autoencoder = bool(p.get("autoencoder"))
+        resp = p.get("response_column") if not autoencoder else None
+        di = DataInfo(train, response=resp,
+                      ignored=p.get("ignored_columns") or (),
+                      weights=p.get("weights_column"),
+                      standardize=bool(p.get("standardize", True)),
+                      use_all_factor_levels=bool(p.get("use_all_factor_levels", True)))
+        n = train.nrows
+        arrays = tuple(c.data for c in di.cols(train))
+        activation = (p.get("activation") or "Rectifier").lower()
+        if activation not in ACTIVATIONS:
+            raise ValueError(f"unknown activation {p['activation']!r}")
+        hidden = [int(h) for h in (p.get("hidden") or [200, 200])]
+        seed = self._seed()
+
+        # response setup
+        nclasses = 1
+        y_dev = None
+        if not autoencoder:
+            y_col = train.col(resp)
+            if y_col.is_categorical:
+                nclasses = max(y_col.cardinality, 2)
+            y_dev = y_col.data
+        w_dev = train.col(p["weights_column"]).data if p.get("weights_column") else None
+
+        X = jax.jit(di.expand)(*arrays)
+        padded = X.shape[0]
+        row_w = (jnp.arange(padded) < n).astype(jnp.float32)
+        if not autoencoder:
+            yw = DataInfo.response_weight(y_dev, w_dev)
+            row_w = row_w * yw
+            y = DataInfo.clean_response(y_dev)
+            y = y.astype(jnp.int32) if nclasses > 1 else y.astype(jnp.float32)
+        else:
+            y = jnp.zeros(padded, jnp.float32)
+
+        out_dim = di.fullN if autoencoder else (nclasses if nclasses > 1 else 1)
+        params0 = _init_params(di.fullN, hidden, out_dim, seed,
+                               p.get("initial_weight_distribution", "UniformAdaptive"),
+                               float(p.get("initial_weight_scale", 1.0)))
+
+        loss_name = (p.get("loss") or "Automatic").lower()
+        if loss_name == "automatic":
+            loss_name = "crossentropy" if nclasses > 1 else "quadratic"
+        if nclasses > 1 and loss_name != "crossentropy":
+            loss_name = "crossentropy"
+        l1 = float(p.get("l1", 0.0))
+        l2 = float(p.get("l2", 0.0))
+        in_drop = float(p.get("input_dropout_ratio", 0.0))
+        hid_drop = p.get("hidden_dropout_ratios")
+        if hid_drop is None and "withdropout" in activation:
+            hid_drop = [0.5] * len(hidden)
+        hid_drop = tuple(float(h) for h in (hid_drop or []))
+
+        batch = max(int(p.get("mini_batch_size", 32)), 1)
+        epochs = float(p.get("epochs", 10.0))
+        steps_per_epoch = max(int(math.ceil(n / batch)), 1)
+        n_epochs = max(int(math.ceil(epochs)), 1)
+
+        if p.get("adaptive_rate", True):
+            opt = optax.adadelta(learning_rate=1.0, rho=float(p.get("rho", 0.99)),
+                                 eps=float(p.get("epsilon", 1e-8)))
+        else:
+            rate = float(p.get("rate", 0.005))
+            anneal = float(p.get("rate_annealing", 1e-6))
+            m_start = float(p.get("momentum_start", 0.0))
+            m_stable = float(p.get("momentum_stable", 0.0))
+            ramp = max(float(p.get("momentum_ramp", 1e6)), 1.0)
+
+            def lr_sched(step):
+                return rate / (1.0 + anneal * step * batch)
+
+            mom = max(m_start, m_stable)
+            opt = (optax.sgd(learning_rate=lr_sched, momentum=mom)
+                   if mom > 0 else optax.sgd(learning_rate=lr_sched))
+
+        def loss_fn(params, xb, yb, wb, key):
+            out = _forward(params, xb, activation, dropout_key=key,
+                           input_dropout=in_drop, hidden_dropout=hid_drop,
+                           train=True)
+            if autoencoder:
+                per_row = jnp.mean((out - xb) ** 2, axis=-1)
+            elif nclasses > 1:
+                logp = jax.nn.log_softmax(out, axis=-1)
+                per_row = -jnp.take_along_axis(logp, yb[:, None], axis=-1)[:, 0]
+            else:
+                f = out[:, 0]
+                if loss_name == "absolute":
+                    per_row = jnp.abs(yb - f)
+                elif loss_name == "huber":
+                    d = jnp.abs(yb - f)
+                    per_row = jnp.where(d <= 1.0, 0.5 * d * d, d - 0.5)
+                else:
+                    per_row = 0.5 * (yb - f) ** 2
+            data_loss = jnp.sum(per_row * wb) / jnp.maximum(jnp.sum(wb), 1.0)
+            reg = 0.0
+            if l1 > 0 or l2 > 0:
+                for W, _ in params:
+                    reg = reg + l1 * jnp.sum(jnp.abs(W)) + l2 * 0.5 * jnp.sum(W * W)
+            return data_loss + reg
+
+        grad_fn = jax.grad(loss_fn)
+
+        @jax.jit
+        def run_epoch(params, opt_state, key):
+            def step(carry, _):
+                params, opt_state, key = carry
+                key, kidx, kdrop = jax.random.split(key, 3)
+                idx = jax.random.randint(kidx, (batch,), 0, padded)
+                xb, yb, wb = X[idx], y[idx], row_w[idx]
+                grads = grad_fn(params, xb, yb, wb, kdrop)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state, key), None
+
+            (params, opt_state, key), _ = jax.lax.scan(
+                step, (params, opt_state, key), None, length=steps_per_epoch)
+            return params, opt_state, key
+
+        opt_state = opt.init(params0)
+        key = jax.random.PRNGKey(seed)
+        params_t = params0
+
+        model = DeepLearningModel(parms=dict(p))
+        self._init_output(model, train)
+        if autoencoder:
+            model._output.model_category = ModelCategory.AutoEncoder
+            model._output.response_name = None
+        model.data_info = di
+        model.activation = activation
+        model.nclasses = nclasses
+        model.autoencoder = autoencoder
+
+        stop_rounds = int(p.get("stopping_rounds", 0) or 0)
+        tol = float(p.get("stopping_tolerance", 1e-3))
+        history: List[float] = []
+        for ep in range(n_epochs):
+            params_t, opt_state, key = run_epoch(params_t, opt_state, key)
+            tr_loss = float(loss_fn(params_t, X, y, row_w, None))
+            model._output.scoring_history.append(
+                {"epoch": ep + 1, "training_loss": tr_loss})
+            history.append(tr_loss)
+            if self.job:
+                self.job.update(progress=(ep + 1) / n_epochs,
+                                msg=f"epoch {ep+1}/{n_epochs} loss={tr_loss:.5f}")
+            if stop_rounds > 0 and len(history) > stop_rounds:
+                best_recent = min(history[-stop_rounds:])
+                best_before = min(history[:-stop_rounds])
+                if best_recent > best_before * (1.0 - tol):
+                    break
+
+        model.params_tree = jax.tree.map(np.asarray, params_t)
+        model.params_tree = [(jnp.asarray(W), jnp.asarray(b))
+                             for W, b in model.params_tree]
+        if p.get("variable_importances", True) and not autoencoder:
+            model._output.variable_importances = _garson_importance(
+                model.params_tree, di)
+        return model
+
+
+def _init_params(in_dim: int, hidden: List[int], out_dim: int, seed: int,
+                 dist: str, scale: float):
+    """UniformAdaptive init (reference Neurons.randomize): U(±√(6/(fan_in+fan_out)))."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    dims = [in_dim] + hidden + [out_dim]
+    params = []
+    for fan_in, fan_out in zip(dims[:-1], dims[1:]):
+        d = (dist or "UniformAdaptive").lower()
+        if d == "uniformadaptive":
+            lim = math.sqrt(6.0 / (fan_in + fan_out))
+            W = rng.uniform(-lim, lim, size=(fan_in, fan_out))
+        elif d == "uniform":
+            W = rng.uniform(-scale, scale, size=(fan_in, fan_out))
+        elif d == "normal":
+            W = rng.normal(0.0, scale, size=(fan_in, fan_out))
+        else:
+            raise ValueError(f"unknown initial_weight_distribution {dist!r}")
+        params.append((jnp.asarray(W, jnp.float32),
+                       jnp.zeros(fan_out, jnp.float32)))
+    return params
+
+
+def _garson_importance(params, di: DataInfo) -> Dict[str, float]:
+    """First-layer |weight| mass per ORIGINAL column (expanded one-hot columns
+    fold back onto their categorical), normalized to max 1 — the spirit of the
+    reference's Gedeon method (DeepLearningModelInfo.computeVariableImportances)."""
+    W1 = np.abs(np.asarray(params[0][0])).sum(axis=1)  # (fullN,)
+    imp: Dict[str, float] = {}
+    for i, cname in enumerate(di.cat_names):
+        s, e = di.cat_offsets[i], di.cat_offsets[i + 1]
+        imp[cname] = float(W1[s:e].sum())
+    for j, nname in enumerate(di.num_names):
+        imp[nname] = float(W1[di.num_offset + j])
+    mx = max(imp.values()) if imp else 1.0
+    return {k: v / mx for k, v in sorted(imp.items(), key=lambda kv: -kv[1])}

@@ -1,0 +1,288 @@
+"""KMeans — Lloyd iterations with PlusPlus / Furthest / Random init.
+
+Reference: hex/kmeans/KMeans.java (init enum :22, Lloyd driver :36, scalable
+seeding :1013) — distributed assignment is an MRTask computing per-row closest
+center; center updates are per-cluster running sums merged in reduce.
+
+TPU-native design: one jitted Lloyd step over the row-sharded design matrix —
+distances (n,k) via a single MXU matmul (‖x‖² − 2XCᵀ + ‖c‖²), assignment is an
+argmin, center sums are a one-hot matmul (oh.T @ X, again MXU) with XLA
+inserting the cross-shard psum. The per-cluster CAS accumulators of the
+reference collapse into segment-sum matmuls; the Lloyd loop runs in
+lax.while_loop so the whole training is ONE compiled program.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.models import metrics as M
+from h2o3_tpu.models.data_info import DataInfo
+from h2o3_tpu.models.model import Model, ModelCategory
+from h2o3_tpu.models.model_builder import ModelBuilder, register
+
+
+class KMeansModel(Model):
+    algo_name = "kmeans"
+
+    def __init__(self, key=None, parms=None):
+        super().__init__(key, parms)
+        self.centers: Optional[np.ndarray] = None       # (k, p) standardized space
+        self.centers_raw: Optional[np.ndarray] = None   # (k, p) original space
+        self.data_info: Optional[DataInfo] = None
+        self.k: int = 0
+
+    def _predict_raw(self, frame: Frame):
+        import jax
+        import jax.numpy as jnp
+
+        di = self.data_info
+        arrays = tuple(c.data for c in di.cols(frame))
+        centers = jnp.asarray(self.centers, jnp.float32)
+
+        @jax.jit
+        def assign(*arrs):
+            X = di.expand(*arrs)
+            d2 = (jnp.sum(X * X, axis=1, keepdims=True)
+                  - 2.0 * X @ centers.T + jnp.sum(centers * centers, axis=1)[None, :])
+            return jnp.argmin(d2, axis=1).astype(jnp.int32), jnp.min(d2, axis=1)
+
+        cluster, dist2 = assign(*arrays)
+        return {"cluster": cluster, "dist2": dist2}
+
+    def _make_metrics(self, frame: Frame, raw):
+        return _clustering_metrics(self, frame, raw)
+
+    def to_dict(self):
+        d = super().to_dict()
+        d["centers"] = self.centers_raw.tolist() if self.centers_raw is not None else None
+        d["k"] = self.k
+        return d
+
+
+def _clustering_metrics(model: KMeansModel, frame: Frame, raw) -> M.ModelMetricsClustering:
+    import jax
+    import jax.numpy as jnp
+
+    di = model.data_info
+    k = model.k
+    arrays = tuple(c.data for c in di.cols(frame))
+    n = frame.nrows
+
+    @jax.jit
+    def stats(cluster, dist2, *arrs):
+        X = di.expand(*arrs)
+        w = (jnp.arange(X.shape[0]) < n).astype(jnp.float32)
+        oh = jax.nn.one_hot(cluster, k, dtype=jnp.float32) * w[:, None]
+        withinss = jnp.sum(oh * dist2[:, None], axis=0)
+        sizes = jnp.sum(oh, axis=0)
+        mean = jnp.sum(X * w[:, None], axis=0) / jnp.maximum(jnp.sum(w), 1.0)
+        totss = jnp.sum(w * jnp.sum((X - mean[None, :]) ** 2, axis=1))
+        return withinss, sizes, totss
+
+    withinss, sizes, totss = stats(raw["cluster"], raw["dist2"], *arrays)
+    withinss = np.asarray(withinss)
+    tot_within = float(withinss.sum())
+    totss_f = float(totss)
+    return M.ModelMetricsClustering(
+        nobs=float(n), tot_withinss=tot_within, totss=totss_f,
+        betweenss=totss_f - tot_within,
+        within_cluster_sizes=np.asarray(sizes).tolist())
+
+
+@register
+class KMeans(ModelBuilder):
+    algo_name = "kmeans"
+    model_class = KMeansModel
+    supervised = False
+
+    @classmethod
+    def default_params(cls):
+        p = super().default_params()
+        p.update({
+            "k": 1,
+            "estimate_k": False,
+            "max_iterations": 10,
+            "init": "Furthest",         # Random/PlusPlus/Furthest/User
+            "user_points": None,
+            "standardize": True,
+            "max_k": 100,               # estimate_k search cap (KMeans.java)
+        })
+        return p
+
+    def _fit(self, train: Frame) -> KMeansModel:
+        import jax
+        import jax.numpy as jnp
+
+        p = self.params
+        di = DataInfo(train, response=None,
+                      ignored=p.get("ignored_columns") or (),
+                      standardize=bool(p.get("standardize", True)),
+                      use_all_factor_levels=True)
+        arrays = tuple(c.data for c in di.cols(train))
+        n = train.nrows
+        seed = self._seed()
+        max_iter = int(p.get("max_iterations", 10))
+
+        Xf = jax.jit(di.expand)(*arrays)
+        w = (jnp.arange(Xf.shape[0]) < n).astype(jnp.float32)
+
+        if p.get("estimate_k"):
+            k, centers = self._estimate_k(Xf, w, seed, max_iter,
+                                          int(p.get("max_k", 100)))
+        else:
+            centers = _init_centers(Xf, w, int(p["k"]), p.get("init", "Furthest"),
+                                    seed, di, p.get("user_points"))
+            k = int(centers.shape[0])   # init='User' defines k by its rows
+            centers, _ = _lloyd(Xf, w, centers, max_iter)
+
+        model = KMeansModel(parms=dict(p))
+        self._init_output(model, train)
+        model._output.model_category = ModelCategory.Clustering
+        model.data_info = di
+        model.k = k
+        model.centers = np.asarray(centers)
+        model.centers_raw = _destandardize(np.asarray(centers), di)
+        model._parms["k"] = k
+        return model
+
+    def _estimate_k(self, Xf, w, seed: int, max_iter: int, max_k: int):
+        """KMeans.java estimate_k: grow k while tot_withinss keeps improving
+        by >20% per added center (the reference's reduction-ratio stop),
+        seeding each new center Furthest."""
+        import jax.numpy as jnp
+
+        centers = _init_centers(Xf, w, 1, "Furthest", seed, None, None)
+        centers, wss = _lloyd(Xf, w, centers, max_iter)
+        best_k, best_c = 1, centers
+        prev = float(wss)
+        for k in range(2, max_k + 1):
+            nxt = _furthest_point(Xf, w, centers)
+            centers = jnp.concatenate([centers, nxt[None, :]], axis=0)
+            centers, wss = _lloyd(Xf, w, centers, max_iter)
+            cur = float(wss)
+            if prev > 0 and (prev - cur) / prev < 0.2:
+                break
+            best_k, best_c = k, centers
+            prev = cur
+        return best_k, best_c
+
+
+def _destandardize(centers: np.ndarray, di: DataInfo) -> np.ndarray:
+    out = centers.copy()
+    if di.num_names and di.standardize:
+        no = di.num_offset
+        out[:, no:] = out[:, no:] * di.num_sigmas[None, :] + di.num_means[None, :]
+    return out
+
+
+def _dist2(X, centers):
+    import jax.numpy as jnp
+
+    return (jnp.sum(X * X, axis=1, keepdims=True)
+            - 2.0 * X @ centers.T
+            + jnp.sum(centers * centers, axis=1)[None, :])
+
+
+def _lloyd(X, w, centers, max_iter: int):
+    """Run Lloyd iterations as one compiled lax.while_loop; returns final
+    centers and tot_withinss. Stops on relative improvement < 1e-6 (the
+    reference's TOLERANCE stopping) or max_iter."""
+    import jax
+    import jax.numpy as jnp
+
+    k = centers.shape[0]
+
+    @jax.jit
+    def run(centers):
+        def step(carry):
+            centers, _, prev, i = carry
+            d2 = _dist2(X, centers)
+            assign = jnp.argmin(d2, axis=1)
+            oh = jax.nn.one_hot(assign, k, dtype=jnp.float32) * w[:, None]
+            sums = oh.T @ X
+            counts = jnp.sum(oh, axis=0)
+            new_centers = jnp.where(counts[:, None] > 0,
+                                    sums / jnp.maximum(counts[:, None], 1.0),
+                                    centers)
+            wss = jnp.sum(w * jnp.maximum(jnp.min(d2, axis=1), 0.0))
+            return new_centers, wss, prev, i + 1
+
+        def cond(carry):
+            _, wss, prev, i = carry
+            improved = (prev - wss) > 1e-6 * jnp.maximum(prev, 1e-12)
+            return (i < max_iter) & ((i < 2) | improved)
+
+        init = (centers, jnp.float32(jnp.inf), jnp.float32(jnp.inf), 0)
+
+        def body(carry):
+            c, wss, _, i = step(carry)
+            return (c, wss, carry[1], i)
+
+        c, wss, _, _ = jax.lax.while_loop(cond, body, init)
+        return c, wss
+
+    return run(centers)
+
+
+def _furthest_point(X, w, centers):
+    """Row with max distance to its closest center (Furthest init step)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def pick(centers):
+        d = jnp.min(_dist2(X, centers), axis=1) * w - (1.0 - w) * 1e30
+        return X[jnp.argmax(d)]
+
+    return pick(centers)
+
+
+def _init_centers(X, w, k: int, method: str, seed: int,
+                  di: Optional[DataInfo], user_points) -> "jax.Array":
+    import jax
+    import jax.numpy as jnp
+
+    method = (method or "Furthest").lower()
+    n_valid = int(jnp.sum(w))
+    rng = np.random.default_rng(seed)
+
+    if method == "user":
+        if user_points is None:
+            raise ValueError("init='User' requires user_points")
+        pts = user_points.to_numpy().astype(np.float32) if isinstance(user_points, Frame) \
+            else np.asarray(user_points, np.float32)
+        if di is not None and di.num_names and di.standardize:
+            no = di.num_offset
+            pts = pts.copy()
+            pts[:, no:] = (pts[:, no:] - di.num_means[None, :]) / di.num_sigmas[None, :]
+        return jnp.asarray(pts, jnp.float32)
+
+    if method == "random":
+        idx = rng.choice(n_valid, size=min(k, n_valid), replace=False)
+        return X[jnp.asarray(idx)]
+
+    # PlusPlus (D² sampling) and Furthest share the min-distance recursion;
+    # both start from one random row (KMeans.java:1013 scalable seeding is
+    # approximated by exact sequential seeding — k is small, X is on device).
+    first = int(rng.integers(n_valid))
+    centers = X[first][None, :]
+    for _ in range(1, k):
+        d = jnp.min(_dist2(X, centers), axis=1) * w
+        d = jnp.maximum(d, 0.0)
+        if method == "plusplus":
+            probs = np.asarray(d, np.float64)
+            s = probs.sum()
+            if s <= 0:
+                idx = int(rng.integers(n_valid))
+            else:
+                idx = int(rng.choice(len(probs), p=probs / s))
+            nxt = X[idx]
+        else:  # furthest
+            nxt = X[jnp.argmax(d - (1.0 - w) * 1e30)]
+        centers = jnp.concatenate([centers, nxt[None, :]], axis=0)
+    return centers

@@ -190,7 +190,9 @@ class Model(Keyed):
         raw = self._predict_raw(adapted)
         return self._make_metrics(test_data, raw)
 
-    def _make_metrics(self, frame: Frame, raw: Dict[str, Any]):
+    def _make_metrics(self, frame: Frame, raw: Dict[str, Any], extra_weight=None):
+        """extra_weight: optional device (N,) multiplier — rows it zeroes are
+        excluded (used by DRF to restrict training metrics to OOB rows)."""
         from h2o3_tpu.models.data_info import DataInfo
 
         resp = self._output.response_name
@@ -202,6 +204,8 @@ class Model(Keyed):
         wname = self._parms.get("weights_column")
         if wname and wname in frame:
             w = frame.col(wname).data
+        if extra_weight is not None:
+            w = extra_weight if w is None else w * extra_weight
         if cat == ModelCategory.Binomial:
             import jax.numpy as jnp
 

@@ -139,6 +139,10 @@ class ModelBuilder:
             if not self.params.get("keep_cross_validation_models", True):
                 for m in cv_models:
                     m.delete()
+        # drop fit-time scratch refs so the builder doesn't pin the training
+        # frame / full-N device buffers after the model is done
+        self._train_frame_ref = None
+        self._oob_raw = None
         return model
 
     def _cross_validate(self, train: Frame, nfolds: int, fold_col: Optional[str]):

@@ -1,0 +1,167 @@
+"""NaiveBayes — per-class count tables / Gaussian conditionals.
+
+Reference: hex/naivebayes/NaiveBayes.java — a single MRTask accumulates
+per-class counts for categorical predictors and per-class sums/sq-sums for
+numerics; laplace smoothing, min_sdev/eps_sdev floors, min_prob/eps_prob.
+
+TPU-native design: the count tables are one-hot outer-product matmuls
+(class-one-hot ᵀ @ predictor-one-hot — MXU work) psum'd across shards inside
+one jitted pass; scoring is a fused gather of log-probability tables plus
+Gaussian log-pdfs. No per-row host iteration anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.models.data_info import DataInfo
+from h2o3_tpu.models.model import Model, ModelCategory
+from h2o3_tpu.models.model_builder import ModelBuilder, register
+
+
+class NaiveBayesModel(Model):
+    algo_name = "naivebayes"
+
+    def __init__(self, key=None, parms=None):
+        super().__init__(key, parms)
+        self.priors: Optional[np.ndarray] = None          # (k,)
+        self.cat_tables: List[np.ndarray] = []            # per cat col: (k, card)
+        self.num_means: Optional[np.ndarray] = None       # (k, n_num)
+        self.num_sdevs: Optional[np.ndarray] = None       # (k, n_num)
+        self.data_info: Optional[DataInfo] = None
+
+    def _predict_raw(self, frame: Frame):
+        import jax
+        import jax.numpy as jnp
+
+        di = self.data_info
+        arrays = tuple(c.data for c in di.cols(frame))
+        log_priors = jnp.asarray(np.log(np.maximum(self.priors, 1e-30)), jnp.float32)
+        log_tables = [jnp.asarray(np.log(np.maximum(t, 1e-30)), jnp.float32)
+                      for t in self.cat_tables]
+        mu = jnp.asarray(self.num_means, jnp.float32) if self.num_means is not None else None
+        sd = jnp.asarray(self.num_sdevs, jnp.float32) if self.num_sdevs is not None else None
+        ncat = len(di.cat_names)
+
+        @jax.jit
+        def score(*arrs):
+            n_rows = arrs[0].shape[0]
+            ll = jnp.broadcast_to(log_priors[None, :], (n_rows, log_priors.shape[0]))
+            for i in range(ncat):
+                codes = arrs[i].astype(jnp.int32)
+                # NA predictor contributes nothing (reference skips NAs)
+                contrib = log_tables[i].T[jnp.maximum(codes, 0)]   # (n, k)
+                ll = ll + jnp.where((codes >= 0)[:, None], contrib, 0.0)
+            for j in range(len(di.num_names)):
+                x = arrs[ncat + j]
+                lp = (-0.5 * ((x[:, None] - mu[None, :, j]) / sd[None, :, j]) ** 2
+                      - jnp.log(sd[None, :, j]) - 0.9189385332046727)
+                ll = ll + jnp.where(jnp.isnan(x)[:, None], 0.0, lp)
+            ll = ll - jnp.max(ll, axis=1, keepdims=True)
+            probs = jnp.exp(ll)
+            return probs / jnp.sum(probs, axis=1, keepdims=True)
+
+        return {"probs": score(*arrays)}
+
+
+@register
+class NaiveBayes(ModelBuilder):
+    algo_name = "naivebayes"
+    model_class = NaiveBayesModel
+
+    @classmethod
+    def default_params(cls):
+        p = super().default_params()
+        p.update({
+            "laplace": 0.0,
+            "min_sdev": 0.001, "eps_sdev": 0.0,
+            "min_prob": 0.001, "eps_prob": 0.0,
+            "compute_metrics": True,
+        })
+        return p
+
+    def _fit(self, train: Frame) -> NaiveBayesModel:
+        import jax
+        import jax.numpy as jnp
+
+        p = self.params
+        resp = p["response_column"]
+        y_col = train.col(resp)
+        if not y_col.is_categorical:
+            raise ValueError("naivebayes requires a categorical response")
+        k = y_col.cardinality
+        di = DataInfo(train, response=resp,
+                      ignored=p.get("ignored_columns") or (),
+                      weights=p.get("weights_column"),
+                      standardize=False, use_all_factor_levels=True)
+        arrays = tuple(c.data for c in di.cols(train))
+        y = y_col.data
+        w_dev = train.col(p["weights_column"]).data if p.get("weights_column") else None
+        ncat = len(di.cat_names)
+        cards = [max(c, 1) for c in di.cards]
+        laplace = float(p.get("laplace", 0.0))
+
+        @jax.jit
+        def accumulate(y, *arrs):
+            w = DataInfo.response_weight(y, w_dev)
+            yc = jnp.maximum(y, 0)
+            Y = jax.nn.one_hot(yc, k, dtype=jnp.float32) * w[:, None]   # (n, k)
+            priors = jnp.sum(Y, axis=0)
+            tables = []
+            for i in range(ncat):
+                codes = arrs[i].astype(jnp.int32)
+                valid = (codes >= 0).astype(jnp.float32)[:, None]
+                C = jax.nn.one_hot(jnp.maximum(codes, 0), cards[i], dtype=jnp.float32)
+                tables.append((Y * valid).T @ C)                         # (k, card)
+            sums, sqs, cnts = [], [], []
+            for j in range(len(di.num_names)):
+                x = arrs[ncat + j]
+                ok = (~jnp.isnan(x)).astype(jnp.float32)
+                xv = jnp.where(jnp.isnan(x), 0.0, x)
+                Yv = Y * ok[:, None]
+                sums.append(Yv.T @ xv[:, None])
+                sqs.append(Yv.T @ (xv * xv)[:, None])
+                cnts.append(jnp.sum(Yv, axis=0))
+            return priors, tables, sums, sqs, cnts
+
+        priors, tables, sums, sqs, cnts = accumulate(y, *arrays)
+        priors = np.asarray(priors, np.float64)
+
+        model = NaiveBayesModel(parms=dict(p))
+        self._init_output(model, train)
+        model.data_info = di
+
+        min_sdev = max(float(p.get("min_sdev", 0.001)), 1e-10)
+        eps_sdev = float(p.get("eps_sdev", 0.0) or 0.0)
+        min_prob = max(float(p.get("min_prob", 0.001)), 1e-30)
+        eps_prob = float(p.get("eps_prob", 0.0) or 0.0)
+        cat_tables = []
+        for i in range(ncat):
+            t = np.asarray(tables[i], np.float64) + laplace
+            t = t / np.maximum(t.sum(axis=1, keepdims=True), 1e-30)
+            # probability floor (NaiveBayes.java): entries below eps_prob
+            # (zero-count levels at the default eps 0) become min_prob so one
+            # unseen level can't veto a class
+            cat_tables.append(np.where(t <= max(eps_prob, 1e-30), min_prob, t))
+        if di.num_names:
+            mu = np.zeros((k, len(di.num_names)))
+            sd = np.zeros((k, len(di.num_names)))
+            for j in range(len(di.num_names)):
+                c = np.maximum(np.asarray(cnts[j], np.float64), 1e-30)
+                m = np.asarray(sums[j], np.float64)[:, 0] / c
+                v = np.asarray(sqs[j], np.float64)[:, 0] / c - m * m
+                mu[:, j] = m
+                s = np.sqrt(np.maximum(v, 0.0))
+                s = np.where(s <= eps_sdev, min_sdev, s)
+                sd[:, j] = np.maximum(s, min_sdev)
+            model.num_means, model.num_sdevs = mu, sd
+        else:
+            model.num_means = np.zeros((k, 0))
+            model.num_sdevs = np.ones((k, 0))
+        model.cat_tables = cat_tables
+        total = priors.sum()
+        model.priors = priors / max(total, 1e-30)
+        return model

@@ -3,27 +3,36 @@
 Reference: hex/tree/drf/DRF.java — SharedTree with per-tree row
 subsampling (sample_rate 0.632), per-node feature subsampling (mtries),
 leaf = node mean, ensemble = average over trees, OOB scoring
-(doOOBScoring).
+(doOOBScoring), binomial_double_trees (one tree per class).
 
 TPU-native: trees are grown on the raw response (no boosting); sampled-out
 rows keep routing with w=0 so their leaf assignments give OOB predictions
 with no extra traversal. Averaging happens by scaling each tree's leaf
 values by 1/ntrees at compression time, so scoring reuses the same summed
-traversal as GBM.
+traversal as GBM. Training metrics are OUT-OF-BAG, like the reference.
 """
 
 from __future__ import annotations
 
-from typing import List
-
 import numpy as np
 
-from h2o3_tpu.models.distribution import auto_distribution, get_distribution
 from h2o3_tpu.models.model import ModelCategory
 from h2o3_tpu.models.model_builder import register
 from h2o3_tpu.models.tree.compressed import CompressedForest
 from h2o3_tpu.models.tree.histogram import leaf_stats
 from h2o3_tpu.models.tree.shared_tree import SharedTree, SharedTreeModel, grow_tree
+
+
+def _node_feat_mask_fn(rng, F: int, mtries: int):
+    """Fresh random mtries-subset of features PER NODE (DTree semantics)."""
+
+    def fn(S):
+        mask = np.zeros((S, F), bool)
+        for s in range(S):
+            mask[s, rng.choice(F, size=mtries, replace=False)] = True
+        return mask
+
+    return fn
 
 
 class DRFModel(SharedTreeModel):
@@ -35,6 +44,10 @@ class DRFModel(SharedTreeModel):
         f = self._margin(frame)      # mean leaf response across trees
         cat = self._output.model_category
         if cat == ModelCategory.Binomial:
+            if f.ndim == 2:          # binomial_double_trees: per-class votes
+                p = jnp.clip(f, 0.0, 1.0)
+                p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-12)
+                return {"probs": p}
             p = jnp.clip(f, 0.0, 1.0)
             return {"probs": jnp.stack([1 - p, p], axis=-1)}
         if cat == ModelCategory.Multinomial:
@@ -66,23 +79,33 @@ class DRF(SharedTree):
         # DRF.java defaults: sqrt(p) classification, p/3 regression
         return max(1, int(np.sqrt(F)) if classification else F // 3)
 
+    def _score_on(self, model, frame):
+        """Training metrics are OOB (DRF.java doOOBScoring): when scoring the
+        training frame right after fit, use the accumulated OOB predictions;
+        rows that were never out-of-bag are weight-0 excluded."""
+        oob = getattr(self, "_oob_raw", None)
+        if oob is not None and frame is getattr(self, "_train_frame_ref", None):
+            raw, mask = oob
+            self._oob_raw = None      # single-use; frees the (N,) device bufs
+            return model._make_metrics(frame, raw, extra_weight=mask)
+        return super()._score_on(model, frame)
+
     def _fit_single(self, model, binned, y, w, offset, spec, dist, rng, ntrees):
         """Bagged trees on the raw response: leaf = weighted mean of y."""
         import jax.numpy as jnp
 
-        N = binned.shape[0]
         classification = model._output.model_category == ModelCategory.Binomial
-        mtries = self._mtries(spec.F, classification)
+        if classification and self.params.get("binomial_double_trees"):
+            return self._fit_multinomial(model, binned, y, w, offset, spec,
+                                         2, rng, ntrees)
 
-        def feat_mask_fn(S):
-            # fresh random feature subset PER NODE (DTree mtries semantics)
-            mask = np.zeros((S, spec.F), bool)
-            for s in range(S):
-                mask[s, rng.choice(spec.F, size=mtries, replace=False)] = True
-            return mask
+        N = binned.shape[0]
+        mtries = self._mtries(spec.F, classification)
+        feat_mask_fn = _node_feat_mask_fn(rng, spec.F, mtries)
 
         max_depth = int(self.params["max_depth"])
         trees, varimp, history = [], {}, []
+        stop_metric = []
         # OOB accumulation: sum of oob predictions and counts per row
         oob_sum = jnp.zeros(N, jnp.float32)
         oob_cnt = jnp.zeros(N, jnp.float32)
@@ -105,13 +128,31 @@ class DRF(SharedTree):
                 oob = (~mask) & (w > 0)
                 oob_sum = oob_sum + jnp.where(oob, pred_t, 0.0)
                 oob_cnt = oob_cnt + oob.astype(jnp.float32)
+            if mask is not None and self._should_score(t, ntrees):
+                # running OOB squared error (DRF.java scores OOB each interval)
+                fcur = jnp.where(oob_cnt > 0, oob_sum / jnp.maximum(oob_cnt, 1.0), 0.0)
+                wm = w * (oob_cnt > 0)
+                mse = float(jnp.sum(wm * (y - fcur) ** 2) /
+                            jnp.maximum(jnp.sum(wm), 1e-12))
+                history.append({"tree": t + 1, "training_rmse": float(np.sqrt(mse))})
+                stop_metric.append(mse)
+                if self._early_stop(stop_metric):
+                    break
             if self.job:
                 self.job.update(progress=(t + 1) / ntrees, msg=f"tree {t + 1}")
-        f = jnp.where(oob_cnt > 0, oob_sum / jnp.maximum(oob_cnt, 1.0), 0.0)
         model._output.scoring_history = history
         self._finalize_varimp(model, varimp)
         forest = CompressedForest.from_host_trees(
             trees, spec, max_depth=max_depth, init_f=0.0, nclasses=1)
+        f = jnp.where(oob_cnt > 0, oob_sum / jnp.maximum(oob_cnt, 1.0), 0.0)
+        self._oob_raw = None
+        if float(jnp.max(oob_cnt)) > 0:
+            oob_mask = (oob_cnt > 0).astype(jnp.float32)
+            if classification:
+                p = jnp.clip(f, 0.0, 1.0)
+                self._oob_raw = ({"probs": jnp.stack([1 - p, p], axis=-1)}, oob_mask)
+            else:
+                self._oob_raw = ({"value": f}, oob_mask)
         return forest, f
 
     def _fit_multinomial(self, model, binned, y, w, offset, spec, K, rng, ntrees):
@@ -123,15 +164,12 @@ class DRF(SharedTree):
         yi = y.astype(jnp.int32)
         onehot = jax.nn.one_hot(yi, K, dtype=jnp.float32)
         mtries = self._mtries(spec.F, True)
-
-        def feat_mask_fn(S):
-            mask = np.zeros((S, spec.F), bool)
-            for s in range(S):
-                mask[s, rng.choice(spec.F, size=mtries, replace=False)] = True
-            return mask
+        feat_mask_fn = _node_feat_mask_fn(rng, spec.F, mtries)
 
         max_depth = int(self.params["max_depth"])
         trees, tree_class, varimp = [], [], {}
+        oob_sum = jnp.zeros((N, K), jnp.float32)
+        oob_cnt = jnp.zeros(N, jnp.float32)
         for t in range(ntrees):
             mask, w_t = self._sample_rows(rng, N, w)
             for k in range(K):
@@ -146,11 +184,23 @@ class DRF(SharedTree):
                 trees.append(tree)
                 tree_class.append(k)
                 self._accumulate_varimp(tree, varimp, model)
+                if mask is not None:
+                    leaf_arr = jnp.asarray(mean.astype(np.float32))
+                    pred_t = jnp.where(row_leaf >= 0,
+                                       leaf_arr[jnp.maximum(row_leaf, 0)], 0.0)
+                    oob = (~mask) & (w > 0)
+                    oob_sum = oob_sum.at[:, k].add(jnp.where(oob, pred_t, 0.0))
+            if mask is not None:
+                oob_cnt = oob_cnt + ((~mask) & (w > 0)).astype(jnp.float32)
             if self.job:
                 self.job.update(progress=(t + 1) / ntrees, msg=f"iter {t + 1}")
         self._finalize_varimp(model, varimp)
         forest = CompressedForest.from_host_trees(
             trees, spec, tree_class=tree_class, max_depth=max_depth,
             nclasses=K)
-        f = None
-        return forest, f
+        self._oob_raw = None
+        if float(jnp.max(oob_cnt)) > 0:
+            p = jnp.clip(oob_sum / jnp.maximum(oob_cnt, 1.0)[:, None], 0.0, 1.0)
+            p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-12)
+            self._oob_raw = ({"probs": p}, (oob_cnt > 0).astype(jnp.float32))
+        return forest, None

@@ -37,5 +37,7 @@ class GBM(SharedTree):
         })
         return p
 
-    def _update_f_lr(self) -> float:
-        return float(self.params.get("learn_rate", 0.1))
+    def _tree_lr(self, t: int) -> float:
+        lr = float(self.params.get("learn_rate", 0.1))
+        anneal = float(self.params.get("learn_rate_annealing", 1.0) or 1.0)
+        return lr * (anneal ** t)

@@ -91,8 +91,6 @@ class IsolationForest(SharedTree):
         ntrees = int(self.params["ntrees"])
         max_depth = int(self.params["max_depth"])
         trees: List[HostTree] = []
-        valid = np.zeros(N, bool)
-        valid[:n_real] = True          # pad rows never sampled
         for t in range(ntrees):
             pick = rng.choice(n_real, size=sample_size, replace=False)
             w = np.zeros(N, np.float32)
@@ -117,7 +115,7 @@ class IsolationForest(SharedTree):
         row_leaf = jnp.full(N, -1, jnp.int32)
         slots = [0]
         zeros = jnp.zeros(N, jnp.float32)
-        counts = {0: None}
+        mtries = int(self.params.get("mtries", -1) or -1)
         for depth in range(max_depth + 1):
             if not slots:
                 break
@@ -131,9 +129,13 @@ class IsolationForest(SharedTree):
                 tree.nodes[nid].weight = cnt
                 if depth == max_depth or cnt <= 1:
                     continue
-                # random feature with >1 occupied value bin; few retries
+                # random feature with >1 occupied value bin; few retries.
+                # mtries>0 restricts candidates to a per-node subset
+                pool = (rng.choice(spec.F, size=min(mtries, spec.F), replace=False)
+                        if mtries > 0 else None)
                 for _ in range(5):
-                    f = int(rng.integers(spec.F))
+                    f = int(rng.choice(pool)) if pool is not None \
+                        else int(rng.integers(spec.F))
                     o, B = int(spec.offsets[f]), int(spec.nbins[f])
                     occ = np.nonzero(hist[s, o:o + B - 1, 0] > 0)[0]
                     if len(occ) >= 2:

@@ -58,7 +58,10 @@ def grow_tree(binned, hist_w, hist_y, spec, *, max_depth: int, min_rows: float,
         if not slots:
             break
         S = len(slots)
-        hist = build_histogram(binned, row_node, hist_w, hist_y, spec, S)
+        # the final level never splits, so skip its histogram build (the
+        # hottest kernel) unless it's also the root stats pass
+        if depth < max_depth or depth == 0:
+            hist = build_histogram(binned, row_node, hist_w, hist_y, spec, S)
         if depth == 0:
             o, B = int(spec.offsets[0]), int(spec.nbins[0])
             tree.nodes[0].weight = float(hist[0, o:o + B, 0].sum())
@@ -164,8 +167,16 @@ class SharedTree(ModelBuilder):
         """Device (num, den) rows for the leaf-value segment sum."""
         return dist.gamma_num(w, y, z, f), dist.gamma_denom(w, y, z, f)
 
-    def _update_f_lr(self) -> float:
+    def _tree_lr(self, t: int) -> float:
+        """Shrinkage applied to tree t's leaves (GBM: learn_rate with
+        learn_rate_annealing^t; DRF/IF: 1)."""
         return 1.0
+
+    def _leaf_clip(self) -> float:
+        """Leaf-value bound: max_abs_leafnode_pred when the user set one,
+        else a numeric-safety bound (GBM.java fitBestConstants clamps)."""
+        clip = float(self.params.get("max_abs_leafnode_pred", 1e30) or 1e30)
+        return clip if clip < 1e30 else 1e4
 
     # driver --------------------------------------------------------------
     def _fit(self, train: Frame) -> SharedTreeModel:
@@ -206,6 +217,7 @@ class SharedTree(ModelBuilder):
 
         rng = np.random.default_rng(self._seed())
         ntrees = int(self.params["ntrees"])
+        self._train_frame_ref = train      # OOB metric routing (DRF)
         t0 = time.time()
         if multinomial:
             forest, f = self._fit_multinomial(model, binned, y, w, offset,
@@ -225,11 +237,14 @@ class SharedTree(ModelBuilder):
         # init f0: weighted argmin of deviance at constant margin
         num = float(jnp.sum(dist.init_f_num(w, y, offset)))
         den = float(jnp.sum(dist.init_f_denom(w, y, offset)))
-        init_f = dist.link(jnp.float32(num / max(den, 1e-12)))
-        init_f = float(np.clip(float(init_f), -19, 19))
+        init_f = float(dist.link(jnp.float32(num / max(den, 1e-12))))
+        if dist.name in ("bernoulli", "quasibinomial"):
+            # only the log-odds prior needs clamping (GBM.java getInitialValue);
+            # identity/log links must keep large means intact
+            init_f = float(np.clip(init_f, -19, 19))
         f = jnp.full(N, init_f, jnp.float32) + offset
 
-        lr = self._update_f_lr()
+        leaf_clip = self._leaf_clip()
         trees, varimp = [], {}
         history = []
         max_depth = int(self.params["max_depth"])
@@ -247,7 +262,8 @@ class SharedTree(ModelBuilder):
             num_r, den_r = self._leaf_num_den(w_t, y, z, f, dist)
             ln, ld = leaf_stats(row_leaf, num_r, den_r, tree.n_leaves)
             gamma = np.where(ld > 1e-12, ln / np.maximum(ld, 1e-12), 0.0)
-            gamma = np.clip(gamma, -1e4, 1e4)
+            gamma = np.clip(gamma, -leaf_clip, leaf_clip)
+            lr = self._tree_lr(t)
             tree.set_leaf_values(gamma * lr)
             leaf_arr = jnp.asarray((gamma * lr).astype(np.float32))
             f = f + jnp.where(row_leaf >= 0, leaf_arr[jnp.maximum(row_leaf, 0)], 0.0)
@@ -283,7 +299,7 @@ class SharedTree(ModelBuilder):
         init = np.log(pri).astype(np.float32)
         f = jnp.broadcast_to(jnp.asarray(init), (N, K)).astype(jnp.float32)
 
-        lr = self._update_f_lr()
+        leaf_clip = self._leaf_clip()
         trees, tree_class, varimp, history = [], [], {}, []
         max_depth = int(self.params["max_depth"])
         stop_metric: List[float] = []
@@ -305,7 +321,8 @@ class SharedTree(ModelBuilder):
                 ln, ld = leaf_stats(row_leaf, w_t * z, w_t * az * (1 - az),
                                     tree.n_leaves)
                 gamma = np.where(ld > 1e-12, (K - 1) / K * ln / np.maximum(ld, 1e-12), 0.0)
-                gamma = np.clip(gamma, -1e4, 1e4)
+                gamma = np.clip(gamma, -leaf_clip, leaf_clip)
+                lr = self._tree_lr(t)
                 tree.set_leaf_values(gamma * lr)
                 leaf_arr = jnp.asarray((gamma * lr).astype(np.float32))
                 upd = jnp.where(row_leaf >= 0, leaf_arr[jnp.maximum(row_leaf, 0)], 0.0)
@@ -342,15 +359,24 @@ class SharedTree(ModelBuilder):
         return mask, jnp.where(mask, w, 0.0)
 
     def _feat_mask_fn(self, rng, spec):
-        rate = float(self.params.get("col_sample_rate_per_tree", 1.0))
-        if rate >= 1.0:
+        """Combine per-tree column sampling (col_sample_rate_per_tree) with
+        per-node sampling (col_sample_rate — GBM.java's per-split rate)."""
+        tree_rate = float(self.params.get("col_sample_rate_per_tree", 1.0))
+        node_rate = float(self.params.get("col_sample_rate", 1.0))
+        if tree_rate >= 1.0 and node_rate >= 1.0:
             return None
-        keep = rng.random(spec.F) < rate
+        keep = rng.random(spec.F) < tree_rate if tree_rate < 1.0 \
+            else np.ones(spec.F, bool)
         if not keep.any():
             keep[rng.integers(spec.F)] = True
 
         def fn(S):
-            return np.broadcast_to(keep, (S, spec.F))
+            mask = np.broadcast_to(keep, (S, spec.F)).copy()
+            if node_rate < 1.0:
+                mask &= rng.random((S, spec.F)) < node_rate
+                for s in np.nonzero(~mask.any(axis=1))[0]:
+                    mask[s, rng.choice(np.nonzero(keep)[0])] = True
+            return mask
 
         return fn
 

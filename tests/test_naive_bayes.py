@@ -1,0 +1,61 @@
+"""NaiveBayes tests vs sklearn GaussianNB/CategoricalNB oracles."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame, T_CAT
+
+
+def test_nb_gaussian_matches_sklearn(cl):
+    from sklearn.naive_bayes import GaussianNB
+
+    from h2o3_tpu.models.naive_bayes import NaiveBayes
+
+    rng = np.random.default_rng(0)
+    n = 2000
+    y = rng.integers(0, 3, n)
+    X = rng.normal(size=(n, 4)) + y[:, None] * np.array([1.0, -1.0, 0.5, 0.0])
+    fr = Frame.from_numpy(X, names=["a", "b", "c", "d"])
+    fr.add("y", Column.from_numpy(np.array([f"c{v}" for v in y]), ctype=T_CAT))
+
+    m = NaiveBayes().train(y="y", training_frame=fr)
+    probs = np.column_stack([m.predict(fr).col(f"c{j}").to_numpy() for j in range(3)])
+
+    sk = GaussianNB().fit(X, y)
+    sk_probs = sk.predict_proba(X)
+    assert (np.argmax(probs, 1) == np.argmax(sk_probs, 1)).mean() > 0.99
+    assert np.abs(probs - sk_probs).max() < 0.05
+    mm = m._output.training_metrics
+    assert mm.logloss < 1.0
+
+
+def test_nb_categorical_laplace(cl):
+    from h2o3_tpu.models.naive_bayes import NaiveBayes
+
+    rng = np.random.default_rng(1)
+    n = 3000
+    y = rng.integers(0, 2, n)
+    # categorical predictor correlated with y
+    x = np.where(rng.random(n) < 0.8, y, 1 - y)
+    fr = Frame()
+    fr.add("x", Column.from_numpy(np.array(["lo", "hi"])[x], ctype=T_CAT))
+    fr.add("y", Column.from_numpy(np.array(["n", "p"])[y], ctype=T_CAT))
+    m = NaiveBayes(laplace=1.0).train(y="y", training_frame=fr)
+    assert m._output.training_metrics.auc > 0.75
+    # P(x=hi | y=p) ≈ 0.8 with laplace pull toward 0.5
+    t = m.cat_tables[0]
+    assert abs(t[1, np.argmax(t[1])] - 0.8) < 0.05
+
+
+def test_nb_handles_nas(cl):
+    from h2o3_tpu.models.naive_bayes import NaiveBayes
+
+    rng = np.random.default_rng(2)
+    n = 1000
+    y = rng.integers(0, 2, n)
+    x = y + rng.normal(0, 0.5, n)
+    x[::7] = np.nan
+    fr = Frame.from_numpy(x.reshape(-1, 1), names=["x"])
+    fr.add("y", Column.from_numpy(np.array(["a", "b"])[y], ctype=T_CAT))
+    m = NaiveBayes().train(y="y", training_frame=fr)
+    assert m._output.training_metrics.auc > 0.8

@@ -139,3 +139,53 @@ def test_isolation_forest_separates_outliers(cl):
     s = sc.col("predict").to_numpy()
     assert s[950:].mean() > s[:950].mean() + 0.1
     assert "mean_length" in sc.names
+
+
+def test_gbm_gaussian_large_mean(cl):
+    """Identity-link init must not clip large response means (review fix)."""
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(2000, 3))
+    y = 1e6 + 100 * X[:, 0] + rng.normal(0, 10, 2000)
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=["a", "b", "c", "y"])
+    m = GBM(ntrees=20, max_depth=3).train(y="y", training_frame=fr)
+    pred = m.predict(fr).col("predict").to_numpy()
+    assert abs(pred.mean() - 1e6) < 1e3
+    assert m._output.training_metrics.rmse < 500
+
+
+def test_drf_training_metrics_are_oob(cl):
+    """DRF training metrics come from out-of-bag predictions (review fix)."""
+    from h2o3_tpu.models.tree.drf import DRF
+
+    fr = _binary()
+    m = DRF(ntrees=30, max_depth=10, seed=5).train(y="y", training_frame=fr)
+    mm = m._output.training_metrics
+    # in-bag AUC of a depth-10 forest is ~1.0; OOB must be meaningfully lower
+    raw = m._predict_raw(m.adapt_test(fr))
+    inbag = m._make_metrics(fr, raw)
+    assert mm.auc < inbag.auc
+    assert 0.6 < mm.auc <= 1.0
+
+
+def test_gbm_annealing_and_leaf_clip(cl):
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    fr, _ = _friedman()
+    m = GBM(ntrees=10, max_depth=3, learn_rate=0.5, learn_rate_annealing=0.5,
+            max_abs_leafnode_pred=0.1).train(y="y", training_frame=fr)
+    # all leaf contributions bounded by max_abs_leafnode_pred * learn_rate
+    assert float(np.abs(np.asarray(m.forest.leaf_val)).max()) <= 0.05 + 1e-6
+
+
+def test_drf_binomial_double_trees(cl):
+    from h2o3_tpu.models.tree.drf import DRF
+
+    fr = _binary()
+    m = DRF(ntrees=20, max_depth=8, binomial_double_trees=True, seed=2).train(
+        y="y", training_frame=fr)
+    assert m._output.training_metrics.auc > 0.75
+    pred = m.predict(fr)
+    p = np.column_stack([pred.col(c).to_numpy() for c in pred.names[1:]])
+    assert np.allclose(p.sum(1), 1.0, atol=1e-5)

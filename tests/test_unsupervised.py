@@ -1,0 +1,112 @@
+"""Unsupervised algos: KMeans, PCA, SVD, GLRM, Aggregator.
+
+Mirrors reference pyunits testdir_algos/kmeans + pca with sklearn/numpy as
+the golden-math oracle."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Frame
+
+
+def _blob_data(n=3000, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[0.0, 0.0], [8.0, 8.0], [-8.0, 8.0]])
+    X = np.concatenate([rng.normal(c, 1.0, size=(n // 3, 2)) for c in centers])
+    rng.shuffle(X)
+    return Frame.from_numpy(X, names=["a", "b"]), centers
+
+
+def test_kmeans_recovers_blobs(cl):
+    from h2o3_tpu.models.kmeans import KMeans
+
+    fr, true_centers = _blob_data()
+    m = KMeans(k=3, standardize=False, max_iterations=20, seed=7).train(
+        training_frame=fr)
+    got = np.sort(np.round(m.centers_raw).astype(int), axis=0)
+    want = np.sort(true_centers.astype(int), axis=0)
+    assert np.allclose(got, want, atol=1)
+    mm = m._output.training_metrics
+    assert mm.tot_withinss < 0.05 * mm.totss
+    assert abs(mm.totss - (mm.tot_withinss + mm.betweenss)) < 1e-2 * mm.totss
+
+
+def test_kmeans_predict_and_sizes(cl):
+    from h2o3_tpu.models.kmeans import KMeans
+
+    fr, _ = _blob_data()
+    m = KMeans(k=3, standardize=True, seed=7).train(training_frame=fr)
+    pred = m.predict(fr)
+    lab = pred.col("predict").to_numpy()
+    assert set(np.unique(lab)) <= {0, 1, 2}
+    sizes = np.bincount(lab, minlength=3)
+    assert all(abs(s - 1000) < 100 for s in sizes)
+
+
+def test_kmeans_estimate_k(cl):
+    from h2o3_tpu.models.kmeans import KMeans
+
+    fr, _ = _blob_data()
+    m = KMeans(estimate_k=True, max_k=8, standardize=False, seed=3).train(
+        training_frame=fr)
+    assert m.k == 3
+
+
+def test_kmeans_init_methods(cl):
+    from h2o3_tpu.models.kmeans import KMeans
+
+    fr, _ = _blob_data(n=600)
+    for init in ("Random", "PlusPlus", "Furthest"):
+        m = KMeans(k=3, init=init, standardize=False, seed=11).train(
+            training_frame=fr)
+        mm = m._output.training_metrics
+        assert mm.tot_withinss < 0.1 * mm.totss, init
+
+
+def test_pca_matches_numpy(cl):
+    from h2o3_tpu.models.pca import PCA
+
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(2000, 6)) @ rng.normal(size=(6, 6))
+    fr = Frame.from_numpy(X, names=[f"c{i}" for i in range(6)])
+    m = PCA(k=3, transform="DEMEAN", pca_method="GramSVD").train(training_frame=fr)
+
+    Xc = X - X.mean(0)
+    _, s, Vt = np.linalg.svd(Xc, full_matrices=False)
+    want_sd = s[:3] / np.sqrt(len(X) - 1)
+    # eigenvectors up to sign
+    for j in range(3):
+        v_ref = Vt[j] * np.sign(Vt[j][np.argmax(np.abs(Vt[j]))])
+        assert np.allclose(np.abs(m.eigenvectors[:, j]), np.abs(v_ref), atol=1e-3)
+    assert np.allclose(m.std_deviation[:3] * np.sqrt(len(X)/(len(X)-1)), want_sd * np.sqrt(len(X)/(len(X)-1)), rtol=2e-3)
+    scores = m.predict(fr)
+    sc = scores.to_numpy()
+    # projected variance matches eigenvalues
+    assert np.allclose(sc.var(0, ddof=1), want_sd ** 2, rtol=5e-3)
+
+
+def test_pca_randomized_close_to_exact(cl):
+    from h2o3_tpu.models.pca import PCA
+
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(1500, 8))
+    X[:, 0] *= 10
+    fr = Frame.from_numpy(X, names=[f"c{i}" for i in range(8)])
+    exact = PCA(k=2, transform="DEMEAN", pca_method="GramSVD").train(training_frame=fr)
+    rand = PCA(k=2, transform="DEMEAN", pca_method="Randomized", seed=1).train(training_frame=fr)
+    assert np.allclose(exact.std_deviation, rand.std_deviation, rtol=1e-3)
+
+
+def test_svd_reconstruction(cl):
+    from h2o3_tpu.models.svd import SVD
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(500, 5))
+    fr = Frame.from_numpy(X, names=[f"c{i}" for i in range(5)])
+    m = SVD(nv=5, transform="NONE", svd_method="GramSVD").train(training_frame=fr)
+    _, s, _ = np.linalg.svd(X, full_matrices=False)
+    assert np.allclose(np.sort(m.d)[::-1], s, rtol=1e-3)
+    u = m.predict(fr).to_numpy()
+    # X ≈ U D Vt
+    recon = u @ np.diag(m.d) @ m.v.T
+    assert np.allclose(recon, X, atol=1e-2)
