@@ -1,0 +1,169 @@
+"""Aggregator — exemplar-based data compression.
+
+Reference: hex/aggregator/Aggregator.java — single-pass radius clustering:
+a row within radius_scale of an existing exemplar folds into it (count++),
+otherwise becomes a new exemplar; output is the exemplar frame + counts.
+
+TPU-native: rows stream in device batches; each batch computes distances to
+the current exemplar set in one MXU matmul, then the (rare) new-exemplar
+admissions run greedily on host over only the batch rows that missed. The
+per-row sequential scan of the reference becomes O(n/batch) device calls.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Column, Frame, T_NUM
+from h2o3_tpu.models.data_info import DataInfo
+from h2o3_tpu.models.model import Model, ModelCategory
+from h2o3_tpu.models.model_builder import ModelBuilder, register
+
+
+class AggregatorModel(Model):
+    algo_name = "aggregator"
+
+    def __init__(self, key=None, parms=None):
+        super().__init__(key, parms)
+        self.exemplars: Optional[np.ndarray] = None
+        self.counts: Optional[np.ndarray] = None
+        self.exemplar_rows: Optional[np.ndarray] = None
+        self.output_frame_key: Optional[str] = None
+        self.data_info: Optional[DataInfo] = None
+
+    def aggregated_frame(self) -> Optional[Frame]:
+        from h2o3_tpu.core.dkv import DKV
+
+        return DKV.get(self.output_frame_key) if self.output_frame_key else None
+
+    def _predict_raw(self, frame: Frame):
+        import jax
+        import jax.numpy as jnp
+
+        di = self.data_info
+        arrays = tuple(c.data for c in di.cols(frame))
+        E = jnp.asarray(self.exemplars, jnp.float32)
+
+        @jax.jit
+        def assign(*arrs):
+            X = di.expand(*arrs)
+            d2 = (jnp.sum(X * X, 1, keepdims=True) - 2 * X @ E.T
+                  + jnp.sum(E * E, 1)[None, :])
+            return jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+        return {"cluster": assign(*arrays)}
+
+    def _make_metrics(self, frame, raw):
+        return None
+
+
+@register
+class Aggregator(ModelBuilder):
+    algo_name = "aggregator"
+    model_class = AggregatorModel
+    supervised = False
+
+    @classmethod
+    def default_params(cls):
+        p = super().default_params()
+        p.update({
+            "target_num_exemplars": 5000,
+            "rel_tol_num_exemplars": 0.5,
+            "transform": "NORMALIZE",
+            "categorical_encoding": "AUTO",
+        })
+        return p
+
+    def _fit(self, train: Frame) -> AggregatorModel:
+        import jax
+        import jax.numpy as jnp
+
+        from h2o3_tpu.models.pca import make_data_info
+
+        p = self.params
+        di = make_data_info(train, p)
+        di.use_all_factor_levels = True
+        n = train.nrows
+        arrays = tuple(c.data for c in di.cols(train))
+        X = np.asarray(jax.jit(di.expand)(*arrays))[:n]
+
+        target = int(p.get("target_num_exemplars", 5000))
+        rel_tol = float(p.get("rel_tol_num_exemplars", 0.5))
+        # initial radius from the data diameter heuristic (Aggregator.java
+        # starts from a PCA-scaled guess then iterates to hit the target count)
+        span = float(np.linalg.norm(X.std(axis=0))) or 1.0
+        radius = span * 0.1
+        lo_t = int(target * (1 - rel_tol))
+
+        for _ in range(20):     # radius search to land in the target band
+            ex_idx, assign_v = _radius_pass(X, radius)
+            if len(ex_idx) > target:
+                radius *= 1.7
+            elif len(ex_idx) < max(lo_t, 1) and radius > 1e-8:
+                radius *= 0.6
+            else:
+                break
+
+        counts = np.bincount(assign_v, minlength=len(ex_idx)).astype(np.float64)
+        model = AggregatorModel(parms=dict(p))
+        self._init_output(model, train)
+        model._output.model_category = ModelCategory.Clustering
+        model.data_info = di
+        model.exemplars = X[ex_idx]
+        model.exemplar_rows = np.asarray(ex_idx)
+        model.counts = counts
+
+        out = Frame()
+        from h2o3_tpu.ops.filters import take_rows
+
+        agg = take_rows(train, np.asarray(ex_idx))
+        for name in agg.names:
+            out.add(name, agg.col(name))
+        out.add("counts", Column.from_numpy(counts))
+        out.install()
+        model.output_frame_key = str(out.key)
+        return model
+
+
+def _radius_pass(X: np.ndarray, radius: float):
+    """One streaming pass: batch distance check against exemplars (device
+    matmul), greedy admission within the missed rows."""
+    import jax
+    import jax.numpy as jnp
+
+    n, d = X.shape
+    r2 = radius * radius
+    ex: list = [0]
+    assign = np.zeros(n, np.int64)
+    batch = 4096
+
+    @jax.jit
+    def dists(B, E):
+        return (jnp.sum(B * B, 1, keepdims=True) - 2 * B @ E.T
+                + jnp.sum(E * E, 1)[None, :])
+
+    i = 1
+    while i < n:
+        j = min(i + batch, n)
+        B = X[i:j]
+        E = X[np.asarray(ex)]
+        d2 = np.asarray(dists(jnp.asarray(B), jnp.asarray(E)))
+        best = d2.argmin(axis=1)
+        bestd = d2[np.arange(len(B)), best]
+        assign[i:j] = best
+        missed = np.nonzero(bestd > r2)[0]
+        if len(missed):
+            # greedy host admission for the (few) rows outside every radius
+            for mi in missed:
+                row = B[mi]
+                dd = ((X[np.asarray(ex)] - row) ** 2).sum(axis=1)
+                bi = int(dd.argmin())
+                if dd[bi] <= r2:
+                    assign[i + mi] = bi
+                else:
+                    ex.append(i + mi)
+                    assign[i + mi] = len(ex) - 1
+        i = j
+    return np.asarray(ex), assign

@@ -1,0 +1,153 @@
+"""StackedEnsemble — metalearner over base-model CV predictions.
+
+Reference: hex/ensemble/StackedEnsemble.java — level-one frame assembled from
+base models' cross-validation holdout predictions (requires identical fold
+assignment + keep_cross_validation_predictions), metalearner GLM (default,
+non-negative) / GBM / DRF / DeepLearning trained on it; scoring stacks base
+predictions then applies the metalearner (StackedEnsembleModel.predictScoreImpl).
+
+TPU-native: the level-one frame is a handful of device columns (one per base
+probability/value) — the metalearner trains on it like any frame; scoring
+chains the base models' jitted predict programs into the metalearner's.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from h2o3_tpu.core.dkv import DKV
+from h2o3_tpu.core.frame import Column, Frame, T_NUM
+from h2o3_tpu.models.model import Model, ModelCategory
+from h2o3_tpu.models.model_builder import ModelBuilder, register
+
+
+def _resolve(m):
+    if isinstance(m, Model):
+        return m
+    got = DKV.get(str(m))
+    if got is None:
+        raise ValueError(f"base model {m!r} not found")
+    return got
+
+
+def _level_one_columns(model: Model, raw: dict, prefix: str):
+    """Base-model prediction → level-one feature arrays (drop last class
+    prob — it's linearly dependent, StackedEnsemble.java keeps K-1+1 conv)."""
+    if "probs" in raw:
+        probs = raw["probs"]
+        k = probs.shape[1]
+        if k == 2:
+            return {f"{prefix}": probs[:, 1]}
+        return {f"{prefix}_p{j}": probs[:, j] for j in range(k)}
+    return {f"{prefix}": raw["value"]}
+
+
+class StackedEnsembleModel(Model):
+    algo_name = "stackedensemble"
+
+    def __init__(self, key=None, parms=None):
+        super().__init__(key, parms)
+        self.base_keys: List[str] = []
+        self.metalearner: Optional[Model] = None
+
+    def _level_one(self, frame: Frame) -> Frame:
+        lf = Frame()
+        n = frame.nrows
+        for bk in self.base_keys:
+            bm = _resolve(bk)
+            raw = bm._predict_raw(bm.adapt_test(frame))
+            for name, arr in _level_one_columns(bm, raw, bk).items():
+                lf.add(name, Column(arr, T_NUM, n))
+        return lf
+
+    def _predict_raw(self, frame: Frame):
+        lf = self._level_one(frame)
+        return self.metalearner._predict_raw(self.metalearner.adapt_test(lf))
+
+
+@register
+class StackedEnsemble(ModelBuilder):
+    algo_name = "stackedensemble"
+    model_class = StackedEnsembleModel
+
+    @classmethod
+    def default_params(cls):
+        p = super().default_params()
+        p.update({
+            "base_models": [],
+            "metalearner_algorithm": "AUTO",   # AUTO(=glm)/glm/gbm/drf/deeplearning
+            "metalearner_nfolds": 0,
+            "metalearner_params": {},
+        })
+        return p
+
+    def _fit(self, train: Frame) -> StackedEnsembleModel:
+        p = self.params
+        resp = p["response_column"]
+        bases = [_resolve(b) for b in (p.get("base_models") or [])]
+        if len(bases) < 1:
+            raise ValueError("stackedensemble requires base_models")
+
+        # level-one training data from CV holdout predictions
+        lf = Frame()
+        n = train.nrows
+        for bm in bases:
+            cvp = bm._output.cross_validation_holdout_predictions
+            if cvp is None:
+                raise ValueError(
+                    f"base model {bm.key} lacks cross-validation predictions "
+                    "(train with nfolds>1 and keep_cross_validation_predictions=True)")
+            if len(cvp) != n:
+                raise ValueError(f"base model {bm.key} was trained on a different frame")
+            raw = ({"probs": cvp} if cvp.ndim == 2 else {"value": cvp})
+            for name, arr in _level_one_columns(bm, raw, str(bm.key)).items():
+                lf.add(name, Column.from_numpy(np.asarray(arr)))
+        lf.add(resp, train.col(resp))
+        if p.get("weights_column"):
+            lf.add(p["weights_column"], train.col(p["weights_column"]))
+
+        algo = (p.get("metalearner_algorithm") or "AUTO").lower()
+        mparams = dict(p.get("metalearner_params") or {})
+        mparams.setdefault("seed", self._seed())
+        if algo in ("auto", "glm"):
+            from h2o3_tpu.models.glm import GLM
+
+            y_col = train.col(resp)
+            if y_col.is_categorical:
+                fam = "binomial" if y_col.cardinality == 2 else "multinomial"
+            else:
+                fam = "gaussian"
+            mparams.setdefault("family", fam)
+            # AUTO metalearner is non-negative GLM (StackedEnsemble.java default)
+            if algo == "auto":
+                mparams.setdefault("non_negative", True)
+                mparams.setdefault("lambda_", 0.0)
+            builder = GLM(**mparams)
+        elif algo == "gbm":
+            from h2o3_tpu.models.tree.gbm import GBM
+
+            builder = GBM(**mparams)
+        elif algo == "drf":
+            from h2o3_tpu.models.tree.drf import DRF
+
+            builder = DRF(**mparams)
+        elif algo == "deeplearning":
+            from h2o3_tpu.models.deeplearning import DeepLearning
+
+            builder = DeepLearning(**mparams)
+        else:
+            raise ValueError(f"unknown metalearner_algorithm {algo!r}")
+
+        nfolds = int(p.get("metalearner_nfolds", 0) or 0)
+        extra = {"nfolds": nfolds} if nfolds > 1 else {}
+        if p.get("weights_column"):
+            extra["weights_column"] = p["weights_column"]
+        meta = builder.train(y=resp, training_frame=lf, **extra)
+
+        model = StackedEnsembleModel(parms=dict(p))
+        self._init_output(model, train)
+        model.base_keys = [str(b.key) for b in bases]
+        model.metalearner = meta
+        return model

@@ -288,15 +288,21 @@ def _irls_fit(arrays, y, w, offset, beta0, lam_l2, lam_l1, beta_eps, *, expand,
         z = (eta - offset) + (y - mu) * gp
         # the distributed Gram pass: one MXU matmul + psum (gram/Gram.java)
         Xw = Xi * wls[:, None]
-        G = Xi.T @ Xw / 1.0
-        q = Xw.T @ z
+        # full f32 precision: TPU matmuls default to bf16, which destroys the
+        # conditioning the Cholesky/ADMM relies on for collinear designs
+        with jax.default_matmul_precision("highest"):
+            G = Xi.T @ Xw
+            q = Xw.T @ z
         Greg = G + lam_l2 * jnp.diag(jnp.concatenate([jnp.ones(p), jnp.zeros(1)]))
         use_admm = (lam_l1 > 0) | non_negative
+        # jitter scaled to the Gram's magnitude: collinear designs (e.g.
+        # one-hot groups summing to the intercept) stay solvable in f32
+        jitter = 1e-6 * (jnp.trace(Greg) / pi + 1.0)
         beta_new = jax.lax.cond(
             use_admm,
             lambda: admm_solve(Greg, q, lam_l1),
             lambda: jsl.cho_solve(
-                jsl.cho_factor(Greg + 1e-7 * jnp.eye(pi, dtype=G.dtype)), q))
+                jsl.cho_factor(Greg + jitter * jnp.eye(pi, dtype=G.dtype)), q))
         dev = dev_of(beta_new)
         return beta_new, it + 1, beta, dev
 
@@ -592,11 +598,19 @@ class GLM(ModelBuilder):
             path = lam_max * np.power(float(self.params["lambda_min_ratio"]), np.linspace(0, 1, nl))
             beta, prev_dev, chosen = b0, np.inf, path[0]
             fitted = 0
+            null_dev_est = None
             for lv in path:
                 beta_new, iters, dev = fit_one(lv, beta)
                 fitted += 1
                 dev = float(dev)
-                if prev_dev < np.inf and dev > prev_dev * (1 - 1e-4):
+                if null_dev_est is None:
+                    null_dev_est = dev     # at lambda_max all coefs are 0
+                # stall-stop only AFTER the path has started explaining
+                # deviance — near lambda_max nothing is active yet and the
+                # improvement is legitimately ~0 (GLM.java walks on)
+                started = dev < null_dev_est * 0.999
+                if (prev_dev < np.inf and started
+                        and dev > prev_dev * (1 - 1e-4)):
                     break  # improvement stalled: keep previous lambda's fit
                 beta, prev_dev, chosen = beta_new, dev, lv
             dev = prev_dev

@@ -48,6 +48,8 @@ class ModelOutput:
         self.validation_metrics: Optional[M.ModelMetrics] = None
         self.cross_validation_metrics: Optional[M.ModelMetrics] = None
         self.cv_fold_metrics: List[M.ModelMetrics] = []
+        # (n,) or (n,K) holdout predictions — StackedEnsemble level-one data
+        self.cross_validation_holdout_predictions = None
         self.variable_importances: Optional[Dict[str, float]] = None
         self.scoring_history: List[dict] = []
         self.run_time_ms: int = 0

@@ -126,10 +126,13 @@ class ModelBuilder:
         fold_col = self.params.get("fold_column")
         cv_models: List[Model] = []
         cv_metrics: List = []
+        cv_preds = None
         if nfolds > 1 or fold_col:
-            cv_models, cv_metrics = self._cross_validate(train, nfolds, fold_col)
+            cv_models, cv_metrics, cv_preds = self._cross_validate(train, nfolds, fold_col)
 
         model = self._fit(train)
+        if cv_preds is not None:
+            model._output.cross_validation_holdout_predictions = cv_preds
         model._output.training_metrics = self._score_on(model, train)
         if valid is not None:
             model._output.validation_metrics = self._score_on(model, valid)
@@ -147,7 +150,10 @@ class ModelBuilder:
 
     def _cross_validate(self, train: Frame, nfolds: int, fold_col: Optional[str]):
         """hex/ModelBuilder CV: assign folds, train N fold models on
-        out-of-fold rows, score each on its holdout."""
+        out-of-fold rows, score each on its holdout. With
+        keep_cross_validation_predictions, holdout predictions are scattered
+        back into one full-length array (the StackedEnsemble level-one data,
+        reference CVModelBuilder + StackedEnsemble.java)."""
         from h2o3_tpu.ops.filters import take_rows
 
         n = train.nrows
@@ -180,22 +186,35 @@ class ModelBuilder:
             else:
                 raise ValueError(f"unknown fold_assignment {scheme!r}")
             folds = list(range(nfolds))
+        keep_preds = bool(self.params.get("keep_cross_validation_predictions"))
         models, mets = [], []
+        preds_buf = None
         for fi, f in enumerate(folds):
+            ho_idx = np.nonzero(assign == f)[0]
             tr = take_rows(train, np.nonzero(assign != f)[0])
-            ho = take_rows(train, np.nonzero(assign == f)[0])
+            ho = take_rows(train, ho_idx)
             sub = type(self)(**{k: v for k, v in self.params.items()
                                 if k not in ("nfolds", "fold_column", "training_frame",
                                              "validation_frame", "model_id")})
             m = sub._fit(tr)
-            mets.append(sub._score_on(m, ho))
+            # one predict pass serves both the fold metrics and the stacked
+            # holdout predictions (review: avoid scoring each holdout twice)
+            raw = m._predict_raw(m.adapt_test(ho))
+            mets.append(m._make_metrics(ho, raw))
+            if keep_preds:
+                vals = np.asarray(raw["probs"] if "probs" in raw else raw["value"])
+                vals = vals[: len(ho_idx)]        # drop shard padding
+                if preds_buf is None:
+                    shape = (n,) + vals.shape[1:]
+                    preds_buf = np.zeros(shape, np.float32)
+                preds_buf[ho_idx] = vals
             models.append(m)
             if self.job:
                 self.job.update(progress=0.5 * (fi + 1) / len(folds),
                                 msg=f"CV fold {fi + 1}/{len(folds)}")
             tr.delete()
             ho.delete()
-        return models, mets
+        return models, mets, preds_buf
 
     def _score_on(self, model: Model, frame: Frame):
         raw = model._predict_raw(model.adapt_test(frame))

@@ -141,7 +141,8 @@ class PCA(ModelBuilder):
             X = di.expand(*arrs)
             w = (jnp.arange(X.shape[0]) < n).astype(jnp.float32)
             Xw = X * w[:, None]
-            return Xw.T @ Xw
+            with jax.default_matmul_precision("highest"):
+                return Xw.T @ Xw
 
         G = gram(*arrays)
         G = np.asarray(G) / max(n - 1, 1)

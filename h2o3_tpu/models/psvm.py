@@ -1,0 +1,180 @@
+"""PSVM — kernel SVM via Incomplete Cholesky Factorization.
+
+Reference: hex/psvm/PSVM.java (:139-143) — Gaussian-kernel SVM made
+distributed by a rank-r Incomplete Cholesky Factorization of the kernel
+matrix (K ≈ HHᵀ), then an interior-point solve on the low-rank system.
+
+TPU-native design: the ICF pivot loop runs r small steps, each computing one
+kernel column as a row-sharded matmul + elementwise exp (MXU + VPU); the SVM
+itself is then solved in the PRIMAL on the explicit feature map H — squared
+hinge + L2, optimized by a jitted full-batch Newton/gradient loop. Same
+model class (K ≈ HHᵀ ⇒ kernel machine ≡ linear machine on H), no interior
+point needed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.models.data_info import DataInfo
+from h2o3_tpu.models.model import Model, ModelCategory
+from h2o3_tpu.models.model_builder import ModelBuilder, register
+
+
+class PSVMModel(Model):
+    algo_name = "psvm"
+
+    def __init__(self, key=None, parms=None):
+        super().__init__(key, parms)
+        self.pivots: Optional[np.ndarray] = None    # (r, d) pivot rows
+        self.icf_L: Optional[np.ndarray] = None     # (r, r) lower-tri map
+        self.beta: Optional[np.ndarray] = None      # (r + 1,) weights + bias
+        self.gamma: float = 1.0
+        self.data_info: Optional[DataInfo] = None
+        self.svs_count: int = 0
+
+    def _features(self, X):
+        """H columns for new rows: k(x, pivots) mapped through L⁻ᵀ."""
+        import jax.numpy as jnp
+
+        P = jnp.asarray(self.pivots, jnp.float32)
+        Linv = jnp.asarray(self.icf_L, jnp.float32)
+        d2 = (jnp.sum(X * X, 1, keepdims=True) - 2 * X @ P.T
+              + jnp.sum(P * P, 1)[None, :])
+        Kp = jnp.exp(-self.gamma * jnp.maximum(d2, 0.0))
+        return Kp @ Linv
+
+    def _predict_raw(self, frame: Frame):
+        import jax
+        import jax.numpy as jnp
+
+        di = self.data_info
+        arrays = tuple(c.data for c in di.cols(frame))
+        beta = jnp.asarray(self.beta, jnp.float32)
+
+        @jax.jit
+        def decide(*arrs):
+            H = self._features(di.expand(*arrs))
+            f = H @ beta[:-1] + beta[-1]
+            p = jax.nn.sigmoid(2.0 * f)      # Platt-lite calibration
+            return jnp.stack([1 - p, p], axis=-1), f
+
+        probs, f = decide(*arrays)
+        return {"probs": probs, "decision": f}
+
+
+@register
+class PSVM(ModelBuilder):
+    algo_name = "psvm"
+    model_class = PSVMModel
+
+    @classmethod
+    def default_params(cls):
+        p = super().default_params()
+        p.update({
+            "hyper_param": 1.0,         # C
+            "kernel_type": "gaussian",
+            "gamma": -1.0,              # -1 = 1/#features
+            "rank_ratio": -1.0,         # ICF rank fraction; -1 = sqrt(n)
+            "positive_weight": 1.0,
+            "negative_weight": 1.0,
+            "sv_threshold": 1e-4,
+            "max_iterations": 200,
+        })
+        return p
+
+    def _fit(self, train: Frame) -> PSVMModel:
+        import jax
+        import jax.numpy as jnp
+
+        p = self.params
+        resp = p["response_column"]
+        y_col = train.col(resp)
+        if not y_col.is_categorical or y_col.cardinality != 2:
+            raise ValueError("psvm requires a binary categorical response")
+        di = DataInfo(train, response=resp,
+                      ignored=p.get("ignored_columns") or (),
+                      standardize=True, use_all_factor_levels=False)
+        n = train.nrows
+        arrays = tuple(c.data for c in di.cols(train))
+        X_all = jax.jit(di.expand)(*arrays)
+        X = np.asarray(X_all)[:n].astype(np.float32)
+        y01 = np.asarray(y_col.data)[:n]
+        yv = np.where(y01 > 0, 1.0, -1.0).astype(np.float32)
+        w = np.where(yv > 0, float(p.get("positive_weight", 1.0)),
+                     float(p.get("negative_weight", 1.0))).astype(np.float32)
+        w[np.asarray(y01) < 0] = 0.0       # NA responses drop out
+
+        gamma = float(p.get("gamma", -1.0))
+        if gamma <= 0:
+            gamma = 1.0 / max(di.fullN, 1)
+        rr = float(p.get("rank_ratio", -1.0))
+        r = int(rr * n) if rr > 0 else int(np.sqrt(n)) + 1
+        r = max(min(r, n, 512), 1)
+
+        pivots_idx, H, L = _icf(X, gamma, r)
+
+        # primal squared-hinge SVM on H (jitted Nesterov gradient loop)
+        C = float(p.get("hyper_param", 1.0))
+        Hd = jnp.asarray(H)
+        yd = jnp.asarray(yv)
+        wd = jnp.asarray(w)
+        r_eff = H.shape[1]
+        max_iter = int(p.get("max_iterations", 200))
+
+        from jax.scipy.optimize import minimize as jmin
+
+        def loss_fn(b):
+            f = Hd @ b[:-1] + b[-1]
+            margin = jnp.maximum(1.0 - yd * f, 0.0)
+            return (0.5 * jnp.sum(b[:-1] ** 2)
+                    + C * jnp.sum(wd * margin * margin))
+
+        # squared hinge is C¹ so BFGS converges fast on the r+1 primal vars
+        res = jax.jit(lambda b0: jmin(loss_fn, b0, method="BFGS",
+                                      options={"maxiter": max_iter * 10}))(
+            jnp.zeros(r_eff + 1, jnp.float32))
+        beta = np.asarray(res.x)
+
+        model = PSVMModel(parms=dict(p))
+        self._init_output(model, train)
+        model.data_info = di
+        model.gamma = gamma
+        model.pivots = X[pivots_idx]
+        model.icf_L = L
+        model.beta = beta
+        f = H @ beta[:-1] + beta[-1]
+        model.svs_count = int(np.sum((1.0 - yv * f) > float(p.get("sv_threshold", 1e-4))))
+        return model
+
+
+def _icf(X: np.ndarray, gamma: float, r: int):
+    """Incomplete Cholesky of the RBF kernel: greedy max-residual pivoting.
+    Returns (pivot_indices, H=(n,r) with K≈HHᵀ, Linv=(r,r) map for new data)."""
+    n = X.shape[0]
+    diag = np.ones(n, np.float64)           # k(x,x)=1 for RBF
+    H = np.zeros((n, r), np.float64)
+    pivots = []
+    Kpp = np.zeros((r, r), np.float64)
+    for j in range(r):
+        i = int(np.argmax(diag))
+        if diag[i] < 1e-10:
+            r = j
+            H = H[:, :r]
+            Kpp = Kpp[:r, :r]
+            break
+        pivots.append(i)
+        d2 = ((X - X[i]) ** 2).sum(axis=1)
+        k_col = np.exp(-gamma * d2)
+        h = (k_col - H[:, :j] @ H[i, :j]) / np.sqrt(diag[i])
+        H[:, j] = h
+        diag = np.maximum(diag - h * h, 0.0)
+    piv = np.asarray(pivots)
+    # map for out-of-sample rows: H_new = K(new, pivots) @ Linv where
+    # L = H[pivots] is lower-triangular by construction
+    Lp = H[piv][:, :len(piv)]
+    Linv = np.linalg.inv(Lp + 1e-10 * np.eye(len(piv)))
+    return piv, H.astype(np.float32), Linv.T.astype(np.float32)

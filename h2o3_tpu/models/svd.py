@@ -101,7 +101,8 @@ class SVD(ModelBuilder):
             X = di.expand(*arrs)
             w = (jnp.arange(X.shape[0]) < n).astype(jnp.float32)
             Xw = X * w[:, None]
-            return Xw.T @ Xw
+            with jax.default_matmul_precision("highest"):
+                return Xw.T @ Xw
 
         G = gram(*arrays)
         if method == "gramsvd":

@@ -105,6 +105,7 @@ class DRF(SharedTree):
 
         max_depth = int(self.params["max_depth"])
         trees, varimp, history = [], {}, []
+        leaf_means: list = []
         stop_metric = []
         # OOB accumulation: sum of oob predictions and counts per row
         oob_sum = jnp.zeros(N, jnp.float32)
@@ -118,7 +119,7 @@ class DRF(SharedTree):
                 feat_mask_fn=feat_mask_fn)
             ln, ld = leaf_stats(row_leaf, w_t * y, w_t, tree.n_leaves)
             mean = np.where(ld > 1e-12, ln / np.maximum(ld, 1e-12), 0.0)
-            tree.set_leaf_values(mean / ntrees)   # scoring sums ⇒ average
+            leaf_means.append(mean)
             trees.append(tree)
             self._accumulate_varimp(tree, varimp, model)
             if mask is not None:
@@ -142,6 +143,10 @@ class DRF(SharedTree):
                 self.job.update(progress=(t + 1) / ntrees, msg=f"tree {t + 1}")
         model._output.scoring_history = history
         self._finalize_varimp(model, varimp)
+        # scale leaves by the ACTUAL tree count (early stopping may truncate)
+        # so the summed traversal averages correctly
+        for tree, mean in zip(trees, leaf_means):
+            tree.set_leaf_values(mean / len(trees))
         forest = CompressedForest.from_host_trees(
             trees, spec, max_depth=max_depth, init_f=0.0, nclasses=1)
         f = jnp.where(oob_cnt > 0, oob_sum / jnp.maximum(oob_cnt, 1.0), 0.0)

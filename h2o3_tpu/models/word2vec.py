@@ -1,0 +1,246 @@
+"""Word2Vec — SkipGram embeddings with negative sampling.
+
+Reference: hex/word2vec/Word2Vec.java (:16 SkipGram/CBOW) and
+WordVectorTrainer.java (:126) — per-node MRTask trains shared weights with
+hierarchical softmax over a host corpus; input is a one-word-per-row string
+frame with NA rows as sentence breaks; transform aggregates embeddings.
+
+TPU-native design: the corpus is tokenized host-side (strings never touch
+the device, SURVEY.md §7); training pairs (center, context) are generated
+per epoch as flat index arrays, and the whole epoch of negative-sampling
+SGD steps runs in one lax.scan — each step is a batched embedding gather +
+dot + scatter-add update, which XLA fuses. Hierarchical softmax is replaced
+by negative sampling (the standard accelerator-friendly variant of the same
+objective).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Column, Frame, T_NUM, T_STR
+from h2o3_tpu.models.model import Model, ModelCategory
+from h2o3_tpu.models.model_builder import ModelBuilder, register
+
+
+class Word2VecModel(Model):
+    algo_name = "word2vec"
+
+    def __init__(self, key=None, parms=None):
+        super().__init__(key, parms)
+        self.vocab: Dict[str, int] = {}
+        self.vectors: Optional[np.ndarray] = None   # (V, dim)
+
+    # -- reference API surface -------------------------------------------
+    def find_synonyms(self, word: str, count: int = 20) -> Dict[str, float]:
+        """Cosine-nearest words (Word2VecModel.findSynonyms)."""
+        if word not in self.vocab:
+            return {}
+        V = self.vectors
+        q = V[self.vocab[word]]
+        sims = V @ q / (np.linalg.norm(V, axis=1) * np.linalg.norm(q) + 1e-12)
+        order = np.argsort(sims)[::-1]
+        words = list(self.vocab)
+        out = {}
+        for i in order:
+            if words[i] == word:
+                continue
+            out[words[i]] = float(sims[i])
+            if len(out) >= count:
+                break
+        return out
+
+    def word_vec(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.get(word)
+        return self.vectors[i] if i is not None else None
+
+    def transform(self, frame: Frame, aggregate_method: str = "NONE") -> Frame:
+        """Embed a one-word-per-row string frame. aggregate_method AVERAGE
+        pools consecutive words into one row per NA-terminated sequence
+        (Word2VecModel.transform)."""
+        words = frame.col(0).host_data if frame.col(0).is_string \
+            else frame.col(0).values()
+        dim = self.vectors.shape[1]
+        if aggregate_method.upper() == "NONE":
+            out = np.full((len(words), dim), np.nan, np.float32)
+            for r, w in enumerate(words):
+                i = self.vocab.get(w) if w is not None else None
+                if i is not None:
+                    out[r] = self.vectors[i]
+        else:  # AVERAGE
+            rows, acc, cnt = [], np.zeros(dim), 0
+            for w in words:
+                if w is None or w != w or w == "":
+                    rows.append(acc / cnt if cnt else np.full(dim, np.nan))
+                    acc, cnt = np.zeros(dim), 0
+                    continue
+                i = self.vocab.get(w)
+                if i is not None:
+                    acc = acc + self.vectors[i]
+                    cnt += 1
+            if cnt or not rows:
+                rows.append(acc / cnt if cnt else np.full(dim, np.nan))
+            out = np.asarray(rows, np.float32)
+        fr = Frame()
+        for j in range(dim):
+            fr.add(f"C{j+1}", Column.from_numpy(out[:, j]))
+        return fr
+
+    def to_frame(self) -> Frame:
+        """Vocab + vectors as a frame (Word2VecModel.toFrame)."""
+        fr = Frame()
+        fr.add("Word", Column.from_numpy(np.asarray(list(self.vocab), object)))
+        for j in range(self.vectors.shape[1]):
+            fr.add(f"V{j+1}", Column.from_numpy(self.vectors[:, j]))
+        return fr
+
+    def _predict_raw(self, frame: Frame):
+        raise NotImplementedError("use transform()/find_synonyms()")
+
+    def _make_metrics(self, frame, raw):
+        return None
+
+
+@register
+class Word2Vec(ModelBuilder):
+    algo_name = "word2vec"
+    model_class = Word2VecModel
+    supervised = False
+
+    def _score_on(self, model, frame):
+        return None      # embeddings have no frame metrics (reference: none)
+
+    @classmethod
+    def default_params(cls):
+        p = super().default_params()
+        p.update({
+            "vec_size": 100,
+            "window_size": 5,
+            "epochs": 5,
+            "min_word_freq": 5,
+            "init_learning_rate": 0.025,
+            "sent_sample_rate": 1e-3,
+            "negative_samples": 5,     # replaces hierarchical softmax
+            "word_model": "SkipGram",
+        })
+        return p
+
+    def _fit(self, train: Frame) -> Word2VecModel:
+        import jax
+        import jax.numpy as jnp
+
+        p = self.params
+        col = train.col(0)
+        words = col.host_data if col.is_string else col.values()
+        seed = self._seed()
+        rng = np.random.default_rng(seed)
+
+        # ---- host: vocab + subsampled corpus of int codes ----------------
+        min_freq = int(p.get("min_word_freq", 5))
+        counts: Dict[str, int] = {}
+        for w in words:
+            if w is None or w != w or w == "":
+                continue
+            counts[w] = counts.get(w, 0) + 1
+        vocab = {w: i for i, (w, c) in enumerate(
+            sorted(counts.items(), key=lambda kv: -kv[1])) if c >= min_freq}
+        if not vocab:
+            raise ValueError("no words above min_word_freq")
+        V = len(vocab)
+        freqs = np.zeros(V)
+        for w, i in vocab.items():
+            freqs[i] = counts[w]
+        total = freqs.sum()
+
+        # frequent-word subsampling (word2vec sent_sample_rate)
+        t = float(p.get("sent_sample_rate", 1e-3)) or 1.0
+        keep_prob = np.minimum(1.0, np.sqrt(t * total / freqs) + t * total / freqs)
+
+        corpus: List[int] = []
+        breaks: List[int] = [0]
+        for w in words:
+            if w is None or w != w or w == "":
+                if len(corpus) > breaks[-1]:
+                    breaks.append(len(corpus))
+                continue
+            i = vocab.get(w)
+            if i is not None and rng.random() < keep_prob[i]:
+                corpus.append(i)
+        if len(corpus) > breaks[-1]:
+            breaks.append(len(corpus))
+        corpus_a = np.asarray(corpus, np.int32)
+
+        # ---- host: skip-gram pair generation (vectorized windows) --------
+        window = int(p.get("window_size", 5))
+        centers, contexts = [], []
+        for s, e in zip(breaks[:-1], breaks[1:]):
+            sent = corpus_a[s:e]
+            L = len(sent)
+            for off in range(1, window + 1):
+                if L > off:
+                    centers.append(sent[:-off]); contexts.append(sent[off:])
+                    centers.append(sent[off:]);  contexts.append(sent[:-off])
+        if not centers:
+            raise ValueError("corpus has no co-occurrence pairs (check window/min_word_freq)")
+        centers_a = np.concatenate(centers)
+        contexts_a = np.concatenate(contexts)
+
+        dim = int(p.get("vec_size", 100))
+        neg = int(p.get("negative_samples", 5))
+        lr0 = float(p.get("init_learning_rate", 0.025))
+        epochs = int(p.get("epochs", 5))
+        batch = 1024
+        n_pairs = len(centers_a)
+        steps = max(n_pairs // batch, 1)
+
+        # unigram^0.75 negative-sampling table
+        ns = freqs ** 0.75
+        ns_probs = jnp.asarray(ns / ns.sum(), jnp.float32)
+
+        Win = jnp.asarray(rng.uniform(-0.5 / dim, 0.5 / dim, (V, dim)), jnp.float32)
+        Wout = jnp.zeros((V, dim), jnp.float32)
+        cen_d = jnp.asarray(centers_a)
+        ctx_d = jnp.asarray(contexts_a)
+
+        @jax.jit
+        def run_epoch(Win, Wout, key, lr):
+            def step(carry, si):
+                Win, Wout, key = carry
+                key, k1, k2 = jax.random.split(key, 3)
+                idx = jax.random.randint(k1, (batch,), 0, n_pairs)
+                c, o = cen_d[idx], ctx_d[idx]
+                negs = jax.random.choice(k2, V, (batch, neg), p=ns_probs)
+                h = Win[c]                                  # (B, d)
+                # positive pair + negatives in one batched matmul
+                tgt = jnp.concatenate([o[:, None], negs], axis=1)   # (B, 1+neg)
+                out = Wout[tgt]                             # (B, 1+neg, d)
+                scores = jnp.einsum("bd,bkd->bk", h, out)
+                labels = jnp.concatenate(
+                    [jnp.ones((batch, 1)), jnp.zeros((batch, neg))], axis=1)
+                g = (jax.nn.sigmoid(scores) - labels) * lr  # (B, 1+neg)
+                grad_h = jnp.einsum("bk,bkd->bd", g, out)
+                grad_out = jnp.einsum("bk,bd->bkd", g, h)
+                Win = Win.at[c].add(-grad_h)
+                Wout = Wout.at[tgt.reshape(-1)].add(
+                    -grad_out.reshape(-1, dim))
+                return (Win, Wout, key), None
+
+            (Win, Wout, key), _ = jax.lax.scan(
+                step, (Win, Wout, key), jnp.arange(steps))
+            return Win, Wout, key
+
+        key = jax.random.PRNGKey(seed)
+        for ep in range(epochs):
+            lr = lr0 * max(1.0 - ep / max(epochs, 1), 1e-2)
+            Win, Wout, key = run_epoch(Win, Wout, key, lr)
+            if self.job:
+                self.job.update(progress=(ep + 1) / epochs, msg=f"epoch {ep+1}")
+
+        model = Word2VecModel(parms=dict(p))
+        model._output.model_category = ModelCategory.WordEmbedding
+        model.vocab = vocab
+        model.vectors = np.asarray(Win, np.float32)
+        return model

@@ -1,0 +1,78 @@
+"""GLRM + Word2Vec tests."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame
+
+
+def test_glrm_low_rank_recovery(cl):
+    from h2o3_tpu.models.glrm import GLRM
+
+    rng = np.random.default_rng(0)
+    Xt = rng.normal(size=(1500, 3))
+    Yt = rng.normal(size=(3, 8))
+    A = Xt @ Yt + 0.01 * rng.normal(size=(1500, 8))
+    fr = Frame.from_numpy(A, names=[f"c{i}" for i in range(8)])
+    m = GLRM(k=3, loss="Quadratic", max_iterations=300, seed=1).train(
+        training_frame=fr)
+    recon = m.predict(fr).to_numpy()
+    rel = np.linalg.norm(recon - A) / np.linalg.norm(A)
+    assert rel < 0.05
+    assert m.archetypes.shape == (3, 8)
+
+
+def test_glrm_nonneg_regularization(cl):
+    from h2o3_tpu.core.dkv import DKV
+    from h2o3_tpu.models.glrm import GLRM
+
+    rng = np.random.default_rng(1)
+    A = np.abs(rng.normal(size=(800, 5)))
+    fr = Frame.from_numpy(A, names=[f"c{i}" for i in range(5)])
+    m = GLRM(k=2, regularization_x="NonNegative", regularization_y="NonNegative",
+             max_iterations=200, seed=2).train(training_frame=fr)
+    X = DKV.get(m.x_key)
+    xv = X.to_numpy()
+    assert xv.min() >= 0.0
+    assert m.archetypes.min() >= 0.0
+
+
+def test_word2vec_synonyms(cl):
+    from h2o3_tpu.models.word2vec import Word2Vec
+
+    rng = np.random.default_rng(3)
+    # synthetic corpus: "cat"/"dog" share contexts; "car"/"truck" share others
+    animals = ["cat", "dog"]
+    vehicles = ["car", "truck"]
+    a_ctx = ["fur", "paw", "meow", "pet"]
+    v_ctx = ["road", "wheel", "engine", "drive"]
+    words = []
+    for _ in range(3000):
+        if rng.random() < 0.5:
+            words += [rng.choice(animals)] + list(rng.choice(a_ctx, 2))
+        else:
+            words += [rng.choice(vehicles)] + list(rng.choice(v_ctx, 2))
+        words.append(None)   # sentence break
+    fr = Frame()
+    fr.add("word", Column.from_numpy(np.asarray(words, object)))
+    m = Word2Vec(vec_size=16, epochs=8, min_word_freq=5, window_size=2,
+                 seed=4).train(training_frame=fr)
+    syn = m.find_synonyms("cat", 3)
+    assert "dog" in list(syn)[:2]
+    syn_v = m.find_synonyms("car", 3)
+    assert "truck" in list(syn_v)[:2]
+
+
+def test_word2vec_transform_average(cl):
+    from h2o3_tpu.models.word2vec import Word2Vec
+
+    words = (["alpha", "beta", None] * 200) + (["alpha", None] * 100)
+    fr = Frame()
+    fr.add("word", Column.from_numpy(np.asarray(words, object)))
+    m = Word2Vec(vec_size=8, epochs=3, min_word_freq=2, window_size=2,
+                 sent_sample_rate=0.0, seed=5).train(training_frame=fr)
+    emb = m.transform(fr, aggregate_method="AVERAGE")
+    assert emb.ncols == 8
+    assert emb.nrows == 300
+    v = m.word_vec("alpha")
+    assert v is not None and v.shape == (8,)

@@ -1,0 +1,66 @@
+"""StackedEnsemble tests (reference pyunits testdir_algos/stackedensemble)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame, T_CAT
+
+
+def _data(n=3000, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4))
+    logit = 1.5 * X[:, 0] - X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+    y = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "Y", "N")
+    fr = Frame.from_numpy(X, names=["a", "b", "c", "d"])
+    fr.add("y", Column.from_numpy(y, ctype=T_CAT))
+    return fr
+
+
+def test_stacked_ensemble_binomial(cl):
+    from h2o3_tpu.models.ensemble import StackedEnsemble
+    from h2o3_tpu.models.glm import GLM
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    fr = _data()
+    gbm = GBM(ntrees=20, max_depth=3, nfolds=3, seed=1,
+              keep_cross_validation_predictions=True).train(y="y", training_frame=fr)
+    glm = GLM(family="binomial", nfolds=3, seed=1,
+              keep_cross_validation_predictions=True).train(y="y", training_frame=fr)
+    se = StackedEnsemble(base_models=[gbm, glm], seed=1).train(
+        y="y", training_frame=fr)
+    auc_se = se._output.training_metrics.auc
+    assert auc_se > 0.80
+    # ensemble should roughly match or beat the best base CV AUC
+    base_cv = max(gbm._output.cross_validation_metrics.auc,
+                  glm._output.cross_validation_metrics.auc)
+    assert auc_se > base_cv - 0.02
+    pred = se.predict(fr)
+    assert set(pred.names) == {"predict", "N", "Y"}
+
+
+def test_stacked_ensemble_requires_cv_preds(cl):
+    from h2o3_tpu.models.ensemble import StackedEnsemble
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    fr = _data(n=800, seed=1)
+    gbm = GBM(ntrees=5, max_depth=3, seed=1).train(y="y", training_frame=fr)
+    with pytest.raises(ValueError, match="cross-validation"):
+        StackedEnsemble(base_models=[gbm]).train(y="y", training_frame=fr)
+
+
+def test_stacked_ensemble_regression(cl):
+    from h2o3_tpu.models.ensemble import StackedEnsemble
+    from h2o3_tpu.models.glm import GLM
+    from h2o3_tpu.models.tree.drf import DRF
+
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(2000, 3))
+    y = 2 * X[:, 0] + np.sin(3 * X[:, 1]) + 0.1 * rng.normal(size=2000)
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=["a", "b", "c", "y"])
+    drf = DRF(ntrees=20, nfolds=3, seed=3,
+              keep_cross_validation_predictions=True).train(y="y", training_frame=fr)
+    glm = GLM(family="gaussian", nfolds=3, seed=3,
+              keep_cross_validation_predictions=True).train(y="y", training_frame=fr)
+    se = StackedEnsemble(base_models=[drf, glm], seed=3).train(
+        y="y", training_frame=fr)
+    assert se._output.training_metrics.r2 > 0.85

@@ -1,0 +1,126 @@
+"""CoxPH, GAM, RuleFit tests."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame, T_CAT
+
+
+def test_coxph_recovers_beta(cl):
+    from h2o3_tpu.models.coxph import CoxPH
+
+    rng = np.random.default_rng(0)
+    n = 3000
+    X = rng.normal(size=(n, 2))
+    beta_true = np.array([0.8, -0.5])
+    # exponential survival times with rate exp(x beta); random censoring
+    t_event = rng.exponential(1.0 / np.exp(X @ beta_true))
+    t_cens = rng.exponential(2.0, n)
+    time = np.minimum(t_event, t_cens)
+    event = (t_event <= t_cens).astype(float)
+    fr = Frame.from_numpy(np.column_stack([X, time, event]),
+                          names=["x1", "x2", "time", "event"])
+    m = CoxPH(stop_column="time", ties="efron").train(y="event", training_frame=fr)
+    assert abs(m.coefficients["x1"] - 0.8) < 0.1
+    assert abs(m.coefficients["x2"] + 0.5) < 0.1
+    assert m.concordance > 0.6
+    assert m.loglik > m.loglik_null
+    # breslow close to efron with few ties
+    mb = CoxPH(stop_column="time", ties="breslow").train(y="event", training_frame=fr)
+    assert abs(mb.coefficients["x1"] - m.coefficients["x1"]) < 0.05
+
+
+def test_gam_fits_nonlinear(cl):
+    from h2o3_tpu.models.gam import GAM
+    from h2o3_tpu.models.glm import GLM
+
+    rng = np.random.default_rng(1)
+    n = 3000
+    x = rng.uniform(-3, 3, n)
+    z = rng.normal(size=n)
+    y = np.sin(x) * 2 + 0.5 * z + 0.1 * rng.normal(size=n)
+    fr = Frame.from_numpy(np.column_stack([x, z, y]), names=["x", "z", "y"])
+    gam = GAM(gam_columns=["x"], num_knots=8, family="gaussian").train(
+        y="y", training_frame=fr)
+    glm = GLM(family="gaussian").train(y="y", training_frame=fr)
+    # spline captures the sine; linear GLM leaves the curvature on the table
+    assert gam._output.training_metrics.r2 > 0.9
+    assert gam._output.training_metrics.r2 > glm._output.training_metrics.r2 + 0.15
+    pred = gam.predict(fr)
+    assert pred.nrows == n
+
+
+def test_rulefit_binomial(cl):
+    from h2o3_tpu.models.rulefit import RuleFit
+
+    rng = np.random.default_rng(2)
+    n = 2000
+    X = rng.uniform(-1, 1, size=(n, 3))
+    # rule-structured truth: x0>0 & x1>0 → mostly YES
+    p = np.where((X[:, 0] > 0) & (X[:, 1] > 0), 0.9, 0.15)
+    y = np.where(rng.random(n) < p, "Y", "N")
+    fr = Frame.from_numpy(X, names=["x0", "x1", "x2"])
+    fr.add("y", Column.from_numpy(y, ctype=T_CAT))
+    m = RuleFit(max_rule_length=2, min_rule_length=2,
+                rule_generation_ntrees=20, seed=3).train(y="y", training_frame=fr)
+    assert m._output.training_metrics.auc > 0.8
+    top = m.rule_importance()[:10]
+    assert any("x0" in r["rule"] or "x1" in r["rule"] for r in top)
+    pred = m.predict(fr)
+    assert "predict" in pred.names
+
+
+def test_psvm_nonlinear_boundary(cl):
+    from h2o3_tpu.models.psvm import PSVM
+
+    rng = np.random.default_rng(4)
+    n = 1500
+    X = rng.normal(size=(n, 2))
+    r2 = (X ** 2).sum(axis=1)
+    y = np.where(r2 < 1.2, "in", "out")      # circular boundary
+    fr = Frame.from_numpy(X, names=["x1", "x2"])
+    fr.add("y", Column.from_numpy(y, ctype=T_CAT))
+    m = PSVM(hyper_param=5.0, seed=1).train(y="y", training_frame=fr)
+    assert m._output.training_metrics.auc > 0.95
+    pred = m.predict(fr)
+    acc = (pred.col("predict").values() == y).mean()
+    assert acc > 0.9
+    assert m.svs_count > 0
+
+
+def test_coxph_left_truncation(cl):
+    """start_column shrinks early risk sets; with entry times the estimate
+    stays consistent while ignoring them would bias it."""
+    from h2o3_tpu.models.coxph import CoxPH
+
+    rng = np.random.default_rng(5)
+    n = 8000
+    x = rng.normal(size=n)
+    t_event = rng.exponential(1.0 / np.exp(0.7 * x))
+    entry = rng.exponential(0.5, n)                  # independent study entry
+    obs = t_event > entry                            # truncation selection
+    x, t_event, entry = x[obs], t_event[obs], entry[obs]
+    fr = Frame.from_numpy(
+        np.column_stack([x, entry, t_event, np.ones(obs.sum())]),
+        names=["x", "entry", "time", "event"])
+    m = CoxPH(stop_column="time", start_column="entry").train(
+        y="event", training_frame=fr)
+    assert abs(m.coefficients["x"] - 0.7) < 0.1
+    # ignoring entry on truncated data is biased
+    m2 = CoxPH(stop_column="time").train(
+        y="event", training_frame=fr.subframe(["x", "time", "event"]))
+    assert abs(m2.coefficients["x"] - 0.7) > abs(m.coefficients["x"] - 0.7)
+
+
+def test_drf_early_stop_keeps_scale(cl):
+    """Truncated forests must still average, not shrink (review fix)."""
+    from h2o3_tpu.models.tree.drf import DRF
+
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(2000, 3))
+    y = 5.0 + X[:, 0]
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=["a", "b", "c", "y"])
+    m = DRF(ntrees=100, max_depth=4, stopping_rounds=2, score_tree_interval=2,
+            stopping_tolerance=0.2, seed=7).train(y="y", training_frame=fr)
+    pred = m.predict(fr).col("predict").to_numpy()
+    assert abs(pred.mean() - 5.0) < 0.3

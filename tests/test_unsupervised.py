@@ -110,3 +110,33 @@ def test_svd_reconstruction(cl):
     # X ≈ U D Vt
     recon = u @ np.diag(m.d) @ m.v.T
     assert np.allclose(recon, X, atol=1e-2)
+
+
+def test_aggregator_compresses(cl):
+    from h2o3_tpu.models.aggregator import Aggregator
+
+    fr, _ = _blob_data(n=3000)
+    m = Aggregator(target_num_exemplars=100, rel_tol_num_exemplars=0.5).train(
+        training_frame=fr)
+    agg = m.aggregated_frame()
+    assert agg is not None
+    assert 20 <= agg.nrows <= 200
+    assert abs(agg.col("counts").to_numpy().sum() - 3000) < 1
+    # exemplars cover all three blobs
+    ex = np.column_stack([agg.col("a").to_numpy(), agg.col("b").to_numpy()])
+    for c in ([0, 0], [8, 8], [-8, 8]):
+        assert (np.linalg.norm(ex - np.asarray(c), axis=1) < 3).any()
+
+
+def test_extended_isolation_forest(cl):
+    from h2o3_tpu.models.extended_isofor import ExtendedIsolationForest
+
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(2000, 4))
+    X[:40] += 8.0                          # planted anomalies
+    fr = Frame.from_numpy(X, names=list("abcd"))
+    m = ExtendedIsolationForest(ntrees=60, sample_size=128, extension_level=3,
+                                seed=1).train(training_frame=fr)
+    pred = m.predict(fr)
+    score = pred.col("predict").to_numpy()
+    assert score[:40].mean() > score[40:].mean() + 0.1
