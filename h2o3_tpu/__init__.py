@@ -25,31 +25,32 @@ from h2o3_tpu.frame_factory import H2OFrame, create_frame
 # estimator surface (mirrors h2o-py/h2o/estimators/*) — loaded lazily so the
 # core package imports fast and partial installs stay importable
 _ESTIMATORS = {
-    "H2OGeneralizedLinearEstimator": "h2o3_tpu.models.glm",
-    "H2OGradientBoostingEstimator": "h2o3_tpu.models.gbm",
-    "H2ORandomForestEstimator": "h2o3_tpu.models.drf",
-    "H2OIsolationForestEstimator": "h2o3_tpu.models.isofor",
-    "H2OExtendedIsolationForestEstimator": "h2o3_tpu.models.extended_isolation_forest",
-    "H2ODeepLearningEstimator": "h2o3_tpu.models.deeplearning",
-    "H2OAutoEncoderEstimator": "h2o3_tpu.models.deeplearning",
-    "H2OKMeansEstimator": "h2o3_tpu.models.kmeans",
-    "H2OPrincipalComponentAnalysisEstimator": "h2o3_tpu.models.pca",
-    "H2OSingularValueDecompositionEstimator": "h2o3_tpu.models.svd",
-    "H2ONaiveBayesEstimator": "h2o3_tpu.models.naive_bayes",
-    "H2OGeneralizedLowRankEstimator": "h2o3_tpu.models.glrm",
-    "H2OWord2vecEstimator": "h2o3_tpu.models.word2vec",
-    "H2OXGBoostEstimator": "h2o3_tpu.models.xgboost",
-    "H2OStackedEnsembleEstimator": "h2o3_tpu.models.stacked_ensemble",
-    "H2ORuleFitEstimator": "h2o3_tpu.models.rulefit",
-    "H2OGeneralizedAdditiveEstimator": "h2o3_tpu.models.gam",
-    "H2OCoxProportionalHazardsEstimator": "h2o3_tpu.models.coxph",
-    "H2OAggregatorEstimator": "h2o3_tpu.models.aggregator",
+    "H2OGeneralizedLinearEstimator": "h2o3_tpu.estimators",
+    "H2OGradientBoostingEstimator": "h2o3_tpu.estimators",
+    "H2ORandomForestEstimator": "h2o3_tpu.estimators",
+    "H2OIsolationForestEstimator": "h2o3_tpu.estimators",
+    "H2OExtendedIsolationForestEstimator": "h2o3_tpu.estimators",
+    "H2ODeepLearningEstimator": "h2o3_tpu.estimators",
+    "H2OAutoEncoderEstimator": "h2o3_tpu.estimators",
+    "H2OKMeansEstimator": "h2o3_tpu.estimators",
+    "H2OPrincipalComponentAnalysisEstimator": "h2o3_tpu.estimators",
+    "H2OSingularValueDecompositionEstimator": "h2o3_tpu.estimators",
+    "H2ONaiveBayesEstimator": "h2o3_tpu.estimators",
+    "H2OGeneralizedLowRankEstimator": "h2o3_tpu.estimators",
+    "H2OWord2vecEstimator": "h2o3_tpu.estimators",
+    "H2OXGBoostEstimator": "h2o3_tpu.estimators",
+    "H2OStackedEnsembleEstimator": "h2o3_tpu.estimators",
+    "H2ORuleFitEstimator": "h2o3_tpu.estimators",
+    "H2OGeneralizedAdditiveEstimator": "h2o3_tpu.estimators",
+    "H2OCoxProportionalHazardsEstimator": "h2o3_tpu.estimators",
+    "H2OAggregatorEstimator": "h2o3_tpu.estimators",
     "H2OTargetEncoderEstimator": "h2o3_tpu.models.target_encoder",
     "H2OGenericEstimator": "h2o3_tpu.models.generic",
-    "H2OSupportVectorMachineEstimator": "h2o3_tpu.models.psvm",
-    "H2OGridSearch": "h2o3_tpu.models.grid",
+    "H2OSupportVectorMachineEstimator": "h2o3_tpu.estimators",
+    "H2OGridSearch": "h2o3_tpu.grid",
     "H2OAutoML": "h2o3_tpu.automl.automl",
-    "rapids_exec": "h2o3_tpu.ops.rapids.rapids",
+    "start_server": "h2o3_tpu.api.server",
+    "exec_rapids": "h2o3_tpu.rapids",
 }
 
 

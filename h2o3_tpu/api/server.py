@@ -1,0 +1,427 @@
+"""REST API server — the /3 (+/99) HTTP surface.
+
+Reference: water/api/RequestServer.java:56 (route table RegisterV3Api.java,
+~122 routes), schemas under water/api/schemas3. Serving stack is jetty in the
+reference; here it's a stdlib ThreadingHTTPServer — the API layer carries
+only JSON metadata, all heavy data stays device-side, so a native web stack
+buys nothing on TPU.
+
+Endpoints (V3 contract subset, grown round over round):
+  GET  /3/Cloud /3/About /3/Jobs/{id} /3/Frames /3/Frames/{id}
+  GET  /3/Frames/{id}/summary /3/Models /3/Models/{id} /3/ModelBuilders
+  GET  /3/ImportFiles?path=  /3/Logs  /4/sessions
+  POST /3/ParseSetup /3/Parse /99/Rapids /3/ModelBuilders/{algo}
+  POST /3/Predictions/models/{m}/frames/{f}  /3/Shutdown
+  DELETE /3/Frames/{id} /3/Models/{id} /3/DKV/{key}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import traceback
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qs, unquote, urlparse
+
+import numpy as np
+
+from h2o3_tpu.core.dkv import DKV
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.core.job import Job
+from h2o3_tpu.models.model import Model
+from h2o3_tpu.rapids import Session, exec_rapids
+
+_JOBS: Dict[str, Job] = {}
+_SESSIONS: Dict[str, Session] = {}
+
+
+def _json_default(o):
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        v = float(o)
+        return None if v != v else v
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    return str(o)
+
+
+def _frame_json(fr: Frame, rows: int = 10) -> dict:
+    cols = []
+    n = min(fr.nrows, rows)
+    for name in fr.names:
+        c = fr.col(name)
+        data = c.values()[:n]
+        cols.append({
+            "label": name, "type": c.ctype,
+            "domain": c.domain,
+            "data": [None if (v is None or (isinstance(v, float) and v != v))
+                     else v for v in data.tolist()],
+        })
+    return {"frame_id": {"name": str(fr.key)}, "rows": fr.nrows,
+            "num_columns": fr.ncols, "columns": cols,
+            "column_names": fr.names}
+
+
+def _summary_json(fr: Frame) -> dict:
+    out = _frame_json(fr, rows=0)
+    out["summary"] = fr.summary()
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ---------------------------------------------------------
+    def log_message(self, fmt, *args):   # quiet; reference logs to file
+        pass
+
+    def _reply(self, obj: Any, code: int = 200):
+        body = json.dumps(obj, default=_json_default).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, msg: str, code: int = 400):
+        self._reply({"__meta": {"schema_type": "H2OError"},
+                     "msg": msg, "exception_msg": msg,
+                     "stacktrace": traceback.format_exc().splitlines()[-8:]},
+                    code)
+
+    def _body(self) -> Dict[str, Any]:
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length).decode() if length else ""
+        ctype = self.headers.get("Content-Type", "")
+        if "json" in ctype and raw:
+            return json.loads(raw)
+        out: Dict[str, Any] = {}
+        for k, vs in parse_qs(raw).items():
+            out[k] = vs[0]
+        return out
+
+    # -- routing ----------------------------------------------------------
+    def do_GET(self):
+        try:
+            self._route("GET")
+        except Exception as e:        # noqa: BLE001 — API boundary
+            self._error(f"{type(e).__name__}: {e}", 500)
+
+    def do_POST(self):
+        try:
+            self._route("POST")
+        except Exception as e:        # noqa: BLE001
+            self._error(f"{type(e).__name__}: {e}", 500)
+
+    def do_DELETE(self):
+        try:
+            self._route("DELETE")
+        except Exception as e:        # noqa: BLE001
+            self._error(f"{type(e).__name__}: {e}", 500)
+
+    def _route(self, method: str):
+        u = urlparse(self.path)
+        parts = [unquote(p) for p in u.path.strip("/").split("/")]
+        q = {k: v[0] for k, v in parse_qs(u.query).items()}
+
+        if parts[0] not in ("3", "99", "4"):
+            return self._error(f"unknown route {u.path}", 404)
+        rest = parts[1:]
+        name = rest[0] if rest else ""
+
+        fn = getattr(self, f"_{method.lower()}_{name.lower().replace('.', '_')}", None)
+        if fn is None:
+            return self._error(f"unknown endpoint {method} {u.path}", 404)
+        return fn(rest[1:], q)
+
+    # -- cloud / misc ------------------------------------------------------
+    def _get_cloud(self, rest, q):
+        from h2o3_tpu.core.runtime import cluster_info
+
+        info = cluster_info()
+        self._reply({"version": info.get("version", "0.1.0"),
+                     "cloud_name": info.get("name", "h2o3_tpu"),
+                     "cloud_size": info.get("n_devices", 1),
+                     "cloud_healthy": True,
+                     "consensus": True, "locked": True,
+                     "nodes": [{"h2o": f"device{i}", "healthy": True}
+                               for i in range(info.get("n_devices", 1))]})
+
+    def _get_about(self, rest, q):
+        self._reply({"entries": [
+            {"name": "Build project", "value": "h2o3_tpu"},
+            {"name": "Backend", "value": "jax/XLA (TPU-native)"}]})
+
+    def _post_shutdown(self, rest, q):
+        self._reply({"result": "shutting down"})
+        threading.Thread(target=self.server.shutdown, daemon=True).start()
+
+    def _get_sessions(self, rest, q):
+        sid = f"_sid{uuid.uuid4().hex[:12]}"
+        _SESSIONS[sid] = Session(sid)
+        self._reply({"session_key": sid})
+
+    _post_initid = _get_sessions
+    _get_initid = _get_sessions
+
+    def _get_logs(self, rest, q):
+        import logging
+
+        lines = []
+        for h in logging.getLogger("h2o3_tpu").handlers:
+            f = getattr(h, "baseFilename", None)
+            if f:
+                try:
+                    with open(f) as fh:
+                        lines = fh.read().splitlines()[-500:]
+                except OSError:
+                    pass
+        self._reply({"log": "\n".join(lines)})
+
+    # -- import / parse ----------------------------------------------------
+    def _get_importfiles(self, rest, q):
+        path = q.get("path", "")
+        import glob as _g
+        import os
+
+        files = sorted(_g.glob(path)) if any(ch in path for ch in "*?") \
+            else ([path] if os.path.exists(path) else [])
+        self._reply({"files": files, "destination_frames": files,
+                     "fails": [] if files else [path]})
+
+    def _post_parsesetup(self, rest, q):
+        from h2o3_tpu.ingest.parse_setup import guess_setup
+
+        body = self._body()
+        paths = body.get("source_frames") or []
+        if isinstance(paths, str):
+            paths = json.loads(paths) if paths.startswith("[") else [paths]
+        paths = [p.strip('"') for p in paths]
+        setup = guess_setup(paths[0])
+        self._reply({"source_frames": paths,
+                     "separator": ord(setup.separator),
+                     "check_header": setup.check_header,
+                     "column_names": setup.column_names,
+                     "column_types": setup.column_types,
+                     "number_columns": len(setup.column_names),
+                     "destination_frame": paths[0].split("/")[-1] + ".hex"})
+
+    def _post_parse(self, rest, q):
+        from h2o3_tpu.ingest.parser import import_file
+
+        body = self._body()
+        paths = body.get("source_frames") or []
+        if isinstance(paths, str):
+            paths = json.loads(paths) if paths.startswith("[") else [paths]
+        paths = [p.strip('"') for p in paths]
+        dest = (body.get("destination_frame") or "").strip('"') or None
+        job = Job(description="Parse")
+        _JOBS[str(job.key)] = job
+        # synchronous on this worker thread (we already run threaded per
+        # request); the job object exists for /3/Jobs polling parity
+        try:
+            job.status = Job.RUNNING
+            fr = import_file(paths[0], destination_frame=dest)
+            job.dest_key = str(fr.key)
+            job.status = Job.DONE
+            job.progress = 1.0
+        except Exception:            # noqa: BLE001
+            job.status = Job.FAILED
+            job.exception = traceback.format_exc()
+        self._reply({"job": _job_json(job), "destination_frame": {"name": getattr(job, "dest_key", None)}})
+
+    # -- rapids ------------------------------------------------------------
+    def _post_rapids(self, rest, q):
+        body = self._body()
+        ast = body.get("ast", "")
+        sid = body.get("session_id", "default")
+        sess = _SESSIONS.setdefault(sid, Session(sid))
+        val = exec_rapids(ast, sess)
+        if isinstance(val, Frame):
+            if DKV.get(str(val.key)) is None:
+                val.install()      # expression results stay addressable
+            self._reply({"key": {"name": str(val.key)},
+                         **_frame_json(val)})
+        elif isinstance(val, (int, float)):
+            self._reply({"scalar": None if val != val else val})
+        elif isinstance(val, str):
+            self._reply({"string": val})
+        else:
+            self._reply({"scalar": None})
+
+    # -- frames ------------------------------------------------------------
+    def _get_frames(self, rest, q):
+        if not rest:
+            frames = [v for v in (DKV.get(k) for k in DKV.keys())
+                      if isinstance(v, Frame)]
+            return self._reply({"frames": [_frame_json(f, rows=0) for f in frames]})
+        fid = rest[0]
+        fr = DKV.get(fid)
+        if not isinstance(fr, Frame):
+            return self._error(f"frame {fid} not found", 404)
+        if len(rest) > 1 and rest[1] == "summary":
+            return self._reply({"frames": [_summary_json(fr)]})
+        nrows = int(q.get("row_count", 10) or 10)
+        offset = int(q.get("row_offset", 0) or 0)
+        from h2o3_tpu.ops.filters import slice_rows
+
+        view = slice_rows(fr, offset, min(offset + nrows, fr.nrows)) \
+            if offset else fr
+        return self._reply({"frames": [_frame_json(view, rows=nrows)]})
+
+    def _delete_frames(self, rest, q):
+        if rest:
+            DKV.remove(rest[0])
+        self._reply({})
+
+    def _delete_dkv(self, rest, q):
+        if rest:
+            DKV.remove(rest[0])
+        else:
+            DKV.clear()
+        self._reply({})
+
+    # -- models / training -------------------------------------------------
+    def _get_modelbuilders(self, rest, q):
+        from h2o3_tpu.models.model_builder import BUILDERS
+
+        self._reply({"model_builders": {
+            name: {"algo": name, "parameters": [
+                {"name": k, "default_value": v}
+                for k, v in cls.default_params().items()]}
+            for name, cls in BUILDERS.items()}})
+
+    def _post_modelbuilders(self, rest, q):
+        from h2o3_tpu.models.model_builder import BUILDERS
+
+        algo = rest[0].lower() if rest else ""
+        cls = BUILDERS.get(algo)
+        if cls is None:
+            return self._error(f"unknown algo {algo!r}", 404)
+        body = self._body()
+        params: Dict[str, Any] = {}
+        defaults = cls.default_params()
+        for k, v in body.items():
+            kk = "lambda_" if k == "lambda" else k
+            kk = cls.translate_param(kk)
+            if kk not in defaults:
+                continue
+            d = defaults[kk]
+            if isinstance(v, str):
+                if v.startswith("[") or v.startswith("{"):
+                    v = json.loads(v)
+                elif isinstance(d, bool):
+                    v = v.lower() == "true"
+                elif isinstance(d, int) and not isinstance(d, bool):
+                    v = int(float(v))
+                elif isinstance(d, float):
+                    v = float(v)
+                else:
+                    v = v.strip('"')
+            params[kk] = v
+        train_key = str(params.pop("training_frame", "")).strip('"')
+        valid_key = str(params.pop("validation_frame", "") or "").strip('"')
+        y = str(params.pop("response_column", "") or "").strip('"') or None
+        train = DKV.get(train_key)
+        if not isinstance(train, Frame):
+            return self._error(f"training_frame {train_key!r} not found", 404)
+        valid = DKV.get(valid_key) if valid_key else None
+
+        builder = cls(**params)
+        job = Job(description=f"{algo} train")
+        _JOBS[str(job.key)] = job
+
+        def run():
+            try:
+                job.status = Job.RUNNING
+                model = builder.train(y=y, training_frame=train,
+                                      validation_frame=valid)
+                job.dest_key = str(model.key)
+                job.status = Job.DONE
+                job.progress = 1.0
+            except Exception:            # noqa: BLE001
+                job.status = Job.FAILED
+                job.exception = traceback.format_exc()
+
+        threading.Thread(target=run, daemon=True).start()
+        self._reply({"job": _job_json(job)})
+
+    def _get_models(self, rest, q):
+        if not rest:
+            models = [v for v in (DKV.get(k) for k in DKV.keys())
+                      if isinstance(v, Model)]
+            return self._reply({"models": [m.to_dict() for m in models]})
+        m = DKV.get(rest[0])
+        if not isinstance(m, Model):
+            return self._error(f"model {rest[0]} not found", 404)
+        self._reply({"models": [m.to_dict()]})
+
+    def _delete_models(self, rest, q):
+        if rest:
+            DKV.remove(rest[0])
+        self._reply({})
+
+    def _post_predictions(self, rest, q):
+        # /3/Predictions/models/{model}/frames/{frame}
+        if len(rest) < 4 or rest[0] != "models" or rest[2] != "frames":
+            return self._error("bad predictions path", 400)
+        m = DKV.get(rest[1])
+        fr = DKV.get(rest[3])
+        if not isinstance(m, Model):
+            return self._error(f"model {rest[1]} not found", 404)
+        if not isinstance(fr, Frame):
+            return self._error(f"frame {rest[3]} not found", 404)
+        body = self._body()
+        dest = str(body.get("predictions_frame", "") or "").strip('"') or None
+        pred = m.predict(fr, key=dest)
+        pred.install()
+        mm = m.model_performance(fr)
+        self._reply({"predictions_frame": {"name": str(pred.key)},
+                     "model_metrics": [mm.to_dict() if mm else {}]})
+
+    # -- jobs --------------------------------------------------------------
+    def _get_jobs(self, rest, q):
+        if not rest:
+            return self._reply({"jobs": [_job_json(j) for j in _JOBS.values()]})
+        job = _JOBS.get(rest[0])
+        if job is None:
+            return self._error(f"job {rest[0]} not found", 404)
+        self._reply({"jobs": [_job_json(job)]})
+
+
+def _job_json(job: Job) -> dict:
+    return {"key": {"name": str(job.key)},
+            "description": job.description,
+            "status": str(job.status),
+            "progress": job.progress,
+            "exception": getattr(job, "exception", None),
+            "dest": {"name": getattr(job, "dest_key", None)}}
+
+
+class ApiServer:
+    """Owns the HTTP thread (reference: water.webserver jetty adapters)."""
+
+    def __init__(self, port: int = 54321):
+        self.port = port
+        self.httpd: Optional[ThreadingHTTPServer] = None
+        self.thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ApiServer":
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", self.port), _Handler)
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+        return self
+
+    def stop(self):
+        if self.httpd:
+            self.httpd.shutdown()
+            self.httpd = None
+
+
+def start_server(port: int = 54321) -> ApiServer:
+    return ApiServer(port).start()

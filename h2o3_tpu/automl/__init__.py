@@ -1,0 +1,3 @@
+"""AutoML (reference: h2o-automl/ — AutoML.java orchestrator)."""
+
+from h2o3_tpu.automl.automl import H2OAutoML  # noqa: F401

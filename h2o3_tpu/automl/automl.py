@@ -1,0 +1,201 @@
+"""AutoML — automatic model selection + leaderboard.
+
+Reference: h2o-automl/src/main/java/ai/h2o/automl/AutoML.java — a step
+registry (ModelingStepsRegistry over {GLM,DRF,GBM,DeepLearning,XGBoost,
+StackedEnsemble}StepsProvider: default configs then random-search grids),
+time/model budgets (WorkAllocations), leaderboard ranked by CV metric
+(leaderboard/Leaderboard.java), event log (events/EventLog.java).
+
+TPU-native: every candidate shares the one device-resident training frame;
+successive models of one family reuse XLA compile caches, so the sweep is
+execution-bound, not compile-bound.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.models.model import Model
+
+_LOWER_IS_BETTER = {"rmse", "mse", "logloss", "mae", "mean_residual_deviance",
+                    "mean_per_class_error", "rmsle"}
+
+
+def _metric(model: Model, name: str) -> float:
+    mm = (model._output.cross_validation_metrics
+          or model._output.validation_metrics
+          or model._output.training_metrics)
+    return float(getattr(mm, name, float("nan"))) if mm else float("nan")
+
+
+class H2OAutoML:
+    """h2o-py H2OAutoML surface: train() then .leader / .leaderboard."""
+
+    def __init__(self, max_models: int = 10, max_runtime_secs: float = 0.0,
+                 seed: int = -1, nfolds: int = 5,
+                 sort_metric: str = "AUTO",
+                 include_algos: Optional[List[str]] = None,
+                 exclude_algos: Optional[List[str]] = None,
+                 project_name: Optional[str] = None, **_ignored):
+        self.max_models = int(max_models)
+        self.max_runtime_secs = float(max_runtime_secs)
+        self.seed = int(seed)
+        self.nfolds = max(int(nfolds), 2)
+        self.sort_metric = sort_metric
+        self.include_algos = [a.lower() for a in include_algos] if include_algos else None
+        self.exclude_algos = [a.lower() for a in (exclude_algos or [])]
+        self.project_name = project_name or f"automl_{int(time.time())}"
+        self.models: List[Model] = []
+        self.event_log: List[Dict[str, Any]] = []
+        self._metric_name: str = "rmse"
+
+    # -- step registry (ModelingStepsRegistry analog) ----------------------
+    def _steps(self, classification: bool):
+        """Ordered (algo, params) candidates: defaults first, then grid
+        variants — mirrors the reference's default + random-grid phases."""
+        rng = np.random.default_rng(self.seed if self.seed >= 0 else None)
+        steps = []
+
+        def add(algo, **params):
+            steps.append((algo, params))
+
+        add("glm", family=("binomial" if classification else "gaussian"),
+            alpha=0.5, lambda_search=True)
+        add("gbm", ntrees=50, max_depth=6, learn_rate=0.1, sample_rate=0.8,
+            col_sample_rate_per_tree=0.8)
+        add("xgboost", ntrees=50, max_depth=8, learn_rate=0.1, sample_rate=0.8)
+        add("drf", ntrees=50)
+        add("deeplearning", hidden=[64, 64], epochs=20)
+        add("gbm", ntrees=100, max_depth=4, learn_rate=0.05, sample_rate=0.9)
+        add("xgboost", ntrees=100, max_depth=5, learn_rate=0.05,
+            reg_lambda=2.0)
+        add("drf", ntrees=100, max_depth=25)
+        # random grid phase
+        for _ in range(20):
+            add("gbm",
+                ntrees=int(rng.choice([30, 50, 100])),
+                max_depth=int(rng.integers(3, 10)),
+                learn_rate=float(rng.choice([0.03, 0.05, 0.1, 0.2])),
+                sample_rate=float(rng.uniform(0.6, 1.0)),
+                col_sample_rate_per_tree=float(rng.uniform(0.5, 1.0)))
+        filt = []
+        for algo, params in steps:
+            if self.include_algos and algo not in self.include_algos:
+                continue
+            if algo in self.exclude_algos:
+                continue
+            filt.append((algo, params))
+        return filt
+
+    def _log(self, msg: str):
+        self.event_log.append({"timestamp": time.time(), "message": msg})
+
+    # -- training loop ------------------------------------------------------
+    def train(self, x=None, y: Optional[str] = None,
+              training_frame: Optional[Frame] = None,
+              validation_frame: Optional[Frame] = None,
+              leaderboard_frame: Optional[Frame] = None) -> "H2OAutoML":
+        from h2o3_tpu.models.model_builder import BUILDERS
+
+        if training_frame is None or y is None:
+            raise ValueError("AutoML requires y and training_frame")
+        y_col = training_frame.col(y)
+        classification = y_col.is_categorical
+        if self.sort_metric in ("AUTO", None, ""):
+            self._metric_name = ("auc" if classification and y_col.cardinality == 2
+                                 else "logloss" if classification else "rmse")
+        else:
+            self._metric_name = self.sort_metric.lower()
+        self._leaderboard_frame = leaderboard_frame
+
+        t0 = time.time()
+        self._log(f"AutoML start: project={self.project_name}")
+        for algo, params in self._steps(classification):
+            if self.max_models and len(self.models) >= self.max_models:
+                break
+            if self.max_runtime_secs and time.time() - t0 > self.max_runtime_secs:
+                self._log("time budget exhausted")
+                break
+            cls = BUILDERS.get(algo)
+            if cls is None:
+                continue
+            params = dict(params)
+            params.update(nfolds=self.nfolds,
+                          keep_cross_validation_predictions=True,
+                          seed=(self.seed if self.seed >= 0 else None))
+            try:
+                b = cls(**params)
+                m = b.train(x=x, y=y, training_frame=training_frame,
+                            validation_frame=validation_frame)
+                self.models.append(m)
+                self._log(f"built {algo}: {self._metric_name}="
+                          f"{_metric(m, self._metric_name):.4f}")
+            except Exception as e:       # noqa: BLE001 — AutoML keeps going
+                self._log(f"FAILED {algo}: {type(e).__name__}: {e}")
+
+        # stacked ensembles (best-of-family + all), reference SE steps
+        self._build_ensembles(y, training_frame)
+        self._log(f"AutoML done: {len(self.models)} models")
+        return self
+
+    def _build_ensembles(self, y: str, train: Frame):
+        from h2o3_tpu.models.ensemble import StackedEnsemble
+
+        usable = [m for m in self.models
+                  if m._output.cross_validation_holdout_predictions is not None]
+        if len(usable) < 2:
+            return
+        by_family: Dict[str, Model] = {}
+        for m in self._ranked(usable):
+            by_family.setdefault(m.algo_name, m)
+        for name, bases in (("BestOfFamily", list(by_family.values())),
+                            ("AllModels", usable)):
+            if len(bases) < 2:
+                continue
+            try:
+                se = StackedEnsemble(base_models=bases,
+                                     seed=(self.seed if self.seed >= 0 else None)
+                                     ).train(y=y, training_frame=train)
+                se._se_name = f"StackedEnsemble_{name}"
+                self.models.append(se)
+                self._log(f"built StackedEnsemble_{name}")
+            except Exception as e:       # noqa: BLE001
+                self._log(f"FAILED StackedEnsemble_{name}: {e}")
+
+    # -- leaderboard --------------------------------------------------------
+    def _ranked(self, models: Optional[List[Model]] = None) -> List[Model]:
+        models = models if models is not None else self.models
+        reverse = self._metric_name not in _LOWER_IS_BETTER
+
+        def keyfn(m):
+            v = _metric(m, self._metric_name)
+            if v != v:
+                return float("-inf") if reverse else float("inf")
+            return v
+
+        return sorted(models, key=keyfn, reverse=reverse)
+
+    @property
+    def leader(self) -> Optional[Model]:
+        ranked = self._ranked()
+        return ranked[0] if ranked else None
+
+    @property
+    def leaderboard(self) -> List[Dict[str, Any]]:
+        rows = []
+        for m in self._ranked():
+            rows.append({
+                "model_id": getattr(m, "_se_name", None) or str(m.key),
+                "algo": m.algo_name,
+                self._metric_name: _metric(m, self._metric_name),
+            })
+        return rows
+
+    def predict(self, frame: Frame):
+        if self.leader is None:
+            raise RuntimeError("AutoML has no models")
+        return self.leader.predict(frame)

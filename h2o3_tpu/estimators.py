@@ -1,0 +1,65 @@
+"""h2o-py estimator-name aliases.
+
+Reference: h2o-py/h2o/estimators/*.py — one generated class per algo whose
+constructor takes the algo's parameters and whose train(x, y, training_frame)
+launches the build. Our ModelBuilder subclasses already follow that contract,
+so the estimator surface is a naming shim (plus h2o-py param spellings).
+
+Resolution is lazy (module __getattr__): accessing one estimator imports only
+its own algo module, and a broken optional module breaks only its own names —
+mirrors models/__init__._register_all's per-module ImportError tolerance.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+# estimator name -> (module, class)
+_MAP = {
+    "H2OAggregatorEstimator": ("h2o3_tpu.models.aggregator", "Aggregator"),
+    "H2OCoxProportionalHazardsEstimator": ("h2o3_tpu.models.coxph", "CoxPH"),
+    "H2ODeepLearningEstimator": ("h2o3_tpu.models.deeplearning", "DeepLearning"),
+    "H2OStackedEnsembleEstimator": ("h2o3_tpu.models.ensemble", "StackedEnsemble"),
+    "H2OExtendedIsolationForestEstimator": ("h2o3_tpu.models.extended_isofor",
+                                            "ExtendedIsolationForest"),
+    "H2OGeneralizedAdditiveEstimator": ("h2o3_tpu.models.gam", "GAM"),
+    "H2OGeneralizedLinearEstimator": ("h2o3_tpu.models.glm", "GLM"),
+    "H2OGeneralizedLowRankEstimator": ("h2o3_tpu.models.glrm", "GLRM"),
+    "H2OKMeansEstimator": ("h2o3_tpu.models.kmeans", "KMeans"),
+    "H2ONaiveBayesEstimator": ("h2o3_tpu.models.naive_bayes", "NaiveBayes"),
+    "H2OPrincipalComponentAnalysisEstimator": ("h2o3_tpu.models.pca", "PCA"),
+    "H2OSupportVectorMachineEstimator": ("h2o3_tpu.models.psvm", "PSVM"),
+    "H2ORuleFitEstimator": ("h2o3_tpu.models.rulefit", "RuleFit"),
+    "H2OSingularValueDecompositionEstimator": ("h2o3_tpu.models.svd", "SVD"),
+    "H2ORandomForestEstimator": ("h2o3_tpu.models.tree.drf", "DRF"),
+    "H2OGradientBoostingEstimator": ("h2o3_tpu.models.tree.gbm", "GBM"),
+    "H2OIsolationForestEstimator": ("h2o3_tpu.models.tree.isofor", "IsolationForest"),
+    "H2OWord2vecEstimator": ("h2o3_tpu.models.word2vec", "Word2Vec"),
+    "H2OXGBoostEstimator": ("h2o3_tpu.models.xgboost", "XGBoost"),
+}
+
+
+def __getattr__(name: str):
+    if name == "H2OAutoEncoderEstimator":
+        base = __getattr__("H2ODeepLearningEstimator")
+
+        class H2OAutoEncoderEstimator(base):
+            """DeepLearning with autoencoder=True (h2o-py parity)."""
+
+            def __init__(self, **params):
+                params.setdefault("autoencoder", True)
+                super().__init__(**params)
+
+        globals()[name] = H2OAutoEncoderEstimator
+        return H2OAutoEncoderEstimator
+    entry = _MAP.get(name)
+    if entry is None:
+        raise AttributeError(f"module 'h2o3_tpu.estimators' has no attribute {name!r}")
+    mod, cls_name = entry
+    cls = getattr(importlib.import_module(mod), cls_name)
+    globals()[name] = cls      # cache for next access
+    return cls
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_MAP) + ["H2OAutoEncoderEstimator"])

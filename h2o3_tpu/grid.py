@@ -1,0 +1,143 @@
+"""Grid search — hyperparameter sweeps with cartesian / random walkers.
+
+Reference: hex/grid/GridSearch.java + HyperSpaceWalker.java (cartesian and
+RandomDiscrete with max_models/max_runtime budget, seed), resumable Grid kept
+in DKV, models ranked by a sort metric.
+
+TPU-native: each candidate trains through the normal builder path (one or a
+few compiled programs); models with identical frame shapes share XLA compile
+caches, so a grid over e.g. learn_rate costs one compile + N executions.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Dict, List, Optional, Sequence, Type
+
+import numpy as np
+
+from h2o3_tpu.core.dkv import DKV, Keyed
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.models.model import Model
+from h2o3_tpu.models.model_builder import BUILDERS, ModelBuilder
+
+_LOWER_IS_BETTER = {"rmse", "mse", "logloss", "mae", "mean_residual_deviance",
+                    "mean_per_class_error", "err", "rmsle"}
+
+
+def _metric_value(model: Model, metric: str) -> float:
+    mm = (model._output.cross_validation_metrics
+          or model._output.validation_metrics
+          or model._output.training_metrics)
+    if mm is None:
+        return float("nan")
+    return float(getattr(mm, metric.lower(), float("nan")))
+
+
+def _default_metric(model: Model) -> str:
+    cat = model._output.model_category
+    return {"Binomial": "auc", "Multinomial": "logloss",
+            "Regression": "rmse"}.get(cat, "rmse")
+
+
+class H2OGridSearch(Keyed):
+    """h2o-py H2OGridSearch surface: build over hyper_params, rank models."""
+
+    def __init__(self, model, hyper_params: Dict[str, Sequence],
+                 grid_id: Optional[str] = None,
+                 search_criteria: Optional[Dict[str, Any]] = None):
+        super().__init__(grid_id)
+        # `model` may be a builder class, an instance (its params become the
+        # base config), or an algo name string
+        if isinstance(model, str):
+            self.builder_cls: Type[ModelBuilder] = BUILDERS[model.lower()]
+            self.base_params: Dict[str, Any] = {}
+        elif isinstance(model, type):
+            self.builder_cls = model
+            self.base_params = {}
+        else:
+            self.builder_cls = type(model)
+            self.base_params = {k: v for k, v in model.params.items()
+                                if v != model.default_params().get(k)}
+        self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
+        self.search_criteria = dict(search_criteria or {"strategy": "Cartesian"})
+        self.models: List[Model] = []
+        self.failed: List[Dict[str, Any]] = []
+        self.install()
+
+    # -- walkers (HyperSpaceWalker.java) ----------------------------------
+    def _candidates(self):
+        keys = list(self.hyper_params)
+        grids = [self.hyper_params[k] for k in keys]
+        strategy = (self.search_criteria.get("strategy") or "Cartesian").lower()
+        combos = list(itertools.product(*grids))
+        if strategy == "randomdiscrete":
+            seed = int(self.search_criteria.get("seed", -1))
+            rng = np.random.default_rng(seed if seed >= 0 else None)
+            rng.shuffle(combos)
+        return keys, combos
+
+    def train(self, x=None, y=None, training_frame: Optional[Frame] = None,
+              validation_frame: Optional[Frame] = None, **kw):
+        keys, combos = self._candidates()
+        max_models = int(self.search_criteria.get("max_models", 0) or 0)
+        max_secs = float(self.search_criteria.get("max_runtime_secs", 0) or 0)
+        t0 = time.time()
+        for combo in combos:
+            if max_models and len(self.models) >= max_models:
+                break
+            if max_secs and time.time() - t0 > max_secs:
+                break
+            params = dict(self.base_params)
+            params.update(kw)
+            params.update(dict(zip(keys, combo)))
+            try:
+                b = self.builder_cls(**params)
+                m = b.train(x=x, y=y, training_frame=training_frame,
+                            validation_frame=validation_frame)
+                m._grid_params = dict(zip(keys, combo))
+                self.models.append(m)
+            except Exception as e:       # noqa: BLE001 — grid keeps going
+                self.failed.append({"params": dict(zip(keys, combo)),
+                                    "error": f"{type(e).__name__}: {e}"})
+        if not self.models:
+            raise RuntimeError(f"grid produced no models; failures: {self.failed[:3]}")
+        return self
+
+    # -- ranking (Grid.java getModels sorted) ------------------------------
+    def get_grid(self, sort_by: Optional[str] = None, decreasing: Optional[bool] = None):
+        metric = (sort_by or _default_metric(self.models[0])).lower()
+        if decreasing is None:
+            decreasing = metric not in _LOWER_IS_BETTER
+        def keyfn(m):
+            v = _metric_value(m, metric)
+            if v != v:
+                return float("inf")
+            return -v if decreasing else v
+
+        order = sorted(self.models, key=keyfn)
+        g = H2OGridSearch.__new__(H2OGridSearch)
+        g.__dict__.update(self.__dict__)
+        g.models = order
+        return g
+
+    @property
+    def model_ids(self) -> List[str]:
+        return [str(m.key) for m in self.models]
+
+    def sorted_metric_table(self, sort_by: Optional[str] = None) -> List[dict]:
+        metric = (sort_by or _default_metric(self.models[0])).lower()
+        rows = [{"model_id": str(m.key), metric: _metric_value(m, metric),
+                 **getattr(m, "_grid_params", {})} for m in self.models]
+        return sorted(rows, key=lambda r: r[metric],
+                      reverse=metric not in _LOWER_IS_BETTER)
+
+    def best_model(self, metric: Optional[str] = None) -> Model:
+        return self.get_grid(sort_by=metric).models[0]
+
+    def __getitem__(self, i: int) -> Model:
+        return self.models[i]
+
+    def __len__(self):
+        return len(self.models)

@@ -42,15 +42,19 @@ def _parse_csv_host(path: str, setup: ParseSetup) -> Dict[str, np.ndarray]:
     import pandas as pd
 
     na = [s for s in setup.na_strings if s != ""]
-    df = pd.read_csv(
-        path, sep=setup.separator,
-        header=0 if setup.check_header == 1 else None,
-        names=setup.column_names,
-        na_values=na, keep_default_na=True, skipinitialspace=True,
-        dtype={n: (str if t in (T_CAT, T_STR) else np.float64)
-               for n, t in zip(setup.column_names, setup.column_types) if t != T_TIME},
-        engine="c",
-    )
+    # python string storage + object dtype: pandas 3's arrow-backed
+    # StringDtype construction has segfaulted on REST worker threads under
+    # concurrent XLA activity; option_context keeps the override scoped
+    with pd.option_context("mode.string_storage", "python"):
+        df = pd.read_csv(
+            path, sep=setup.separator,
+            header=0 if setup.check_header == 1 else None,
+            names=setup.column_names,
+            na_values=na, keep_default_na=True, skipinitialspace=True,
+            dtype={n: (object if t in (T_CAT, T_STR) else np.float64)
+                   for n, t in zip(setup.column_names, setup.column_types) if t != T_TIME},
+            engine="c",
+        )
     out = {}
     for name, t in zip(setup.column_names, setup.column_types):
         s = df[name]

@@ -47,6 +47,12 @@ class ModelBuilder:
 
     # -- param surface ----------------------------------------------------
     @classmethod
+    def translate_param(cls, name: str) -> str:
+        """Map an external param spelling to the canonical one (overridden
+        by XGBoost for eta/n_estimators/... — used by the REST layer)."""
+        return name
+
+    @classmethod
     def default_params(cls) -> Dict[str, Any]:
         return {
             "response_column": None,

@@ -178,6 +178,18 @@ class SharedTree(ModelBuilder):
         clip = float(self.params.get("max_abs_leafnode_pred", 1e30) or 1e30)
         return clip if clip < 1e30 else 1e4
 
+    def _leaf_den_offset(self) -> float:
+        """Additive leaf-denominator regularizer (XGBoost's λ on the hessian
+        sum); 0 for plain GBM/DRF."""
+        return 0.0
+
+    def _leaf_gamma(self, ln, ld):
+        """Leaf Newton step from the (num, den) segment sums; XGBoost
+        overrides to apply its α soft-threshold."""
+        return np.where(ld > 1e-12,
+                        ln / np.maximum(ld + self._leaf_den_offset(), 1e-12),
+                        0.0)
+
     # driver --------------------------------------------------------------
     def _fit(self, train: Frame) -> SharedTreeModel:
         import jax
@@ -261,7 +273,7 @@ class SharedTree(ModelBuilder):
                 feat_mask_fn=feat_mask_fn)
             num_r, den_r = self._leaf_num_den(w_t, y, z, f, dist)
             ln, ld = leaf_stats(row_leaf, num_r, den_r, tree.n_leaves)
-            gamma = np.where(ld > 1e-12, ln / np.maximum(ld, 1e-12), 0.0)
+            gamma = self._leaf_gamma(ln, ld)
             gamma = np.clip(gamma, -leaf_clip, leaf_clip)
             lr = self._tree_lr(t)
             tree.set_leaf_values(gamma * lr)

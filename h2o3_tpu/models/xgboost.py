@@ -1,0 +1,87 @@
+"""XGBoost-compatible booster — the native-dependency replacement.
+
+Reference: h2o-extensions/xgboost — H2O wraps the C++ XGBoost library over
+JNI (NativeLibraryLoaderChain), moves Frames into off-heap DMatrix buffers,
+and rebuilds the Rabit all-reduce tracker in Java (RabitTrackerH2O.java:14);
+the GPU path is CUDA grow_gpu_hist (XGBoostModel.java:384-389).
+
+TPU-native design (SURVEY.md §2.10 item 1): no external native library at
+all — the SAME Pallas/XLA histogram tree kernel family as GBM IS the
+booster (hist == gpu_hist == our device histogram build), and the gradient
+all-reduce is the mesh psum the histogram already performs. This class maps
+the XGBoost parameter vocabulary (eta, colsample_*, reg_lambda, ...) onto
+that engine, so `H2OXGBoostEstimator` users keep their param names.
+"""
+
+from __future__ import annotations
+
+from h2o3_tpu.models.model_builder import register
+from h2o3_tpu.models.tree.gbm import GBM, GBMModel
+
+
+class XGBoostModel(GBMModel):
+    algo_name = "xgboost"
+
+
+# xgboost param name -> shared-tree param name
+_ALIASES = {
+    "eta": "learn_rate",
+    "learn_rate": "learn_rate",
+    "max_depth": "max_depth",
+    "ntrees": "ntrees",
+    "n_estimators": "ntrees",
+    "subsample": "sample_rate",
+    "sample_rate": "sample_rate",
+    "colsample_bytree": "col_sample_rate_per_tree",
+    "col_sample_rate_per_tree": "col_sample_rate_per_tree",
+    "colsample_bylevel": "col_sample_rate",
+    "col_sample_rate": "col_sample_rate",
+    "min_child_weight": "min_rows",
+    "min_rows": "min_rows",
+    "max_bins": "nbins",
+    "gamma": "min_split_improvement",
+    "min_split_improvement": "min_split_improvement",
+}
+
+
+@register
+class XGBoost(GBM):
+    algo_name = "xgboost"
+    model_class = XGBoostModel
+
+    @classmethod
+    def default_params(cls):
+        p = super().default_params()
+        p.update({
+            # xgboost-flavored knobs kept for API parity; reg_alpha/reg_lambda
+            # act through leaf-value shrinkage like the reference's booster
+            "reg_lambda": 1.0,
+            "reg_alpha": 0.0,
+            "booster": "gbtree",
+            "tree_method": "hist",     # always hist — that IS the TPU kernel
+        })
+        return p
+
+    def __init__(self, **params):
+        mapped = {}
+        for k, v in params.items():
+            mapped[_ALIASES.get(k, k)] = v
+        super().__init__(**mapped)
+
+    @classmethod
+    def translate_param(cls, name: str) -> str:
+        return _ALIASES.get(name, name)
+
+    def _leaf_den_offset(self) -> float:
+        # xgboost leaf weight = G / (H + λ): λ lands on the summed hessian
+        return float(self.params.get("reg_lambda", 1.0) or 0.0)
+
+    def _leaf_gamma(self, ln, ld):
+        # xgboost L1: soft-threshold the gradient sum by reg_alpha before
+        # dividing by (H + λ)
+        import numpy as np
+
+        alpha = float(self.params.get("reg_alpha", 0.0) or 0.0)
+        num = np.sign(ln) * np.maximum(np.abs(ln) - alpha, 0.0) if alpha > 0 else ln
+        den = ld + self._leaf_den_offset()
+        return np.where(ld > 1e-12, num / np.maximum(den, 1e-12), 0.0)

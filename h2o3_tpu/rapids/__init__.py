@@ -1,0 +1,14 @@
+"""Rapids — the lazy dataframe-algebra protocol.
+
+Reference: water/rapids/ (23,281 LoC) — clients build ASTs client-side and
+POST Lisp-like strings to /99/Rapids (Rapids.java parser, Session.java
+refcounted temps, Env.java stack, 205 prim files under ast/prims/).
+
+TPU-native design: the wire grammar is kept verbatim (h2o-py compatibility)
+but prims dispatch straight to the jitted ops layer (h2o3_tpu/ops/*) — an
+AST '(+ frame 5)' becomes one fused XLA elementwise program over row-sharded
+columns instead of a chunk-iterating MRTask.
+"""
+
+from h2o3_tpu.rapids.parser import parse
+from h2o3_tpu.rapids.eval import Env, Session, exec_rapids  # noqa: F401

@@ -1,0 +1,691 @@
+"""Rapids evaluator: Env/Session + primitive registry.
+
+Reference: water/rapids/Session.java (refcounted temp frames),
+Env.java (scope stack), ast/prims/* (205 prim classes). Prims here
+dispatch to the jitted ops layer — each prim is one or a few fused XLA
+programs over row-sharded columns.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.core.dkv import DKV
+from h2o3_tpu.core.frame import Column, Frame, T_CAT, T_NUM, T_STR
+from h2o3_tpu.ops import elementwise as E
+from h2o3_tpu.ops import filters as FL
+from h2o3_tpu.rapids.parser import (Id, Lambda, NumList, Span, StrLit,
+                                    StrList, parse)
+
+
+class Session:
+    """Refcounted temp frames (water/rapids/Session.java)."""
+
+    def __init__(self, session_id: str = "default"):
+        self.id = session_id
+        self.temps: Dict[str, Frame] = {}
+
+    def assign(self, key: str, fr: Frame) -> Frame:
+        out = Frame(key=key)
+        for n in fr.names:
+            out.add(n, fr.col(n))
+        out.install()
+        self.temps[key] = out
+        return out
+
+    def remove(self, key: str):
+        self.temps.pop(key, None)
+        DKV.remove(key)
+
+    def end(self):
+        for k in list(self.temps):
+            self.remove(k)
+
+
+class Env:
+    """Lexical scopes for lambda application (water/rapids/Env.java)."""
+
+    def __init__(self, session: Session, parent: Optional["Env"] = None):
+        self.session = session
+        self.parent = parent
+        self.vars: Dict[str, Any] = {}
+
+    def lookup(self, name: str):
+        if name == "_":          # h2o-py placeholder arg (e.g. quantile weights)
+            return None
+        e: Optional[Env] = self
+        while e is not None:
+            if name in e.vars:
+                return e.vars[name]
+            e = e.parent
+        got = DKV.get(name)
+        if got is not None:
+            return got
+        raise KeyError(f"unknown identifier {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# value helpers
+# ---------------------------------------------------------------------------
+
+def _is_fr(v) -> bool:
+    return isinstance(v, Frame)
+
+
+def _one_col(v) -> Column:
+    if isinstance(v, Column):
+        return v
+    if _is_fr(v):
+        if v.ncols != 1:
+            raise ValueError("expected a single-column frame")
+        return v.col(0)
+    raise TypeError(f"expected column, got {type(v)}")
+
+
+def _colfr(col: Column, name: str = "C1") -> Frame:
+    fr = Frame()
+    fr.add(name, col)
+    return fr
+
+
+def _scalar(v) -> float:
+    if isinstance(v, (int, float)):
+        return float(v)
+    if _is_fr(v) and v.ncols == 1 and v.nrows == 1:
+        return float(v.col(0).to_numpy()[0])
+    raise TypeError(f"expected scalar, got {type(v)}")
+
+
+def _idx_list(v, n: int) -> np.ndarray:
+    """NumList/Span/scalar → absolute row/col indices."""
+    if isinstance(v, (int, float)):
+        return np.asarray([int(v)])
+    out: List[int] = []
+    for item in v:
+        if isinstance(item, Span):
+            lo = int(item.lo)
+            out.extend(range(lo, lo + int(item.cnt)))
+        else:
+            out.append(int(item))
+    idx = np.asarray(out, np.int64)
+    if len(idx) and (idx < 0).all():
+        keep = np.setdiff1d(np.arange(n), -idx)   # negative = drop (R style)
+        return keep
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# primitive registry
+# ---------------------------------------------------------------------------
+
+PRIMS: Dict[str, Callable] = {}
+
+
+def prim(*names):
+    def deco(fn):
+        for nm in names:
+            PRIMS[nm] = fn
+        return fn
+    return deco
+
+
+# -- assignment / session (ast/prims/assign) --------------------------------
+
+@prim("tmp=", "assign")
+def _assign(env, key, val):
+    key = key.name if isinstance(key, Id) else str(key)
+    fr = val if _is_fr(val) else _colfr(_one_col(val))
+    return env.session.assign(key, fr)
+
+
+@prim("rm")
+def _rm(env, key):
+    env.session.remove(key if isinstance(key, str) else key.name)
+    return 0.0
+
+
+# -- structure (ast/prims/mungers) ------------------------------------------
+
+@prim("cols", "cols_py")
+def _cols(env, fr, sel):
+    names = fr.names
+    if isinstance(sel, str):
+        return fr.subframe([sel])
+    if isinstance(sel, list) and sel and isinstance(sel[0], str):
+        return fr.subframe(list(sel))
+    idx = _idx_list(sel, fr.ncols)
+    return fr.subframe([names[i] for i in idx])
+
+
+@prim("rows")
+def _rows(env, fr, sel):
+    if _is_fr(sel):
+        return FL.filter_rows(fr, _one_col(sel))
+    idx = _idx_list(sel, fr.nrows)
+    if len(idx) and np.array_equal(idx, np.arange(idx[0], idx[-1] + 1)):
+        return FL.slice_rows(fr, int(idx[0]), int(idx[-1]) + 1)
+    return FL.take_rows(fr, idx)
+
+
+@prim("cbind")
+def _cbind(env, *frames):
+    out = frames[0] if _is_fr(frames[0]) else _colfr(_one_col(frames[0]))
+    for f in frames[1:]:
+        out = out.cbind(f if _is_fr(f) else _colfr(_one_col(f)))
+    return out
+
+
+@prim("rbind")
+def _rbind(env, *frames):
+    return FL.rbind(list(frames))
+
+
+@prim("colnames=")
+def _colnames(env, fr, cols, names):
+    idx = _idx_list(cols, fr.ncols)
+    new = [names] if isinstance(names, str) else [
+        s.s if isinstance(s, StrLit) else str(s) for s in names]
+    out = fr.subframe(fr.names)
+    for i, nm in zip(idx, new):
+        out.rename(out.names[int(i)], nm)
+    return out
+
+
+@prim("sort")
+def _sort(env, fr, by, *asc):
+    from h2o3_tpu.ops.sort import sort_frame
+
+    idx = _idx_list(by, fr.ncols)
+    ascending = [bool(a) for a in _idx_list(asc[0], len(idx))] if asc else True
+    return sort_frame(fr, [fr.names[i] for i in idx], ascending=ascending)
+
+
+@prim("merge")
+def _merge(env, left, right, all_x, all_y, by_x, by_y, method="auto"):
+    from h2o3_tpu.ops.merge import merge
+
+    return merge(left, right, all_x=bool(all_x), all_y=bool(all_y))
+
+
+@prim("unique")
+def _unique(env, fr, include_nas=False):
+    from h2o3_tpu.ops.groupby import GroupBy
+
+    return GroupBy(fr, fr.names).count().get_frame().subframe(fr.names)
+
+
+@prim("table")
+def _table(env, fr, *rest):
+    from h2o3_tpu.ops.groupby import table
+
+    return table(fr)
+
+
+@prim("h2o.impute")
+def _impute(env, fr, column, method, combine_method, by, *rest):
+    from h2o3_tpu.ops.impute import impute
+
+    col = int(_scalar(column)) if not isinstance(column, (list, NumList)) else -1
+    method = method.s if isinstance(method, StrLit) else str(method)
+    return impute(fr, column=col, method=method.lower())
+
+
+@prim("na.omit")
+def _na_omit(env, fr):
+    mask = None
+    for c in fr.columns:
+        m = E.is_na(c)
+        mask = m if mask is None else E.binop("+", mask, m)
+    keep = E.binop("==", mask, 0.0)
+    return FL.filter_rows(fr, keep)
+
+
+@prim("is.na")
+def _isna_prim(env, v):
+    return _colfr(E.is_na(_one_col(v)), "isNA")
+
+
+@prim("ifelse")
+def _ifelse_prim(env, cond, yes, no):
+    c = _one_col(cond)
+    y = _one_col(yes) if _is_fr(yes) else yes
+    n = _one_col(no) if _is_fr(no) else no
+    return _colfr(E.ifelse(c, y, n))
+
+
+@prim("h2o.runif")
+def _runif(env, fr, seed):
+    rng = np.random.default_rng(int(seed) if seed == seed and seed >= 0 else None)
+    return _colfr(Column.from_numpy(rng.random(fr.nrows)), "rnd")
+
+
+@prim("asfactor", "as.factor")
+def _asfactor(env, fr):
+    out = Frame()
+    for n in (fr.names if _is_fr(fr) else ["C1"]):
+        c = fr.col(n)
+        if c.is_categorical:
+            out.add(n, c)
+            continue
+        vals = c.to_numpy()
+        out.add(n, Column.from_numpy(
+            np.asarray([("" if v != v else ("%g" % v)) for v in vals], object),
+            ctype=T_CAT))
+    return out
+
+
+@prim("as.numeric", "asnumeric")
+def _asnumeric(env, fr):
+    out = Frame()
+    for n in fr.names:
+        c = fr.col(n)
+        if c.is_categorical:
+            # levels that look numeric convert by value; else by code
+            dom = c.domain or []
+            try:
+                lut = np.asarray([float(x) for x in dom], np.float32)
+                codes = c.to_numpy()
+                vals = np.where(codes >= 0, lut[np.maximum(codes, 0)], np.nan)
+            except ValueError:
+                vals = np.where(c.to_numpy() >= 0, c.to_numpy(), np.nan)
+            out.add(n, Column.from_numpy(vals.astype(np.float64)))
+        else:
+            out.add(n, c)
+    return out
+
+
+@prim("as.character", "ascharacter")
+def _ascharacter(env, fr):
+    out = Frame()
+    for n in fr.names:
+        c = fr.col(n)
+        out.add(n, Column.from_numpy(np.asarray(
+            [None if v is None else str(v) for v in c.values()], object)))
+    return out
+
+
+@prim("levels")
+def _levels(env, fr):
+    c = _one_col(fr)
+    out = Frame()
+    out.add("levels", Column.from_numpy(np.asarray(c.domain or [], object)))
+    return out
+
+
+@prim("append")
+def _append(env, fr, col, name):
+    out = fr.subframe(fr.names)
+    nm = name.s if isinstance(name, StrLit) else str(name)
+    out.add(nm, _one_col(col))
+    return out
+
+
+@prim(":=")
+def _colassign(env, fr, rhs, col_idx, row_sel):
+    """In-place column update → copy-on-write new frame."""
+    out = fr.subframe(fr.names)
+    idx = _idx_list(col_idx, fr.ncols)
+    rhs_cols = (rhs.columns if _is_fr(rhs) else
+                [rhs] if isinstance(rhs, Column) else None)
+    for k, ci in enumerate(idx):
+        nm = fr.names[int(ci)] if int(ci) < fr.ncols else f"C{int(ci)+1}"
+        if rhs_cols is not None:
+            newc = rhs_cols[k if len(rhs_cols) > 1 else 0]
+        else:
+            newc = Column.from_numpy(np.full(fr.nrows, float(rhs), np.float64))
+        if nm in out:
+            out.replace(nm, newc)
+        else:
+            out.add(nm, newc)
+    return out
+
+
+# -- group by ----------------------------------------------------------------
+
+@prim("GB")
+def _gb(env, fr, by, *aggs):
+    """(GB fr [by...] agg col na agg col na ...) — triples per aggregate."""
+    from h2o3_tpu.ops.groupby import GroupBy
+
+    idx = _idx_list(by, fr.ncols)
+    gb = GroupBy(fr, [fr.names[i] for i in idx])
+    for i in range(0, len(aggs) - 2, 3):
+        agg = aggs[i] if isinstance(aggs[i], str) else (
+            aggs[i].name if isinstance(aggs[i], Id) else str(aggs[i]))
+        col = aggs[i + 1]
+        if agg == "nrow":
+            gb.count()
+            continue
+        cname = col if isinstance(col, str) else fr.names[int(_scalar(col))]
+        getattr(gb, agg)(cname)
+    return gb.get_frame()
+
+
+# -- reducers (ast/prims/reducers) ------------------------------------------
+
+@prim("mean")
+def _mean(env, v, *rest):
+    return _one_col(v).rollups.mean
+
+
+@prim("sum")
+def _sum(env, v, *rest):
+    c = _one_col(v)
+    r = c.rollups
+    return r.mean * (c.nrows - r.na_count)
+
+
+@prim("min")
+def _min(env, v, *rest):
+    return _one_col(v).rollups.min
+
+
+@prim("max")
+def _max(env, v, *rest):
+    return _one_col(v).rollups.max
+
+
+@prim("sd")
+def _sd(env, v, *rest):
+    return _one_col(v).rollups.sigma
+
+
+@prim("var")
+def _var(env, v, *rest):
+    s = _one_col(v).rollups.sigma
+    return s * s
+
+
+@prim("naCnt", "nacnt")
+def _nacnt(env, v):
+    return float(_one_col(v).rollups.na_count)
+
+
+@prim("median")
+def _median(env, v, *rest):
+    from h2o3_tpu.ops.quantile import quantile_column
+
+    return quantile_column(_one_col(v), [0.5])[0]
+
+
+@prim("quantile")
+def _quantile(env, fr, probs, *rest):
+    from h2o3_tpu.ops.quantile import quantile_column
+
+    pl = [float(x) for x in (probs if isinstance(probs, (list, NumList)) else [probs])]
+    out = Frame()
+    out.add("Probs", Column.from_numpy(np.asarray(pl)))
+    for n in fr.names:
+        c = fr.col(n)
+        if c.is_numeric:
+            out.add(f"{n}QuantilesQ", Column.from_numpy(
+                np.asarray(quantile_column(c, pl))))
+    return out
+
+
+@prim("all")
+def _all(env, v):
+    c = _one_col(v)
+    r = c.rollups
+    return 1.0 if r.min == 1.0 and r.max == 1.0 else 0.0
+
+
+@prim("any")
+def _any(env, v):
+    return 1.0 if _one_col(v).rollups.max == 1.0 else 0.0
+
+
+@prim("nrow")
+def _nrow(env, fr):
+    return float(fr.nrows)
+
+
+@prim("ncol")
+def _ncol(env, fr):
+    return float(fr.ncols)
+
+
+# -- cumulative (ast/prims/repeaters? timeseries) ----------------------------
+
+def _cum(op):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(d):
+        x = jnp.where(jnp.isnan(d), {"add": 0.0, "mul": 1.0, "min": jnp.inf,
+                                     "max": -jnp.inf}[op], d)
+        f = {"add": jnp.cumsum, "mul": jnp.cumprod,
+             "min": jnp.minimum.accumulate, "max": jnp.maximum.accumulate}[op]
+        return f(x).astype(jnp.float32)
+
+    return run
+
+
+@prim("cumsum")
+def _cumsum(env, v, axis=0):
+    c = _one_col(v)
+    return _colfr(Column.from_device(_cum("add")(c.data), T_NUM, c.nrows))
+
+
+@prim("cumprod")
+def _cumprod(env, v, axis=0):
+    c = _one_col(v)
+    return _colfr(Column.from_device(_cum("mul")(c.data), T_NUM, c.nrows))
+
+
+@prim("cummin")
+def _cummin(env, v, axis=0):
+    c = _one_col(v)
+    return _colfr(Column.from_device(_cum("min")(c.data), T_NUM, c.nrows))
+
+
+@prim("cummax")
+def _cummax(env, v, axis=0):
+    c = _one_col(v)
+    return _colfr(Column.from_device(_cum("max")(c.data), T_NUM, c.nrows))
+
+
+# -- string ops (host-side; TPUs never see strings) --------------------------
+
+def _strop(fn):
+    def impl(env, fr, *args):
+        out = Frame()
+        for n in fr.names:
+            c = fr.col(n)
+            if c.is_categorical:
+                dom = [fn(x, *args) for x in (c.domain or [])]
+                out.add(n, Column(c.data, T_CAT, c.nrows, domain=dom))
+            elif c.is_string:
+                vals = np.asarray([None if v is None else fn(v, *args)
+                                   for v in c.host_data], object)
+                out.add(n, Column.from_numpy(vals))
+            else:
+                out.add(n, c)
+        return out
+    return impl
+
+
+PRIMS["toupper"] = _strop(lambda s: s.upper())
+PRIMS["tolower"] = _strop(lambda s: s.lower())
+PRIMS["trim"] = _strop(lambda s: s.strip())
+
+
+@prim("replacefirst")
+def _replacefirst(env, fr, pat, rep, ignore_case=0.0):
+    import re
+
+    p = pat.s if isinstance(pat, StrLit) else str(pat)
+    r = rep.s if isinstance(rep, StrLit) else str(rep)
+    flags = re.IGNORECASE if ignore_case else 0
+    return _strop(lambda s: re.sub(p, r, s, count=1, flags=flags))(env, fr)
+
+
+@prim("replaceall")
+def _replaceall(env, fr, pat, rep, ignore_case=0.0):
+    import re
+
+    p = pat.s if isinstance(pat, StrLit) else str(pat)
+    r = rep.s if isinstance(rep, StrLit) else str(rep)
+    flags = re.IGNORECASE if ignore_case else 0
+    return _strop(lambda s: re.sub(p, r, s, flags=flags))(env, fr)
+
+
+@prim("strlen", "nchar")
+def _strlen(env, fr):
+    out = Frame()
+    for n in fr.names:
+        c = fr.col(n)
+        if c.is_string:
+            vals = np.asarray([np.nan if v is None else len(v) for v in c.host_data])
+        elif c.is_categorical:
+            lut = np.asarray([len(x) for x in (c.domain or [])] or [0], np.float64)
+            codes = c.to_numpy()
+            vals = np.where(codes >= 0, lut[np.maximum(codes, 0)], np.nan)
+        else:
+            vals = np.full(c.nrows, np.nan)
+        out.add(n, Column.from_numpy(vals))
+    return out
+
+
+# -- arithmetic / comparison / logic ----------------------------------------
+
+def _binprim(op):
+    def impl(env, l, r):
+        lv = _one_col(l) if _is_fr(l) else l
+        rv = _one_col(r) if _is_fr(r) else r
+        if isinstance(lv, Column) or isinstance(rv, Column):
+            return _colfr(E.binop(op, lv, rv), op)
+        return float(E.binop(op, Column.from_numpy(np.asarray([float(lv)])),
+                             float(rv)).to_numpy()[0])
+    return impl
+
+
+for _op in ("+", "-", "*", "/", "^", "%", "intDiv", "==", "!=", "<", "<=",
+            ">", ">="):
+    PRIMS[_op] = _binprim(_op)
+PRIMS["%%"] = _binprim("%")
+PRIMS["%/%"] = _binprim("intDiv")
+
+
+def _logical(op):
+    def impl(env, l, r):
+        lc = _one_col(l) if _is_fr(l) else l
+        rc = _one_col(r) if _is_fr(r) else r
+        import jax.numpy as jnp
+
+        a = E._as_f32(lc) if isinstance(lc, Column) else jnp.float32(lc)
+        b = E._as_f32(rc) if isinstance(rc, Column) else jnp.float32(rc)
+        if op == "&":
+            v = jnp.where((a == 0) | (b == 0), 0.0,
+                          jnp.where(jnp.isnan(a) | jnp.isnan(b), jnp.nan, 1.0))
+        else:
+            v = jnp.where((a != 0) & ~jnp.isnan(a) | ((b != 0) & ~jnp.isnan(b)), 1.0,
+                          jnp.where(jnp.isnan(a) | jnp.isnan(b), jnp.nan, 0.0))
+        ref = lc if isinstance(lc, Column) else rc
+        return _colfr(Column.from_device(v, T_NUM, ref.nrows), op)
+    return impl
+
+
+PRIMS["&"] = _logical("&")
+PRIMS["&&"] = _logical("&")
+PRIMS["|"] = _logical("|")
+PRIMS["||"] = _logical("|")
+
+
+def _unprim(op):
+    def impl(env, v):
+        return _colfr(E.unop(op, _one_col(v)), op)
+    return impl
+
+
+for _op in E._UNOPS:
+    PRIMS[_op] = _unprim(_op)
+
+
+@prim("scale")
+def _scale(env, fr, center, scale):
+    out = Frame()
+    for n in fr.names:
+        c = fr.col(n)
+        if not c.is_numeric:
+            out.add(n, c)
+            continue
+        r = c.rollups
+        mu = r.mean if (center == 1.0 or center is True) else 0.0
+        sd = r.sigma if (scale == 1.0 or scale is True) else 1.0
+        cc = E.binop("/", E.binop("-", c, mu), sd if sd else 1.0)
+        out.add(n, cc)
+    return out
+
+
+# -- frame split / misc ------------------------------------------------------
+
+@prim("h2o.splitframe")
+def _splitframe(env, fr, ratios, seed=-1.0):
+    rl = [float(x) for x in (ratios if isinstance(ratios, (list, NumList)) else [ratios])]
+    parts = FL.split_frame(fr, rl, seed=int(seed) if seed >= 0 else None)
+    for i, pr in enumerate(parts):
+        env.session.assign(f"{fr.key}_split_{i}", pr)
+    return parts[0]
+
+
+# ---------------------------------------------------------------------------
+# evaluation
+# ---------------------------------------------------------------------------
+
+def _eval(ast, env: Env):
+    if isinstance(ast, (int, float)):
+        return float(ast)
+    if isinstance(ast, StrLit):
+        return ast.s
+    if isinstance(ast, (NumList, StrList)):
+        return ast
+    if isinstance(ast, Lambda):
+        return ast
+    if isinstance(ast, Id):
+        return env.lookup(ast.name)
+    if isinstance(ast, list):
+        if not ast:
+            return None
+        head = ast[0]
+        if isinstance(head, Id):
+            name = head.name
+            if name in ("tmp=", "assign"):
+                key = ast[1]
+                val = _eval(ast[2], env)
+                return PRIMS[name](env, key, val)
+            if name == "rm":
+                k = ast[1]
+                return PRIMS["rm"](env, k.name if isinstance(k, Id) else _eval(k, env))
+            fn = PRIMS.get(name)
+            if fn is None:
+                raise ValueError(f"unknown rapids primitive {name!r}")
+            args = [_eval(a, env) for a in ast[1:]]
+            return fn(env, *args)
+        if isinstance(head, Lambda):
+            lam = head
+            args = [_eval(a, env) for a in ast[1:]]
+            sub = Env(env.session, parent=env)
+            for nm, v in zip(lam.args, args):
+                sub.vars[nm] = v
+            return _eval(lam.body, sub)
+        # raw list of expressions: evaluate all, return last
+        res = None
+        for e in ast:
+            res = _eval(e, env)
+        return res
+    raise TypeError(f"cannot evaluate {ast!r}")
+
+
+def exec_rapids(expr: str, session: Optional[Session] = None):
+    """Parse + evaluate one Rapids expression (water/rapids/Rapids.exec)."""
+    session = session or Session()
+    env = Env(session)
+    ast = parse(expr)
+    # StrLit/list at top level (e.g. "frame_id") → lookup
+    if isinstance(ast, StrLit):
+        return env.lookup(ast.s)
+    return _eval(ast, env)

@@ -1,0 +1,43 @@
+"""AutoML tests (reference: h2o-automl pyunits)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame, T_CAT
+from h2o3_tpu.automl import H2OAutoML
+
+
+def test_automl_binomial_leaderboard(cl):
+    rng = np.random.default_rng(0)
+    n = 1200
+    X = rng.normal(size=(n, 4))
+    logit = 1.2 * X[:, 0] - X[:, 1] + 0.5 * X[:, 2]
+    y = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "Y", "N")
+    fr = Frame.from_numpy(X, names=["a", "b", "c", "d"])
+    fr.add("y", Column.from_numpy(y, ctype=T_CAT))
+
+    aml = H2OAutoML(max_models=4, nfolds=3, seed=7,
+                    include_algos=["glm", "gbm", "drf", "xgboost"])
+    aml.train(y="y", training_frame=fr)
+    lb = aml.leaderboard
+    # 4 base models + up to 2 ensembles
+    assert len(lb) >= 4
+    assert lb[0]["auc"] >= lb[-1]["auc"]
+    assert aml.leader is not None
+    assert lb[0]["auc"] > 0.75
+    pred = aml.predict(fr)
+    assert pred.nrows == n
+    assert any("StackedEnsemble" in r["model_id"] for r in lb)
+    assert any("built" in e["message"] for e in aml.event_log)
+
+
+def test_automl_regression(cl):
+    rng = np.random.default_rng(1)
+    n = 1000
+    X = rng.normal(size=(n, 3))
+    y = 2 * X[:, 0] + X[:, 1] ** 2 + 0.1 * rng.normal(size=n)
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=["a", "b", "c", "y"])
+    aml = H2OAutoML(max_models=3, nfolds=3, seed=1,
+                    include_algos=["glm", "gbm"])
+    aml.train(y="y", training_frame=fr)
+    assert aml.leaderboard[0]["rmse"] < 1.0

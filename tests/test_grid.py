@@ -1,0 +1,53 @@
+"""Grid search tests (reference: hex/grid pyunits)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame, T_CAT
+from h2o3_tpu.grid import H2OGridSearch
+
+
+def _data(n=1500, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 3))
+    logit = 1.5 * X[:, 0] - X[:, 1]
+    y = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "Y", "N")
+    fr = Frame.from_numpy(X, names=["a", "b", "c"])
+    fr.add("y", Column.from_numpy(y, ctype=T_CAT))
+    return fr
+
+
+def test_cartesian_grid(cl):
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    fr = _data()
+    g = H2OGridSearch(GBM, {"max_depth": [2, 4], "learn_rate": [0.05, 0.3]},
+                      search_criteria={"strategy": "Cartesian"})
+    g.train(y="y", training_frame=fr, ntrees=10, seed=1)
+    assert len(g) == 4
+    table = g.sorted_metric_table("auc")
+    assert table[0]["auc"] >= table[-1]["auc"]
+    best = g.best_model("auc")
+    assert best._output.training_metrics.auc >= 0.8
+
+
+def test_random_discrete_budget(cl):
+    from h2o3_tpu.models.glm import GLM
+
+    fr = _data(n=800, seed=1)
+    g = H2OGridSearch(GLM, {"alpha": [0.0, 0.5, 1.0],
+                            "lambda_": [0.0, 0.001, 0.01, 0.1]},
+                      search_criteria={"strategy": "RandomDiscrete",
+                                       "max_models": 5, "seed": 42})
+    g.train(y="y", training_frame=fr, family="binomial")
+    assert len(g) == 5
+
+
+def test_grid_survives_failures(cl):
+    from h2o3_tpu.models.glm import GLM
+
+    fr = _data(n=500, seed=2)
+    g = H2OGridSearch(GLM, {"family": ["binomial", "nosuchfamily"]})
+    g.train(y="y", training_frame=fr)
+    assert len(g) == 1
+    assert len(g.failed) == 1

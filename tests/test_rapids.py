@@ -1,0 +1,87 @@
+"""Rapids parser + evaluator tests (reference: water/rapids pyunits)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame, T_CAT
+from h2o3_tpu.rapids import Session, exec_rapids
+
+
+@pytest.fixture()
+def sess():
+    s = Session("t")
+    yield s
+    s.end()
+
+
+@pytest.fixture()
+def fr(cl):
+    f = Frame(key="testfr")
+    f.add("a", Column.from_numpy(np.arange(10, dtype=float)))
+    f.add("b", Column.from_numpy(np.arange(10, dtype=float) * 2))
+    f.add("g", Column.from_numpy(np.asarray(["x", "y"] * 5, object), ctype=T_CAT))
+    f.install()
+    yield f
+    f.delete()
+
+
+def test_arith_and_assign(sess, fr):
+    out = exec_rapids("(tmp= res (+ (cols testfr [0]) 5))", sess)
+    assert np.allclose(out.col(0).to_numpy(), np.arange(10) + 5)
+    out2 = exec_rapids("(* (cols_py testfr 'a') (cols_py testfr 'b'))", sess)
+    assert np.allclose(out2.col(0).to_numpy(), np.arange(10) * np.arange(10) * 2)
+
+
+def test_rows_filter_and_slice(sess, fr):
+    out = exec_rapids("(rows testfr (> (cols testfr [0]) 6))", sess)
+    assert out.nrows == 3
+    out2 = exec_rapids("(rows testfr [0:4])", sess)
+    assert out2.nrows == 4
+    out3 = exec_rapids("(rows testfr [1 3 5])", sess)
+    assert np.allclose(out3.col("a").to_numpy(), [1, 3, 5])
+
+
+def test_reducers(sess, fr):
+    assert exec_rapids("(mean (cols testfr [0]))", sess) == pytest.approx(4.5)
+    assert exec_rapids("(sum (cols testfr [1]))", sess) == pytest.approx(90.0)
+    assert exec_rapids("(max (cols testfr [0]))", sess) == pytest.approx(9.0)
+    assert exec_rapids("(nrow testfr)", sess) == 10.0
+
+
+def test_groupby_prim(sess, fr):
+    out = exec_rapids('(GB testfr [2] "mean" 0 "all" "nrow" 0 "all")', sess)
+    df = {tuple(r) for r in np.column_stack(
+        [out.col("g").values(), out.col("mean_a").to_numpy()])}
+    assert ("x", 4.0) in df and ("y", 5.0) in df
+
+
+def test_ifelse_isna_cumsum(sess, fr):
+    out = exec_rapids("(cumsum (cols testfr [0]) 0)", sess)
+    assert np.allclose(out.col(0).to_numpy(), np.cumsum(np.arange(10)))
+    out2 = exec_rapids("(ifelse (> (cols testfr [0]) 4) 1 0)", sess)
+    assert out2.col(0).to_numpy().sum() == 5
+
+
+def test_string_and_factor(sess, fr):
+    out = exec_rapids("(toupper (cols testfr [2]))", sess)
+    assert set(out.col(0).domain) == {"X", "Y"}
+    out2 = exec_rapids("(as.numeric (asfactor (cols testfr [0])))", sess)
+    assert np.allclose(np.sort(out2.col(0).to_numpy()), np.arange(10))
+
+
+def test_quantile_and_sort(sess, fr):
+    out = exec_rapids("(quantile testfr [0.5] 'interpolated' _)", sess)
+    assert "Probs" in out.names
+    srt = exec_rapids("(sort testfr [1] [0])", sess)
+    assert srt.col("b").to_numpy()[0] == 18.0  # descending
+
+
+def test_lambda_apply(sess, fr):
+    out = exec_rapids("({x . (+ x 1)} 41)", sess)
+    assert out == 42.0
+
+
+def test_colassign_and_append(sess, fr):
+    out = exec_rapids("(append testfr (* (cols testfr [0]) 10) 'a10')", sess)
+    assert "a10" in out.names
+    assert np.allclose(out.col("a10").to_numpy(), np.arange(10) * 10)

@@ -1,0 +1,111 @@
+"""REST API round-trip tests: server + thin client over real HTTP.
+
+Reference analog: h2o-py pyunits driven through the REST layer (SURVEY.md §4
+tier 3) — here the client and server run in one process over loopback."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.api.server import start_server
+from h2o3_tpu import client
+
+
+@pytest.fixture(scope="module")
+def server(cl):
+    srv = start_server(port=0)        # ephemeral port
+    client.connect(port=srv.port)
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def csv_path(tmp_path):
+    rng = np.random.default_rng(0)
+    p = tmp_path / "api_test.csv"
+    with open(p, "w") as f:
+        f.write("g,x,y\n")
+        for i in range(500):
+            g = ["a", "b", "c"][i % 3]
+            x = rng.normal()
+            f.write(f"{g},{x:.4f},{'YES' if x + rng.normal()*0.3 > 0 else 'NO'}\n")
+    return str(p)
+
+
+def test_cloud_status(server):
+    cloud = client.cluster_status()
+    assert cloud["cloud_healthy"]
+    assert cloud["cloud_size"] >= 1
+
+
+def test_import_parse_frames(server, csv_path):
+    fr = client.import_file(csv_path)
+    assert fr.nrows == 500
+    assert fr.names == ["g", "x", "y"]
+    head = fr.head(5)
+    assert len(head) == 5 and set(head[0]) == {"g", "x", "y"}
+    summ = fr.summary()
+    assert summ["x"]["type"] == "real"
+    fr.delete()
+
+
+def test_rapids_over_http(server, csv_path):
+    fr = client.import_file(csv_path)
+    m = fr.mean("x")
+    assert abs(m) < 0.2
+    sub = fr.cols(["g", "x"])
+    assert sub.ncols == 2
+    out = client.rapids(f"(tmp= filt (rows {fr.frame_id} (> (cols_py {fr.frame_id} 'x') 0)))")
+    assert 0 < out["rows"] < 500
+
+
+def test_train_predict_over_http(server, csv_path):
+    fr = client.import_file(csv_path)
+    m = client.train("gbm", y="y", training_frame=fr, ntrees=10, max_depth=3)
+    info = m.info()
+    assert info["model_category"] == "Binomial"
+    assert info["training_metrics"]["AUC"] > 0.7
+    pred = m.predict(fr)
+    assert pred.nrows == 500
+    assert "predict" in pred.names
+    assert m.model_id in client.list_models()
+
+
+def test_glm_over_http(server, csv_path):
+    fr = client.import_file(csv_path)
+    m = client.train("glm", y="y", training_frame=fr, family="binomial")
+    assert m.info()["training_metrics"]["AUC"] > 0.7
+
+
+def test_error_paths(server):
+    with pytest.raises(client.H2OServerError):
+        client.train("nosuchalgo", y="y",
+                     training_frame=client.RemoteFrame("nope"))
+    with pytest.raises(FileNotFoundError):
+        client.import_file("/does/not/exist.csv")
+
+
+def test_estimator_aliases(cl):
+    import h2o3_tpu as h2o
+
+    cls = h2o.H2OGradientBoostingEstimator
+    assert cls.algo_name == "gbm"
+    assert h2o.H2OKMeansEstimator.algo_name == "kmeans"
+    assert h2o.H2OXGBoostEstimator.algo_name == "xgboost"
+
+
+def test_xgboost_param_mapping(cl):
+    import numpy as np
+
+    from h2o3_tpu.models.xgboost import XGBoost
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1500, 4))
+    y = X[:, 0] * 2 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=1500)
+    from h2o3_tpu.core.frame import Frame
+
+    fr = Frame.from_numpy(np.column_stack([X, y]), names=["a", "b", "c", "d", "y"])
+    m = XGBoost(n_estimators=30, eta=0.2, subsample=0.8,
+                colsample_bytree=0.8, reg_lambda=1.0, seed=1).train(
+        y="y", training_frame=fr)
+    assert m.algo_name == "xgboost"
+    assert m._output.training_metrics.r2 > 0.85
