@@ -142,13 +142,15 @@ class _Handler(BaseHTTPRequestHandler):
         from h2o3_tpu.core.runtime import cluster_info
 
         info = cluster_info()
+        size = int(info.get("cloud_size", 1))
         self._reply({"version": info.get("version", "0.1.0"),
-                     "cloud_name": info.get("name", "h2o3_tpu"),
-                     "cloud_size": info.get("n_devices", 1),
-                     "cloud_healthy": True,
-                     "consensus": True, "locked": True,
+                     "cloud_name": info.get("cloud_name", "h2o3_tpu"),
+                     "cloud_size": size,
+                     "cloud_uptime_millis": info.get("cloud_uptime_millis", 0),
+                     "cloud_healthy": bool(info.get("cloud_healthy", True)),
+                     "consensus": True, "locked": bool(info.get("locked", True)),
                      "nodes": [{"h2o": f"device{i}", "healthy": True}
-                               for i in range(info.get("n_devices", 1))]})
+                               for i in range(size)]})
 
     def _get_about(self, rest, q):
         self._reply({"entries": [
@@ -164,6 +166,8 @@ class _Handler(BaseHTTPRequestHandler):
         _SESSIONS[sid] = Session(sid)
         self._reply({"session_key": sid})
 
+    # h2o-py's connection handshake issues POST /4/sessions (advisor finding)
+    _post_sessions = _get_sessions
     _post_initid = _get_sessions
     _get_initid = _get_sessions
 

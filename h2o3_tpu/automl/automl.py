@@ -32,6 +32,23 @@ def _metric(model: Model, name: str) -> float:
     return float(getattr(mm, name, float("nan"))) if mm else float("nan")
 
 
+def _leaderboard_metric(model: Model, name: str, frame: Optional[Frame],
+                        cache: Dict[str, float]) -> float:
+    """Rank metric with uniform provenance: score every model on the
+    leaderboard frame when one is given (reference Leaderboard.java ranks on
+    the leaderboard_frame metrics), else fall back to CV/valid metrics."""
+    if frame is None:
+        return _metric(model, name)
+    key = str(model.key)
+    if key not in cache:
+        try:
+            mm = model.model_performance(frame)
+            cache[key] = float(getattr(mm, name, float("nan"))) if mm else float("nan")
+        except Exception:    # noqa: BLE001 — unrankable model sorts last
+            cache[key] = float("nan")
+    return cache[key]
+
+
 class H2OAutoML:
     """h2o-py H2OAutoML surface: train() then .leader / .leaderboard."""
 
@@ -43,7 +60,12 @@ class H2OAutoML:
                  project_name: Optional[str] = None, **_ignored):
         self.max_models = int(max_models)
         self.max_runtime_secs = float(max_runtime_secs)
-        self.seed = int(seed)
+        from h2o3_tpu.models.model_builder import random_seed
+
+        # pin one shared seed even when the user gives none, so every base
+        # model draws identical CV fold assignments — the StackedEnsemble
+        # level-one frame requires it (ensemble.py fold-digest check)
+        self.seed = int(seed) if int(seed) >= 0 else random_seed()
         self.nfolds = max(int(nfolds), 2)
         self.sort_metric = sort_metric
         self.include_algos = [a.lower() for a in include_algos] if include_algos else None
@@ -57,7 +79,7 @@ class H2OAutoML:
     def _steps(self, classification: bool):
         """Ordered (algo, params) candidates: defaults first, then grid
         variants — mirrors the reference's default + random-grid phases."""
-        rng = np.random.default_rng(self.seed if self.seed >= 0 else None)
+        rng = np.random.default_rng(self.seed)
         steps = []
 
         def add(algo, **params):
@@ -111,6 +133,7 @@ class H2OAutoML:
         else:
             self._metric_name = self.sort_metric.lower()
         self._leaderboard_frame = leaderboard_frame
+        self._lb_cache: Dict[str, float] = {}
 
         t0 = time.time()
         self._log(f"AutoML start: project={self.project_name}")
@@ -126,7 +149,7 @@ class H2OAutoML:
             params = dict(params)
             params.update(nfolds=self.nfolds,
                           keep_cross_validation_predictions=True,
-                          seed=(self.seed if self.seed >= 0 else None))
+                          seed=self.seed)
             try:
                 b = cls(**params)
                 m = b.train(x=x, y=y, training_frame=training_frame,
@@ -137,8 +160,12 @@ class H2OAutoML:
             except Exception as e:       # noqa: BLE001 — AutoML keeps going
                 self._log(f"FAILED {algo}: {type(e).__name__}: {e}")
 
-        # stacked ensembles (best-of-family + all), reference SE steps
-        self._build_ensembles(y, training_frame)
+        # stacked ensembles (best-of-family + all), reference SE steps —
+        # honoring include/exclude_algos like any other algo step
+        se_wanted = "stackedensemble" not in self.exclude_algos and (
+            self.include_algos is None or "stackedensemble" in self.include_algos)
+        if se_wanted:
+            self._build_ensembles(y, training_frame)
         self._log(f"AutoML done: {len(self.models)} models")
         return self
 
@@ -157,8 +184,10 @@ class H2OAutoML:
             if len(bases) < 2:
                 continue
             try:
-                se = StackedEnsemble(base_models=bases,
-                                     seed=(self.seed if self.seed >= 0 else None)
+                # metalearner_nfolds = AutoML nfolds so the SE's rank metric
+                # is CV-based like the base models' (metric provenance)
+                se = StackedEnsemble(base_models=bases, seed=self.seed,
+                                     metalearner_nfolds=self.nfolds,
                                      ).train(y=y, training_frame=train)
                 se._se_name = f"StackedEnsemble_{name}"
                 self.models.append(se)
@@ -170,9 +199,11 @@ class H2OAutoML:
     def _ranked(self, models: Optional[List[Model]] = None) -> List[Model]:
         models = models if models is not None else self.models
         reverse = self._metric_name not in _LOWER_IS_BETTER
+        lb = getattr(self, "_leaderboard_frame", None)
+        cache = getattr(self, "_lb_cache", {})
 
         def keyfn(m):
-            v = _metric(m, self._metric_name)
+            v = _leaderboard_metric(m, self._metric_name, lb, cache)
             if v != v:
                 return float("-inf") if reverse else float("inf")
             return v
@@ -186,12 +217,15 @@ class H2OAutoML:
 
     @property
     def leaderboard(self) -> List[Dict[str, Any]]:
+        lb = getattr(self, "_leaderboard_frame", None)
+        cache = getattr(self, "_lb_cache", {})
         rows = []
         for m in self._ranked():
             rows.append({
                 "model_id": getattr(m, "_se_name", None) or str(m.key),
                 "algo": m.algo_name,
-                self._metric_name: _metric(m, self._metric_name),
+                self._metric_name: _leaderboard_metric(
+                    m, self._metric_name, lb, cache),
             })
         return rows
 

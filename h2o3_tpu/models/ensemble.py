@@ -90,7 +90,17 @@ class StackedEnsemble(ModelBuilder):
         if len(bases) < 1:
             raise ValueError("stackedensemble requires base_models")
 
-        # level-one training data from CV holdout predictions
+        # level-one training data from CV holdout predictions; all base
+        # models must share one fold assignment or the level-one rows mix
+        # in-fold and out-of-fold predictions (StackedEnsemble.java
+        # checkAndInheritModelProperties)
+        digests = {bm._output.fold_assignment_digest for bm in bases
+                   if bm._output.fold_assignment_digest is not None}
+        if len(digests) > 1:
+            raise ValueError(
+                "base models were cross-validated with different fold "
+                "assignments; train them with the same nfolds/fold_assignment/"
+                f"seed (saw {len(digests)} distinct assignments)")
         lf = Frame()
         n = train.nrows
         for bm in bases:
@@ -150,4 +160,10 @@ class StackedEnsemble(ModelBuilder):
         self._init_output(model, train)
         model.base_keys = [str(b.key) for b in bases]
         model.metalearner = meta
+        # the metalearner's CV metrics are the ensemble's honest generaliza-
+        # tion estimate — surface them so leaderboards rank SEs on the same
+        # provenance as CV-scored base models
+        if meta._output.cross_validation_metrics is not None:
+            model._output.cross_validation_metrics = \
+                meta._output.cross_validation_metrics
         return model

@@ -54,6 +54,9 @@ class ModelOutput:
         self.scoring_history: List[dict] = []
         self.run_time_ms: int = 0
         self.start_time: float = 0.0
+        # digest of the CV fold-assignment vector — StackedEnsemble refuses
+        # to stack base models whose folds differ (hex/ensemble parity)
+        self.fold_assignment_digest: Optional[str] = None
 
     @property
     def nclasses(self) -> int:
@@ -229,7 +232,7 @@ class Model(Keyed):
                                              distribution=dist)
         return None
 
-    # -- persistence (binary save/load; MOJO analog in export.py) ---------
+    # -- persistence (binary save/load; MOJO zip format in models/mojo.py) -
     def save(self, path: str) -> str:
         import pickle
 

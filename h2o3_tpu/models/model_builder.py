@@ -24,6 +24,12 @@ from h2o3_tpu.models import metrics as M
 from h2o3_tpu.models.model import Model, ModelCategory
 
 
+def random_seed() -> int:
+    """Fresh 31-bit seed — the one seed-derivation policy (builders' seed=-1
+    fallback, AutoML's pinned shared seed)."""
+    return int(np.random.SeedSequence().entropy % (2 ** 31))
+
+
 class ModelBuilder:
     """Base estimator. Subclass contract:
     - class attrs: `algo_name`, `model_class`
@@ -77,7 +83,7 @@ class ModelBuilder:
 
     def _seed(self) -> int:
         s = int(self.params.get("seed", -1) or -1)
-        return s if s >= 0 else np.random.SeedSequence().entropy % (2**31)
+        return s if s >= 0 else random_seed()
 
     # -- h2o-py style entry ----------------------------------------------
     def train(self, x: Optional[Sequence[str]] = None, y: Optional[str] = None,
@@ -133,12 +139,20 @@ class ModelBuilder:
         cv_models: List[Model] = []
         cv_metrics: List = []
         cv_preds = None
+        fold_digest = None
         if nfolds > 1 or fold_col:
-            cv_models, cv_metrics, cv_preds = self._cross_validate(train, nfolds, fold_col)
+            cv_models, cv_metrics, cv_preds, fold_digest = \
+                self._cross_validate(train, nfolds, fold_col)
 
-        model = self._fit(train)
+        self._valid_frame_ref = valid      # in-training validation scoring
+        try:
+            model = self._fit(train)
+        finally:
+            self._valid_frame_ref = None
         if cv_preds is not None:
             model._output.cross_validation_holdout_predictions = cv_preds
+        if fold_digest is not None:
+            model._output.fold_assignment_digest = fold_digest
         model._output.training_metrics = self._score_on(model, train)
         if valid is not None:
             model._output.validation_metrics = self._score_on(model, valid)
@@ -220,7 +234,10 @@ class ModelBuilder:
                                 msg=f"CV fold {fi + 1}/{len(folds)}")
             tr.delete()
             ho.delete()
-        return models, mets, preds_buf
+        import hashlib
+
+        digest = hashlib.sha1(np.ascontiguousarray(assign, np.int64)).hexdigest()
+        return models, mets, preds_buf, digest
 
     def _score_on(self, model: Model, frame: Frame):
         raw = model._predict_raw(model.adapt_test(frame))

@@ -61,6 +61,9 @@ class DRFModel(SharedTreeModel):
 class DRF(SharedTree):
     algo_name = "drf"
     model_class = DRFModel
+    # validation-frame stopping supported in _fit_single (reference
+    # ScoreKeeper prefers validation metrics over OOB when a frame is given)
+    _intrain_valid = True
 
     @classmethod
     def default_params(cls):
@@ -107,6 +110,9 @@ class DRF(SharedTree):
         trees, varimp, history = [], {}, []
         leaf_means: list = []
         stop_metric = []
+        vs = self._vstate
+        v_sum = np.zeros(vs["binned"].shape[0], np.float64) \
+            if vs is not None else None
         # OOB accumulation: sum of oob predictions and counts per row
         oob_sum = jnp.zeros(N, jnp.float32)
         oob_cnt = jnp.zeros(N, jnp.float32)
@@ -129,14 +135,33 @@ class DRF(SharedTree):
                 oob = (~mask) & (w > 0)
                 oob_sum = oob_sum + jnp.where(oob, pred_t, 0.0)
                 oob_cnt = oob_cnt + oob.astype(jnp.float32)
-            if mask is not None and self._should_score(t, ntrees):
-                # running OOB squared error (DRF.java scores OOB each interval)
-                fcur = jnp.where(oob_cnt > 0, oob_sum / jnp.maximum(oob_cnt, 1.0), 0.0)
-                wm = w * (oob_cnt > 0)
-                mse = float(jnp.sum(wm * (y - fcur) ** 2) /
-                            jnp.maximum(jnp.sum(wm), 1e-12))
-                history.append({"tree": t + 1, "training_rmse": float(np.sqrt(mse))})
-                stop_metric.append(mse)
+            if v_sum is not None:
+                # unscaled per-tree means; final leaf values are rescaled by
+                # the actual tree count after the loop
+                tree.set_leaf_values(mean)
+                v_sum += tree.apply_binned(vs["binned"], spec)
+            if (mask is not None or v_sum is not None) \
+                    and self._should_score(t, ntrees):
+                entry = {"tree": t + 1}
+                mse = None
+                if mask is not None:
+                    # running OOB squared error (DRF.java scores OOB each interval)
+                    fcur = jnp.where(oob_cnt > 0, oob_sum / jnp.maximum(oob_cnt, 1.0), 0.0)
+                    wm = w * (oob_cnt > 0)
+                    mse = float(jnp.sum(wm * (y - fcur) ** 2) /
+                                jnp.maximum(jnp.sum(wm), 1e-12))
+                    entry["training_rmse"] = float(np.sqrt(mse))
+                if v_sum is not None:
+                    fv = v_sum / (t + 1)
+                    if classification:
+                        fv = np.clip(fv, 0.0, 1.0)
+                    vmse = float(np.sum(vs["w"] * (vs["y"] - fv) ** 2) /
+                                 max(float(vs["w"].sum()), 1e-12))
+                    entry["validation_rmse"] = float(np.sqrt(vmse))
+                    stop_metric.append(vmse)
+                else:
+                    stop_metric.append(mse)
+                history.append(entry)
                 if self._early_stop(stop_metric):
                     break
             if self.job:

@@ -172,3 +172,35 @@ class HostTree:
         for n in self.nodes:
             if n.leaf_id >= 0:
                 n.leaf_value = float(values[n.leaf_id])
+
+    def apply_binned(self, binned: np.ndarray, spec) -> np.ndarray:
+        """Vectorized host traversal: per-row leaf value for a (n, F) binned
+        matrix — used for in-training validation scoring, where the valid
+        margin is maintained incrementally one tree at a time."""
+        n_nodes = len(self.nodes)
+        feat = np.full(n_nodes, -1, np.int32)
+        left = np.zeros(n_nodes, np.int32)
+        right = np.zeros(n_nodes, np.int32)
+        value = np.zeros(n_nodes, np.float64)
+        maxB = int(spec.nbins.max())
+        splits = [nd.split for nd in self.nodes]
+        lt = left_table_for(splits, spec, maxB)   # one routing convention
+        for nd in self.nodes:
+            if nd.split is None:
+                value[nd.nid] = nd.leaf_value
+                continue
+            feat[nd.nid] = nd.split.feat
+            left[nd.nid] = nd.left
+            right[nd.nid] = nd.right
+        n = len(binned)
+        node = np.zeros(n, np.int32)
+        rows = np.arange(n)
+        while True:
+            f = feat[node]
+            live = f >= 0
+            if not live.any():
+                break
+            b = binned[rows, np.maximum(f, 0)]
+            gl = lt[node, np.minimum(b, maxB - 1)]
+            node = np.where(live, np.where(gl, left[node], right[node]), node)
+        return value[node]

@@ -146,6 +146,10 @@ class SharedTree(ModelBuilder):
     stopping, variable importances."""
 
     model_class = SharedTreeModel
+    # GBM consumes the in-training validation state; DRF/IF override the fit
+    # loops without reading it (DRF's stopping metric is OOB, reference
+    # doOOBScoring), so they skip building it
+    _intrain_valid = True
 
     @classmethod
     def default_params(cls):
@@ -230,13 +234,47 @@ class SharedTree(ModelBuilder):
         rng = np.random.default_rng(self._seed())
         ntrees = int(self.params["ntrees"])
         self._train_frame_ref = train      # OOB metric routing (DRF)
+        # in-training validation state for early stopping (ScoreKeeper stops
+        # on the validation metric when a validation_frame is given)
+        self._vstate = None
+        valid = getattr(self, "_valid_frame_ref", None)
+        # only pay for the per-tree validation traversal when intermediate
+        # scores are observable (stopping or per-iteration scoring); the
+        # final validation metrics come from _score_on's full predict anyway
+        wants_scores = bool(self.params.get("stopping_rounds")
+                            or self.params.get("score_each_iteration")
+                            or self.params.get("score_tree_interval"))
+        if valid is not None and self._intrain_valid and wants_scores \
+                and resp in valid:
+            va = model.adapt_test(valid)
+            yv_col = model._adapt_response(valid.col(resp))
+            wv_user = None
+            if self.params.get("weights_column") and \
+                    self.params["weights_column"] in valid:
+                wv_user = valid.col(self.params["weights_column"]).data
+            binned_v = np.asarray(spec.bin_columns(va))
+            off_v = np.zeros(binned_v.shape[0], np.float64)
+            ocn = self.params.get("offset_column")
+            if ocn and ocn in valid:
+                oc = np.asarray(valid.col(ocn).data, np.float64)
+                off_v = np.where(np.isnan(oc), 0.0, oc)
+            self._vstate = {
+                "binned": binned_v,
+                "y": np.asarray(DataInfo.clean_response(yv_col.data), np.float32),
+                "w": np.asarray(DataInfo.response_weight(yv_col.data, wv_user),
+                                np.float32),
+                "offset": off_v,
+            }
         t0 = time.time()
-        if multinomial:
-            forest, f = self._fit_multinomial(model, binned, y, w, offset,
-                                              spec, nclasses, rng, ntrees)
-        else:
-            forest, f = self._fit_single(model, binned, y, w, offset,
-                                         spec, dist, rng, ntrees)
+        try:
+            if multinomial:
+                forest, f = self._fit_multinomial(model, binned, y, w, offset,
+                                                  spec, nclasses, rng, ntrees)
+            else:
+                forest, f = self._fit_single(model, binned, y, w, offset,
+                                             spec, dist, rng, ntrees)
+        finally:
+            self._vstate = None
         model.forest = forest
         model._output.run_time_ms = int((time.time() - t0) * 1000)
         return model
@@ -261,6 +299,8 @@ class SharedTree(ModelBuilder):
         history = []
         max_depth = int(self.params["max_depth"])
         stop_metric: List[float] = []
+        vs = self._vstate
+        f_valid = (init_f + vs["offset"] if vs is not None else None)
         for t in range(ntrees):
             z = dist.neg_half_gradient(y, f)
             row_active, w_t = self._sample_rows(rng, N, w)
@@ -281,12 +321,21 @@ class SharedTree(ModelBuilder):
             f = f + jnp.where(row_leaf >= 0, leaf_arr[jnp.maximum(row_leaf, 0)], 0.0)
             trees.append(tree)
             self._accumulate_varimp(tree, varimp, model)
-            dev = None
+            if f_valid is not None:
+                f_valid += tree.apply_binned(vs["binned"], spec)
             if self._should_score(t, ntrees):
                 dev = float(jnp.sum(dist.deviance(w, y, f)) /
                             jnp.maximum(jnp.sum(w), 1e-12))
-                history.append({"tree": t + 1, "training_deviance": dev})
-                stop_metric.append(dev)
+                entry = {"tree": t + 1, "training_deviance": dev}
+                if f_valid is not None:
+                    vdev = float(np.sum(np.asarray(dist.deviance(
+                        vs["w"], vs["y"], f_valid.astype(np.float32)))) /
+                        max(float(vs["w"].sum()), 1e-12))
+                    entry["validation_deviance"] = vdev
+                    stop_metric.append(vdev)
+                else:
+                    stop_metric.append(dev)
+                history.append(entry)
                 if self._early_stop(stop_metric):
                     break
             if self.job:
@@ -316,6 +365,9 @@ class SharedTree(ModelBuilder):
         max_depth = int(self.params["max_depth"])
         stop_metric: List[float] = []
         onehot = jax.nn.one_hot(yi, K, dtype=jnp.float32)
+        vs = self._vstate
+        f_valid = (np.broadcast_to(init, (vs["binned"].shape[0], K)).copy()
+                   .astype(np.float64) if vs is not None else None)
         for t in range(ntrees):
             P = jax.nn.softmax(f, axis=-1)
             row_active, w_t = self._sample_rows(rng, N, w)
@@ -342,12 +394,25 @@ class SharedTree(ModelBuilder):
                 trees.append(tree)
                 tree_class.append(k)
                 self._accumulate_varimp(tree, varimp, model)
+                if f_valid is not None:
+                    f_valid[:, k] += tree.apply_binned(vs["binned"], spec)
             if self._should_score(t, ntrees):
                 ll = float(jnp.sum(-w * jnp.log(jnp.maximum(
                     jax.nn.softmax(f, axis=-1)[jnp.arange(N), yi], 1e-15))) /
                     jnp.maximum(jnp.sum(w), 1e-12))
-                history.append({"tree": t + 1, "training_logloss": ll})
-                stop_metric.append(ll)
+                entry = {"tree": t + 1, "training_logloss": ll}
+                if f_valid is not None:
+                    ex = np.exp(f_valid - f_valid.max(axis=1, keepdims=True))
+                    pv = ex / np.maximum(ex.sum(axis=1, keepdims=True), 1e-30)
+                    yv = np.maximum(vs["y"].astype(np.int64), 0)
+                    vll = float(np.sum(-vs["w"] * np.log(np.maximum(
+                        pv[np.arange(len(yv)), yv], 1e-15))) /
+                        max(float(vs["w"].sum()), 1e-12))
+                    entry["validation_logloss"] = vll
+                    stop_metric.append(vll)
+                else:
+                    stop_metric.append(ll)
+                history.append(entry)
                 if self._early_stop(stop_metric):
                     break
             if self.job:

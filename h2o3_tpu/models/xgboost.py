@@ -59,6 +59,16 @@ class XGBoost(GBM):
             "reg_alpha": 0.0,
             "booster": "gbtree",
             "tree_method": "hist",     # always hist — that IS the TPU kernel
+            # XGBoost defaults, not GBM's (XGBoostModel.XGBoostParameters):
+            # eta=0.3, min_child_weight=1, subsample/colsample=1, max_depth=6
+            "learn_rate": 0.3,
+            "min_rows": 1.0,
+            "max_depth": 6,
+            "sample_rate": 1.0,
+            "col_sample_rate_per_tree": 1.0,
+            "nbins": 256,
+            "min_split_improvement": 0.0,   # gamma default
+
         })
         return p
 

@@ -17,7 +17,7 @@ def test_automl_binomial_leaderboard(cl):
     fr.add("y", Column.from_numpy(y, ctype=T_CAT))
 
     aml = H2OAutoML(max_models=4, nfolds=3, seed=7,
-                    include_algos=["glm", "gbm", "drf", "xgboost"])
+                    include_algos=["glm", "gbm", "drf", "xgboost", "stackedensemble"])
     aml.train(y="y", training_frame=fr)
     lb = aml.leaderboard
     # 4 base models + up to 2 ensembles
