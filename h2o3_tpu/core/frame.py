@@ -106,7 +106,7 @@ class Column:
         else:
             raise TypeError(f"cannot device-store ctype {ctype}")
 
-        data = jax.device_put(buf, cl.row_sharding())
+        data = cl.put_rows(buf)
         host = None
         if ctype == T_TIME and np.asarray(arr).dtype.kind in "Mi":
             host = np.asarray(arr)  # exact epoch-millis kept host-side

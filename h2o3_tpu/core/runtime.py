@@ -41,6 +41,9 @@ class OptArgs:
     coordinator_address: Optional[str] = None
     num_processes: int = 1
     process_id: int = 0
+    # explicit device list (dryrun/test harnesses pin a subset or a forced
+    # CPU mesh); None = all of jax.devices()
+    devices: Optional[Sequence] = None
 
     @staticmethod
     def from_env() -> "OptArgs":
@@ -75,7 +78,7 @@ class Cluster:
                 num_processes=args.num_processes,
                 process_id=args.process_id,
             )
-        self.devices = jax.devices()
+        self.devices = list(args.devices) if args.devices else jax.devices()
         n = len(self.devices)
         if args.mesh_shape is None:
             shape = (n, 1)
@@ -110,6 +113,30 @@ class Cluster:
         """Smallest padded length >= n divisible by (row_shards * row_align)."""
         m = self.row_shards * self.args.row_align
         return max(int(-(-n // m) * m), m)
+
+    def put_rows(self, buf: np.ndarray):
+        """Pin a padded host array into device memory row-sharded. In
+        multi-process mode each process materializes only its addressable
+        shards from its (replicated) host copy — the multi-host analog of
+        H2O's parse-then-home-chunks ingestion (every node reads its share)."""
+        import jax
+
+        sh = self.row_sharding()
+        if jax.process_count() > 1:
+            return jax.make_array_from_callback(
+                buf.shape, sh, lambda idx: buf[idx])
+        return jax.device_put(buf, sh)
+
+    def reshard_rows(self, x):
+        """Re-lay an existing device array out over the rows axis. Eager
+        device_put single-process; a compiled identity with out_shardings in
+        multi-process mode (cross-host resharding must go through XLA)."""
+        import jax
+
+        sh = self.row_sharding()
+        if jax.process_count() > 1:
+            return jax.jit(lambda a: a, out_shardings=sh)(x)
+        return jax.device_put(x, sh)
 
     # -- info / observability --------------------------------------------
     def info(self) -> dict:

@@ -115,10 +115,10 @@ class Model(Keyed):
                 # missing predictor: fill NA (Model.java adaptTestForTrain warning path)
                 if train_dom is not None:
                     buf = np.full(padded, NA_CAT, np.int32)
-                    col = Column(jax.device_put(buf, cl.row_sharding()), T_CAT, n, domain=train_dom)
+                    col = Column(cl.put_rows(buf), T_CAT, n, domain=train_dom)
                 else:
                     buf = np.full(padded, np.nan, np.float32)
-                    col = Column(jax.device_put(buf, cl.row_sharding()), T_NUM, n)
+                    col = Column(cl.put_rows(buf), T_NUM, n)
                 out.add(name, col)
                 continue
             c = test.col(name)

@@ -59,7 +59,7 @@ def _apply_order(frame: Frame, order, k: int, key: Optional[str] = None) -> Fram
             out.add(name, Column(None, c.ctype, k, host_data=c.host_data[host]))
             continue
         g = _gather_fn(c.ctype == T_CAT, out_len)(c.data, order, jnp.int32(k))
-        g = jax.device_put(g, cl.row_sharding())
+        g = cl.reshard_rows(g)
         out.add(name, Column(g, c.ctype, k, domain=c.domain))
     return out
 
@@ -101,7 +101,7 @@ def take_rows(frame: Frame, rows: np.ndarray, key: Optional[str] = None) -> Fram
             out.add(name, Column(None, c.ctype, k, host_data=c.host_data[rows]))
             continue
         g = _gather_fn(c.ctype == T_CAT, out_len)(c.data, order_dev, jnp.int32(k))
-        g = jax.device_put(g, cl.row_sharding())
+        g = cl.reshard_rows(g)
         out.add(name, Column(g, c.ctype, k, domain=c.domain))
     return out
 
@@ -126,14 +126,14 @@ def rbind(frames: Sequence[Frame], key: Optional[str] = None) -> Frame:
                 parts.append(np.where(codes >= 0, remap[np.maximum(codes, 0)], NA_CAT))
             buf = np.full(cl.pad_rows(total), NA_CAT, np.int32)
             buf[:total] = np.concatenate(parts)
-            out.add(name, Column(jax.device_put(buf, cl.row_sharding()), T_CAT, total, domain=dom))
+            out.add(name, Column(cl.put_rows(buf), T_CAT, total, domain=dom))
         elif cols[0].data is None:
             host = np.concatenate([c.host_data[: c.nrows] for c in cols])
             out.add(name, Column(None, ctype, total, host_data=host))
         else:
             buf = np.full(cl.pad_rows(total), np.nan, np.float32)
             buf[:total] = np.concatenate([c.to_numpy() for c in cols])
-            out.add(name, Column(jax.device_put(buf, cl.row_sharding()), ctype, total))
+            out.add(name, Column(cl.put_rows(buf), ctype, total))
     return out
 
 

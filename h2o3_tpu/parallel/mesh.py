@@ -43,12 +43,17 @@ def replicated_spec():
 
 
 def shard_rows(arr, mesh=None):
-    """Pin a host array into HBM row-sharded (device_put with NamedSharding)."""
+    """Pin a host array into HBM row-sharded. Multi-process safe: each
+    process materializes only its addressable shards."""
     import jax
+    import numpy as np
     from jax.sharding import NamedSharding
 
     if mesh is None:
         from h2o3_tpu.core.runtime import cluster
 
         mesh = cluster().mesh
-    return jax.device_put(arr, NamedSharding(mesh, row_spec()))
+    sh = NamedSharding(mesh, row_spec())
+    if jax.process_count() > 1 and isinstance(arr, np.ndarray):
+        return jax.make_array_from_callback(arr.shape, sh, lambda idx: arr[idx])
+    return jax.device_put(arr, sh)

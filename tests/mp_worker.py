@@ -1,0 +1,74 @@
+"""Worker for the 2-process jax.distributed localhost tier (run via
+test_multiprocess.py; reference analog: the 4-JVM localhost cloud of
+multiNodeUtils.sh + water.TestUtil.stall_till_cloudsize).
+
+Each process hosts 2 virtual CPU devices; the cloud is the 4-device global
+mesh. Training runs the REAL framework paths: Frame construction with
+per-process shard materialization, GLM IRLS (per-shard Gram + psum across
+process boundaries), and metric reduction to replicated scalars."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+import numpy as np
+
+
+def main():
+    port, pid = sys.argv[1], int(sys.argv[2])
+    from h2o3_tpu.parallel import distributed
+
+    distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=pid)
+    assert jax.process_count() == 2, jax.process_count()
+    assert distributed.process_count() == 2
+    assert distributed.is_coordinator() == (pid == 0)
+    devs = jax.devices()
+    assert len(devs) == 4, devs          # 2 local + 2 remote
+
+    import h2o3_tpu
+    from h2o3_tpu.core.frame import Column, Frame
+
+    cl = h2o3_tpu.init()
+    assert cl.n_devices == 4
+    assert int(cl.mesh.shape["rows"]) == 4
+
+    # identical host data in both processes (the parse layer would hand each
+    # process the same logical rows); shards materialize per process
+    rng = np.random.default_rng(7)
+    n = 512
+    X = rng.standard_normal((n, 4))
+    logit = 2.0 * X[:, 0] - X[:, 1]
+    y = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "Y", "N")
+    fr = Frame.from_numpy(X, names=["a", "b", "c", "d"])
+    fr.add("y", Column.from_numpy(y, ctype="enum"))
+    col = fr.col("a").data
+    assert len(col.sharding.device_set) == 4
+    assert len(col.addressable_shards) == 2      # only local shards held
+
+    # cross-process reduction through the framework's rollup path
+    mean = float(fr.col("a").mean())
+    assert abs(mean - X[:, 0].mean()) < 1e-4, (mean, X[:, 0].mean())
+
+    from h2o3_tpu.models.glm import GLM
+
+    m = GLM(family="binomial", lambda_=0.0, seed=1).train(
+        y="y", training_frame=fr)
+    auc = float(m._output.training_metrics.auc)
+    assert np.isfinite(auc) and auc > 0.8, auc
+
+    # scoring path: adapt_test + predict across the process boundary
+    preds = m.predict(fr)
+    s = float(preds.col("Y").data.sum())       # replicated reduction
+    assert np.isfinite(s)
+    print(f"proc {pid}: OK auc={auc:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Tier-2 multi-process tests: 2 jax.distributed processes on localhost.
+
+Reference analog: SURVEY.md §4 tier 2 — the 4-JVM localhost cloud
+(multiNodeUtils.sh:22-27). Here: 2 OS processes × 2 virtual CPU devices
+form a 4-device global mesh; collectives cross the process boundary over
+the jax.distributed transport."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_cloud_trains_glm():
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "mp_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS",)}          # workers pick their own count
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(worker)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen([sys.executable, worker, str(port), str(i)],
+                              stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                              env=env, cwd=os.path.dirname(os.path.dirname(worker)))
+             for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out.decode())
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"multi-process workers hung; partial output: {outs}")
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+        assert f"proc {i}: OK" in out
