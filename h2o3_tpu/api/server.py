@@ -1,408 +1,921 @@
-"""REST API server — the /3 (+/99) HTTP surface.
+"""REST API server — the /3 (+/4, /99) HTTP surface stock h2o-py speaks.
 
-Reference: water/api/RequestServer.java:56 (route table RegisterV3Api.java,
-~122 routes), schemas under water/api/schemas3. Serving stack is jetty in the
-reference; here it's a stdlib ThreadingHTTPServer — the API layer carries
-only JSON metadata, all heavy data stays device-side, so a native web stack
-buys nothing on TPU.
+Reference: water/api/RequestServer.java:56 with the RegisterV3Api.java route
+table (~122 routes) and the water/api/schemas3 DTO layer. Serving stack is
+jetty in the reference; here a stdlib ThreadingHTTPServer — the API layer
+carries only JSON metadata, all heavy data stays device-side, so a native
+web stack buys nothing on TPU.
 
-Endpoints (V3 contract subset, grown round over round):
-  GET  /3/Cloud /3/About /3/Jobs/{id} /3/Frames /3/Frames/{id}
-  GET  /3/Frames/{id}/summary /3/Models /3/Models/{id} /3/ModelBuilders
-  GET  /3/ImportFiles?path=  /3/Logs  /4/sessions
-  POST /3/ParseSetup /3/Parse /99/Rapids /3/ModelBuilders/{algo}
-  POST /3/Predictions/models/{m}/frames/{f}  /3/Shutdown
-  DELETE /3/Frames/{id} /3/Models/{id} /3/DKV/{key}
+Design: a declarative ROUTES table (method, pattern, handler, summary) —
+the same shape as RequestServer's route registry — drives both dispatch and
+the self-describing /3/Metadata/endpoints listing that h2o-bindings-style
+codegen introspects (water/api/SchemaServer.java:20).
+
+Contract notes (verified against h2o-py):
+- every schema'd response carries __meta.schema_name; H2OResponse.__new__
+  (h2o-py backend/connection.py:869) dispatches on it.
+- jobs flow: POST returns {"job": JobV3}; client polls GET /3/Jobs/{key}.
+- model builds are asynchronous background Jobs, like hex/ModelBuilder
+  trainModel() (:359).
 """
 
 from __future__ import annotations
 
+import io
 import json
+import re
 import threading
+import time
 import traceback
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, unquote, urlparse
 
 import numpy as np
 
-from h2o3_tpu.core.dkv import DKV
+from h2o3_tpu.api import schemas as S
+from h2o3_tpu.core.dkv import DKV, Key
 from h2o3_tpu.core.frame import Frame
 from h2o3_tpu.core.job import Job
 from h2o3_tpu.models.model import Model
 from h2o3_tpu.rapids import Session, exec_rapids
 
-_JOBS: Dict[str, Job] = {}
 _SESSIONS: Dict[str, Session] = {}
+_TIMELINE: List[dict] = []          # ring of recent requests (water/TimeLine.java:22)
+_TIMELINE_MAX = 2048
+
+
+def _timeline_record(method: str, path: str, status: int, ms: float):
+    _TIMELINE.append({"time_ms": int(time.time() * 1000), "method": method,
+                      "path": path, "status": status, "duration_ms": round(ms, 3)})
+    if len(_TIMELINE) > _TIMELINE_MAX:
+        del _TIMELINE[: len(_TIMELINE) - _TIMELINE_MAX]
 
 
 def _json_default(o):
     if isinstance(o, (np.integer,)):
         return int(o)
     if isinstance(o, (np.floating,)):
-        v = float(o)
-        return None if v != v else v
+        return float(o)
     if isinstance(o, np.ndarray):
         return o.tolist()
     return str(o)
 
 
-def _frame_json(fr: Frame, rows: int = 10) -> dict:
-    cols = []
-    n = min(fr.nrows, rows)
-    for name in fr.names:
-        c = fr.col(name)
-        data = c.values()[:n]
-        cols.append({
-            "label": name, "type": c.ctype,
-            "domain": c.domain,
-            "data": [None if (v is None or (isinstance(v, float) and v != v))
-                     else v for v in data.tolist()],
-        })
-    return {"frame_id": {"name": str(fr.key)}, "rows": fr.nrows,
-            "num_columns": fr.ncols, "columns": cols,
-            "column_names": fr.names}
+def _parse_list(v) -> Optional[list]:
+    """Tolerant list parse: accepts JSON, h2o-py stringify_list ('[a,b]' with
+    optionally-quoted items), or an actual list."""
+    if v is None:
+        return None
+    if isinstance(v, list):
+        return v
+    s = str(v).strip()
+    if not s.startswith("["):
+        return [s.strip('"')]
+    try:
+        return json.loads(s)
+    except ValueError:
+        inner = s[1:-1].strip()
+        if not inner:
+            return []
+        return [p.strip().strip('"').strip("'") for p in inner.split(",")]
 
 
-def _summary_json(fr: Frame) -> dict:
-    out = _frame_json(fr, rows=0)
-    out["summary"] = fr.summary()
+def _coerce(v: Any, template: Any) -> Any:
+    """Coerce a form-encoded string to the type of a default value."""
+    if not isinstance(v, str):
+        return v
+    s = v.strip()
+    if s.startswith("[") or s.startswith("{"):
+        try:
+            return json.loads(s)
+        except ValueError:
+            return _parse_list(s)
+    if isinstance(template, bool):
+        return s.lower() in ("true", "1")
+    if isinstance(template, int) and not isinstance(template, bool):
+        try:
+            return int(float(s))
+        except ValueError:
+            return s.strip('"')
+    if isinstance(template, float):
+        try:
+            return float(s)
+        except ValueError:
+            return s.strip('"')
+    if isinstance(template, (list, tuple)):
+        return _parse_list(s)
+    if s.lower() in ("true", "false"):
+        return s.lower() == "true"
+    return s.strip('"')
+
+
+def _frame_or_404(fid: str) -> Frame:
+    fr = DKV.get(fid)
+    if not isinstance(fr, Frame):
+        raise ApiError(f"Object '{fid}' not found for argument: frame", 404)
+    return fr
+
+
+def _model_or_404(mid: str) -> Model:
+    m = DKV.get(mid)
+    if not isinstance(m, Model):
+        raise ApiError(f"Object '{mid}' not found for argument: model", 404)
+    return m
+
+
+class ApiError(Exception):
+    def __init__(self, msg: str, status: int = 400, schema: str = "H2OErrorV3"):
+        super().__init__(msg)
+        self.status = status
+        self.schema = schema
+
+
+# ---------------------------------------------------------------------------
+# handlers (each: fn(ctx) -> (obj, status)); ctx carries path/query/body
+# ---------------------------------------------------------------------------
+
+class Ctx:
+    def __init__(self, params: Dict[str, str], query: Dict[str, str],
+                 body: Dict[str, Any], server: "ApiServer"):
+        self.params = params
+        self.query = query
+        self.body = body
+        self.server = server
+
+    def arg(self, name: str, default=None):
+        # parse_qs already URL-decoded form/query values; JSON was never
+        # encoded — do NOT unquote again (it corrupts literal '%xx').
+        return self.body.get(name, self.query.get(name, default))
+
+
+def h_cloud(ctx: Ctx):
+    from h2o3_tpu.core.runtime import cluster_info
+
+    return S.cloud_v3(cluster_info())
+
+
+def h_about(ctx: Ctx):
+    return {"__meta": S.meta("AboutV3"), "entries": [
+        {"name": "Build project", "value": "h2o3_tpu"},
+        {"name": "Build version", "value": S.SERVER_VERSION},
+        {"name": "Backend", "value": "jax/XLA (TPU-native)"}]}
+
+
+def h_ping(ctx: Ctx):
+    return {"__meta": S.meta("PingV3"), "status": "running"}
+
+
+def h_session_new(ctx: Ctx):
+    sid = f"_sid{uuid.uuid4().hex[:12]}"
+    _SESSIONS[sid] = Session(sid)
+    return {"__meta": S.meta("InitIDV3"), "session_key": sid}
+
+
+def h_session_end(ctx: Ctx):
+    sid = ctx.params.get("session_key", "")
+    sess = _SESSIONS.pop(sid, None)
+    if sess is not None:
+        sess.end()
+    return {"__meta": S.meta("InitIDV3"), "session_key": sid}
+
+
+def h_shutdown(ctx: Ctx):
+    threading.Thread(target=ctx.server.stop, daemon=True).start()
+    return {"__meta": S.meta("ShutdownV3"), "result": "shutting down"}
+
+
+def h_logs(ctx: Ctx):
+    import logging
+
+    lines: List[str] = []
+    for h in logging.getLogger("h2o3_tpu").handlers:
+        f = getattr(h, "baseFilename", None)
+        if f:
+            try:
+                with open(f) as fh:
+                    lines = fh.read().splitlines()[-500:]
+            except OSError:
+                pass
+    return {"__meta": S.meta("LogsV3"), "log": "\n".join(lines)}
+
+
+def h_timeline(ctx: Ctx):
+    return {"__meta": S.meta("TimelineV3"), "events": list(_TIMELINE)}
+
+
+# -- import / parse ---------------------------------------------------------
+
+def _list_files(path: str) -> List[str]:
+    import glob as _g
+    import os
+
+    if any(ch in path for ch in "*?"):
+        return sorted(_g.glob(path))
+    if os.path.isdir(path):
+        return sorted(os.path.join(path, f) for f in os.listdir(path))
+    return [path] if os.path.exists(path) or "://" in path else []
+
+
+def h_importfiles(ctx: Ctx):
+    path = ctx.arg("path", "")
+    files = _list_files(path)
+    return {"__meta": S.meta("ImportFilesV3"), "path": path,
+            "files": files, "destination_frames": files,
+            "fails": [] if files else [path], "dels": []}
+
+
+def h_importfiles_multi(ctx: Ctx):
+    paths = _parse_list(ctx.arg("paths")) or []
+    files: List[str] = []
+    fails: List[str] = []
+    for p in paths:
+        got = _list_files(p)
+        files.extend(got)
+        if not got:
+            fails.append(p)
+    return {"__meta": S.meta("ImportFilesMultiV3"), "paths": paths,
+            "files": files, "destination_frames": files, "fails": fails,
+            "dels": []}
+
+
+def h_postfile(ctx: Ctx):
+    """Multipart upload → raw file key (upload_file path)."""
+    dest = ctx.query.get("destination_frame") or f"upload_{uuid.uuid4().hex[:8]}"
+    data = ctx.body.get("__file__")
+    if data is None:
+        raise ApiError("no file payload", 400)
+    import os
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="h2o3_upload_")
+    fpath = os.path.join(d, dest.replace("/", "_"))
+    with open(fpath, "wb") as f:
+        f.write(data)
+    DKV.put(dest, fpath)          # raw file key → local path
+    return {"__meta": S.meta("PostFileV3"), "destination_frame": dest,
+            "total_bytes": len(data)}
+
+
+def _resolve_sources(paths: List[str]) -> List[str]:
+    """Map source_frames entries (raw upload keys or literal paths) to paths."""
+    out = []
+    for p in paths:
+        v = DKV.get(p)
+        out.append(v if isinstance(v, str) else p)
     return out
 
 
+def h_parsesetup(ctx: Ctx):
+    from h2o3_tpu.ingest.parse_setup import guess_setup
+
+    paths = [p.strip('"') for p in (_parse_list(ctx.arg("source_frames")) or [])]
+    if not paths:
+        raise ApiError("source_frames required", 400)
+    real = _resolve_sources(paths)
+    setup = guess_setup(real[0])
+    col_names = ctx.arg("column_names")
+    col_types = ctx.arg("column_types")
+    sep = ctx.arg("separator")
+    check_header = ctx.arg("check_header")
+    names = _parse_list(col_names) if col_names else setup.column_names
+    types = _parse_list(col_types) if col_types else setup.column_types
+    return {
+        "__meta": S.meta("ParseSetupV3"),
+        "source_frames": [{"__meta": S.meta("FrameKeyV3"), "name": p} for p in paths],
+        "parse_type": "CSV",
+        "separator": int(sep) if sep else ord(setup.separator),
+        "single_quotes": False,
+        "check_header": int(check_header) if check_header is not None else setup.check_header,
+        "column_names": names,
+        "column_types": types,
+        "na_strings": None,
+        "number_columns": len(names or types or []),
+        "skipped_columns": [],
+        "custom_non_data_line_markers": None,
+        "partition_by": None,
+        "destination_frame": _default_dest(paths[0]),
+        "header_lines": 0,
+        "chunk_size": 1 << 22,
+        "total_filtered_column_count": len(names or types or []),
+        "warnings": [],
+    }
+
+
+def _default_dest(path: str) -> str:
+    base = path.rstrip("/").split("/")[-1]
+    base = re.sub(r"\.(csv|tsv|txt|dat|gz|zip)$", "", base, flags=re.I)
+    key = re.sub(r"[^\w.]", "_", base) + ".hex"
+    return key
+
+
+def h_parse(ctx: Ctx):
+    from h2o3_tpu.ingest.parser import import_file
+
+    paths = [p.strip('"') for p in (_parse_list(ctx.arg("source_frames")) or [])]
+    real = _resolve_sources(paths)
+    dest = (str(ctx.arg("destination_frame") or "")).strip('"') or _default_dest(paths[0])
+    col_names = [str(c).strip('"') for c in (_parse_list(ctx.arg("column_names")) or [])] or None
+    col_types = [str(c).strip('"') for c in (_parse_list(ctx.arg("column_types")) or [])] or None
+    check_header = ctx.arg("check_header")
+    job = Job(description="Parse")
+    job.dest_type = "Key<Frame>"
+    job.dest_key = dest
+
+    def run(j: Job):
+        kw = dict(col_names=col_names, col_types=col_types,
+                  header=int(check_header) if check_header is not None else 0)
+        fr = import_file(real[0], destination_frame=dest, **kw)
+        if len(real) > 1:
+            # multi-file import: parse each file and stack (reference
+            # MultiFileParseTask parses all byte-chunks into ONE frame,
+            # water/parser/ParseDataset.java:623)
+            from h2o3_tpu.ops.filters import rbind
+
+            parts = [fr]
+            for i, p in enumerate(real[1:]):
+                j.update(progress=(i + 1) / len(real), msg=f"parsing {p}")
+                parts.append(import_file(p, destination_frame=f"{dest}_part{i+1}", **kw))
+            fr = rbind(parts, key=dest)
+            for part in parts:
+                part.delete()
+            fr.install()
+        j.dest_key = str(fr.key)
+        return fr
+
+    job.start(run, background=True)
+    return {"__meta": S.meta("ParseV3"), "job": S.job_v3(job),
+            "destination_frame": {"name": dest}}
+
+
+# -- jobs -------------------------------------------------------------------
+
+def _find_job(key: str) -> Job:
+    j = DKV.get(key)
+    if not isinstance(j, Job):
+        raise ApiError(f"Job {key} not found", 404)
+    return j
+
+
+def h_jobs_list(ctx: Ctx):
+    jobs = [v for v in (DKV.get(k) for k in DKV.keys()) if isinstance(v, Job)]
+    return {"__meta": S.meta("JobsV3"), "jobs": [S.job_v3(j) for j in jobs]}
+
+
+def h_job_get(ctx: Ctx):
+    return {"__meta": S.meta("JobsV3"), "jobs": [S.job_v3(_find_job(ctx.params["job_id"]))]}
+
+
+def h_job_cancel(ctx: Ctx):
+    _find_job(ctx.params["job_id"]).cancel()
+    return {"__meta": S.meta("JobsV3"), "jobs": []}
+
+
+# -- rapids -----------------------------------------------------------------
+
+def h_rapids(ctx: Ctx):
+    ast = ctx.arg("ast", "")
+    sid = str(ctx.arg("session_id", "default"))
+    sess = _SESSIONS.setdefault(sid, Session(sid))
+    val = exec_rapids(ast, sess)
+    out: Dict[str, Any] = {"__meta": S.meta("RapidsFrameV3", "RapidsFrameV3")}
+    if isinstance(val, Frame):
+        if DKV.get(str(val.key)) is None:
+            val.install()
+        out.update({"key": {"name": str(val.key)},
+                    "num_rows": val.nrows, "num_cols": val.ncols})
+        return out
+    if isinstance(val, (bool, np.bool_)):
+        return {"__meta": S.meta("RapidsScalarV3"), "scalar": bool(val)}
+    if isinstance(val, (int, float, np.integer, np.floating)):
+        v = float(val)
+        return {"__meta": S.meta("RapidsScalarV3"), "scalar": None if v != v else v}
+    if isinstance(val, str):
+        return {"__meta": S.meta("RapidsStringV3"), "string": val}
+    if isinstance(val, (list, tuple, np.ndarray)):
+        return {"__meta": S.meta("RapidsScalarV3"),
+                "scalar": [None if (isinstance(x, float) and x != x) else x
+                           for x in np.asarray(val).tolist()]}
+    return {"__meta": S.meta("RapidsScalarV3"), "scalar": None}
+
+
+# -- frames -----------------------------------------------------------------
+
+def _frame_reply(fr: Frame, ctx: Ctx, with_data: bool = True):
+    rc = int(ctx.arg("row_count", 10) or 10)
+    ro = int(ctx.arg("row_offset", 0) or 0)
+    cc = int(ctx.arg("column_count", -1) or -1)
+    co = int(ctx.arg("column_offset", 0) or 0)
+    fj = S.frame_v3(fr, row_count=rc, row_offset=ro, column_count=cc,
+                    column_offset=co, with_data=with_data)
+    fj["column_names"] = fr.names        # in-repo thin-client convenience
+    return fj
+
+
+def h_frames_list(ctx: Ctx):
+    frames = [v for v in (DKV.get(k) for k in DKV.keys()) if isinstance(v, Frame)]
+    return {"__meta": S.meta("FramesListV3"),
+            "frames": [S.frame_v3(f, with_data=False) | {"column_names": f.names}
+                       for f in frames]}
+
+
+def h_frame_get(ctx: Ctx):
+    fr = _frame_or_404(ctx.params["frame_id"])
+    return {"__meta": S.meta("FramesV3"), "frames": [_frame_reply(fr, ctx)]}
+
+
+def h_frame_light(ctx: Ctx):
+    fr = _frame_or_404(ctx.params["frame_id"])
+    return {"__meta": S.meta("FramesV3"), "frames": [_frame_reply(fr, ctx)]}
+
+
+def h_frame_summary(ctx: Ctx):
+    fr = _frame_or_404(ctx.params["frame_id"])
+    fj = _frame_reply(fr, ctx)
+    fj["summary"] = fr.summary()
+    for cj in fj["columns"]:
+        col = fr.col(cj["label"])
+        if col.is_numeric:
+            from h2o3_tpu.ops.quantile import quantile_column
+
+            probs = [0.01, 0.1, 0.25, 0.333, 0.5, 0.667, 0.75, 0.9, 0.99]
+            try:
+                cj["percentiles"] = [float(v) for v in quantile_column(col, probs)]
+                cj["default_percentiles"] = probs
+            except Exception:   # noqa: BLE001 — summary stays best-effort
+                pass
+    return {"__meta": S.meta("FramesV3"), "frames": [fj]}
+
+
+def h_frame_delete(ctx: Ctx):
+    DKV.remove(ctx.params["frame_id"])
+    return {"__meta": S.meta("FramesV3")}
+
+
+def h_dkv_delete(ctx: Ctx):
+    DKV.remove(ctx.params["key"])
+    return {"__meta": S.meta("RemoveV3")}
+
+
+def h_dkv_delete_all(ctx: Ctx):
+    DKV.clear()
+    return {"__meta": S.meta("RemoveAllV3")}
+
+
+def h_download_dataset(ctx: Ctx):
+    fr = _frame_or_404(str(ctx.arg("frame_id", "")))
+    df = fr.to_pandas()
+    buf = io.StringIO()
+    df.to_csv(buf, index=False)
+    return RawReply(buf.getvalue().encode(), "text/plain")
+
+
+# -- model builders ---------------------------------------------------------
+
+def _builders():
+    from h2o3_tpu.models.model_builder import BUILDERS
+
+    return BUILDERS
+
+
+def _builder_schema(name: str, cls) -> dict:
+    return {
+        "__meta": S.meta("ModelBuilderSchema"),
+        "algo": name, "algo_full_name": name.upper(),
+        "can_build": ["Supervised" if cls.supervised else "Unsupervised"],
+        "visibility": "Stable",
+        "parameters": [S.model_parameter_v3(k, v, v)
+                       for k, v in cls.default_params().items()],
+        "messages": [], "error_count": 0,
+    }
+
+
+def h_modelbuilders_list(ctx: Ctx):
+    return {"__meta": S.meta("ModelBuildersV3"),
+            "model_builders": {name: _builder_schema(name, cls)
+                               for name, cls in _builders().items()}}
+
+
+def h_modelbuilder_get(ctx: Ctx):
+    algo = ctx.params["algo"].lower()
+    cls = _builders().get(algo)
+    if cls is None:
+        raise ApiError(f"unknown algo {algo!r}", 404)
+    return {"__meta": S.meta("ModelBuildersV3"),
+            "model_builders": {algo: _builder_schema(algo, cls)}}
+
+
+def _extract_train_params(cls, body: Dict[str, Any]):
+    defaults = cls.default_params()
+    params: Dict[str, Any] = {}
+    ignored = []
+    for k, v in body.items():
+        kk = "lambda_" if k == "lambda" else k
+        kk = cls.translate_param(kk)
+        if kk not in defaults:
+            ignored.append(k)
+            continue
+        params[kk] = _coerce(v, defaults[kk])
+    return params, ignored
+
+
+def h_modelbuilder_train(ctx: Ctx):
+    algo = ctx.params["algo"].lower()
+    cls = _builders().get(algo)
+    if cls is None:
+        raise ApiError(f"unknown algo {algo!r}", 404)
+    body = dict(ctx.body)
+    params, _ignored = _extract_train_params(cls, body)
+    train_key = str(params.pop("training_frame", "") or "").strip('"')
+    valid_key = str(params.pop("validation_frame", "") or "").strip('"')
+    y = str(params.pop("response_column", "") or "").strip('"') or None
+    model_id = str(params.pop("model_id", "") or "").strip('"') or None
+    x_ignored = params.pop("ignored_columns", None)
+    if not train_key:
+        raise ApiError("training_frame required", 412, "H2OModelBuilderErrorV3")
+    train = DKV.get(train_key)
+    if not isinstance(train, Frame):
+        raise ApiError(f"Object '{train_key}' not found for argument: training_frame",
+                       404, "H2OModelBuilderErrorV3")
+    valid = DKV.get(valid_key) if valid_key else None
+
+    try:
+        builder = cls(**params)
+        if x_ignored:
+            builder.params["ignored_columns"] = [str(c).strip('"') for c in x_ignored]
+        if model_id:
+            builder.params["model_id"] = model_id
+    except ValueError as e:
+        raise ApiError(str(e), 412, "H2OModelBuilderErrorV3") from None
+
+    dest = model_id or f"{algo.upper()}_model_{uuid.uuid4().hex[:12]}"
+    job = Job(description=f"{algo} Model Build", dest=dest)
+    job.dest_type = "Key<Model>"
+    job.dest_key = dest
+
+    def run(j: Job):
+        model = builder.train(y=y, training_frame=train, validation_frame=valid)
+        # the client captured dest at submit time (h2o-py H2OJob.__init__
+        # reads dest.name once) — re-home the model under the advertised key
+        old = str(model.key)
+        if old != dest:
+            DKV.remove(old)
+            model._key = Key(dest)
+        DKV.put(dest, model)
+        model._parms.setdefault("training_frame", train_key)
+        return model
+
+    job.start(run, background=True)
+    return {"__meta": S.meta("ModelBuilderJobV3", "ModelBuilderJob"),
+            "job": S.job_v3(job), "messages": [], "error_count": 0,
+            "parameters": [S.model_parameter_v3(k, cls.default_params().get(k), v)
+                           for k, v in params.items()],
+            "algo": algo}
+
+
+def h_modelbuilder_validate(ctx: Ctx):
+    algo = ctx.params["algo"].lower()
+    cls = _builders().get(algo)
+    if cls is None:
+        raise ApiError(f"unknown algo {algo!r}", 404)
+    params, ignored = _extract_train_params(cls, dict(ctx.body))
+    msgs = [{"__meta": S.meta("ValidationMessageV3"), "message_type": "WARN",
+             "field_name": k, "message": f"unknown parameter {k}"} for k in ignored]
+    return {"__meta": S.meta("ModelBuildersV3"), "messages": msgs,
+            "error_count": 0, "parameters": []}
+
+
+# -- models -----------------------------------------------------------------
+
+def _model_json(m: Model) -> dict:
+    cls = _builders().get(m.algo_name)
+    return S.model_v3(m, builder_cls=cls)
+
+
+def h_models_list(ctx: Ctx):
+    models = [v for v in (DKV.get(k) for k in DKV.keys()) if isinstance(v, Model)]
+    return {"__meta": S.meta("ModelsV3"), "models": [_model_json(m) for m in models]}
+
+
+def h_model_get(ctx: Ctx):
+    return {"__meta": S.meta("ModelsV3"),
+            "models": [_model_json(_model_or_404(ctx.params["model_id"]))]}
+
+
+def h_model_delete(ctx: Ctx):
+    DKV.remove(ctx.params["model_id"])
+    return {"__meta": S.meta("ModelsV3")}
+
+
+def h_predict_v3(ctx: Ctx):
+    m = _model_or_404(ctx.params["model_id"])
+    fr = _frame_or_404(ctx.params["frame_id"])
+    dest = str(ctx.arg("predictions_frame", "") or "").strip('"') or None
+    pred = m.predict(fr, key=dest)
+    pred.install()
+    mm = m.model_performance(fr)
+    return {"__meta": S.meta("ModelMetricsListSchemaV3"),
+            "predictions_frame": {"name": str(pred.key)},
+            "model_metrics": [S.metrics_v3(mm, str(m.key), str(fr.key))] if mm else []}
+
+
+def h_predict_v4(ctx: Ctx):
+    m = _model_or_404(ctx.params["model_id"])
+    fr = _frame_or_404(ctx.params["frame_id"])
+    job = Job(description=f"{m.algo_name} prediction")
+    job.dest_type = "Key<Frame>"
+    pred_key = f"prediction_{m.key}_on_{fr.key}"
+    job.dest_key = pred_key
+
+    def run(j: Job):
+        pred = m.predict(fr, key=pred_key)
+        pred.install()
+        return pred
+
+    job.start(run, background=True)
+    return {"__meta": S.meta("JobV4"), "job": S.job_v3(job)}
+
+
+def h_model_metrics(ctx: Ctx):
+    m = _model_or_404(ctx.params["model_id"])
+    fr = _frame_or_404(ctx.params["frame_id"])
+    mm = m.model_performance(fr)
+    out = []
+    if mm is not None:
+        out.append(S.metrics_v3(mm, str(m.key), str(fr.key)))
+    return {"__meta": S.meta("ModelMetricsListSchemaV3"), "model_metrics": out}
+
+
+def h_model_mojo(ctx: Ctx):
+    try:
+        from h2o3_tpu.models import mojo
+    except ImportError:
+        raise ApiError("MOJO export not available in this build", 501) from None
+    m = _model_or_404(ctx.params["model_id"])
+    data = mojo.export_mojo_bytes(m)
+    return RawReply(data, "application/zip",
+                    headers={"Content-Disposition":
+                             f'attachment; filename="{m.key}.zip"'})
+
+
+# -- metadata (schema introspection, water/api/SchemaServer.java:20) --------
+
+def h_metadata_endpoints(ctx: Ctx):
+    routes = []
+    for i, (method, pattern, handler, summary) in enumerate(ROUTES):
+        routes.append({
+            "__meta": S.meta("EndpointV4"),
+            "num": i,
+            "http_method": method,
+            "url_pattern": pattern,
+            "summary": summary,
+            "api_name": handler.__name__.lstrip("h_"),
+            "input_schema": "Iced", "output_schema": "Iced",
+        })
+    return {"__meta": S.meta("EndpointsListV4"), "endpoints": routes,
+            "routes": routes}
+
+
+_SCHEMA_REGISTRY = [
+    "CloudV3", "JobV3", "JobsV3", "FrameV3", "FramesV3", "ColV3",
+    "ParseSetupV3", "ParseV3", "ImportFilesV3", "InitIDV3",
+    "RapidsFrameV3", "RapidsScalarV3", "RapidsStringV3",
+    "ModelsV3", "ModelBuildersV3", "ModelParameterSchemaV3",
+    "ModelMetricsBinomialV3", "ModelMetricsMultinomialV3",
+    "ModelMetricsRegressionV3", "ModelMetricsClusteringV3",
+    "TwoDimTableV3", "KeyV3", "H2OErrorV3", "H2OModelBuilderErrorV3",
+    "TimelineV3", "LogsV3", "AboutV3",
+]
+
+
+def h_metadata_schemas(ctx: Ctx):
+    return {"__meta": S.meta("SchemaMetadataV3"),
+            "schemas": [{"__meta": S.meta("SchemaMetadataV3"),
+                         "name": s, "version": 3, "type": s.rstrip("V3")}
+                        for s in _SCHEMA_REGISTRY]}
+
+
+def h_metadata_schema(ctx: Ctx):
+    name = ctx.params["schema_name"]
+    if name not in _SCHEMA_REGISTRY:
+        raise ApiError(f"unknown schema {name!r}", 404)
+    return {"__meta": S.meta("SchemaMetadataV3"),
+            "schemas": [{"name": name, "version": 3, "type": name.rstrip("V3"),
+                         "fields": []}]}
+
+
+# ---------------------------------------------------------------------------
+# route table (RegisterV3Api.java analog)
+# ---------------------------------------------------------------------------
+
+ROUTES: List[Tuple[str, str, Callable, str]] = [
+    ("GET", "/3/Cloud", h_cloud, "Cluster status"),
+    ("HEAD", "/3/Cloud", h_cloud, "Cluster status (head)"),
+    ("GET", "/3/About", h_about, "Server build info"),
+    ("GET", "/3/Ping", h_ping, "Liveness probe"),
+    ("GET", "/4/sessions", h_session_new, "Open session (legacy GET)"),
+    ("POST", "/4/sessions", h_session_new, "Open a new session"),
+    ("DELETE", "/4/sessions/{session_key}", h_session_end, "End a session"),
+    ("POST", "/3/InitID", h_session_new, "Open session (legacy)"),
+    ("GET", "/3/InitID", h_session_new, "Open session (legacy)"),
+    ("POST", "/3/Shutdown", h_shutdown, "Shut the server down"),
+    ("GET", "/3/Logs", h_logs, "Server log tail"),
+    ("GET", "/3/Timeline", h_timeline, "Recent request timeline"),
+    ("GET", "/3/ImportFiles", h_importfiles, "List importable files"),
+    ("POST", "/3/ImportFilesMulti", h_importfiles_multi, "List files for many paths"),
+    ("POST", "/3/PostFile", h_postfile, "Upload a raw file"),
+    ("POST", "/3/PostFile.bin", h_postfile, "Upload a raw file (binary)"),
+    ("POST", "/3/ParseSetup", h_parsesetup, "Guess parse setup"),
+    ("POST", "/3/Parse", h_parse, "Parse files into a Frame"),
+    ("GET", "/3/Jobs", h_jobs_list, "List jobs"),
+    ("GET", "/3/Jobs/{job_id}", h_job_get, "Job status"),
+    ("POST", "/3/Jobs/{job_id}/cancel", h_job_cancel, "Cancel a job"),
+    ("POST", "/99/Rapids", h_rapids, "Execute a Rapids AST"),
+    ("GET", "/3/Frames", h_frames_list, "List frames"),
+    ("GET", "/3/Frames/{frame_id}", h_frame_get, "Frame preview"),
+    ("GET", "/3/Frames/{frame_id}/light", h_frame_light, "Frame preview (light)"),
+    ("GET", "/3/Frames/{frame_id}/summary", h_frame_summary, "Frame summary"),
+    ("DELETE", "/3/Frames/{frame_id}", h_frame_delete, "Delete a frame"),
+    ("DELETE", "/3/DKV/{key}", h_dkv_delete, "Delete a DKV key"),
+    ("DELETE", "/3/DKV", h_dkv_delete_all, "Delete all DKV keys"),
+    ("GET", "/3/DownloadDataset", h_download_dataset, "Frame as CSV"),
+    ("GET", "/3/DownloadDataset.bin", h_download_dataset, "Frame as CSV (binary)"),
+    ("GET", "/3/ModelBuilders", h_modelbuilders_list, "List algorithms"),
+    ("GET", "/3/ModelBuilders/{algo}", h_modelbuilder_get, "Algorithm parameters"),
+    ("POST", "/3/ModelBuilders/{algo}", h_modelbuilder_train, "Train a model"),
+    ("POST", "/3/ModelBuilders/{algo}/parameters", h_modelbuilder_validate,
+     "Validate parameters"),
+    ("GET", "/3/Models", h_models_list, "List models"),
+    ("GET", "/3/Models/{model_id}", h_model_get, "Model details"),
+    ("DELETE", "/3/Models/{model_id}", h_model_delete, "Delete a model"),
+    ("GET", "/3/Models/{model_id}/mojo", h_model_mojo, "Export MOJO artifact"),
+    ("POST", "/3/Predictions/models/{model_id}/frames/{frame_id}", h_predict_v3,
+     "Score a frame (sync)"),
+    ("POST", "/4/Predictions/models/{model_id}/frames/{frame_id}", h_predict_v4,
+     "Score a frame (async job)"),
+    ("POST", "/3/ModelMetrics/models/{model_id}/frames/{frame_id}", h_model_metrics,
+     "Compute model metrics on a frame"),
+    ("GET", "/3/Metadata/endpoints", h_metadata_endpoints, "List REST endpoints"),
+    ("GET", "/3/Metadata/schemas", h_metadata_schemas, "List schemas"),
+    ("GET", "/3/Metadata/schemas/{schema_name}", h_metadata_schema, "Schema detail"),
+]
+
+
+def _compile_routes():
+    compiled = []
+    for method, pattern, handler, summary in ROUTES:
+        parts = pattern.strip("/").split("/")
+        compiled.append((method, parts, handler))
+    return compiled
+
+
+_COMPILED = _compile_routes()
+
+
+def _match(method: str, path: str):
+    parts = [unquote(p) for p in path.strip("/").split("/")]
+    best = None
+    for m, pat, handler in _COMPILED:
+        if m != method or len(pat) != len(parts):
+            continue
+        params = {}
+        ok = True
+        for pp, vp in zip(pat, parts):
+            if pp.startswith("{"):
+                params[pp[1:-1]] = vp
+            elif pp != vp:
+                ok = False
+                break
+        if ok:
+            # prefer literal-only matches over parameterized ones
+            score = sum(1 for pp in pat if not pp.startswith("{"))
+            if best is None or score > best[2]:
+                best = (handler, params, score)
+    if best is None:
+        return None, None
+    return best[0], best[1]
+
+
+class RawReply:
+    def __init__(self, data: bytes, content_type: str,
+                 headers: Optional[Dict[str, str]] = None):
+        self.data = data
+        self.content_type = content_type
+        self.headers = headers or {}
+
+
+# ---------------------------------------------------------------------------
+# HTTP plumbing
+# ---------------------------------------------------------------------------
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    server_ref: "ApiServer" = None    # set by ApiServer
 
-    # -- plumbing ---------------------------------------------------------
-    def log_message(self, fmt, *args):   # quiet; reference logs to file
+    def log_message(self, fmt, *args):    # quiet; reference logs to file
         pass
 
-    def _reply(self, obj: Any, code: int = 200):
-        body = json.dumps(obj, default=_json_default).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _error(self, msg: str, code: int = 400):
-        self._reply({"__meta": {"schema_type": "H2OError"},
-                     "msg": msg, "exception_msg": msg,
-                     "stacktrace": traceback.format_exc().splitlines()[-8:]},
-                    code)
-
-    def _body(self) -> Dict[str, Any]:
+    # -- body parsing -----------------------------------------------------
+    def _read_body(self) -> Dict[str, Any]:
         length = int(self.headers.get("Content-Length") or 0)
-        raw = self.rfile.read(length).decode() if length else ""
+        raw = self.rfile.read(length) if length else b""
         ctype = self.headers.get("Content-Type", "")
-        if "json" in ctype and raw:
-            return json.loads(raw)
+        if not raw:
+            return {}
+        if "multipart/form-data" in ctype:
+            return self._parse_multipart(raw, ctype)
+        if "json" in ctype:
+            return json.loads(raw.decode())
         out: Dict[str, Any] = {}
-        for k, vs in parse_qs(raw).items():
+        for k, vs in parse_qs(raw.decode(), keep_blank_values=True).items():
             out[k] = vs[0]
         return out
 
-    # -- routing ----------------------------------------------------------
-    def do_GET(self):
-        try:
-            self._route("GET")
-        except Exception as e:        # noqa: BLE001 — API boundary
-            self._error(f"{type(e).__name__}: {e}", 500)
-
-    def do_POST(self):
-        try:
-            self._route("POST")
-        except Exception as e:        # noqa: BLE001
-            self._error(f"{type(e).__name__}: {e}", 500)
-
-    def do_DELETE(self):
-        try:
-            self._route("DELETE")
-        except Exception as e:        # noqa: BLE001
-            self._error(f"{type(e).__name__}: {e}", 500)
-
-    def _route(self, method: str):
-        u = urlparse(self.path)
-        parts = [unquote(p) for p in u.path.strip("/").split("/")]
-        q = {k: v[0] for k, v in parse_qs(u.query).items()}
-
-        if parts[0] not in ("3", "99", "4"):
-            return self._error(f"unknown route {u.path}", 404)
-        rest = parts[1:]
-        name = rest[0] if rest else ""
-
-        fn = getattr(self, f"_{method.lower()}_{name.lower().replace('.', '_')}", None)
-        if fn is None:
-            return self._error(f"unknown endpoint {method} {u.path}", 404)
-        return fn(rest[1:], q)
-
-    # -- cloud / misc ------------------------------------------------------
-    def _get_cloud(self, rest, q):
-        from h2o3_tpu.core.runtime import cluster_info
-
-        info = cluster_info()
-        size = int(info.get("cloud_size", 1))
-        self._reply({"version": info.get("version", "0.1.0"),
-                     "cloud_name": info.get("cloud_name", "h2o3_tpu"),
-                     "cloud_size": size,
-                     "cloud_uptime_millis": info.get("cloud_uptime_millis", 0),
-                     "cloud_healthy": bool(info.get("cloud_healthy", True)),
-                     "consensus": True, "locked": bool(info.get("locked", True)),
-                     "nodes": [{"h2o": f"device{i}", "healthy": True}
-                               for i in range(size)]})
-
-    def _get_about(self, rest, q):
-        self._reply({"entries": [
-            {"name": "Build project", "value": "h2o3_tpu"},
-            {"name": "Backend", "value": "jax/XLA (TPU-native)"}]})
-
-    def _post_shutdown(self, rest, q):
-        self._reply({"result": "shutting down"})
-        threading.Thread(target=self.server.shutdown, daemon=True).start()
-
-    def _get_sessions(self, rest, q):
-        sid = f"_sid{uuid.uuid4().hex[:12]}"
-        _SESSIONS[sid] = Session(sid)
-        self._reply({"session_key": sid})
-
-    # h2o-py's connection handshake issues POST /4/sessions (advisor finding)
-    _post_sessions = _get_sessions
-    _post_initid = _get_sessions
-    _get_initid = _get_sessions
-
-    def _get_logs(self, rest, q):
-        import logging
-
-        lines = []
-        for h in logging.getLogger("h2o3_tpu").handlers:
-            f = getattr(h, "baseFilename", None)
-            if f:
-                try:
-                    with open(f) as fh:
-                        lines = fh.read().splitlines()[-500:]
-                except OSError:
-                    pass
-        self._reply({"log": "\n".join(lines)})
-
-    # -- import / parse ----------------------------------------------------
-    def _get_importfiles(self, rest, q):
-        path = q.get("path", "")
-        import glob as _g
-        import os
-
-        files = sorted(_g.glob(path)) if any(ch in path for ch in "*?") \
-            else ([path] if os.path.exists(path) else [])
-        self._reply({"files": files, "destination_frames": files,
-                     "fails": [] if files else [path]})
-
-    def _post_parsesetup(self, rest, q):
-        from h2o3_tpu.ingest.parse_setup import guess_setup
-
-        body = self._body()
-        paths = body.get("source_frames") or []
-        if isinstance(paths, str):
-            paths = json.loads(paths) if paths.startswith("[") else [paths]
-        paths = [p.strip('"') for p in paths]
-        setup = guess_setup(paths[0])
-        self._reply({"source_frames": paths,
-                     "separator": ord(setup.separator),
-                     "check_header": setup.check_header,
-                     "column_names": setup.column_names,
-                     "column_types": setup.column_types,
-                     "number_columns": len(setup.column_names),
-                     "destination_frame": paths[0].split("/")[-1] + ".hex"})
-
-    def _post_parse(self, rest, q):
-        from h2o3_tpu.ingest.parser import import_file
-
-        body = self._body()
-        paths = body.get("source_frames") or []
-        if isinstance(paths, str):
-            paths = json.loads(paths) if paths.startswith("[") else [paths]
-        paths = [p.strip('"') for p in paths]
-        dest = (body.get("destination_frame") or "").strip('"') or None
-        job = Job(description="Parse")
-        _JOBS[str(job.key)] = job
-        # synchronous on this worker thread (we already run threaded per
-        # request); the job object exists for /3/Jobs polling parity
-        try:
-            job.status = Job.RUNNING
-            fr = import_file(paths[0], destination_frame=dest)
-            job.dest_key = str(fr.key)
-            job.status = Job.DONE
-            job.progress = 1.0
-        except Exception:            # noqa: BLE001
-            job.status = Job.FAILED
-            job.exception = traceback.format_exc()
-        self._reply({"job": _job_json(job), "destination_frame": {"name": getattr(job, "dest_key", None)}})
-
-    # -- rapids ------------------------------------------------------------
-    def _post_rapids(self, rest, q):
-        body = self._body()
-        ast = body.get("ast", "")
-        sid = body.get("session_id", "default")
-        sess = _SESSIONS.setdefault(sid, Session(sid))
-        val = exec_rapids(ast, sess)
-        if isinstance(val, Frame):
-            if DKV.get(str(val.key)) is None:
-                val.install()      # expression results stay addressable
-            self._reply({"key": {"name": str(val.key)},
-                         **_frame_json(val)})
-        elif isinstance(val, (int, float)):
-            self._reply({"scalar": None if val != val else val})
-        elif isinstance(val, str):
-            self._reply({"string": val})
-        else:
-            self._reply({"scalar": None})
-
-    # -- frames ------------------------------------------------------------
-    def _get_frames(self, rest, q):
-        if not rest:
-            frames = [v for v in (DKV.get(k) for k in DKV.keys())
-                      if isinstance(v, Frame)]
-            return self._reply({"frames": [_frame_json(f, rows=0) for f in frames]})
-        fid = rest[0]
-        fr = DKV.get(fid)
-        if not isinstance(fr, Frame):
-            return self._error(f"frame {fid} not found", 404)
-        if len(rest) > 1 and rest[1] == "summary":
-            return self._reply({"frames": [_summary_json(fr)]})
-        nrows = int(q.get("row_count", 10) or 10)
-        offset = int(q.get("row_offset", 0) or 0)
-        from h2o3_tpu.ops.filters import slice_rows
-
-        view = slice_rows(fr, offset, min(offset + nrows, fr.nrows)) \
-            if offset else fr
-        return self._reply({"frames": [_frame_json(view, rows=nrows)]})
-
-    def _delete_frames(self, rest, q):
-        if rest:
-            DKV.remove(rest[0])
-        self._reply({})
-
-    def _delete_dkv(self, rest, q):
-        if rest:
-            DKV.remove(rest[0])
-        else:
-            DKV.clear()
-        self._reply({})
-
-    # -- models / training -------------------------------------------------
-    def _get_modelbuilders(self, rest, q):
-        from h2o3_tpu.models.model_builder import BUILDERS
-
-        self._reply({"model_builders": {
-            name: {"algo": name, "parameters": [
-                {"name": k, "default_value": v}
-                for k, v in cls.default_params().items()]}
-            for name, cls in BUILDERS.items()}})
-
-    def _post_modelbuilders(self, rest, q):
-        from h2o3_tpu.models.model_builder import BUILDERS
-
-        algo = rest[0].lower() if rest else ""
-        cls = BUILDERS.get(algo)
-        if cls is None:
-            return self._error(f"unknown algo {algo!r}", 404)
-        body = self._body()
-        params: Dict[str, Any] = {}
-        defaults = cls.default_params()
-        for k, v in body.items():
-            kk = "lambda_" if k == "lambda" else k
-            kk = cls.translate_param(kk)
-            if kk not in defaults:
+    @staticmethod
+    def _parse_multipart(raw: bytes, ctype: str) -> Dict[str, Any]:
+        """RFC 2046 byte-exact parsing: each body part is delimited by
+        CRLF--boundary; strip exactly the framing CRLFs, never content bytes."""
+        m = re.search(r'boundary="?([^";]+)"?', ctype)
+        if not m:
+            return {}
+        delim = b"--" + m.group(1).encode()
+        out: Dict[str, Any] = {}
+        chunks = raw.split(delim)
+        # chunks[0] = preamble; last chunk starts with b"--" (close delimiter)
+        for part in chunks[1:]:
+            if part.startswith(b"--"):
+                break
+            if part.startswith(b"\r\n"):
+                part = part[2:]
+            if part.endswith(b"\r\n"):       # CRLF that precedes the next delimiter
+                part = part[:-2]
+            if b"\r\n\r\n" not in part:
                 continue
-            d = defaults[kk]
-            if isinstance(v, str):
-                if v.startswith("[") or v.startswith("{"):
-                    v = json.loads(v)
-                elif isinstance(d, bool):
-                    v = v.lower() == "true"
-                elif isinstance(d, int) and not isinstance(d, bool):
-                    v = int(float(v))
-                elif isinstance(d, float):
-                    v = float(v)
-                else:
-                    v = v.strip('"')
-            params[kk] = v
-        train_key = str(params.pop("training_frame", "")).strip('"')
-        valid_key = str(params.pop("validation_frame", "") or "").strip('"')
-        y = str(params.pop("response_column", "") or "").strip('"') or None
-        train = DKV.get(train_key)
-        if not isinstance(train, Frame):
-            return self._error(f"training_frame {train_key!r} not found", 404)
-        valid = DKV.get(valid_key) if valid_key else None
+            head, _, payload = part.partition(b"\r\n\r\n")
+            headtext = head.decode(errors="replace")
+            if "filename=" in headtext:
+                out["__file__"] = payload
+                fm = re.search(r'filename="([^"]*)"', headtext)
+                if fm:
+                    out["__filename__"] = fm.group(1)
+            else:
+                nm = re.search(r'name="([^"]*)"', headtext)
+                if nm:
+                    out[nm.group(1)] = payload.decode(errors="replace")
+        return out
 
-        builder = cls(**params)
-        job = Job(description=f"{algo} train")
-        _JOBS[str(job.key)] = job
+    # -- replies ----------------------------------------------------------
+    def _send(self, code: int, body: bytes, content_type: str,
+              extra: Optional[Dict[str, str]] = None):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(body)
 
-        def run():
-            try:
-                job.status = Job.RUNNING
-                model = builder.train(y=y, training_frame=train,
-                                      validation_frame=valid)
-                job.dest_key = str(model.key)
-                job.status = Job.DONE
-                job.progress = 1.0
-            except Exception:            # noqa: BLE001
-                job.status = Job.FAILED
-                job.exception = traceback.format_exc()
+    def _reply_json(self, obj: Any, code: int = 200):
+        body = json.dumps(obj, default=_json_default).encode()
+        self._send(code, body, "application/json")
 
-        threading.Thread(target=run, daemon=True).start()
-        self._reply({"job": _job_json(job)})
+    def _reply_error(self, msg: str, code: int, schema: str = "H2OErrorV3",
+                     stack: Optional[List[str]] = None):
+        self._reply_json(S.error_v3(msg, code, stacktrace=stack, schema=schema), code)
 
-    def _get_models(self, rest, q):
-        if not rest:
-            models = [v for v in (DKV.get(k) for k in DKV.keys())
-                      if isinstance(v, Model)]
-            return self._reply({"models": [m.to_dict() for m in models]})
-        m = DKV.get(rest[0])
-        if not isinstance(m, Model):
-            return self._error(f"model {rest[0]} not found", 404)
-        self._reply({"models": [m.to_dict()]})
+    # -- dispatch ---------------------------------------------------------
+    def _handle(self):
+        t0 = time.time()
+        status = 200
+        u = urlparse(self.path)
+        try:
+            handler, params = _match(self.command, u.path)
+            if handler is None:
+                status = 404
+                return self._reply_error(f"unknown route {self.command} {u.path}", 404)
+            query = {k: v[0] for k, v in parse_qs(u.query, keep_blank_values=True).items()}
+            body = self._read_body() if self.command in ("POST", "PUT", "DELETE") else {}
+            ctx = Ctx(params, query, body, self.server_ref)
+            out = handler(ctx)
+            if isinstance(out, RawReply):
+                return self._send(200, out.data, out.content_type, out.headers)
+            return self._reply_json(out)
+        except ApiError as e:
+            status = e.status
+            return self._reply_error(str(e), e.status, e.schema)
+        except BrokenPipeError:
+            status = 499
+        except Exception as e:          # noqa: BLE001 — API boundary
+            status = 500
+            return self._reply_error(
+                f"{type(e).__name__}: {e}", 500,
+                stack=traceback.format_exc().splitlines()[-12:])
+        finally:
+            _timeline_record(self.command, u.path, status, (time.time() - t0) * 1000)
 
-    def _delete_models(self, rest, q):
-        if rest:
-            DKV.remove(rest[0])
-        self._reply({})
-
-    def _post_predictions(self, rest, q):
-        # /3/Predictions/models/{model}/frames/{frame}
-        if len(rest) < 4 or rest[0] != "models" or rest[2] != "frames":
-            return self._error("bad predictions path", 400)
-        m = DKV.get(rest[1])
-        fr = DKV.get(rest[3])
-        if not isinstance(m, Model):
-            return self._error(f"model {rest[1]} not found", 404)
-        if not isinstance(fr, Frame):
-            return self._error(f"frame {rest[3]} not found", 404)
-        body = self._body()
-        dest = str(body.get("predictions_frame", "") or "").strip('"') or None
-        pred = m.predict(fr, key=dest)
-        pred.install()
-        mm = m.model_performance(fr)
-        self._reply({"predictions_frame": {"name": str(pred.key)},
-                     "model_metrics": [mm.to_dict() if mm else {}]})
-
-    # -- jobs --------------------------------------------------------------
-    def _get_jobs(self, rest, q):
-        if not rest:
-            return self._reply({"jobs": [_job_json(j) for j in _JOBS.values()]})
-        job = _JOBS.get(rest[0])
-        if job is None:
-            return self._error(f"job {rest[0]} not found", 404)
-        self._reply({"jobs": [_job_json(job)]})
-
-
-def _job_json(job: Job) -> dict:
-    return {"key": {"name": str(job.key)},
-            "description": job.description,
-            "status": str(job.status),
-            "progress": job.progress,
-            "exception": getattr(job, "exception", None),
-            "dest": {"name": getattr(job, "dest_key", None)}}
+    do_GET = do_POST = do_DELETE = do_PUT = do_HEAD = _handle
 
 
 class ApiServer:
@@ -414,10 +927,10 @@ class ApiServer:
         self.thread: Optional[threading.Thread] = None
 
     def start(self) -> "ApiServer":
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", self.port), _Handler)
+        handler = type("_BoundHandler", (_Handler,), {"server_ref": self})
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", self.port), handler)
         self.port = self.httpd.server_address[1]
-        self.thread = threading.Thread(target=self.httpd.serve_forever,
-                                       daemon=True)
+        self.thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         self.thread.start()
         return self
 

@@ -93,9 +93,20 @@ class RemoteFrame:
     def head(self, rows: int = 10) -> List[dict]:
         fr = _req("GET", f"/3/Frames/{self.frame_id}",
                   query={"row_count": rows})["frames"][0]
-        cols = fr["columns"]
-        return [{c["label"]: c["data"][i] for c in cols if i < len(c["data"])}
-                for i in range(min(rows, fr["rows"]))]
+
+        def cell(c, i):
+            if c.get("string_data") is not None:
+                vals = c["string_data"]
+                return vals[i] if i < len(vals) else None
+            vals = c.get("data") or []
+            v = vals[i] if i < len(vals) else None
+            if c["type"] == "enum" and isinstance(v, int) and c.get("domain"):
+                return c["domain"][v]          # decode code -> label
+            return None if v == "NaN" else v
+
+        n = min(rows, fr["rows"])
+        return [{c["label"]: cell(c, i) for c in fr["columns"]}
+                for i in range(n)]
 
     def summary(self) -> dict:
         return _req("GET", f"/3/Frames/{self.frame_id}/summary")["frames"][0]["summary"]
@@ -158,7 +169,8 @@ class RemoteModel:
 
     @property
     def auc(self):
-        return (self.info().get("training_metrics") or {}).get("AUC")
+        out = self.info().get("output") or {}
+        return (out.get("training_metrics") or {}).get("AUC")
 
     def predict(self, frame: RemoteFrame,
                 destination_frame: Optional[str] = None) -> RemoteFrame:
@@ -197,7 +209,7 @@ def list_frames() -> List[str]:
 
 
 def list_models() -> List[str]:
-    return [m["model_id"] for m in _req("GET", "/3/Models")["models"]]
+    return [m["model_id"]["name"] for m in _req("GET", "/3/Models")["models"]]
 
 
 def shutdown():

@@ -308,9 +308,15 @@ def _ascharacter(env, fr):
 
 @prim("levels")
 def _levels(env, fr):
-    c = _one_col(fr)
+    """One output column per input column holding its level strings, padded
+    with '' to equal length (AstLevels; h2o-py frame.levels() transposes and
+    strips the padding client-side)."""
+    doms = [list(fr.col(n).domain or []) for n in fr.names]
+    depth = max((len(d) for d in doms), default=0) or 1
     out = Frame()
-    out.add("levels", Column.from_numpy(np.asarray(c.domain or [], object)))
+    for n, d in zip(fr.names, doms):
+        vals = d + [""] * (depth - len(d))
+        out.add(n, Column.from_numpy(np.asarray(vals, object)))
     return out
 
 
@@ -365,13 +371,78 @@ def _gb(env, fr, by, *aggs):
 
 # -- reducers (ast/prims/reducers) ------------------------------------------
 
+def _percol(fr, stat) -> List[float]:
+    """Per-column reduction over a frame; non-numeric -> NaN (reducer prims
+    in the reference operate frame-wide, ast/prims/reducers)."""
+    out = []
+    for n in fr.names:
+        c = fr.col(n)
+        out.append(float(stat(c)) if c.is_numeric or c.ctype == "time"
+                   else float("nan"))
+    return out
+
+
 @prim("mean")
 def _mean(env, v, *rest):
+    """(mean fr) -> scalar (single col); (mean fr skipna axis) -> h2o-py's
+    frame form: 1-row frame of per-column means (frame.py:3188)."""
+    if _is_fr(v) and rest:
+        axis = int(_scalar(rest[1])) if len(rest) > 1 else 0
+        out = Frame()
+        if axis == 1:
+            import jax.numpy as jnp
+
+            num = [v.col(n) for n in v.names if v.col(n).is_numeric]
+            if not num:
+                raise ValueError("no numeric columns for row-wise mean")
+            stack = jnp.stack([c.data for c in num], axis=1)
+            mask = ~jnp.isnan(stack)
+            s = jnp.where(mask, stack, 0.0).sum(axis=1)
+            cnt = mask.sum(axis=1)
+            vals = jnp.where(cnt > 0, s / jnp.maximum(cnt, 1), jnp.nan)
+            out.add("mean", Column(vals, T_NUM, v.nrows))
+            return out
+        for n, m in zip(v.names, _percol(v, lambda c: c.rollups.mean)):
+            out.add(n, Column.from_numpy(np.asarray([m])))
+        return out
     return _one_col(v).rollups.mean
+
+
+@prim("ls")
+def _ls(env):
+    """DKV key listing as a 1-column frame (AstLs; h2o.ls())."""
+    from h2o3_tpu.models.model import Model
+
+    keys = [k for k in DKV.keys()
+            if isinstance(DKV.get(k), (Frame, Model))]
+    out = Frame()
+    out.add("key", Column.from_numpy(np.asarray(keys or [""], object)))
+    return out
+
+
+@prim("getrow")
+def _getrow(env, fr):
+    """1xn frame -> scalar list (h2o-py frame.getrow, frame.py:918)."""
+    if not _is_fr(fr) or fr.nrows != 1:
+        raise ValueError("getrow expects a single-row frame")
+    out = []
+    for n in fr.names:
+        c = fr.col(n)
+        if c.is_string:
+            out.append(float("nan"))
+        else:
+            v = c.to_numpy()[0]
+            out.append(float(v))
+    return out
 
 
 @prim("sum")
 def _sum(env, v, *rest):
+    if _is_fr(v) and v.ncols > 1:
+        def tot(c):
+            r = c.rollups
+            return r.mean * (c.nrows - r.na_count)
+        return _percol(v, tot)
     c = _one_col(v)
     r = c.rollups
     return r.mean * (c.nrows - r.na_count)
@@ -379,27 +450,37 @@ def _sum(env, v, *rest):
 
 @prim("min")
 def _min(env, v, *rest):
+    if _is_fr(v) and v.ncols > 1:
+        return float(np.nanmin(_percol(v, lambda c: c.rollups.min)))
     return _one_col(v).rollups.min
 
 
 @prim("max")
 def _max(env, v, *rest):
+    if _is_fr(v) and v.ncols > 1:
+        return float(np.nanmax(_percol(v, lambda c: c.rollups.max)))
     return _one_col(v).rollups.max
 
 
 @prim("sd")
 def _sd(env, v, *rest):
+    if _is_fr(v) and v.ncols > 1:
+        return _percol(v, lambda c: c.rollups.sigma)
     return _one_col(v).rollups.sigma
 
 
 @prim("var")
 def _var(env, v, *rest):
+    if _is_fr(v) and v.ncols > 1:
+        return _percol(v, lambda c: c.rollups.sigma ** 2)
     s = _one_col(v).rollups.sigma
     return s * s
 
 
 @prim("naCnt", "nacnt")
 def _nacnt(env, v):
+    if _is_fr(v) and v.ncols > 1:
+        return [float(v.col(n).rollups.na_count) for n in v.names]
     return float(_one_col(v).rollups.na_count)
 
 
@@ -407,6 +488,9 @@ def _nacnt(env, v):
 def _median(env, v, *rest):
     from h2o3_tpu.ops.quantile import quantile_column
 
+    if _is_fr(v) and v.ncols > 1:
+        return [quantile_column(v.col(n), [0.5])[0] if v.col(n).is_numeric
+                else float("nan") for n in v.names]
     return quantile_column(_one_col(v), [0.5])[0]
 
 

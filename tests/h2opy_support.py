@@ -1,0 +1,83 @@
+"""Load GENUINE h2o-py (the reference client, /root/reference/h2o-py) for
+compatibility tests — the SURVEY §7 north star is that stock h2o-py drives
+this server unchanged.
+
+h2o-py still imports the py2/3 compat package `future` (not installed here,
+and irrelevant on py3); we register a minimal in-memory shim BEFORE adding
+h2o-py to sys.path. No reference code is copied — the client is imported
+in place, read-only.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+H2OPY_PATH = "/root/reference/h2o-py"
+
+
+def _install_future_shim():
+    if "future" in sys.modules:
+        return
+    future = types.ModuleType("future")
+    utils = types.ModuleType("future.utils")
+    utils.PY2 = False
+    utils.PY3 = True
+
+    def with_metaclass(meta, *bases):
+        # six.with_metaclass: a temporary metaclass that replaces itself
+        class metaclass(meta):
+            def __new__(cls, name, this_bases, d):
+                return meta(name, bases, d)
+
+        return type.__new__(metaclass, "temporary_class", (), {})
+
+    utils.with_metaclass = with_metaclass
+    # dict view helpers (on py3 these are just the bound methods)
+    utils.viewitems = lambda d: d.items()
+    utils.viewkeys = lambda d: d.keys()
+    utils.viewvalues = lambda d: d.values()
+
+    builtins_pkg = types.ModuleType("future.builtins")
+    iterators = types.ModuleType("future.builtins.iterators")
+    iterators.range, iterators.filter = range, filter
+    iterators.map, iterators.zip = map, zip
+    misc = types.ModuleType("future.builtins.misc")
+    misc.chr, misc.input, misc.open = chr, input, open
+    misc.next, misc.round, misc.super = next, round, super
+    builtins_pkg.iterators = iterators
+    builtins_pkg.misc = misc
+
+    future.utils = utils
+    future.builtins = builtins_pkg
+    sys.modules["future"] = future
+    sys.modules["future.utils"] = utils
+    sys.modules["future.builtins"] = builtins_pkg
+    sys.modules["future.builtins.iterators"] = iterators
+    sys.modules["future.builtins.misc"] = misc
+
+    if "imp" not in sys.modules:      # removed in py3.12; h2o-py probes
+        imp = types.ModuleType("imp")  # pandas/numpy presence via find_module
+
+        def find_module(name, path=None):
+            import importlib.util
+
+            spec = importlib.util.find_spec(name)
+            if spec is None:
+                raise ImportError(name)
+            return None, spec.origin, ("", "", 5)
+
+        imp.find_module = find_module
+        sys.modules["imp"] = imp
+
+
+def ensure_h2opy():
+    """Import and return genuine h2o-py."""
+    if "h2o" in sys.modules and hasattr(sys.modules["h2o"], "connect"):
+        return sys.modules["h2o"]
+    _install_future_shim()
+    if H2OPY_PATH not in sys.path:
+        sys.path.insert(0, H2OPY_PATH)
+    import h2o  # noqa: PLC0415
+
+    return h2o

@@ -55,15 +55,15 @@ def test_rapids_over_http(server, csv_path):
     sub = fr.cols(["g", "x"])
     assert sub.ncols == 2
     out = client.rapids(f"(tmp= filt (rows {fr.frame_id} (> (cols_py {fr.frame_id} 'x') 0)))")
-    assert 0 < out["rows"] < 500
+    assert 0 < out["num_rows"] < 500
 
 
 def test_train_predict_over_http(server, csv_path):
     fr = client.import_file(csv_path)
     m = client.train("gbm", y="y", training_frame=fr, ntrees=10, max_depth=3)
     info = m.info()
-    assert info["model_category"] == "Binomial"
-    assert info["training_metrics"]["AUC"] > 0.7
+    assert info["output"]["model_category"] == "Binomial"
+    assert info["output"]["training_metrics"]["AUC"] > 0.7
     pred = m.predict(fr)
     assert pred.nrows == 500
     assert "predict" in pred.names
@@ -73,7 +73,7 @@ def test_train_predict_over_http(server, csv_path):
 def test_glm_over_http(server, csv_path):
     fr = client.import_file(csv_path)
     m = client.train("glm", y="y", training_frame=fr, family="binomial")
-    assert m.info()["training_metrics"]["AUC"] > 0.7
+    assert m.info()["output"]["training_metrics"]["AUC"] > 0.7
 
 
 def test_error_paths(server):
