@@ -1,0 +1,472 @@
+"""Fully device-side tree growth — histogram, split search, routing and leaf
+statistics in ONE compiled program per tree.
+
+Reference: hex/tree/ScoreBuildHistogram2.java:60 (per-row histogram build,
+CAS adds into DHistogram._vals, DHistogram.java:62-90) + DTree.decideBestSplit
++ GBM.java:416 GammaPass. The round-2 implementation kept the reference's
+host/device split: a device scatter-add per level, then host numpy split
+search, then a device routing pass — 2 dispatches + a blocking transfer per
+level. Profiled on a v5e chip, the scatter-add alone was 57% of training
+time (scatter serializes on TPU), and on this environment every device→host
+fetch pays ~60 ms of tunnel latency, so per-level (and even per-tree) syncs
+dominate everything else.
+
+TPU-native redesign (this module):
+- Histograms are MXU matmuls, not scatters:  hist = Oᵀ·V  with
+  O (rows, F·maxB) the per-feature bin one-hot and V (rows, 3·S) the
+  (w, w·y, w·y²) triples crossed with the node one-hot. Operands are cast
+  to bf16 (the one-hot is exact in bf16; the MXU accumulates in f32 via
+  preferred_element_type), halving HBM traffic — the bandwidth, not the
+  FLOPs, is the roofline here. Blocked over row chunks.
+- The split search runs on device, vectorized over (node, feature, bin):
+  categorical bins are ordered by per-node mean response (argsort) — the
+  same sorted-subset optimum the host search computed — numeric bins keep
+  natural order via an iota sort key. NA direction is tried both ways.
+- Nodes live at HEAP positions (level-relative slot s → children 2s, 2s+1):
+  no host renumbering between levels; terminal rows record a heap-global
+  leaf id (2^d - 1 + s).
+- The GammaPass inputs (num, den) are computed BEFORE the tree from
+  (w, y, z, f) and segment-summed per leaf inside the same program, so leaf
+  Newton steps need no extra dispatch.
+- All per-level tables pack into ONE (depth+1, S_max, 4+maxB+3) f32 array;
+  training keeps it on device and fetches every tree's tables in a single
+  end-of-training transfer (one ~60 ms tunnel round-trip total, not one per
+  level per tree).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+EPS_W = 1e-12
+
+
+def _mesh():
+    from h2o3_tpu.core.runtime import cluster
+
+    return cluster().mesh
+
+
+def heap_size(max_depth: int) -> int:
+    return 2 ** (max_depth + 1) - 1
+
+
+# ---------------------------------------------------------------------------
+# device split search (replicated per shard; inputs are psum'd histograms)
+# ---------------------------------------------------------------------------
+
+def _search_level(hist, *, nbins, is_cat, maxB, min_rows, min_split_improvement,
+                  feat_mask):
+    """hist (S, F, maxB, 3) -> split tables for this level.
+
+    Returns split_feat (S,) int32 (-1 terminal), thresh (S,) int32 (position
+    in sorted-bin space), na_left (S,) bool, gain (S,) f32,
+    left_table (S, maxB) bool, tot (S, 3) f32 node totals.
+    """
+    import jax.numpy as jnp
+
+    S, F = hist.shape[0], hist.shape[1]
+    nb = jnp.asarray(nbins, jnp.int32)                    # (F,) incl NA bin
+    cat = jnp.asarray(is_cat)
+    binsr = jnp.arange(maxB, dtype=jnp.int32)
+
+    na_pos = nb - 1                                        # (F,)
+    val_mask = binsr[None, :] < na_pos[:, None]            # (F, maxB) value bins
+    na = jnp.take_along_axis(
+        hist, na_pos[None, :, None, None].astype(jnp.int32).repeat(S, 0),
+        axis=2)[:, :, 0, :]                                # (S, F, 3)
+    V = hist * val_mask[None, :, :, None]
+    tot = V.sum(axis=2) + na                               # (S, F, 3)
+
+    w_, wy_, wyy_ = tot[..., 0], tot[..., 1], tot[..., 2]
+    se_parent = wyy_ - jnp.where(w_ > EPS_W, wy_ * wy_ / jnp.maximum(w_, EPS_W), 0.0)
+
+    # bin ordering: categorical by per-node mean response, numeric by index
+    mean = jnp.where(V[..., 0] > EPS_W,
+                     V[..., 1] / jnp.maximum(V[..., 0], EPS_W), jnp.inf)
+    sort_key = jnp.where(cat[None, :, None], mean,
+                         binsr[None, None, :].astype(jnp.float32))
+    order = jnp.argsort(sort_key, axis=2)                  # (S, F, maxB)
+    Vs = jnp.take_along_axis(V, order[..., None], axis=2)
+    prefix = jnp.cumsum(Vs, axis=2)                        # (S, F, maxB, 3)
+    cand = prefix[:, :, :-1, :]                            # split after pos t
+
+    # valid candidate positions: t <= nbins[f]-3 (value bins minus one)
+    cand_ok = binsr[None, :-1] <= (nb[:, None] - 3)        # (F, maxB-1)
+
+    def gains_for(na_dir):
+        L = cand + (na[:, :, None, :] if na_dir else 0.0)
+        R = tot[:, :, None, :] - L
+        ok = (L[..., 0] >= min_rows) & (R[..., 0] >= min_rows) & cand_ok[None]
+        seL = L[..., 2] - jnp.where(L[..., 0] > EPS_W,
+                                    L[..., 1] ** 2 / jnp.maximum(L[..., 0], EPS_W), 0.0)
+        seR = R[..., 2] - jnp.where(R[..., 0] > EPS_W,
+                                    R[..., 1] ** 2 / jnp.maximum(R[..., 0], EPS_W), 0.0)
+        g = se_parent[:, :, None] - seL - seR
+        return jnp.where(ok, g, -jnp.inf)
+
+    gains = jnp.stack([gains_for(0), gains_for(1)], axis=-1)  # (S,F,maxB-1,2)
+    if feat_mask is not None:
+        gains = jnp.where(feat_mask[:, :, None, None], gains, -jnp.inf)
+
+    flat = gains.reshape(S, -1)
+    bi = jnp.argmax(flat, axis=1)
+    bg = jnp.take_along_axis(flat, bi[:, None], axis=1)[:, 0]
+    per_f = (maxB - 1) * 2
+    f_star = (bi // per_f).astype(jnp.int32)
+    rem = bi % per_f
+    t_star = (rem // 2).astype(jnp.int32)
+    na_left = (rem % 2).astype(jnp.bool_)
+
+    valid = bg > min_split_improvement
+    split_feat = jnp.where(valid, f_star, -1)
+
+    # routing LUT: bin b goes left iff its position in the sorted order <= t*
+    order_sel = jnp.take_along_axis(
+        order, f_star[:, None, None].repeat(maxB, 2), axis=1)[:, 0, :]  # (S,maxB)
+    rank = jnp.argsort(order_sel, axis=1)          # inverse permutation
+    go_left = rank <= t_star[:, None]
+    napos_sel = na_pos[f_star]                     # (S,)
+    left_table = jnp.where(binsr[None, :] == napos_sel[:, None],
+                           na_left[:, None], go_left)
+
+    tot0 = tot[:, 0, :]                            # per-f totals identical
+    return (split_feat, t_star, na_left,
+            jnp.where(valid, bg, 0.0).astype(jnp.float32),
+            left_table, tot0)
+
+
+# ---------------------------------------------------------------------------
+# the per-tree program
+# ---------------------------------------------------------------------------
+
+def pack_width(maxB: int) -> int:
+    """Per-slot f32 lanes: split_feat, thresh, na_left, gain, left_table
+    (maxB), tot (3)."""
+    return 4 + maxB + 3
+
+
+@functools.lru_cache(maxsize=32)
+def _grow_fn(max_depth: int, F: int, maxB: int, nbins: tuple, is_cat: tuple,
+             min_rows: float, min_split_improvement: float,
+             has_masks: bool, mesh, n_shard: int, blk: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    nblk = -(-n_shard // blk)
+    pad_to = nblk * blk
+    L = heap_size(max_depth)                   # heap leaf-id space
+    Lp = max(1 << (L - 1).bit_length(), 1)
+    Smax = 2 ** max_depth
+    K = pack_width(maxB)
+
+    def hist_level(binned, row_node, live, w, y, S):
+        """(S, F, maxB, 3) via blocked bf16 one-hot matmul + psum."""
+
+        def body(i, acc):
+            sl = lambda a: jax.lax.dynamic_slice_in_dim(a, i * blk, blk, 0)
+            bb = sl(binned)
+            nodeb = sl(row_node)
+            liveb = sl(live)
+            wb = jnp.where(liveb, sl(w), 0.0)
+            yb = sl(y)
+            Ob = jnp.concatenate(
+                [jax.nn.one_hot(bb[:, f], maxB, dtype=jnp.bfloat16)
+                 for f in range(F)], axis=1)                     # (blk, F*maxB)
+            node_oh = jax.nn.one_hot(nodeb, S, dtype=jnp.float32)
+            vals = jnp.stack([wb, wb * yb, wb * yb * yb], axis=-1)
+            V = (node_oh[:, :, None] * vals[:, None, :]).reshape(blk, S * 3)
+            return acc + jnp.dot(Ob.T, V.astype(jnp.bfloat16),
+                                 preferred_element_type=jnp.float32)
+
+        acc0 = jax.lax.pcast(jnp.zeros((F * maxB, S * 3), jnp.float32),
+                             ("rows",), to="varying")
+        acc = jax.lax.fori_loop(0, nblk, body, acc0)
+        acc = jax.lax.psum(acc, "rows")
+        return acc.reshape(F, maxB, S, 3).transpose(2, 0, 1, 3)
+
+    def leaf_sums(row_leaf, cols):
+        """(Lp, C) per-heap-leaf sums of the given row columns (n, C);
+        f32 one-hot matmul (exact accumulation for the Newton steps)."""
+        C = cols.shape[1]
+
+        def body(i, acc):
+            sl = lambda a: jax.lax.dynamic_slice_in_dim(a, i * blk, blk, 0)
+            lb = sl(row_leaf)
+            vb = sl(cols)
+            oh = jax.nn.one_hot(jnp.maximum(lb, 0), Lp, dtype=jnp.float32)
+            oh = oh * (lb >= 0)[:, None]
+            return acc + jnp.dot(oh.T, vb, preferred_element_type=jnp.float32)
+
+        acc0 = jax.lax.pcast(jnp.zeros((Lp, C), jnp.float32), ("rows",),
+                             to="varying")
+        acc = jax.lax.fori_loop(0, nblk, body, acc0)
+        return jax.lax.psum(acc, "rows")
+
+    def tree_program(binned, w, y, num, den, masks):
+        n = binned.shape[0]
+        if pad_to != n:
+            padn = pad_to - n
+            binned = jnp.pad(binned, ((0, padn), (0, 0)))
+            w = jnp.pad(w, (0, padn))
+            y = jnp.pad(y, (0, padn))
+            num = jnp.pad(num, (0, padn))
+            den = jnp.pad(den, (0, padn))
+        # center y for the histogram: SE-reduction gains are invariant under
+        # a constant shift, and a centered target keeps the bf16 histogram
+        # operands at signal scale (w·y² of a mean-1000/σ-20 target would
+        # otherwise bury the gains in quantization noise). Leaf statistics
+        # (leaf4) use the UNcentered values through the f32 path below; only
+        # the packed per-node (w, wy, wyy) totals are in centered space.
+        ymean = jax.lax.psum(jnp.sum(w * y), "rows") / \
+            jnp.maximum(jax.lax.psum(jnp.sum(w), "rows"), EPS_W)
+        yc = y - ymean
+        row_node = jnp.zeros(pad_to, jnp.int32)
+        row_leaf = jnp.full(pad_to, -1, jnp.int32)
+        if pad_to != n:        # pad rows are immediately dead
+            row_leaf = row_leaf.at[n:].set(L)     # off-range sentinel
+
+        packed = jnp.zeros((max_depth + 1, Smax, K), jnp.float32)
+        for d in range(max_depth + 1):
+            S = 2 ** d
+            live = row_leaf < 0
+            if d < max_depth:
+                hist = hist_level(binned, row_node, live, w, yc, S)
+                fm = masks[d] if has_masks else None
+                (split_feat, t_star, na_left, gain,
+                 left_table, tot) = _search_level(
+                    hist, nbins=nbins, is_cat=is_cat, maxB=maxB,
+                    min_rows=min_rows,
+                    min_split_improvement=min_split_improvement,
+                    feat_mask=fm)
+            else:
+                split_feat = jnp.full(S, -1, jnp.int32)
+                t_star = jnp.zeros(S, jnp.int32)
+                na_left = jnp.zeros(S, bool)
+                gain = jnp.zeros(S, jnp.float32)
+                left_table = jnp.zeros((S, maxB), bool)
+                tot = jnp.zeros((S, 3), jnp.float32)
+
+            # de-center the recorded node totals back to true y space
+            # (wy = wy_c + w·ȳ; wyy = wyy_c + 2ȳ·wy_c + ȳ²·w)
+            tot_true = jnp.stack(
+                [tot[:, 0],
+                 tot[:, 1] + tot[:, 0] * ymean,
+                 tot[:, 2] + 2 * ymean * tot[:, 1] + ymean * ymean * tot[:, 0]],
+                axis=1)
+            row = jnp.concatenate(
+                [split_feat.astype(jnp.float32)[:, None],
+                 t_star.astype(jnp.float32)[:, None],
+                 na_left.astype(jnp.float32)[:, None],
+                 gain[:, None],
+                 left_table.astype(jnp.float32),
+                 tot_true], axis=1)                  # (S, K)
+            packed = packed.at[d, :S, :].set(row)
+
+            node = row_node
+            terminal = split_feat[node] < 0
+            heap_id = (S - 1) + node
+            row_leaf = jnp.where(live & terminal, heap_id, row_leaf)
+            f_sel = jnp.maximum(split_feat[node], 0)
+            b = jnp.take_along_axis(binned, f_sel[:, None], axis=1)[:, 0]
+            gl = left_table[node, jnp.minimum(b, maxB - 1)]
+            row_node = jnp.where(live & ~terminal,
+                                 2 * node + (1 - gl.astype(jnp.int32)),
+                                 0)
+
+        cols = jnp.stack([w, w * y, num, den], axis=-1)
+        leaf4 = leaf_sums(row_leaf, cols)
+        row_leaf = jnp.where(row_leaf >= L, -1, row_leaf)   # clear pad sentinel
+        return packed, leaf4[:L], row_leaf[:n]
+
+    in_specs = (P("rows", None), P("rows"), P("rows"), P("rows"), P("rows"),
+                tuple(P() for _ in range(max_depth)) if has_masks else P())
+    fn = jax.shard_map(tree_program, mesh=mesh,
+                       in_specs=in_specs,
+                       out_specs=(P(), P(), P("rows")))
+    return jax.jit(fn)
+
+
+def _pick_blk(n_shard: int, F: int, maxB: int) -> int:
+    """Row-block size: keep the per-block one-hot under ~64 MB."""
+    budget = 64 * 1024 * 1024 // (2 * F * maxB)
+    blk = 1 << max(int(np.floor(np.log2(max(budget, 1)))), 10)
+    return int(min(blk, max(n_shard, 1)))
+
+
+def _mesh_size(mesh) -> int:
+    return int(np.prod([mesh.shape[a] for a in mesh.axis_names])) or 1
+
+
+def grow_tree_device(binned, w, y, spec, *, max_depth: int, min_rows: float,
+                     min_split_improvement: float, num=None, den=None,
+                     feat_masks: Optional[List[np.ndarray]] = None):
+    """Grow one tree fully on device — NOTHING is fetched to host.
+
+    binned (N, F) int32 row-sharded; w, y, num, den (N,) device (num/den are
+    the GammaPass numerator/denominator rows; default num=w·y, den=w).
+    feat_masks: optional per-level (2^d, F) bool arrays, levels
+    0..max_depth-1 (mtries / column sampling).
+
+    Returns device arrays (packed, leaf4, row_leaf):
+      packed   — (max_depth+1, 2^max_depth, 4+maxB+3) f32 per-level split
+                 tables (see pack_width)
+      leaf4    — (heap_size, 4) per-heap-leaf sums of (w, w·y, num, den)
+      row_leaf — (N,) int32 heap-global leaf id per row
+    """
+    import jax.numpy as jnp
+
+    mesh = _mesh()
+    N, F = binned.shape
+    n_shard = N // _mesh_size(mesh)
+    maxB = int(spec.nbins.max())
+    blk = _pick_blk(n_shard, F, maxB)
+    has_masks = feat_masks is not None
+    fn = _grow_fn(int(max_depth), F, maxB, tuple(int(b) for b in spec.nbins),
+                  tuple(bool(c) for c in spec.is_cat), float(min_rows),
+                  float(min_split_improvement), has_masks, mesh, n_shard, blk)
+    w = w.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    if num is None:
+        num = w * y
+    if den is None:
+        den = w
+    masks_in = (tuple(jnp.asarray(m) for m in feat_masks) if has_masks
+                else jnp.zeros(0))
+    return fn(binned, w, y, num.astype(jnp.float32), den.astype(jnp.float32),
+              masks_in)
+
+
+# ---------------------------------------------------------------------------
+# device traversal with packed tables (in-training validation scoring)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=32)
+def _apply_fn(max_depth: int, maxB: int, mesh):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    def apply(binned, packed, values):
+        """Route rows through the packed tree; -> (n,) leaf values."""
+        n = binned.shape[0]
+        row_node = jnp.zeros(n, jnp.int32)
+        row_leaf = jnp.full(n, -1, jnp.int32)
+        for d in range(max_depth + 1):
+            S = 2 ** d
+            split_feat = packed[d, :S, 0].astype(jnp.int32)
+            left_table = packed[d, :S, 4:4 + maxB] > 0.5
+            live = row_leaf < 0
+            node = row_node
+            terminal = split_feat[node] < 0
+            row_leaf = jnp.where(live & terminal, (S - 1) + node, row_leaf)
+            f_sel = jnp.maximum(split_feat[node], 0)
+            b = jnp.take_along_axis(binned, f_sel[:, None], axis=1)[:, 0]
+            gl = left_table[node, jnp.minimum(b, maxB - 1)]
+            row_node = jnp.where(live & ~terminal,
+                                 2 * node + (1 - gl.astype(jnp.int32)), 0)
+        return values[jnp.maximum(row_leaf, 0)]
+
+    fn = jax.shard_map(apply, mesh=mesh,
+                       in_specs=(P("rows", None), P(), P()),
+                       out_specs=P("rows"))
+    return jax.jit(fn)
+
+
+def apply_packed(binned, packed, values, max_depth: int, maxB: int):
+    """Device traversal: (N, F) binned rows -> (N,) leaf values, using a
+    packed tree table and a (heap_size,) leaf-value array."""
+    import jax.numpy as jnp
+
+    fn = _apply_fn(int(max_depth), int(maxB), _mesh())
+    return fn(binned, packed, values.astype(jnp.float32))
+
+
+def assemble_trees(packs, leaf_vals, leaf_wys, spec, max_depth: int,
+                   scale: float = 1.0):
+    """End-of-training epilogue shared by every fit loop: stack the
+    device-resident per-tree tables, fetch them in ONE transfer, and build
+    the HostTrees (leaf values scaled by `scale` — DRF divides by the tree
+    count so the summed traversal averages)."""
+    import jax.numpy as jnp
+
+    packs_np = np.asarray(jnp.stack(packs))
+    vals_np = np.asarray(jnp.stack(leaf_vals), np.float64) * scale
+    wys_np = np.asarray(jnp.stack(leaf_wys), np.float64)
+    return [host_tree_from_packed(packs_np[i], wys_np[i], spec, max_depth,
+                                  leaf_values=vals_np[i])
+            for i in range(len(packs))]
+
+
+# ---------------------------------------------------------------------------
+# host tree assembly (end-of-training, from the batch-fetched tables)
+# ---------------------------------------------------------------------------
+
+def host_tree_from_packed(packed_np: np.ndarray, leaf_wy: np.ndarray,
+                          spec, max_depth: int,
+                          leaf_values: Optional[np.ndarray] = None):
+    """Assemble a HostTree from one tree's packed table (numpy).
+
+    packed_np (max_depth+1, Smax, K); leaf_wy (heap, 2) = per-heap-leaf
+    (w, w·y); leaf_values optional (heap,) final leaf predictions.
+    Leaf ids are HEAP-GLOBAL — n_leaves is the heap size, so leaf-value
+    arrays index directly by heap id."""
+    from h2o3_tpu.models.tree.dtree import HostTree, Split
+
+    maxB = int(spec.nbins.max())
+    L = heap_size(max_depth)
+    tree = HostTree()
+    tree.n_leaves = L
+    slot_nid = {(0, 0): 0}
+    root_tot = packed_np[0, 0, 4 + maxB:]
+    tree.nodes[0].weight = float(root_tot[0])
+    tree.nodes[0].pred = float(root_tot[1]) / max(float(root_tot[0]), EPS_W)
+
+    for d in range(max_depth + 1):
+        lv = packed_np[d]
+        next_lv = packed_np[d + 1] if d + 1 <= max_depth else None
+        for (dd, s), nid in [x for x in slot_nid.items() if x[0][0] == d]:
+            node = tree.nodes[nid]
+            f = int(lv[s, 0])
+            if f < 0:
+                heap = (2 ** d - 1) + s
+                node.leaf_id = heap
+                lw, lwy = leaf_wy[heap]
+                node.weight = float(lw)
+                node.pred = float(lwy) / max(float(lw), EPS_W)
+                if leaf_values is not None:
+                    node.leaf_value = float(leaf_values[heap])
+                continue
+            Bf = int(spec.nbins[f])
+            lt_row = lv[s, 4:4 + maxB] > 0.5
+            if bool(spec.is_cat[f]):
+                sp = Split(f, True, -1, lt_row[: Bf - 1].copy(),
+                           bool(lv[s, 2] > 0.5), float(lv[s, 3]),
+                           (0.0, 0.0), (0.0, 0.0))
+            else:
+                sp = Split(f, False, int(lv[s, 1]), None,
+                           bool(lv[s, 2] > 0.5), float(lv[s, 3]),
+                           (0.0, 0.0), (0.0, 0.0))
+            node.split = sp
+            node.left = tree.new_node(d + 1)
+            node.right = tree.new_node(d + 1)
+            ls, rs = 2 * s, 2 * s + 1
+            slot_nid[(d + 1, ls)] = node.left
+            slot_nid[(d + 1, rs)] = node.right
+            if next_lv is not None:
+                for child_nid, cs in ((node.left, ls), (node.right, rs)):
+                    cw = float(next_lv[cs, 4 + maxB])
+                    cwy = float(next_lv[cs, 4 + maxB + 1])
+                    tree.nodes[child_nid].weight = cw
+                    tree.nodes[child_nid].pred = cwy / max(cw, EPS_W)
+                sp.left_stats = (float(next_lv[ls, 4 + maxB]),
+                                 float(next_lv[ls, 4 + maxB + 1]))
+                sp.right_stats = (float(next_lv[rs, 4 + maxB]),
+                                  float(next_lv[rs, 4 + maxB + 1]))
+    return tree
+
+

@@ -19,18 +19,51 @@ import numpy as np
 from h2o3_tpu.models.model import ModelCategory
 from h2o3_tpu.models.model_builder import register
 from h2o3_tpu.models.tree.compressed import CompressedForest
-from h2o3_tpu.models.tree.histogram import leaf_stats
-from h2o3_tpu.models.tree.shared_tree import SharedTree, SharedTreeModel, grow_tree
+from h2o3_tpu.models.tree.shared_tree import SharedTree, SharedTreeModel
+
+
+_DRF_STEPS = {}
+
+
+def _drf_step_fns(sampling: bool):
+    """Jitted bagging pre (sample mask) + post (leaf means and OOB
+    accumulation) — one dispatch each per tree instead of ~10 eager ops
+    (each eager op is a ~10 ms tunnel round trip on this environment)."""
+    key = ("drf", sampling)
+    fns = _DRF_STEPS.get(key)
+    if fns is None:
+        import jax
+        import jax.numpy as jnp
+
+        def pre(w, rkey, t, rate):
+            mask = jax.random.uniform(jax.random.fold_in(rkey, t),
+                                      w.shape) < rate
+            return mask, jnp.where(mask, w, 0.0)
+
+        def post(leaf4, row_leaf, mask, w, oob_sum, oob_cnt):
+            ln, ld = leaf4[:, 2], leaf4[:, 3]
+            mean = jnp.where(ld > 1e-12, ln / jnp.maximum(ld, 1e-12), 0.0)
+            pred_t = jnp.where(row_leaf >= 0,
+                               mean[jnp.maximum(row_leaf, 0)], 0.0)
+            oob = (~mask) & (w > 0)
+            oob_sum = oob_sum + jnp.where(oob, pred_t, 0.0)
+            oob_cnt = oob_cnt + oob.astype(jnp.float32)
+            return mean.astype(jnp.float32), oob_sum, oob_cnt
+
+        fns = (jax.jit(pre), jax.jit(post))
+        _DRF_STEPS[key] = fns
+    return fns
 
 
 def _node_feat_mask_fn(rng, F: int, mtries: int):
-    """Fresh random mtries-subset of features PER NODE (DTree semantics)."""
+    """Fresh random mtries-subset of features PER NODE (DTree semantics).
+    Vectorized: one rank-of-randoms draw per level, not a Python loop of
+    rng.choice per node."""
 
     def fn(S):
-        mask = np.zeros((S, F), bool)
-        for s in range(S):
-            mask[s, rng.choice(F, size=mtries, replace=False)] = True
-        return mask
+        r = rng.random((S, F))
+        rank = np.argsort(np.argsort(r, axis=1), axis=1)
+        return rank < mtries
 
     return fn
 
@@ -94,14 +127,129 @@ class DRF(SharedTree):
         return super()._score_on(model, frame)
 
     def _fit_single(self, model, binned, y, w, offset, spec, dist, rng, ntrees):
-        """Bagged trees on the raw response: leaf = weighted mean of y."""
+        """Bagged trees on the raw response: leaf = weighted mean of y.
+        Device-resident like SharedTree._fit_single: one dispatch per tree,
+        OOB/validation margins on device, single end-of-loop fetch."""
         import jax.numpy as jnp
+
+        from h2o3_tpu.models.tree.device_tree import (apply_packed,
+                                                      grow_tree_device)
 
         classification = model._output.model_category == ModelCategory.Binomial
         if classification and self.params.get("binomial_double_trees"):
             return self._fit_multinomial(model, binned, y, w, offset, spec,
                                          2, rng, ntrees)
+        from h2o3_tpu.models.tree.shared_tree import DEVICE_DEPTH_LIMIT
 
+        if int(self.params["max_depth"]) > DEVICE_DEPTH_LIMIT:
+            return self._fit_single_deep(model, binned, y, w, offset, spec,
+                                         dist, rng, ntrees)
+
+        N = binned.shape[0]
+        mtries = self._mtries(spec.F, classification)
+        feat_mask_fn = _node_feat_mask_fn(rng, spec.F, mtries)
+
+        max_depth = int(self.params["max_depth"])
+        maxB = int(spec.nbins.max())
+        min_rows = float(self.params["min_rows"])
+        msi = float(self.params["min_split_improvement"])
+        history = []
+        stop_metric = []
+        vs = self._vstate
+        v_sum = (jnp.zeros(vs["binned"].shape[0], jnp.float32)
+                 if vs is not None else None)
+        # OOB accumulation: sum of oob predictions and counts per row
+        oob_sum = jnp.zeros(N, jnp.float32)
+        oob_cnt = jnp.zeros(N, jnp.float32)
+        sample_rate = float(self.params.get("sample_rate", 0.632) or 1.0)
+        sampling = sample_rate < 1.0
+        pre, post = _drf_step_fns(sampling)
+        import jax
+
+        root_key = jax.random.PRNGKey(self._seed())
+        packs, leaf_means, leaf_wys = [], [], []
+        mask = None
+        for t in range(ntrees):
+            mask, w_t = pre(w, root_key, np.int32(t), sample_rate) \
+                if sampling else (None, w)
+            masks = [np.asarray(feat_mask_fn(2 ** d), bool)
+                     for d in range(max_depth)]
+            packed, leaf4, row_leaf = grow_tree_device(
+                binned, w_t, y, spec, max_depth=max_depth, min_rows=min_rows,
+                min_split_improvement=msi, feat_masks=masks)
+            if mask is not None:
+                mean, oob_sum, oob_cnt = post(leaf4, row_leaf, mask, w,
+                                              oob_sum, oob_cnt)
+            else:
+                ln, ld = leaf4[:, 2], leaf4[:, 3]  # defaults: (w·y, w) sums
+                mean = jnp.where(ld > 1e-12, ln / jnp.maximum(ld, 1e-12), 0.0)
+            packs.append(packed)
+            leaf_means.append(mean)
+            leaf_wys.append(leaf4[:, :2])
+            if v_sum is not None:
+                v_sum = v_sum + apply_packed(vs["binned"], packed, mean,
+                                             max_depth, maxB)
+            if (mask is not None or v_sum is not None) \
+                    and self._should_score(t, ntrees):
+                entry = {"tree": t + 1}
+                mse = None
+                if mask is not None:
+                    # running OOB squared error (DRF.java scores OOB each interval)
+                    fcur = jnp.where(oob_cnt > 0, oob_sum / jnp.maximum(oob_cnt, 1.0), 0.0)
+                    wm = w * (oob_cnt > 0)
+                    mse = float(jnp.sum(wm * (y - fcur) ** 2) /
+                                jnp.maximum(jnp.sum(wm), 1e-12))
+                    entry["training_rmse"] = float(np.sqrt(mse))
+                if v_sum is not None:
+                    fv = v_sum / (t + 1)
+                    if classification:
+                        fv = jnp.clip(fv, 0.0, 1.0)
+                    vmse = float(jnp.sum(vs["w"] * (vs["y"] - fv) ** 2) /
+                                 jnp.maximum(jnp.sum(vs["w"]), 1e-12))
+                    entry["validation_rmse"] = float(np.sqrt(vmse))
+                    stop_metric.append(vmse)
+                else:
+                    stop_metric.append(mse)
+                history.append(entry)
+                if self._early_stop(stop_metric):
+                    break
+            if self.job:
+                self.job.update(progress=(t + 1) / ntrees, msg=f"tree {t + 1}")
+
+        # one batched fetch; scale leaves by the ACTUAL tree count (early
+        # stopping may truncate) so the summed traversal averages correctly
+        from h2o3_tpu.models.tree.device_tree import assemble_trees
+
+        trees = assemble_trees(packs, leaf_means, leaf_wys, spec, max_depth,
+                               scale=1.0 / len(packs))
+        varimp = {}
+        for tree in trees:
+            self._accumulate_varimp(tree, varimp, model)
+        model._output.scoring_history = history
+        self._finalize_varimp(model, varimp)
+        forest = CompressedForest.from_host_trees(
+            trees, spec, max_depth=max_depth, init_f=0.0, nclasses=1)
+        f = jnp.where(oob_cnt > 0, oob_sum / jnp.maximum(oob_cnt, 1.0), 0.0)
+        self._oob_raw = None
+        if float(jnp.max(oob_cnt)) > 0:
+            oob_mask = (oob_cnt > 0).astype(jnp.float32)
+            if classification:
+                p = jnp.clip(f, 0.0, 1.0)
+                self._oob_raw = ({"probs": jnp.stack([1 - p, p], axis=-1)}, oob_mask)
+            else:
+                self._oob_raw = ({"value": f}, oob_mask)
+        return forest, f
+
+    def _fit_single_deep(self, model, binned, y, w, offset, spec, dist, rng,
+                         ntrees):
+        """Deep-tree fallback: host-orchestrated level loop (host_grow.py),
+        memory O(active nodes) — required at the DRF default max_depth=20."""
+        import jax.numpy as jnp
+
+        from h2o3_tpu.models.tree.histogram import leaf_stats
+        from h2o3_tpu.models.tree.host_grow import grow_tree_host
+
+        classification = model._output.model_category == ModelCategory.Binomial
         N = binned.shape[0]
         mtries = self._mtries(spec.F, classification)
         feat_mask_fn = _node_feat_mask_fn(rng, spec.F, mtries)
@@ -111,14 +259,14 @@ class DRF(SharedTree):
         leaf_means: list = []
         stop_metric = []
         vs = self._vstate
-        v_sum = np.zeros(vs["binned"].shape[0], np.float64) \
+        binned_v = np.asarray(vs["binned"]) if vs is not None else None
+        v_sum = np.zeros(binned_v.shape[0], np.float64) \
             if vs is not None else None
-        # OOB accumulation: sum of oob predictions and counts per row
         oob_sum = jnp.zeros(N, jnp.float32)
         oob_cnt = jnp.zeros(N, jnp.float32)
         for t in range(ntrees):
             mask, w_t = self._sample_rows(rng, N, w)
-            tree, row_leaf = grow_tree(
+            tree, row_leaf = grow_tree_host(
                 binned, w_t, y, spec, max_depth=max_depth,
                 min_rows=float(self.params["min_rows"]),
                 min_split_improvement=float(self.params["min_split_improvement"]),
@@ -139,14 +287,14 @@ class DRF(SharedTree):
                 # unscaled per-tree means; final leaf values are rescaled by
                 # the actual tree count after the loop
                 tree.set_leaf_values(mean)
-                v_sum += tree.apply_binned(vs["binned"], spec)
+                v_sum += tree.apply_binned(binned_v, spec)
             if (mask is not None or v_sum is not None) \
                     and self._should_score(t, ntrees):
                 entry = {"tree": t + 1}
                 mse = None
                 if mask is not None:
-                    # running OOB squared error (DRF.java scores OOB each interval)
-                    fcur = jnp.where(oob_cnt > 0, oob_sum / jnp.maximum(oob_cnt, 1.0), 0.0)
+                    fcur = jnp.where(oob_cnt > 0,
+                                     oob_sum / jnp.maximum(oob_cnt, 1.0), 0.0)
                     wm = w * (oob_cnt > 0)
                     mse = float(jnp.sum(wm * (y - fcur) ** 2) /
                                 jnp.maximum(jnp.sum(wm), 1e-12))
@@ -155,8 +303,10 @@ class DRF(SharedTree):
                     fv = v_sum / (t + 1)
                     if classification:
                         fv = np.clip(fv, 0.0, 1.0)
-                    vmse = float(np.sum(vs["w"] * (vs["y"] - fv) ** 2) /
-                                 max(float(vs["w"].sum()), 1e-12))
+                    wv = np.asarray(vs["w"])
+                    yv = np.asarray(vs["y"])
+                    vmse = float(np.sum(wv * (yv - fv) ** 2) /
+                                 max(float(wv.sum()), 1e-12))
                     entry["validation_rmse"] = float(np.sqrt(vmse))
                     stop_metric.append(vmse)
                 else:
@@ -169,7 +319,6 @@ class DRF(SharedTree):
         model._output.scoring_history = history
         self._finalize_varimp(model, varimp)
         # scale leaves by the ACTUAL tree count (early stopping may truncate)
-        # so the summed traversal averages correctly
         for tree, mean in zip(trees, leaf_means):
             tree.set_leaf_values(mean / len(trees))
         forest = CompressedForest.from_host_trees(
@@ -190,6 +339,77 @@ class DRF(SharedTree):
         import jax
         import jax.numpy as jnp
 
+        from h2o3_tpu.models.tree.device_tree import grow_tree_device
+        from h2o3_tpu.models.tree.shared_tree import DEVICE_DEPTH_LIMIT
+
+        if int(self.params["max_depth"]) > DEVICE_DEPTH_LIMIT:
+            return self._fit_multinomial_deep(model, binned, y, w, offset,
+                                              spec, K, rng, ntrees)
+
+        N = binned.shape[0]
+        yi = y.astype(jnp.int32)
+        onehot = jax.nn.one_hot(yi, K, dtype=jnp.float32)
+        mtries = self._mtries(spec.F, True)
+        feat_mask_fn = _node_feat_mask_fn(rng, spec.F, mtries)
+
+        max_depth = int(self.params["max_depth"])
+        min_rows = float(self.params["min_rows"])
+        msi = float(self.params["min_split_improvement"])
+        tree_class = []
+        oob_sum = jnp.zeros((N, K), jnp.float32)
+        oob_cnt = jnp.zeros(N, jnp.float32)
+        packs, leaf_means, leaf_wys = [], [], []
+        for t in range(ntrees):
+            mask, w_t = self._sample_rows(rng, N, w)
+            for k in range(K):
+                masks = [np.asarray(feat_mask_fn(2 ** d), bool)
+                         for d in range(max_depth)]
+                packed, leaf4, row_leaf = grow_tree_device(
+                    binned, w_t, onehot[:, k], spec, max_depth=max_depth,
+                    min_rows=min_rows, min_split_improvement=msi,
+                    feat_masks=masks)
+                mean = jnp.where(leaf4[:, 3] > 1e-12,
+                                 leaf4[:, 2] / jnp.maximum(leaf4[:, 3], 1e-12),
+                                 0.0)
+                packs.append(packed)
+                leaf_means.append(mean.astype(jnp.float32))
+                leaf_wys.append(leaf4[:, :2])
+                tree_class.append(k)
+                if mask is not None:
+                    pred_t = jnp.where(row_leaf >= 0,
+                                       mean[jnp.maximum(row_leaf, 0)], 0.0)
+                    oob = (~mask) & (w > 0)
+                    oob_sum = oob_sum.at[:, k].add(jnp.where(oob, pred_t, 0.0))
+            if mask is not None:
+                oob_cnt = oob_cnt + ((~mask) & (w > 0)).astype(jnp.float32)
+            if self.job:
+                self.job.update(progress=(t + 1) / ntrees, msg=f"iter {t + 1}")
+        from h2o3_tpu.models.tree.device_tree import assemble_trees
+
+        trees = assemble_trees(packs, leaf_means, leaf_wys, spec, max_depth,
+                               scale=1.0 / ntrees)
+        varimp = {}
+        for tree in trees:
+            self._accumulate_varimp(tree, varimp, model)
+        self._finalize_varimp(model, varimp)
+        forest = CompressedForest.from_host_trees(
+            trees, spec, tree_class=tree_class, max_depth=max_depth,
+            nclasses=K)
+        self._oob_raw = None
+        if float(jnp.max(oob_cnt)) > 0:
+            p = jnp.clip(oob_sum / jnp.maximum(oob_cnt, 1.0)[:, None], 0.0, 1.0)
+            p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-12)
+            self._oob_raw = ({"probs": p}, (oob_cnt > 0).astype(jnp.float32))
+        return forest, None
+
+    def _fit_multinomial_deep(self, model, binned, y, w, offset, spec, K,
+                              rng, ntrees):
+        import jax
+        import jax.numpy as jnp
+
+        from h2o3_tpu.models.tree.histogram import leaf_stats
+        from h2o3_tpu.models.tree.host_grow import grow_tree_host
+
         N = binned.shape[0]
         yi = y.astype(jnp.int32)
         onehot = jax.nn.one_hot(yi, K, dtype=jnp.float32)
@@ -203,12 +423,13 @@ class DRF(SharedTree):
         for t in range(ntrees):
             mask, w_t = self._sample_rows(rng, N, w)
             for k in range(K):
-                tree, row_leaf = grow_tree(
+                tree, row_leaf = grow_tree_host(
                     binned, w_t, onehot[:, k], spec, max_depth=max_depth,
                     min_rows=float(self.params["min_rows"]),
                     min_split_improvement=float(self.params["min_split_improvement"]),
                     feat_mask_fn=feat_mask_fn)
-                ln, ld = leaf_stats(row_leaf, w_t * onehot[:, k], w_t, tree.n_leaves)
+                ln, ld = leaf_stats(row_leaf, w_t * onehot[:, k], w_t,
+                                    tree.n_leaves)
                 mean = np.where(ld > 1e-12, ln / np.maximum(ld, 1e-12), 0.0)
                 tree.set_leaf_values(mean / ntrees)
                 trees.append(tree)

@@ -88,10 +88,11 @@ class XGBoost(GBM):
 
     def _leaf_gamma(self, ln, ld):
         # xgboost L1: soft-threshold the gradient sum by reg_alpha before
-        # dividing by (H + λ)
-        import numpy as np
+        # dividing by (H + λ) — device math (training never syncs per tree)
+        import jax.numpy as jnp
 
         alpha = float(self.params.get("reg_alpha", 0.0) or 0.0)
-        num = np.sign(ln) * np.maximum(np.abs(ln) - alpha, 0.0) if alpha > 0 else ln
+        num = (jnp.sign(ln) * jnp.maximum(jnp.abs(ln) - alpha, 0.0)
+               if alpha > 0 else ln)
         den = ld + self._leaf_den_offset()
-        return np.where(ld > 1e-12, num / np.maximum(den, 1e-12), 0.0)
+        return jnp.where(ld > 1e-12, num / jnp.maximum(den, 1e-12), 0.0)
