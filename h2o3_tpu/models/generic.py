@@ -1,0 +1,78 @@
+"""Generic model — import an external MOJO as a first-class model.
+
+Reference: h2o-algos/src/main/java/hex/generic/ (Generic.java,
+GenericModel.java, ~774 LoC): reads a MOJO artifact and serves the standard
+Model API (predict / metrics / REST) by delegating score0 to the embedded
+genmodel scorer.
+
+Here the MOJO reader (models/mojo.py) reconstructs the concrete scoring
+model (forest / GLM / kmeans / MLP) and GenericModel wraps it, so predict,
+adaptTestForTrain and metrics reuse the inner model's exact device code —
+round-trip predictions are bit-identical to the exporting model's.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.models.model import Model
+from h2o3_tpu.models.model_builder import ModelBuilder, register
+
+
+class GenericModel(Model):
+    algo_name = "generic"
+
+    def __init__(self, inner: Model, parms=None):
+        super().__init__(parms=parms)
+        self._inner = inner
+        # mirror the inner model's world so REST/metrics introspection works
+        self._output = inner._output
+
+    def _predict_raw(self, frame: Frame):
+        return self._inner._predict_raw(frame)
+
+    def adapt_test(self, test: Frame) -> Frame:
+        return self._inner.adapt_test(test)
+
+    @property
+    def inner_algo(self) -> str:
+        return self._inner.algo_name
+
+
+@register
+class Generic(ModelBuilder):
+    """H2OGenericEstimator: `Generic(path=...).train()` (no training data) —
+    loads the MOJO and registers it in the DKV like any trained model
+    (hex/generic/Generic.java)."""
+
+    algo_name = "generic"
+    model_class = GenericModel
+    supervised = False
+
+    @classmethod
+    def default_params(cls):
+        p = super().default_params()
+        p.update({"path": None, "model_key": None})
+        return p
+
+    def train(self, x=None, y=None, training_frame=None,
+              validation_frame=None, **kw) -> GenericModel:
+        self.params.update({k: v for k, v in kw.items() if v is not None})
+        path = self.params.get("path") or self.params.get("model_key")
+        if not path:
+            raise ValueError("Generic: 'path' to a MOJO file is required")
+        from h2o3_tpu.models import mojo
+
+        inner = mojo.read_mojo(path)
+        model = GenericModel(inner, parms=dict(self.params))
+        if self.params.get("model_id"):
+            from h2o3_tpu.core.dkv import DKV
+
+            DKV.put(self.params["model_id"], model)
+        self.model = model
+        return model
+
+
+# h2o-py spelling
+H2OGenericEstimator = Generic

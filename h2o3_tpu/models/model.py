@@ -232,7 +232,14 @@ class Model(Keyed):
                                              distribution=dist)
         return None
 
-    # -- persistence (binary save/load; MOJO zip format in models/mojo.py) -
+    # -- persistence ------------------------------------------------------
+    def download_mojo(self, path: str) -> str:
+        """Export this model as a MOJO zip (hex/genmodel MojoWriter analog;
+        format in models/mojo.py)."""
+        from h2o3_tpu.models import mojo
+
+        return mojo.export_mojo(self, path)
+
     def save(self, path: str) -> str:
         import pickle
 

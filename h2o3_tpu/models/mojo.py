@@ -1,0 +1,417 @@
+"""MOJO — the portable scoring artifact (export / import).
+
+Reference: h2o-genmodel/src/main/java/hex/genmodel/ModelMojoReader.java and
+AbstractMojoWriter.java — a zip with a `model.ini` of three sections
+([info] key=value pairs, [columns], [domains]) plus `domains/d*.txt` files
+and per-algo binary payloads; loaded by MojoModel.load and wrapped by the
+Generic model (h2o-algos hex/generic/).
+
+This implementation keeps the reference's container layout (model.ini with
+the same [info] keys h2o-genmodel parses — algo, category, n_features,
+n_classes, supervised, default_threshold, mojo_version — plus domains/
+files) so MOJO tooling can introspect the artifact, while the per-algo
+payload is stored as dependency-free numpy `.npy` entries under `data/`
+described by `scorer.json`. The payload codec is versioned (mojo_version
+99.0 marks the TPU lineage) — the reference's Java bytecode tree format is
+deliberately NOT reproduced: our forests are already flat arrays (SURVEY §7
+CompressedTree → dense array design), and arrays are the natural
+dependency-free exchange format for a numpy/JAX scoring runtime.
+
+Round-trip contract (tests/test_mojo.py): export → import gives a Generic
+model with IDENTICAL predictions for GBM / DRF / IsolationForest / XGBoost /
+GLM / KMeans / DeepLearning.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import uuid as _uuid
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from h2o3_tpu.models.model import Model, ModelCategory
+
+MOJO_VERSION = 99.0
+
+
+# ---------------------------------------------------------------------------
+# DataInfo (de)hydration — linear/NN/kmeans models carry an expansion plan
+# ---------------------------------------------------------------------------
+
+def _datainfo_state(di) -> dict:
+    return {
+        "response_name": di.response_name,
+        "weights_name": di.weights_name,
+        "offset_name": di.offset_name,
+        "standardize": di.standardize,
+        "use_all_factor_levels": di.use_all_factor_levels,
+        "missing_values_handling": di.missing_values_handling,
+        "cat_names": di.cat_names,
+        "num_names": di.num_names,
+        "domains": di.domains,
+        "cards": di.cards,
+        "num_means": np.asarray(di.num_means).tolist(),
+        "num_sigmas": np.asarray(di.num_sigmas).tolist(),
+        "cat_modes": np.asarray(di.cat_modes).tolist(),
+        "impute_values": np.asarray(di.impute_values).tolist(),
+    }
+
+
+def _datainfo_restore(state: dict):
+    from h2o3_tpu.models.data_info import DataInfo
+
+    di = DataInfo.__new__(DataInfo)
+    di.response_name = state["response_name"]
+    di.weights_name = state["weights_name"]
+    di.offset_name = state["offset_name"]
+    di.standardize = state["standardize"]
+    di.use_all_factor_levels = state["use_all_factor_levels"]
+    di.missing_values_handling = state["missing_values_handling"]
+    di.cat_names = list(state["cat_names"])
+    di.num_names = list(state["num_names"])
+    di.predictor_names = di.cat_names + di.num_names
+    di.domains = {k: list(v) for k, v in state["domains"].items()}
+    di.cards = list(state["cards"])
+    base = 0 if di.use_all_factor_levels else 1
+    di.cat_widths = [max(c - base, 1) for c in di.cards]
+    di.cat_offsets = np.concatenate([[0], np.cumsum(di.cat_widths)]).astype(int) \
+        if di.cat_widths else np.zeros(1, int)
+    di.num_offset = int(di.cat_offsets[-1])
+    di.fullN = di.num_offset + len(di.num_names)
+    di.num_means = np.asarray(state["num_means"], np.float32)
+    di.num_sigmas = np.asarray(state["num_sigmas"], np.float32)
+    di.cat_modes = np.asarray(state["cat_modes"], np.int32)
+    di.impute_values = np.asarray(state["impute_values"], np.float32)
+    return di
+
+
+# ---------------------------------------------------------------------------
+# per-algo payload writers / readers
+# ---------------------------------------------------------------------------
+
+def _forest_payload(model) -> Tuple[dict, Dict[str, np.ndarray]]:
+    fo = model.forest
+    spec = model.spec
+    arrays = {
+        "feat": fo.feat, "thresh_bin": fo.thresh_bin,
+        "na_left": fo.na_left.astype(np.int8),
+        "left": fo.left, "right": fo.right, "leaf_val": fo.leaf_val,
+        "cat_split": fo.cat_split, "cat_table": fo.cat_table.astype(np.int8),
+        "tree_class": fo.tree_class, "na_bins": fo.na_bins,
+        "spec_nbins": np.asarray(spec.nbins, np.int64),
+        "spec_is_cat": np.asarray(spec.is_cat, np.int8),
+        "spec_cards": np.asarray(spec.cards, np.int64),
+        "spec_edges_flat": (np.concatenate([np.asarray(e, np.float64)
+                                            for e in spec.edges])
+                            if spec.edges else np.zeros(0)),
+        "spec_edges_len": np.asarray([len(e) for e in spec.edges], np.int64),
+    }
+    if fo.init_class is not None:
+        arrays["init_class"] = np.asarray(fo.init_class, np.float32)
+    dist = getattr(model, "_distribution", None)
+    meta = {
+        "max_depth": fo.max_depth, "init_f": fo.init_f,
+        "nclasses": fo.nclasses,
+        "spec_names": spec.names,
+        "distribution": getattr(dist, "name", None),
+        "tweedie_power": float(getattr(dist, "tweedie_power", 1.5) or 1.5)
+        if dist is not None else 1.5,
+        "quantile_alpha": float(getattr(dist, "quantile_alpha", 0.5) or 0.5)
+        if dist is not None else 0.5,
+        "cnorm": float(model._parms.get("_cnorm", 1.0) or 1.0),
+    }
+    return meta, arrays
+
+
+def _forest_restore(model, meta: dict, arrays: Dict[str, np.ndarray]):
+    from h2o3_tpu.models.distribution import get_distribution
+    from h2o3_tpu.models.tree.binning import BinSpec
+    from h2o3_tpu.models.tree.compressed import CompressedForest
+
+    lens = arrays["spec_edges_len"]
+    flat = arrays["spec_edges_flat"]
+    edges, pos = [], 0
+    for ln in lens:
+        edges.append(np.asarray(flat[pos: pos + int(ln)], np.float32))
+        pos += int(ln)
+    spec = BinSpec(meta["spec_names"], arrays["spec_is_cat"].astype(bool),
+                   arrays["spec_nbins"], edges, arrays["spec_cards"])
+    forest = CompressedForest(
+        arrays["feat"], arrays["thresh_bin"], arrays["na_left"].astype(bool),
+        arrays["left"], arrays["right"], arrays["leaf_val"],
+        arrays["cat_split"], arrays["cat_table"].astype(bool),
+        arrays["tree_class"], arrays["na_bins"],
+        max_depth=int(meta["max_depth"]), init_f=float(meta["init_f"]),
+        nclasses=int(meta["nclasses"]))
+    if "init_class" in arrays:
+        forest.init_class = arrays["init_class"]
+    model.forest = forest
+    model.spec = spec
+    if meta.get("distribution"):
+        model._distribution = get_distribution(
+            meta["distribution"], tweedie_power=meta["tweedie_power"],
+            quantile_alpha=meta["quantile_alpha"])
+    model._parms.setdefault("_cnorm", meta.get("cnorm", 1.0))
+
+
+def _glm_payload(model) -> Tuple[dict, Dict[str, np.ndarray]]:
+    arrays = {"beta": np.asarray(model.beta, np.float64)}
+    meta = {"linkname": model.linkname, "link_power": model.link_power,
+            "dinfo": _datainfo_state(model.dinfo)}
+    return meta, arrays
+
+
+def _glm_restore(model, meta, arrays):
+    import jax.numpy as jnp
+
+    model.beta = jnp.asarray(arrays["beta"], jnp.float32)
+    model.linkname = meta["linkname"]
+    model.link_power = float(meta["link_power"])
+    model.dinfo = _datainfo_restore(meta["dinfo"])
+    model.p_values = None
+    model.std_errors = None
+
+
+def _kmeans_payload(model):
+    return ({"k": model.k, "dinfo": _datainfo_state(model.data_info)},
+            {"centers": np.asarray(model.centers, np.float64),
+             "centers_raw": np.asarray(model.centers_raw, np.float64)})
+
+
+def _kmeans_restore(model, meta, arrays):
+    model.centers = np.asarray(arrays["centers"], np.float32)
+    model.centers_raw = np.asarray(arrays["centers_raw"], np.float32)
+    model.k = int(meta["k"])
+    model.data_info = _datainfo_restore(meta["dinfo"])
+
+
+def _dl_payload(model):
+    arrays = {}
+    for i, (W, b) in enumerate(model.params_tree):
+        arrays[f"W{i}"] = np.asarray(W, np.float32)
+        arrays[f"b{i}"] = np.asarray(b, np.float32)
+    meta = {"n_layers": len(model.params_tree),
+            "activation": model.activation,
+            "nclasses": model.nclasses,
+            "autoencoder": model.autoencoder,
+            "dinfo": _datainfo_state(model.data_info)}
+    return meta, arrays
+
+
+def _dl_restore(model, meta, arrays):
+    import jax.numpy as jnp
+
+    model.params_tree = [
+        (jnp.asarray(arrays[f"W{i}"]), jnp.asarray(arrays[f"b{i}"]))
+        for i in range(int(meta["n_layers"]))]
+    model.activation = meta["activation"]
+    model.nclasses = int(meta["nclasses"])
+    model.autoencoder = bool(meta["autoencoder"])
+    model.data_info = _datainfo_restore(meta["dinfo"])
+
+
+def _model_class(algo: str):
+    if algo == "gbm":
+        from h2o3_tpu.models.tree.gbm import GBMModel
+        return GBMModel
+    if algo == "xgboost":
+        from h2o3_tpu.models.xgboost import XGBoostModel
+        return XGBoostModel
+    if algo == "drf":
+        from h2o3_tpu.models.tree.drf import DRFModel
+        return DRFModel
+    if algo == "isolationforest":
+        from h2o3_tpu.models.tree.isofor import IsolationForestModel
+        return IsolationForestModel
+    if algo == "glm":
+        from h2o3_tpu.models.glm import GLMModel
+        return GLMModel
+    if algo == "kmeans":
+        from h2o3_tpu.models.kmeans import KMeansModel
+        return KMeansModel
+    if algo == "deeplearning":
+        from h2o3_tpu.models.deeplearning import DeepLearningModel
+        return DeepLearningModel
+    raise ValueError(f"MOJO export not supported for algo {algo!r}")
+
+
+_TREE_ALGOS = {"gbm", "drf", "isolationforest", "xgboost"}
+
+
+def _payload(model) -> Tuple[dict, Dict[str, np.ndarray]]:
+    algo = model.algo_name
+    if algo in _TREE_ALGOS:
+        return _forest_payload(model)
+    if algo == "glm":
+        return _glm_payload(model)
+    if algo == "kmeans":
+        return _kmeans_payload(model)
+    if algo == "deeplearning":
+        return _dl_payload(model)
+    raise ValueError(f"MOJO export not supported for algo {algo!r}")
+
+
+def _restore_payload(model, algo, meta, arrays):
+    if algo in _TREE_ALGOS:
+        _forest_restore(model, meta, arrays)
+    elif algo == "glm":
+        _glm_restore(model, meta, arrays)
+    elif algo == "kmeans":
+        _kmeans_restore(model, meta, arrays)
+    elif algo == "deeplearning":
+        _dl_restore(model, meta, arrays)
+
+
+# ---------------------------------------------------------------------------
+# writer (AbstractMojoWriter analog)
+# ---------------------------------------------------------------------------
+
+def _default_threshold(model) -> float:
+    tm = model._output.training_metrics
+    aucd = getattr(tm, "auc_data", None)
+    return float(aucd.max_f1_threshold) if aucd is not None else 0.5
+
+
+def export_mojo_bytes(model: Model) -> bytes:
+    """Serialize a trained model to MOJO zip bytes."""
+    o = model._output
+    meta, arrays = _payload(model)
+
+    columns = list(o.names)
+    if o.response_name:
+        columns.append(o.response_name)
+    dom_cols = []          # (column_index, domain) like reference model.ini
+    for i, c in enumerate(columns):
+        d = (o.domains.get(c) if c != o.response_name else o.response_domain)
+        if d:
+            dom_cols.append((i, c, d))
+
+    ini = ["[info]"]
+    info = {
+        "algo": model.algo_name,
+        "algorithm": model.algo_name.upper(),
+        "h2o_version": "h2o3_tpu",
+        "mojo_version": MOJO_VERSION,
+        "category": o.model_category,
+        "uuid": _uuid.uuid4().hex,
+        "supervised": "true" if o.response_name else "false",
+        "n_features": len(o.names),
+        "n_classes": o.nclasses,
+        "n_columns": len(columns),
+        "n_domains": len(dom_cols),
+        "balance_classes": "false",
+        "default_threshold": _default_threshold(model),
+        "prior_class_distrib": "null",
+        "model_class_distrib": "null",
+        "timestamp": "",
+    }
+    ini += [f"{k} = {v}" for k, v in info.items()]
+    ini.append("")
+    ini.append("[columns]")
+    ini += columns
+    ini.append("")
+    ini.append("[domains]")
+    for j, (i, _c, d) in enumerate(dom_cols):
+        ini.append(f"{i}: {len(d)} d{j:03d}.txt")
+
+    scorer = {
+        "algo": model.algo_name,
+        "model_category": o.model_category,
+        "names": o.names,
+        "response_name": o.response_name,
+        "response_domain": o.response_domain,
+        "domains": o.domains,
+        "default_threshold": _default_threshold(model),
+        "parms": {k: v for k, v in model._parms.items()
+                  if isinstance(v, (int, float, str, bool, type(None)))},
+        "meta": meta,
+    }
+
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("model.ini", "\n".join(ini) + "\n")
+        for j, (_i, _c, d) in enumerate(dom_cols):
+            z.writestr(f"domains/d{j:03d}.txt", "\n".join(str(x) for x in d) + "\n")
+        z.writestr("scorer.json", json.dumps(scorer, default=str))
+        for name, arr in arrays.items():
+            ab = io.BytesIO()
+            np.save(ab, np.asarray(arr))
+            z.writestr(f"data/{name}.npy", ab.getvalue())
+    return buf.getvalue()
+
+
+def export_mojo(model: Model, path: str) -> str:
+    """h2o-py model.download_mojo / save_mojo analog."""
+    data = export_mojo_bytes(model)
+    if not path.endswith(".zip"):
+        path = path + ".zip"
+    with open(path, "wb") as f:
+        f.write(data)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# reader (ModelMojoReader analog)
+# ---------------------------------------------------------------------------
+
+def _threshold_metrics(thr: float):
+    """Stand-in training metrics carrying only the labeling threshold, so
+    Model.predict labels with the trained model's max-F1 threshold after a
+    MOJO round trip. A real ModelMetricsBinomial (NaN-filled) so the REST
+    schema layer can serialize MOJO-loaded models like any other."""
+    from h2o3_tpu.models import metrics as M
+
+    mm = M.ModelMetricsBinomial(description="restored from MOJO artifact")
+    mm.auc_data = M.AUCData(
+        auc=float("nan"), pr_auc=float("nan"), gini=float("nan"),
+        max_f1=float("nan"), max_f1_threshold=float(thr),
+        thresholds=np.asarray([thr]), tps=np.zeros(1), fps=np.zeros(1),
+        p=0.0, n=0.0)
+    return mm
+
+
+def read_mojo(source) -> Model:
+    """Load a MOJO (path / bytes / file-like) back into a scoring model."""
+    if isinstance(source, (bytes, bytearray)):
+        source = io.BytesIO(source)
+    with zipfile.ZipFile(source) as z:
+        names = set(z.namelist())
+        if "scorer.json" not in names:
+            raise ValueError("not an h2o3_tpu MOJO: scorer.json missing "
+                             "(reference-Java MOJO payloads are not supported)")
+        scorer = json.loads(z.read("scorer.json").decode())
+        arrays = {}
+        for n in names:
+            if n.startswith("data/") and n.endswith(".npy"):
+                arrays[n[len("data/"):-len(".npy")]] = np.load(
+                    io.BytesIO(z.read(n)), allow_pickle=False)
+
+    algo = scorer["algo"]
+    cls = _model_class(algo)
+    model = cls.__new__(cls)
+    Model.__init__(model, parms=dict(scorer.get("parms") or {}))
+    # per-class extra attribute defaults that __init__ would have set
+    for attr, default in (("forest", None), ("spec", None),
+                          ("_distribution", None), ("beta", None),
+                          ("dinfo", None), ("centers", None),
+                          ("centers_raw", None), ("data_info", None),
+                          ("params_tree", None), ("k", 0),
+                          ("linkname", "identity"), ("link_power", 0.0),
+                          ("activation", "rectifier"), ("nclasses", 1),
+                          ("autoencoder", False)):
+        if not hasattr(model, attr):
+            setattr(model, attr, default)
+
+    o = model._output
+    o.names = list(scorer["names"])
+    o.response_name = scorer.get("response_name")
+    o.response_domain = scorer.get("response_domain")
+    o.domains = {k: list(v) for k, v in (scorer.get("domains") or {}).items()}
+    o.model_category = scorer["model_category"]
+    if o.model_category == ModelCategory.Binomial:
+        o.training_metrics = _threshold_metrics(float(scorer["default_threshold"]))
+    _restore_payload(model, algo, scorer["meta"], arrays)
+    return model

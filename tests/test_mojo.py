@@ -1,0 +1,166 @@
+"""MOJO export/import round trip + Generic estimator.
+
+Reference: hex/genmodel ModelMojoReader/MojoModel (artifact contract) and
+hex/generic/Generic.java (MOJO as first-class model). Acceptance (VERDICT
+r2 task #3): export → reimport → IDENTICAL predictions per algo, phantom
+H2OGenericEstimator entry replaced by a real implementation.
+"""
+
+import io
+import zipfile
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame
+from h2o3_tpu.models import mojo
+
+
+@pytest.fixture(scope="module")
+def data(cl):
+    rng = np.random.default_rng(7)
+    n = 1200
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    g = np.array(["a", "b", "c", "d"])[rng.integers(0, 4, n)]
+    logit = 1.3 * x1 - x2 + (g == "a") * 1.0 - (g == "d") * 0.7
+    ybin = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "Y", "N")
+    yreg = logit + 0.2 * rng.normal(size=n)
+    fr = Frame()
+    fr.add("x1", Column.from_numpy(x1))
+    fr.add("x2", Column.from_numpy(x2))
+    fr.add("g", Column.from_numpy(g, ctype="enum"))
+    fr.add("ybin", Column.from_numpy(ybin, ctype="enum"))
+    fr.add("yreg", Column.from_numpy(yreg))
+    return fr
+
+
+def _roundtrip_identical(model, fr, tmp_path, pred_cols=None):
+    path = model.download_mojo(str(tmp_path / f"{model.algo_name}.zip"))
+    loaded = mojo.read_mojo(path)
+    p0 = model.predict(fr).to_pandas()
+    p1 = loaded.predict(fr).to_pandas()
+    assert list(p0.columns) == list(p1.columns)
+    for c in (pred_cols or p0.columns):
+        a, b = p0[c].to_numpy(), p1[c].to_numpy()
+        if a.dtype.kind in "fc":
+            np.testing.assert_allclose(a.astype(float), b.astype(float),
+                                       rtol=0, atol=0)
+        else:
+            assert (a == b).all()
+    return path
+
+
+def test_mojo_container_layout(data, tmp_path, cl):
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    m = GBM(ntrees=5, max_depth=3, seed=1).train(y="ybin", training_frame=data)
+    blob = mojo.export_mojo_bytes(m)
+    with zipfile.ZipFile(io.BytesIO(blob)) as z:
+        names = z.namelist()
+        assert "model.ini" in names
+        ini = z.read("model.ini").decode()
+        assert "[info]" in ini and "[columns]" in ini and "[domains]" in ini
+        assert "algo = gbm" in ini
+        assert "category = Binomial" in ini
+        # domains files referenced by the ini exist
+        assert any(n.startswith("domains/") for n in names)
+
+
+def test_gbm_roundtrip(data, tmp_path, cl):
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    m = GBM(ntrees=8, max_depth=4, seed=1).train(y="ybin", training_frame=data)
+    _roundtrip_identical(m, data, tmp_path)
+
+
+def test_gbm_regression_roundtrip(data, tmp_path, cl):
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    m = GBM(ntrees=6, max_depth=3, seed=2).train(y="yreg", training_frame=data)
+    _roundtrip_identical(m, data, tmp_path)
+
+
+def test_drf_roundtrip(data, tmp_path, cl):
+    from h2o3_tpu.models.tree.drf import DRF
+
+    m = DRF(ntrees=6, max_depth=5, seed=3).train(y="ybin", training_frame=data)
+    _roundtrip_identical(m, data, tmp_path)
+
+
+def test_isofor_roundtrip(data, tmp_path, cl):
+    from h2o3_tpu.models.tree.isofor import IsolationForest
+
+    m = IsolationForest(ntrees=10, seed=4).train(
+        training_frame=data.subframe(["x1", "x2", "g"]))
+    _roundtrip_identical(m, data.subframe(["x1", "x2", "g"]), tmp_path)
+
+
+def test_xgboost_roundtrip(data, tmp_path, cl):
+    from h2o3_tpu.models.xgboost import XGBoost
+
+    m = XGBoost(ntrees=6, max_depth=3, seed=5).train(y="ybin",
+                                                     training_frame=data)
+    _roundtrip_identical(m, data, tmp_path)
+
+
+def test_glm_roundtrip(data, tmp_path, cl):
+    from h2o3_tpu.models.glm import GLM
+
+    m = GLM(family="binomial", lambda_=0.0).train(y="ybin",
+                                                  training_frame=data)
+    _roundtrip_identical(m, data, tmp_path)
+
+
+def test_kmeans_roundtrip(data, tmp_path, cl):
+    from h2o3_tpu.models.kmeans import KMeans
+
+    sub = data.subframe(["x1", "x2"])
+    m = KMeans(k=3, seed=6).train(training_frame=sub)
+    _roundtrip_identical(m, sub, tmp_path)
+
+
+def test_deeplearning_roundtrip(data, tmp_path, cl):
+    from h2o3_tpu.models.deeplearning import DeepLearning
+
+    m = DeepLearning(hidden=[8, 8], epochs=3, seed=7).train(
+        y="ybin", training_frame=data)
+    _roundtrip_identical(m, data, tmp_path)
+
+
+def test_generic_estimator(data, tmp_path, cl):
+    import h2o3_tpu
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    m = GBM(ntrees=5, max_depth=3, seed=8).train(y="ybin", training_frame=data)
+    path = m.download_mojo(str(tmp_path / "for_generic.zip"))
+    # the public entry that was a phantom for two rounds
+    est = h2o3_tpu.H2OGenericEstimator(path=path)
+    gm = est.train()
+    assert gm.algo_name == "generic"
+    assert gm.inner_algo == "gbm"
+    p0 = m.predict(data).to_pandas()
+    p1 = gm.predict(data).to_pandas()
+    np.testing.assert_allclose(p0["Y"].to_numpy(), p1["Y"].to_numpy())
+    mm = gm.model_performance(data)
+    assert mm is not None and np.isfinite(mm.auc)
+
+
+def test_mojo_rest_endpoint(data, cl):
+    from h2o3_tpu.api.server import start_server
+    import urllib.request
+
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    m = GBM(ntrees=4, max_depth=3, seed=9).train(y="ybin", training_frame=data)
+    srv = start_server(port=0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/3/Models/{m.key}/mojo") as r:
+            blob = r.read()
+        loaded = mojo.read_mojo(blob)
+        p0 = np.asarray(m.predict(data).col("Y").to_numpy())
+        p1 = np.asarray(loaded.predict(data).col("Y").to_numpy())
+        np.testing.assert_allclose(p0, p1)
+    finally:
+        srv.stop()
