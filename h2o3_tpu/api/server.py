@@ -659,6 +659,31 @@ def h_model_mojo(ctx: Ctx):
                              f'attachment; filename="{m.key}.zip"'})
 
 
+def h_te_transform(ctx: Ctx):
+    """GET /3/TargetEncoderTransform (h2o-py targetencoder.transform)."""
+    m = _model_or_404(str(ctx.arg("model", "")))
+    fr = _frame_or_404(str(ctx.arg("frame", "")))
+    if not hasattr(m, "transform"):
+        raise ApiError(f"model {m.key} is not a TargetEncoder", 400)
+
+    def _opt_f(name):
+        v = ctx.arg(name)
+        return None if v in (None, "", "null", "None") else float(v)
+
+    blending = ctx.arg("blending")
+    out = m.transform(
+        fr,
+        as_training=str(ctx.arg("as_training", "false")).lower() == "true",
+        blending=None if blending in (None, "", "null") else
+        str(blending).lower() == "true",
+        inflection_point=_opt_f("inflection_point"),
+        smoothing=_opt_f("smoothing"),
+        noise=_opt_f("noise"))
+    out.install()
+    return {"__meta": S.meta("TargetEncoderTransformV3"),
+            "name": str(out.key)}
+
+
 # -- metadata (schema introspection, water/api/SchemaServer.java:20) --------
 
 def h_metadata_endpoints(ctx: Ctx):
@@ -756,6 +781,8 @@ ROUTES: List[Tuple[str, str, Callable, str]] = [
      "Score a frame (async job)"),
     ("POST", "/3/ModelMetrics/models/{model_id}/frames/{frame_id}", h_model_metrics,
      "Compute model metrics on a frame"),
+    ("GET", "/3/TargetEncoderTransform", h_te_transform,
+     "Apply a trained TargetEncoder to a frame"),
     ("GET", "/3/Metadata/endpoints", h_metadata_endpoints, "List REST endpoints"),
     ("GET", "/3/Metadata/schemas", h_metadata_schemas, "List schemas"),
     ("GET", "/3/Metadata/schemas/{schema_name}", h_metadata_schema, "Schema detail"),

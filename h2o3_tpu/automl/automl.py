@@ -57,7 +57,8 @@ class H2OAutoML:
                  sort_metric: str = "AUTO",
                  include_algos: Optional[List[str]] = None,
                  exclude_algos: Optional[List[str]] = None,
-                 project_name: Optional[str] = None, **_ignored):
+                 project_name: Optional[str] = None,
+                 preprocessing: Optional[List[str]] = None, **_ignored):
         self.max_models = int(max_models)
         self.max_runtime_secs = float(max_runtime_secs)
         from h2o3_tpu.models.model_builder import random_seed
@@ -71,9 +72,52 @@ class H2OAutoML:
         self.include_algos = [a.lower() for a in include_algos] if include_algos else None
         self.exclude_algos = [a.lower() for a in (exclude_algos or [])]
         self.project_name = project_name or f"automl_{int(time.time())}"
+        # reference ai.h2o.automl.preprocessing: ["target_encoding"] adds a
+        # KFold TargetEncoder stage over the shared AutoML fold assignment
+        self.preprocessing = [str(p).lower() for p in (preprocessing or [])]
+        self.te_model = None
         self.models: List[Model] = []
         self.event_log: List[Dict[str, Any]] = []
         self._metric_name: str = "rmse"
+
+    def _apply_target_encoding(self, y, train, valid, lb):
+        """KFold TargetEncoder over the shared AutoML fold assignment
+        (reference ai.h2o.automl.preprocessing.TargetEncoding): encoded
+        columns are appended to every frame; the training frame uses
+        out-of-fold encodings so the level-one data stays leak-free."""
+        from h2o3_tpu.core.frame import Column
+        from h2o3_tpu.models.target_encoder import TargetEncoder
+
+        cats = [c for c in train.names
+                if c != y and train.col(c).is_categorical]
+        if not cats:
+            return train, valid, lb
+        rng = np.random.default_rng(self.seed)
+        assign = rng.integers(0, self.nfolds, train.nrows)
+        tr = train.subframe(train.names)
+        tr.add("_automl_te_fold", Column.from_numpy(assign.astype(np.float64)))
+        te = TargetEncoder(blending=True, noise=0.0,
+                           data_leakage_handling="KFold",
+                           fold_column="_automl_te_fold",
+                           seed=self.seed).train(y=y, training_frame=tr)
+        self.te_model = te
+        out_train = te.transform(tr, as_training=True)
+        # the fold column STAYS in the frame and is passed as fold_column to
+        # every builder, so CV holdouts are structurally the same folds the
+        # encoder left out — no reliance on two RNGs drawing identically
+        self._te_fold_col = "_automl_te_fold"
+        out_valid = te.transform(valid) if valid is not None else None
+        out_lb = te.transform(lb) if lb is not None else None
+        self._log(f"target encoding applied to {len(cats)} column(s)")
+        return out_train, out_valid, out_lb
+
+    def predict(self, frame: Frame):
+        """Score with the leader, applying the AutoML preprocessing stage
+        first when one was trained (reference: the TE preprocessor is part
+        of the scoring pipeline)."""
+        if self.te_model is not None:
+            frame = self.te_model.transform(frame)
+        return self.leader.predict(frame)
 
     # -- step registry (ModelingStepsRegistry analog) ----------------------
     def _steps(self, classification: bool):
@@ -135,6 +179,12 @@ class H2OAutoML:
         self._leaderboard_frame = leaderboard_frame
         self._lb_cache: Dict[str, float] = {}
 
+        if "target_encoding" in self.preprocessing:
+            training_frame, validation_frame, leaderboard_frame = \
+                self._apply_target_encoding(y, training_frame,
+                                            validation_frame, leaderboard_frame)
+            self._leaderboard_frame = leaderboard_frame
+
         t0 = time.time()
         self._log(f"AutoML start: project={self.project_name}")
         for algo, params in self._steps(classification):
@@ -150,6 +200,8 @@ class H2OAutoML:
             params.update(nfolds=self.nfolds,
                           keep_cross_validation_predictions=True,
                           seed=self.seed)
+            if getattr(self, "_te_fold_col", None):
+                params.update(fold_column=self._te_fold_col)
             try:
                 b = cls(**params)
                 m = b.train(x=x, y=y, training_frame=training_frame,

@@ -277,6 +277,9 @@ def _default_threshold(model) -> float:
 
 def export_mojo_bytes(model: Model) -> bytes:
     """Serialize a trained model to MOJO zip bytes."""
+    inner = getattr(model, "_inner", None)
+    if inner is not None:          # Generic wraps a MOJO-loaded model —
+        model = inner              # re-export the wrapped scorer
     o = model._output
     meta, arrays = _payload(model)
 
@@ -344,9 +347,14 @@ def export_mojo_bytes(model: Model) -> bytes:
 
 
 def export_mojo(model: Model, path: str) -> str:
-    """h2o-py model.download_mojo / save_mojo analog."""
+    """h2o-py model.download_mojo / save_mojo analog: a directory argument
+    means 'save into it as <key>.zip' (h2o-py model_base.download_mojo)."""
+    import os
+
     data = export_mojo_bytes(model)
-    if not path.endswith(".zip"):
+    if os.path.isdir(path):
+        path = os.path.join(path, f"{model.key}.zip")
+    elif not path.endswith(".zip"):
         path = path + ".zip"
     with open(path, "wb") as f:
         f.write(data)

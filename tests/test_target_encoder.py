@@ -1,0 +1,191 @@
+"""TargetEncoder semantics vs reference TargetEncoderHelper arithmetic.
+
+Reference: ai/h2o/targetencoding/TargetEncoderHelper.java —
+getBlendedValue (:256): λ = 1/(1+e^((k−n)/f)); enc = λ·post + (1−λ)·prior;
+holdout: None / LeaveOneOut / KFold. Plus the AutoML preprocessing hook
+(ai.h2o.automl.preprocessing.TargetEncoding) and the
+GET /3/TargetEncoderTransform REST contract.
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame
+
+
+@pytest.fixture()
+def tframe(cl):
+    rng = np.random.default_rng(5)
+    n = 600
+    g = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+    rates = {"a": 0.8, "b": 0.5, "c": 0.2}
+    y = np.array(["Y" if rng.random() < rates[v] else "N" for v in g])
+    fr = Frame()
+    fr.add("g", Column.from_numpy(g, ctype="enum"))
+    fr.add("x", Column.from_numpy(rng.normal(size=n)))
+    fr.add("y", Column.from_numpy(y, ctype="enum"))
+    return fr, g, y
+
+
+def _counts(g, y):
+    import collections
+
+    num = collections.Counter()
+    den = collections.Counter()
+    for gi, yi in zip(g, y):
+        num[gi] += (yi == "Y")
+        den[gi] += 1
+    return num, den
+
+
+def test_plain_encoding_matches_means(tframe):
+    from h2o3_tpu.models.target_encoder import TargetEncoder
+
+    fr, g, y = tframe
+    te = TargetEncoder(noise=0.0).train(y="y", training_frame=fr)
+    out = te.transform(fr)
+    vals = out.col("g_te").to_numpy()
+    num, den = _counts(g, y)
+    for lvl in "abc":
+        want = num[lvl] / den[lvl]
+        got = vals[g == lvl]
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_blending_formula(tframe):
+    from h2o3_tpu.models.target_encoder import TargetEncoder
+
+    fr, g, y = tframe
+    k, f = 35.0, 25.0
+    te = TargetEncoder(blending=True, inflection_point=k, smoothing=f,
+                       noise=0.0).train(y="y", training_frame=fr)
+    out = te.transform(fr)
+    vals = out.col("g_te").to_numpy()
+    num, den = _counts(g, y)
+    prior = sum(num.values()) / sum(den.values())
+    for lvl in "abc":
+        n = den[lvl]
+        lam = 1.0 / (1.0 + np.exp((k - n) / f))    # TargetEncoderHelper.java:256
+        want = lam * (num[lvl] / n) + (1 - lam) * prior
+        np.testing.assert_allclose(vals[g == lvl], want, atol=1e-6)
+
+
+def test_leave_one_out(tframe):
+    from h2o3_tpu.models.target_encoder import TargetEncoder
+
+    fr, g, y = tframe
+    te = TargetEncoder(data_leakage_handling="LeaveOneOut",
+                       noise=0.0).train(y="y", training_frame=fr)
+    out = te.transform(fr, as_training=True)
+    vals = out.col("g_te").to_numpy()
+    num, den = _counts(g, y)
+    # row i's own target must be excluded
+    for i in [0, 10, 100]:
+        lvl, yi = g[i], (y[i] == "Y")
+        want = (num[lvl] - yi) / (den[lvl] - 1)
+        np.testing.assert_allclose(vals[i], want, atol=1e-6)
+    # non-training transform still uses full stats
+    out2 = te.transform(fr)
+    v2 = out2.col("g_te").to_numpy()
+    assert not np.allclose(vals, v2)
+
+
+def test_kfold_out_of_fold(tframe):
+    from h2o3_tpu.models.target_encoder import TargetEncoder
+
+    fr, g, y = tframe
+    rng = np.random.default_rng(1)
+    folds = rng.integers(0, 3, fr.nrows)
+    fr.add("fold", Column.from_numpy(folds.astype(np.float64)))
+    te = TargetEncoder(data_leakage_handling="KFold", fold_column="fold",
+                       noise=0.0).train(y="y", training_frame=fr)
+    out = te.transform(fr, as_training=True)
+    vals = out.col("g_te").to_numpy()
+    for i in [3, 33, 333]:
+        lvl, fo = g[i], folds[i]
+        mask = (g == lvl) & (folds != fo)
+        want = (y[mask] == "Y").mean()
+        np.testing.assert_allclose(vals[i], want, atol=1e-6)
+
+
+def test_unseen_level_gets_prior(tframe, cl):
+    from h2o3_tpu.models.target_encoder import TargetEncoder
+
+    fr, g, y = tframe
+    te = TargetEncoder(noise=0.0).train(y="y", training_frame=fr)
+    test = Frame()
+    test.add("g", Column.from_numpy(np.array(["zz", "a"]), ctype="enum"))
+    test.add("x", Column.from_numpy(np.zeros(2)))
+    out = te.transform(test)
+    vals = out.col("g_te").to_numpy()
+    num, den = _counts(g, y)
+    prior = sum(num.values()) / sum(den.values())
+    np.testing.assert_allclose(vals[0], prior, atol=1e-6)
+    np.testing.assert_allclose(vals[1], num["a"] / den["a"], atol=1e-6)
+
+
+def test_noise_only_on_training(tframe):
+    from h2o3_tpu.models.target_encoder import TargetEncoder
+
+    fr, g, y = tframe
+    te = TargetEncoder(noise=0.05, seed=3).train(y="y", training_frame=fr)
+    a = te.transform(fr).col("g_te").to_numpy()
+    b = te.transform(fr).col("g_te").to_numpy()
+    np.testing.assert_allclose(a, b)        # non-training: deterministic
+    c = te.transform(fr, as_training=True).col("g_te").to_numpy()
+    assert not np.allclose(a, c)            # training: noise applied
+
+
+def test_phantom_entry_resolved(cl):
+    import h2o3_tpu
+
+    cls = h2o3_tpu.H2OTargetEncoderEstimator
+    assert cls.algo_name == "targetencoder"
+
+
+def test_automl_te_preprocessing(cl):
+    from h2o3_tpu.automl.automl import H2OAutoML
+
+    rng = np.random.default_rng(0)
+    n = 800
+    g = np.array(["a", "b", "c", "d"])[rng.integers(0, 4, n)]
+    x = rng.normal(size=n)
+    rates = {"a": 0.85, "b": 0.6, "c": 0.4, "d": 0.15}
+    y = np.array(["Y" if rng.random() < rates[v] else "N" for v in g])
+    fr = Frame()
+    fr.add("g", Column.from_numpy(g, ctype="enum"))
+    fr.add("x", Column.from_numpy(x))
+    fr.add("y", Column.from_numpy(y, ctype="enum"))
+    aml = H2OAutoML(max_models=2, nfolds=2, seed=11,
+                    include_algos=["glm", "gbm"],
+                    preprocessing=["target_encoding"]).train(
+        y="y", training_frame=fr)
+    assert aml.te_model is not None
+    assert len(aml.models) >= 1
+    lead = aml.leader
+    assert "g_te" in lead._output.names
+
+
+def test_te_rest_transform(tframe):
+    import json
+    import urllib.request
+
+    from h2o3_tpu.api.server import start_server
+    from h2o3_tpu.models.target_encoder import TargetEncoder
+
+    fr, g, y = tframe
+    fr.install()
+    te = TargetEncoder(noise=0.0).train(y="y", training_frame=fr)
+    srv = start_server(port=0)
+    try:
+        url = (f"http://127.0.0.1:{srv.port}/3/TargetEncoderTransform"
+               f"?model={te.key}&frame={fr.key}&blending=false")
+        with urllib.request.urlopen(url) as r:
+            out = json.loads(r.read())
+        assert out["name"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/3/Frames/{out['name']}") as r:
+            fj = json.loads(r.read())["frames"][0]
+        assert any(c["label"] == "g_te" for c in fj["columns"])
+    finally:
+        srv.stop()
