@@ -319,7 +319,12 @@ class Frame(Keyed):
     def to_pandas(self):
         import pandas as pd
 
-        return pd.DataFrame({n: self._cols[n].values() for n in self._names})
+        # python string storage, scoped: pandas-3's pyarrow-backed string
+        # construction has crashed (SIGSEGV) under the threaded REST server
+        # in this environment; keep the workaround out of global state
+        with pd.option_context("mode.string_storage", "python"):
+            return pd.DataFrame({n: self._cols[n].values()
+                                 for n in self._names})
 
     def to_numpy(self) -> np.ndarray:
         return np.column_stack([self._cols[n].to_numpy() for n in self._names])

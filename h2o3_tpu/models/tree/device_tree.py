@@ -335,8 +335,10 @@ def grow_tree_device(binned, w, y, spec, *, max_depth: int, min_rows: float,
         num = w * y
     if den is None:
         den = w
-    masks_in = (tuple(jnp.asarray(m) for m in feat_masks) if has_masks
-                else jnp.zeros(0))
+    # host numpy inputs replicate cleanly under multi-process meshes (a
+    # process-local device array would carry a conflicting placement)
+    masks_in = (tuple(np.asarray(m) for m in feat_masks) if has_masks
+                else np.zeros(0, np.float32))
     return fn(binned, w, y, num.astype(jnp.float32), den.astype(jnp.float32),
               masks_in)
 

@@ -31,6 +31,22 @@ def _cat_to_f32(d):
     return jnp.where(d >= 0, d.astype(jnp.float32), jnp.nan)
 
 
+def _trigamma(x):
+    """ψ′(x): recurrence ψ′(x)=1/x²+ψ′(x+1) shifted to z=x+8, then the
+    asymptotic series 1/z + 1/2z² + 1/6z³ − 1/30z⁵ + 1/42z⁷ — stable in
+    f32 (jax.scipy has no polygamma; AstTriGamma parity)."""
+    acc = jnp.zeros_like(x)
+    z = x
+    for _ in range(8):
+        acc = acc + 1.0 / (z * z)
+        z = z + 1.0
+    zi = 1.0 / z
+    zi2 = zi * zi
+    asym = zi + 0.5 * zi2 + zi * zi2 * (1.0 / 6.0 - zi2 * (1.0 / 30.0
+                                                           - zi2 / 42.0))
+    return jnp.where(x > 0, acc + asym, jnp.nan)
+
+
 _BINOPS = {
     "+": jnp.add, "-": jnp.subtract, "*": jnp.multiply, "/": jnp.divide,
     "^": jnp.power, "%": jnp.mod, "intDiv": lambda a, b: jnp.floor_divide(a, b),
@@ -45,9 +61,14 @@ _UNOPS = {
     "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
     "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
     "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
+    "cospi": lambda x: jnp.cos(jnp.pi * x),
+    "sinpi": lambda x: jnp.sin(jnp.pi * x),
+    "tanpi": lambda x: jnp.tan(jnp.pi * x),
     "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
     "lgamma": jax.scipy.special.gammaln,
     "digamma": jax.scipy.special.digamma,
+    "trigamma": lambda x: _trigamma(x),
     "not": lambda x: jnp.where(jnp.isnan(x), jnp.nan, (x == 0).astype(jnp.float32)),
 }
 

@@ -21,22 +21,48 @@ from h2o3_tpu.rapids.parser import (Id, Lambda, NumList, Span, StrLit,
 
 
 class Session:
-    """Refcounted temp frames (water/rapids/Session.java)."""
+    """Refcounted temp frames (water/rapids/Session.java).
+
+    Columns are immutable device arrays, so temp frames are cheap COW views;
+    the refcount tracks how many OTHER temps alias a temp's columns so a
+    client `rm` releases the key immediately but the backing columns only
+    die when the last aliasing temp does (Session.java's sanity-checked
+    refcnts — here Python's GC owns the buffers, the counts serve the
+    `rm`/`end` bookkeeping and introspection)."""
 
     def __init__(self, session_id: str = "default"):
         self.id = session_id
         self.temps: Dict[str, Frame] = {}
+        self.refcnt: Dict[int, int] = {}     # id(Column) -> temp refs
+
+    def _track(self, fr: Frame, delta: int):
+        for c in fr.columns:
+            cid = id(c)
+            n = self.refcnt.get(cid, 0) + delta
+            if n <= 0:
+                self.refcnt.pop(cid, None)
+            else:
+                self.refcnt[cid] = n
 
     def assign(self, key: str, fr: Frame) -> Frame:
         out = Frame(key=key)
         for n in fr.names:
             out.add(n, fr.col(n))
         out.install()
+        old = self.temps.get(key)
+        if old is not None:
+            self._track(old, -1)
         self.temps[key] = out
+        self._track(out, +1)
         return out
 
+    def column_refs(self, col: Column) -> int:
+        return self.refcnt.get(id(col), 0)
+
     def remove(self, key: str):
-        self.temps.pop(key, None)
+        old = self.temps.pop(key, None)
+        if old is not None:
+            self._track(old, -1)
         DKV.remove(key)
 
     def end(self):
@@ -750,18 +776,28 @@ def _eval(ast, env: Env):
             args = [_eval(a, env) for a in ast[1:]]
             return fn(env, *args)
         if isinstance(head, Lambda):
-            lam = head
             args = [_eval(a, env) for a in ast[1:]]
-            sub = Env(env.session, parent=env)
-            for nm, v in zip(lam.args, args):
-                sub.vars[nm] = v
-            return _eval(lam.body, sub)
+            return _eval_lambda(env, head, args)
         # raw list of expressions: evaluate all, return last
         res = None
         for e in ast:
             res = _eval(e, env)
         return res
     raise TypeError(f"cannot evaluate {ast!r}")
+
+
+def _eval_lambda(env: Env, lam, args):
+    """Apply an AST lambda (AstFunction) to evaluated args; arity is
+    checked like the reference (AstFunction.apply errors on mismatch)."""
+    if not isinstance(lam, Lambda):
+        raise TypeError(f"expected lambda, got {type(lam)}")
+    if len(args) != len(lam.args):
+        raise ValueError(f"lambda expects {len(lam.args)} argument(s) "
+                         f"({' '.join(lam.args)}), got {len(args)}")
+    sub = Env(env.session, parent=env)
+    for nm, v in zip(lam.args, args):
+        sub.vars[nm] = v
+    return _eval(lam.body, sub)
 
 
 def exec_rapids(expr: str, session: Optional[Session] = None):
@@ -773,3 +809,8 @@ def exec_rapids(expr: str, session: Optional[Session] = None):
     if isinstance(ast, StrLit):
         return env.lookup(ast.s)
     return _eval(ast, env)
+
+
+# extended prim suites register themselves on import (advmath/time/string/
+# search/mungers/matrix/repeaters/timeseries — water/rapids/ast/prims/*)
+from h2o3_tpu.rapids import prims_ext as _prims_ext  # noqa: E402,F401

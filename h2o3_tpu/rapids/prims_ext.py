@@ -1,0 +1,1235 @@
+"""Extended Rapids primitive suites — advmath, time, string, search,
+mungers, matrix, repeaters, timeseries.
+
+Reference: water/rapids/ast/prims/* (205 prim classes, each an MRTask).
+Here each prim is a jitted device op over row-sharded columns where the
+work is numeric (cor/distance/moments/matrix/cumulative/time arithmetic),
+and a host pass where the reference also works on host-side data (string
+transforms operate on enum DOMAINS, never shipping strings to the TPU —
+core/frame.py design).
+
+Prim names are exactly the strings h2o-py's ExprNode emits (verified
+against h2o-py/h2o/frame.py + h2o.py), so the client's lazy AST surface
+keeps working over POST /99/Rapids.
+"""
+
+from __future__ import annotations
+
+import math as _math
+from typing import List, Optional
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Column, Frame, T_CAT, T_NUM, T_STR, T_TIME
+from h2o3_tpu.ops import elementwise as E
+from h2o3_tpu.rapids.eval import (Lambda, NumList, Span, StrLit, _colfr,
+                                  _eval_lambda, _idx_list, _is_fr, _one_col,
+                                  _percol, _scalar, prim)
+
+
+def _num_matrix(fr: Frame) -> np.ndarray:
+    return np.column_stack([np.asarray(fr.col(n).to_numpy(), np.float64)
+                            for n in fr.names])
+
+
+def _s(v) -> str:
+    if isinstance(v, StrLit):
+        return v.s
+    return str(v)
+
+
+# ---------------------------------------------------------------------------
+# advmath (ast/prims/advmath)
+# ---------------------------------------------------------------------------
+
+@prim("cor")
+def _cor(env, fr, other, use, method="pearson"):
+    """Correlation matrix / vector (AstCorrelation). use: everything |
+    complete.obs | all.obs; method: pearson | spearman."""
+    import jax.numpy as jnp
+
+    method = _s(method).strip('"').lower()
+    X = _num_matrix(fr)
+    Y = _num_matrix(other) if _is_fr(other) and other is not fr else X
+    usemode = _s(use).strip('"')
+    both = np.concatenate([X, Y], axis=1)
+    if usemode in ("complete.obs", "everything"):
+        keep = ~np.isnan(both).any(axis=1)
+        if usemode == "complete.obs":
+            X, Y = X[keep], Y[keep]
+    if method == "spearman":
+        from scipy import stats as _st
+
+        X = np.apply_along_axis(_st.rankdata, 0, X)
+        Y = np.apply_along_axis(_st.rankdata, 0, Y)
+    Xc = X - X.mean(axis=0)
+    Yc = Y - Y.mean(axis=0)
+    denom = np.outer(np.sqrt((Xc ** 2).sum(axis=0)),
+                     np.sqrt((Yc ** 2).sum(axis=0)))
+    C = (Xc.T @ Yc) / np.maximum(denom, 1e-300)
+    if C.shape == (1, 1):
+        return float(C[0, 0])
+    out = Frame()
+    for j, n in enumerate((other if _is_fr(other) else fr).names):
+        out.add(n, Column.from_numpy(C[:, j]))
+    return out
+
+
+@prim("distance")
+def _distance(env, fr, other, measure):
+    """Pairwise distances (AstDistance): rows of fr × rows of other."""
+    import jax
+    import jax.numpy as jnp
+
+    measure = _s(measure).strip('"').lower()
+    A = jnp.asarray(_num_matrix(fr), jnp.float32)
+    B = jnp.asarray(_num_matrix(other), jnp.float32)
+
+    @jax.jit
+    def dists(A, B):
+        if measure in ("l2", "euclidean"):
+            aa = jnp.sum(A * A, axis=1)[:, None]
+            bb = jnp.sum(B * B, axis=1)[None, :]
+            return jnp.sqrt(jnp.maximum(aa + bb - 2 * A @ B.T, 0.0))
+        if measure == "l1":
+            return jnp.abs(A[:, None, :] - B[None, :, :]).sum(-1)
+        # cosine / cosine_sq
+        an = A / jnp.maximum(jnp.linalg.norm(A, axis=1, keepdims=True), 1e-12)
+        bn = B / jnp.maximum(jnp.linalg.norm(B, axis=1, keepdims=True), 1e-12)
+        c = an @ bn.T
+        return c * c if measure == "cosine_sq" else c
+
+    D = np.asarray(dists(A, B))
+    out = Frame()
+    for j in range(D.shape[1]):
+        out.add(f"C{j + 1}", Column.from_numpy(D[:, j]))
+    return out
+
+
+@prim("hist")
+def _hist(env, fr, breaks):
+    """AstHist: histogram frame (breaks, counts, mids_true, mids, density)."""
+    x = np.asarray(_one_col(fr).to_numpy(), np.float64)
+    x = x[~np.isnan(x)]
+    if isinstance(breaks, (NumList, list)):
+        edges = np.asarray([float(b) for b in breaks])
+    else:
+        b = _s(breaks).strip('"')
+        if b in ("sturges", "Sturges"):
+            k = int(np.ceil(np.log2(max(len(x), 2)) + 1))
+        elif b in ("rice", "Rice"):
+            k = int(np.ceil(2 * len(x) ** (1 / 3)))
+        elif b in ("sqrt", "Sqrt"):
+            k = int(np.ceil(np.sqrt(len(x))))
+        elif b in ("doane", "Doane", "scott", "Scott", "fd", "FD"):
+            k = max(len(np.histogram_bin_edges(x, bins=b.lower())) - 1, 1)
+        else:
+            k = int(float(b))
+        edges = np.linspace(x.min(), x.max(), k + 1) if len(x) else np.array([0.0, 1.0])
+    counts, edges = np.histogram(x, bins=edges)
+    mids = 0.5 * (edges[:-1] + edges[1:])
+    widths = np.diff(edges)
+    dens = counts / np.maximum(counts.sum() * widths, 1e-300)
+    out = Frame()
+    out.add("breaks", Column.from_numpy(edges[1:]))
+    out.add("counts", Column.from_numpy(counts.astype(np.float64)))
+    out.add("mids_true", Column.from_numpy(mids))
+    out.add("mids", Column.from_numpy(mids))
+    out.add("density", Column.from_numpy(dens))
+    return out
+
+
+def _moment_stat(fr, power: int, na_rm) -> list:
+    import jax
+    import jax.numpy as jnp
+
+    out = []
+    for n in fr.names:
+        c = fr.col(n)
+        if not c.is_numeric:
+            out.append(float("nan"))
+            continue
+
+        @jax.jit
+        def stat(d):
+            valid = ~jnp.isnan(d)
+            nn = jnp.sum(valid)
+            mu = jnp.sum(jnp.where(valid, d, 0)) / jnp.maximum(nn, 1)
+            dc = jnp.where(valid, d - mu, 0.0)
+            m2 = jnp.sum(dc ** 2) / jnp.maximum(nn - 1, 1)
+            mk = jnp.sum(dc ** power) / jnp.maximum(nn, 1)
+            return mk / jnp.maximum(m2 ** (power / 2.0), 1e-300)
+
+        out.append(float(stat(c.data)))
+    return out
+
+
+@prim("skewness")
+def _skewness(env, fr, na_rm=True):
+    v = _moment_stat(fr, 3, na_rm)
+    return v[0] if len(v) == 1 else v
+
+
+@prim("kurtosis")
+def _kurtosis(env, fr, na_rm=True):
+    v = _moment_stat(fr, 4, na_rm)
+    return v[0] if len(v) == 1 else v
+
+
+@prim("mode")
+def _mode(env, fr):
+    c = _one_col(fr)
+    codes = np.asarray(c.to_numpy())
+    codes = codes[codes >= 0] if c.is_categorical else codes[~np.isnan(codes)]
+    if not len(codes):
+        return float("nan")
+    vals, cnt = np.unique(codes, return_counts=True)
+    return float(vals[np.argmax(cnt)])
+
+
+@prim("kfold_column")
+def _kfold(env, fr, nfolds, seed):
+    n = fr.nrows
+    sd = int(_scalar(seed))
+    rng = np.random.default_rng(sd if sd >= 0 else None)
+    return _colfr(Column.from_numpy(
+        rng.integers(0, int(_scalar(nfolds)), n).astype(np.float64)), "kfold")
+
+
+@prim("modulo_kfold_column")
+def _modulo_kfold(env, fr, nfolds):
+    return _colfr(Column.from_numpy(
+        (np.arange(fr.nrows) % int(_scalar(nfolds))).astype(np.float64)),
+        "kfold")
+
+
+@prim("stratified_kfold_column")
+def _strat_kfold(env, fr, nfolds, seed):
+    c = _one_col(fr)
+    y = np.asarray(c.to_numpy())
+    k = int(_scalar(nfolds))
+    sd = int(_scalar(seed))
+    rng = np.random.default_rng(sd if sd >= 0 else None)
+    assign = rng.integers(0, k, len(y))
+    for cls in np.unique(y[~np.isnan(y.astype(np.float64))] if y.dtype.kind == "f"
+                         else y[y >= 0]):
+        idx = np.nonzero(y == cls)[0]
+        rng.shuffle(idx)
+        assign[idx] = (np.arange(len(idx)) + rng.integers(k)) % k
+    return _colfr(Column.from_numpy(assign.astype(np.float64)), "kfold")
+
+
+@prim("h2o.random_stratified_split")
+def _strat_split(env, fr, test_frac, seed):
+    c = _one_col(fr)
+    y = np.asarray(c.to_numpy())
+    frac = float(_scalar(test_frac))
+    sd = int(_scalar(seed))
+    rng = np.random.default_rng(sd if sd >= 0 else None)
+    out = np.zeros(len(y))
+    for cls in np.unique(y[y >= 0] if c.is_categorical else y):
+        idx = np.nonzero(y == cls)[0]
+        rng.shuffle(idx)
+        out[idx[: int(round(len(idx) * frac))]] = 1.0
+    return _colfr(Column.from_numpy(out), "split")
+
+
+# ---------------------------------------------------------------------------
+# matrix (ast/prims/matrix)
+# ---------------------------------------------------------------------------
+
+@prim("t")
+def _transpose(env, fr):
+    M = _num_matrix(fr).T
+    out = Frame()
+    for j in range(M.shape[1]):
+        out.add(f"C{j + 1}", Column.from_numpy(M[:, j]))
+    return out
+
+
+@prim("x")
+def _mmult(env, a, b):
+    import jax
+    import jax.numpy as jnp
+
+    A = jnp.asarray(_num_matrix(a), jnp.float32)
+    B = jnp.asarray(_num_matrix(b), jnp.float32)
+    M = np.asarray(jax.jit(jnp.matmul)(A, B), np.float64)
+    out = Frame()
+    for j in range(M.shape[1]):
+        out.add(f"C{j + 1}", Column.from_numpy(M[:, j]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# repeaters (ast/prims/repeaters)
+# ---------------------------------------------------------------------------
+
+@prim("rep_len")
+def _rep_len(env, x, length):
+    n = int(_scalar(length))
+    if _is_fr(x):
+        vals = np.asarray(_one_col(x).to_numpy(), np.float64)
+    else:
+        vals = np.asarray([float(x)])
+    return _colfr(Column.from_numpy(np.resize(vals, n)), "rep_len")
+
+
+@prim("seq")
+def _seq(env, frm, to, by):
+    a, b, s = _scalar(frm), _scalar(to), _scalar(by)
+    vals = np.arange(a, b + (s / 2 if s > 0 else -s / 2), s, dtype=np.float64)
+    return _colfr(Column.from_numpy(vals), "seq")
+
+
+@prim("seq_len")
+def _seq_len(env, n):
+    return _colfr(Column.from_numpy(
+        np.arange(1, int(_scalar(n)) + 1, dtype=np.float64)), "seq_len")
+
+
+# ---------------------------------------------------------------------------
+# search (ast/prims/search)
+# ---------------------------------------------------------------------------
+
+@prim("match")
+def _match(env, fr, table, nomatch=float("nan"), *_):
+    c = _one_col(fr)
+    if isinstance(table, (NumList, list)):
+        tbl = [t.s if isinstance(t, StrLit) else t for t in table]
+    else:
+        tbl = [table.s if isinstance(table, StrLit) else table]
+    nm = float("nan") if (not isinstance(nomatch, (int, float))
+                          or nomatch != nomatch) else float(nomatch)
+    if c.is_categorical:
+        lut = np.full(max(c.cardinality, 1), nm, np.float64)
+        for pos, t in enumerate(tbl):
+            t = str(t)
+            if t in (c.domain or []):
+                lut[c.domain.index(t)] = pos + 1          # R 1-based match
+        codes = np.asarray(c.to_numpy())
+        vals = np.where(codes >= 0, lut[np.maximum(codes, 0)], nm)
+    else:
+        x = np.asarray(c.to_numpy(), np.float64)
+        vals = np.full(len(x), nm)
+        for pos, t in enumerate(tbl):
+            vals = np.where(x == float(t), pos + 1, vals)
+    return _colfr(Column.from_numpy(vals), "match")
+
+
+@prim("which")
+def _which(env, fr):
+    c = _one_col(fr)
+    x = np.asarray(c.to_numpy(), np.float64)
+    idx = np.nonzero(~np.isnan(x) & (x != 0))[0].astype(np.float64)
+    return _colfr(Column.from_numpy(idx), "which")
+
+
+def _whichextreme(fr, na_rm, axis, is_max: bool):
+    M = _num_matrix(fr)
+    ax = int(_scalar(axis))
+    fn = np.nanargmax if is_max else np.nanargmin
+    name = "which.max" if is_max else "which.min"
+    if ax == 1:          # per row
+        vals = np.asarray([float(fn(r)) if not np.isnan(r).all() else np.nan
+                           for r in M])
+        return _colfr(Column.from_numpy(vals), name)
+    vals = np.asarray([float(fn(M[:, j])) if not np.isnan(M[:, j]).all()
+                       else np.nan for j in range(M.shape[1])])
+    return _colfr(Column.from_numpy(vals), name)
+
+
+@prim("which.max")
+def _whichmax(env, fr, na_rm=True, axis=0):
+    return _whichextreme(fr, na_rm, axis, True)
+
+
+@prim("which.min")
+def _whichmin(env, fr, na_rm=True, axis=0):
+    return _whichextreme(fr, na_rm, axis, False)
+
+
+# ---------------------------------------------------------------------------
+# string suite — operates on enum DOMAINS / host string data (strings never
+# reach the device; core/frame.py)
+# ---------------------------------------------------------------------------
+
+def _map_strings(fr, fn, name=None):
+    """Apply a str->str fn per column: enum columns transform their domain
+    (deduplicating like the reference), string columns transform values."""
+    out = Frame()
+    for n in fr.names:
+        c = fr.col(n)
+        if c.is_categorical:
+            newdom = [fn(v) for v in (c.domain or [])]
+            uniq = sorted(set(newdom))
+            remap = np.asarray([uniq.index(v) for v in newdom], np.int32)
+            codes = np.asarray(c.to_numpy())
+            newcodes = np.where(codes >= 0, remap[np.maximum(codes, 0)], -1)
+            out.add(n, Column.from_numpy(
+                np.asarray([uniq[i] if i >= 0 else None for i in newcodes],
+                           object), ctype=T_CAT))
+        elif c.is_string:
+            vals = np.asarray([None if v is None else fn(str(v))
+                               for v in c.host_data[: c.nrows]], object)
+            out.add(n, Column._from_strings(vals))
+        else:
+            out.add(n, c)
+    return out
+
+
+def _map_string_nums(fr, fn, name):
+    """str -> float per value; NA for NA."""
+    out = Frame()
+    for n in fr.names:
+        c = fr.col(n)
+        if c.is_categorical:
+            tbl = np.asarray([fn(v) for v in (c.domain or [])] or [np.nan],
+                             np.float64)
+            codes = np.asarray(c.to_numpy())
+            vals = np.where(codes >= 0, tbl[np.maximum(codes, 0)], np.nan)
+        elif c.is_string:
+            vals = np.asarray([np.nan if v is None else fn(str(v))
+                               for v in c.host_data[: c.nrows]], np.float64)
+        else:
+            continue
+        out.add(n, Column.from_numpy(vals))
+    if not out.ncols:
+        raise ValueError(f"{name}: no string/enum columns")
+    return out
+
+
+@prim("tolower")
+def _tolower(env, fr):
+    return _map_strings(fr, str.lower)
+
+
+@prim("toupper")
+def _toupper(env, fr):
+    return _map_strings(fr, str.upper)
+
+
+@prim("trim")
+def _trim(env, fr):
+    return _map_strings(fr, str.strip)
+
+
+@prim("lstrip")
+def _lstrip(env, fr, chars=None):
+    cs = _s(chars).strip('"') if chars is not None else None
+    return _map_strings(fr, lambda s: s.lstrip(cs))
+
+
+@prim("rstrip")
+def _rstrip(env, fr, chars=None):
+    cs = _s(chars).strip('"') if chars is not None else None
+    return _map_strings(fr, lambda s: s.rstrip(cs))
+
+
+@prim("substring")
+def _substring(env, fr, start, end=None):
+    a = int(_scalar(start))
+    b = None if end is None or (isinstance(end, float) and end != end) \
+        else int(_scalar(end))
+    return _map_strings(fr, lambda s: s[a:b])
+
+
+@prim("entropy")
+def _entropy(env, fr):
+    def ent(s):
+        if not s:
+            return 0.0
+        _, cnt = np.unique(list(s), return_counts=True)
+        p = cnt / cnt.sum()
+        return float(-(p * np.log2(p)).sum())
+    return _map_string_nums(fr, ent, "entropy")
+
+
+@prim("countmatches")
+def _countmatches(env, fr, pats):
+    pl = ([_s(p).strip('"') for p in pats]
+          if isinstance(pats, (list, NumList)) else [_s(pats).strip('"')])
+    return _map_string_nums(fr, lambda s: float(sum(s.count(p) for p in pl)),
+                            "countmatches")
+
+
+@prim("num_valid_substrings")
+def _num_valid_substrings(env, fr, path):
+    with open(_s(path).strip('"')) as fh:
+        words = set(w.strip() for w in fh if w.strip())
+
+    def count(s):
+        n = 0
+        for i in range(len(s)):
+            for j in range(i + 1, len(s) + 1):
+                if s[i:j] in words:
+                    n += 1
+        return float(n)
+    return _map_string_nums(fr, count, "num_valid_substrings")
+
+
+@prim("grep")
+def _grep(env, fr, regex, ignore_case=0, invert=0, output_logical=0):
+    import re as _re
+
+    flags = _re.IGNORECASE if _scalar(ignore_case) else 0
+    rx = _re.compile(_s(regex).strip('"'), flags)
+    inv = bool(_scalar(invert))
+    logical = bool(_scalar(output_logical))
+    c = _one_col(fr)
+    if c.is_categorical:
+        dom_hit = np.asarray([bool(rx.search(v)) for v in (c.domain or [])] or
+                             [False])
+        codes = np.asarray(c.to_numpy())
+        hits = np.where(codes >= 0, dom_hit[np.maximum(codes, 0)], False)
+    else:
+        hits = np.asarray([v is not None and bool(rx.search(str(v)))
+                           for v in c.host_data[: c.nrows]])
+    if inv:
+        hits = ~hits
+    if logical:
+        return _colfr(Column.from_numpy(hits.astype(np.float64)), "grep")
+    return _colfr(Column.from_numpy(np.nonzero(hits)[0].astype(np.float64)),
+                  "grep")
+
+
+@prim("strsplit")
+def _strsplit(env, fr, pattern):
+    import re as _re
+
+    rx = _re.compile(_s(pattern).strip('"'))
+    c = _one_col(fr)
+    if c.is_categorical:
+        vals = [None if v is None else str(v) for v in c.values()]
+    else:
+        vals = [None if v is None else str(v) for v in c.host_data[: c.nrows]]
+    parts = [([] if v is None else rx.split(v)) for v in vals]
+    width = max((len(p) for p in parts), default=1) or 1
+    out = Frame()
+    for j in range(width):
+        col = np.asarray([p[j] if j < len(p) else None for p in parts], object)
+        out.add(f"C{j + 1}", Column.from_numpy(col, ctype=T_CAT))
+    return out
+
+
+@prim("tokenize")
+def _tokenize(env, fr, split):
+    import re as _re
+
+    rx = _re.compile(_s(split).strip('"'))
+    c = _one_col(fr)
+    vals = ([None if v is None else str(v) for v in c.values()]
+            if c.is_categorical else
+            [None if v is None else str(v) for v in c.host_data[: c.nrows]])
+    toks: List[Optional[str]] = []
+    for v in vals:
+        if v is not None:
+            toks.extend(t for t in rx.split(v) if t)
+        toks.append(None)                     # sentence separator row
+    return _colfr(Column._from_strings(np.asarray(toks, object)))
+
+
+@prim("strDistance")
+def _strdistance(env, fr, other, measure, compare_empty=1):
+    measure = _s(measure).strip('"').lower()
+
+    def lev(a, b):
+        if a is None or b is None:
+            return np.nan
+        la, lb = len(a), len(b)
+        d = np.arange(lb + 1, dtype=np.float64)
+        for i in range(1, la + 1):
+            prev = d.copy()
+            d[0] = i
+            for j in range(1, lb + 1):
+                d[j] = min(prev[j] + 1, d[j - 1] + 1,
+                           prev[j - 1] + (a[i - 1] != b[j - 1]))
+        return float(d[lb])
+
+    def jw(a, b):
+        if a is None or b is None:
+            return np.nan
+        if a == b:
+            return 1.0
+        la, lb = len(a), len(b)
+        if not la or not lb:
+            return 0.0
+        match_dist = max(la, lb) // 2 - 1
+        fa = [False] * la
+        fb = [False] * lb
+        matches = 0
+        for i in range(la):
+            for j in range(max(0, i - match_dist), min(lb, i + match_dist + 1)):
+                if not fb[j] and a[i] == b[j]:
+                    fa[i] = fb[j] = True
+                    matches += 1
+                    break
+        if not matches:
+            return 0.0
+        t = 0
+        k = 0
+        for i in range(la):
+            if fa[i]:
+                while not fb[k]:
+                    k += 1
+                if a[i] != b[k]:
+                    t += 1
+                k += 1
+        t /= 2
+        return (matches / la + matches / lb + (matches - t) / matches) / 3
+
+    fn = jw if measure in ("jw", "jaccard_winkler", "jarowinkler") else lev
+    a = _one_col(fr)
+    b = _one_col(other)
+    av = a.values() if a.is_categorical else a.host_data[: a.nrows]
+    bv = b.values() if b.is_categorical else b.host_data[: b.nrows]
+    vals = np.asarray([fn(None if x is None else str(x),
+                          None if y is None else str(y))
+                       for x, y in zip(av, bv)], np.float64)
+    return _colfr(Column.from_numpy(vals), "strDistance")
+
+
+# ---------------------------------------------------------------------------
+# time suite (ast/prims/time) — columns are epoch milliseconds
+# ---------------------------------------------------------------------------
+
+def _as_dt64(col: Column) -> np.ndarray:
+    # exact epoch millis live host-side when available (core/frame.py keeps
+    # them for time columns — f32 device storage rounds ~1-minute at 2020
+    # magnitudes, enough to flip a midnight-boundary year)
+    if col.host_data is not None and col.host_data.dtype.kind in "Mi":
+        hd = col.host_data[: col.nrows]
+        if hd.dtype.kind == "M":
+            return hd.astype("datetime64[ms]")
+        return hd.astype("int64").astype("datetime64[ms]")
+    ms = np.asarray(col.to_numpy(), np.float64)
+    out = np.full(len(ms), np.datetime64("NaT", "ms"))
+    ok = ~np.isnan(ms)
+    out[ok] = ms[ok].astype("int64").astype("datetime64[ms]")
+    return out
+
+
+def _time_field(fr, extract, name):
+    out = Frame()
+    for n in fr.names:
+        c = fr.col(n)
+        dt = _as_dt64(c)
+        vals = np.full(len(dt), np.nan)
+        ok = ~np.isnat(dt)
+        vals[ok] = extract(dt[ok])
+        out.add(n, Column.from_numpy(vals))
+    return out
+
+
+@prim("year")
+def _year(env, fr):
+    return _time_field(fr, lambda d: d.astype("datetime64[Y]").astype(int) + 1970,
+                       "year")
+
+
+@prim("month")
+def _month(env, fr):
+    return _time_field(
+        fr, lambda d: d.astype("datetime64[M]").astype(int) % 12 + 1, "month")
+
+
+@prim("day")
+def _day(env, fr):
+    return _time_field(
+        fr, lambda d: (d.astype("datetime64[D]")
+                       - d.astype("datetime64[M]").astype("datetime64[D]")
+                       ).astype(int) + 1, "day")
+
+
+@prim("dayOfWeek")
+def _dayofweek(env, fr):
+    # reference AstDayOfWeek: 0 = Monday
+    return _time_field(
+        fr, lambda d: (d.astype("datetime64[D]").astype(int) + 3) % 7,
+        "dayOfWeek")
+
+
+@prim("week")
+def _week(env, fr):
+    def iso_week(d):
+        days = d.astype("datetime64[D]")
+        return np.asarray([int(x.astype("datetime64[D]").item()
+                               .isocalendar()[1]) for x in days], np.float64)
+    return _time_field(fr, iso_week, "week")
+
+
+@prim("hour")
+def _hour(env, fr):
+    return _time_field(
+        fr, lambda d: (d.astype("int64") // 3_600_000) % 24, "hour")
+
+
+@prim("minute")
+def _minute(env, fr):
+    return _time_field(
+        fr, lambda d: (d.astype("int64") // 60_000) % 60, "minute")
+
+
+@prim("second")
+def _second(env, fr):
+    return _time_field(
+        fr, lambda d: (d.astype("int64") // 1000) % 60, "second")
+
+
+@prim("millis")
+def _millis(env, fr):
+    return _time_field(fr, lambda d: d.astype("int64") % 1000, "millis")
+
+
+@prim("mktime")
+def _mktime(env, year, month, day, hour, minute, second, msec):
+    def vals(v, default=0.0):
+        if _is_fr(v):
+            return np.asarray(_one_col(v).to_numpy(), np.float64)
+        return np.asarray([float(v)])
+    parts = [vals(v) for v in (year, month, day, hour, minute, second, msec)]
+    n = max(len(p) for p in parts)
+    parts = [np.resize(p, n) for p in parts]
+    out = np.empty(n, np.float64)
+    import datetime as _dt
+
+    for i in range(n):
+        y, mo, d, h, mi, s, ms = (parts[j][i] for j in range(7))
+        # reference mktime: month and day are 0-based
+        t = _dt.datetime(int(y), int(mo) + 1, int(d) + 1, int(h), int(mi),
+                         int(s), int(ms) * 1000, tzinfo=_dt.timezone.utc)
+        out[i] = t.timestamp() * 1000
+    return _colfr(Column.from_numpy(out), "mktime")
+
+
+@prim("moment")
+def _moment(env, *args):
+    return _mktime(env, *args)
+
+
+@prim("as.Date")
+def _asdate(env, fr, fmt):
+    import datetime as _dt
+
+    fmt = _s(fmt).strip('"')
+    pyfmt = (fmt.replace("yyyy", "%Y").replace("yy", "%y")
+             .replace("MM", "%m").replace("dd", "%d")
+             .replace("HH", "%H").replace("mm", "%M").replace("ss", "%S"))
+    c = _one_col(fr)
+    vals = (c.values() if c.is_categorical
+            else c.host_data[: c.nrows] if c.is_string
+            else None)
+    if vals is None:
+        return _colfr(c)                    # already numeric/time
+    out = np.full(len(vals), np.nan)
+    for i, v in enumerate(vals):
+        if v is None:
+            continue
+        try:
+            t = _dt.datetime.strptime(str(v), pyfmt).replace(
+                tzinfo=_dt.timezone.utc)
+            out[i] = t.timestamp() * 1000
+        except ValueError:
+            pass
+    col = Column.from_numpy(out)
+    col.ctype = T_TIME
+    return _colfr(col, "as.Date")
+
+
+@prim("listTimeZones")
+def _list_tz(env):
+    import zoneinfo
+
+    zones = sorted(zoneinfo.available_timezones())
+    return _colfr(Column._from_strings(np.asarray(zones, object)))
+
+
+@prim("getTimeZone")
+def _get_tz(env):
+    return "UTC"
+
+
+@prim("setTimeZone")
+def _set_tz(env, tz):
+    return _s(tz).strip('"')
+
+
+# ---------------------------------------------------------------------------
+# timeseries
+# ---------------------------------------------------------------------------
+
+@prim("difflag1")
+def _difflag1(env, fr):
+    import jax
+    import jax.numpy as jnp
+
+    c = _one_col(fr)
+
+    @jax.jit
+    def diff(d):
+        return jnp.concatenate([jnp.asarray([jnp.nan], d.dtype),
+                                d[1:] - d[:-1]])
+
+    x = np.asarray(c.to_numpy(), np.float64)
+    vals = np.concatenate([[np.nan], x[1:] - x[:-1]])
+    return _colfr(Column.from_numpy(vals), "difflag1")
+
+
+# ---------------------------------------------------------------------------
+# mungers — the remaining ones
+# ---------------------------------------------------------------------------
+
+@prim("any.factor")
+def _anyfactor(env, fr):
+    return 1.0 if any(fr.col(n).is_categorical for n in fr.names) else 0.0
+
+
+@prim("is.factor")
+def _isfactor(env, fr):
+    return [1.0 if fr.col(n).is_categorical else 0.0 for n in fr.names]
+
+
+@prim("is.numeric")
+def _isnumeric(env, fr):
+    return [1.0 if fr.col(n).is_numeric else 0.0 for n in fr.names]
+
+
+@prim("is.character")
+def _ischaracter(env, fr):
+    return [1.0 if fr.col(n).is_string else 0.0 for n in fr.names]
+
+
+@prim("columnsByType")
+def _columns_by_type(env, fr, coltype):
+    ct = _s(coltype).strip('"').lower()
+    idx = []
+    for i, n in enumerate(fr.names):
+        c = fr.col(n)
+        hit = (ct == "numeric" and c.is_numeric or
+               ct == "categorical" and c.is_categorical or
+               ct == "string" and c.is_string or
+               ct == "time" and c.ctype == T_TIME or
+               ct == "bad" and c.ctype == "bad" or
+               ct == "uuid" and c.ctype == "uuid")
+        if hit:
+            idx.append(float(i))
+    return idx
+
+
+@prim("flatten")
+def _flatten(env, fr):
+    c = _one_col(fr)
+    if c.is_categorical:
+        code = int(np.asarray(c.to_numpy())[0])
+        return (c.domain[code] if code >= 0 else "NA")
+    if c.is_string:
+        return str(c.host_data[0])
+    return float(np.asarray(c.to_numpy(), np.float64)[0])
+
+
+@prim("nlevels")
+def _nlevels(env, fr):
+    return [float(fr.col(n).cardinality) for n in fr.names]
+
+
+@prim("cut")
+def _cut(env, fr, breaks, labels, include_lowest, right, dig_lab):
+    x = np.asarray(_one_col(fr).to_numpy(), np.float64)
+    edges = np.asarray([float(b) for b in breaks], np.float64)
+    right_ = bool(_scalar(right))
+    incl = bool(_scalar(include_lowest))
+    dig = int(_scalar(dig_lab))
+    if isinstance(labels, (list, NumList)) and len(labels):
+        labs = [_s(v).strip('"') for v in labels]
+    else:
+        def f(v):
+            return f"%.{dig}g" % v
+        labs = [(f"({f(edges[i])},{f(edges[i+1])}]" if right_
+                 else f"[{f(edges[i])},{f(edges[i+1])})")
+                for i in range(len(edges) - 1)]
+    codes = np.full(len(x), -1, np.int32)
+    for i in range(len(edges) - 1):
+        lo, hi = edges[i], edges[i + 1]
+        if right_:
+            m = (x > lo) & (x <= hi)
+            if i == 0 and incl:
+                m |= x == lo
+        else:
+            m = (x >= lo) & (x < hi)
+            if i == len(edges) - 2 and incl:
+                m |= x == hi
+        codes[m] = i
+    vals = np.asarray([labs[c] if c >= 0 else None for c in codes], object)
+    return _colfr(Column.from_numpy(vals, ctype=T_CAT), "cut")
+
+
+@prim("h2o.fillna")
+def _fillna(env, fr, method, axis, maxlen):
+    method = _s(method).strip('"').lower()
+    ax = int(_scalar(axis))
+    mx = int(_scalar(maxlen))
+    M = _num_matrix(fr)
+    if ax == 1:
+        M = M.T
+    for j in range(M.shape[1]):
+        col = M[:, j]
+        isna = np.isnan(col)
+        if method in ("forward", "ffill"):
+            run = 0
+            for i in range(1, len(col)):
+                if isna[i]:
+                    run += 1
+                    if run <= mx and not np.isnan(col[i - 1]):
+                        col[i] = col[i - 1]
+                else:
+                    run = 0
+        else:                                 # backward
+            run = 0
+            for i in range(len(col) - 2, -1, -1):
+                if isna[i]:
+                    run += 1
+                    if run <= mx and not np.isnan(col[i + 1]):
+                        col[i] = col[i + 1]
+                else:
+                    run = 0
+    if ax == 1:
+        M = M.T
+    out = Frame()
+    for j, n in enumerate(fr.names):
+        out.add(n, Column.from_numpy(M[:, j]))
+    return out
+
+
+@prim("filterNACols")
+def _filternacols(env, fr, frac):
+    f = float(_scalar(frac))
+    keep = []
+    for i, n in enumerate(fr.names):
+        c = fr.col(n)
+        na = float(c.rollups.na_count) if not c.is_string else \
+            sum(1 for v in c.host_data[: c.nrows] if v is None)
+        if na / max(fr.nrows, 1) < f:
+            keep.append(float(i))
+    return keep
+
+
+@prim("relevel")
+def _relevel(env, fr, level):
+    c = _one_col(fr)
+    lvl = _s(level).strip('"')
+    dom = list(c.domain or [])
+    if lvl not in dom:
+        raise ValueError(f"level {lvl!r} not in domain")
+    newdom = [lvl] + [d for d in dom if d != lvl]
+    remap = np.asarray([newdom.index(d) for d in dom], np.int32)
+    codes = np.asarray(c.to_numpy())
+    newcodes = np.where(codes >= 0, remap[np.maximum(codes, 0)], -1)
+    vals = np.asarray([newdom[i] if i >= 0 else None for i in newcodes], object)
+    return _colfr(Column.from_numpy(vals, ctype=T_CAT), "relevel")
+
+
+@prim("setDomain")
+def _setdomain(env, fr, in_place, domain):
+    c = _one_col(fr)
+    newdom = ([_s(v).strip('"') for v in domain]
+              if isinstance(domain, (list, NumList)) else None)
+    col = Column(c.data, T_CAT, c.nrows, domain=newdom)
+    return _colfr(col, fr.names[0] if _is_fr(fr) else "C1")
+
+
+@prim("setLevel")
+def _setlevel(env, fr, level):
+    c = _one_col(fr)
+    lvl = _s(level).strip('"')
+    dom = list(c.domain or [])
+    if lvl not in dom:
+        raise ValueError(f"level {lvl!r} not in domain")
+    code = dom.index(lvl)
+    vals = np.asarray([lvl] * c.nrows, object)
+    return _colfr(Column.from_numpy(vals, ctype=T_CAT), "setLevel")
+
+
+@prim("dropdup")
+def _dropdup(env, fr, cols, keep):
+    idx = _idx_list(cols, fr.ncols)
+    keep_s = _s(keep).strip('"').lower()
+    key_cols = [np.asarray(fr.col(int(i)).to_numpy()) for i in idx]
+    seen = {}
+    order = range(fr.nrows) if keep_s == "first" else range(fr.nrows - 1, -1, -1)
+    for r in order:
+        k = tuple(c[r] for c in key_cols)
+        if k not in seen:
+            seen[k] = r
+    rows = np.asarray(sorted(seen.values()), np.int64)
+    from h2o3_tpu.ops.filters import take_rows
+
+    return take_rows(fr, rows)
+
+
+@prim("sumaxis")
+def _sumaxis(env, fr, na_rm, axis):
+    import jax.numpy as jnp
+
+    ax = int(_scalar(axis))
+    out = Frame()
+    if ax == 1:
+        num = [fr.col(n) for n in fr.names if fr.col(n).is_numeric]
+        stack = jnp.stack([c.data for c in num], axis=1)
+        mask = ~jnp.isnan(stack)
+        s = jnp.where(mask, stack, 0.0).sum(axis=1)
+        out.add("sum", Column(s, T_NUM, fr.nrows))
+        return out
+    for n, v in zip(fr.names, _percol(fr, lambda c: c.rollups.mean *
+                                      (c.nrows - c.rollups.na_count))):
+        out.add(n, Column.from_numpy(np.asarray([v])))
+    return out
+
+
+@prim("sumNA")
+def _sumna(env, fr, na_rm):
+    """sum with na_rm=False semantics: NA if any NA present."""
+    vals = []
+    for n in fr.names:
+        c = fr.col(n)
+        if not c.is_numeric:
+            vals.append(float("nan"))
+            continue
+        r = c.rollups
+        vals.append(float("nan") if r.na_count > 0
+                    else r.mean * (c.nrows - r.na_count))
+    return vals[0] if len(vals) == 1 else vals
+
+
+@prim("prod.na", "prod")
+def _prod(env, fr, *rest):
+    import jax
+    import jax.numpy as jnp
+
+    c = _one_col(fr)
+
+    @jax.jit
+    def p(d):
+        return jnp.prod(jnp.where(jnp.isnan(d), 1.0, d))
+
+    return float(p(c.data.astype(jnp.float64)
+                   if hasattr(c.data, "astype") else c.data))
+
+
+@prim("mad")
+def _mad(env, fr, const=1.4826, *rest):
+    from h2o3_tpu.ops.quantile import quantile_column
+
+    c = _one_col(fr)
+    med = quantile_column(c, [0.5])[0]
+    dev = Column.from_numpy(np.abs(np.asarray(c.to_numpy(), np.float64) - med))
+    k = float(_scalar(const)) if not _is_fr(const) else 1.4826
+    return k * quantile_column(dev, [0.5])[0]
+
+
+@prim("topn")
+def _topn(env, fr, col_idx, npercent, grab_topn):
+    c = fr.col(int(_scalar(col_idx)))
+    x = np.asarray(c.to_numpy(), np.float64)
+    valid = np.nonzero(~np.isnan(x))[0]
+    n = max(int(np.ceil(len(valid) * float(_scalar(npercent)) / 100.0)), 1)
+    top = int(_scalar(grab_topn)) >= 0
+    order = valid[np.argsort(x[valid])]
+    pick = order[-n:][::-1] if top else order[:n]
+    out = Frame()
+    out.add("Row Indices", Column.from_numpy(pick.astype(np.float64)))
+    out.add(fr.names[int(_scalar(col_idx))], Column.from_numpy(x[pick]))
+    return out
+
+
+@prim("signif")
+def _signif(env, fr, digits):
+    d = int(_scalar(digits))
+
+    def sig(x):
+        with np.errstate(divide="ignore", invalid="ignore"):
+            mag = np.where(x == 0, 1.0,
+                           10.0 ** (d - 1 - np.floor(np.log10(np.abs(x)))))
+        return np.round(x * mag) / mag
+    out = Frame()
+    for n in fr.names:
+        c = fr.col(n)
+        if c.is_numeric:
+            out.add(n, Column.from_numpy(sig(np.asarray(c.to_numpy(),
+                                                        np.float64))))
+        else:
+            out.add(n, c)
+    return out
+
+
+@prim("any.na")
+def _anyna(env, fr):
+    for n in fr.names:
+        c = fr.col(n)
+        if c.is_string:
+            if any(v is None for v in c.host_data[: c.nrows]):
+                return 1.0
+        elif float(c.rollups.na_count) > 0:
+            return 1.0
+    return 0.0
+
+
+@prim("melt")
+def _melt(env, fr, id_vars, value_vars, var_name, value_name, skipna):
+    ids = [fr.names[i] for i in _idx_list(id_vars, fr.ncols)]
+    if value_vars is None or (isinstance(value_vars, (list, NumList))
+                              and not len(value_vars)):
+        vals = [n for n in fr.names if n not in ids]
+    else:
+        vals = [fr.names[i] for i in _idx_list(value_vars, fr.ncols)]
+    vn = _s(var_name).strip('"') or "variable"
+    valn = _s(value_name).strip('"') or "value"
+    skip = bool(_scalar(skipna))
+    n = fr.nrows
+    id_data = {c: np.asarray(fr.col(c).values(), object) for c in ids}
+    var_col: List = []
+    val_col: List[float] = []
+    id_cols: dict = {c: [] for c in ids}
+    for v in vals:
+        x = np.asarray(fr.col(v).to_numpy(), np.float64)
+        for i in range(n):
+            if skip and np.isnan(x[i]):
+                continue
+            var_col.append(v)
+            val_col.append(x[i])
+            for c in ids:
+                id_cols[c].append(id_data[c][i])
+    out = Frame()
+    for c in ids:
+        out.add(c, Column.from_numpy(np.asarray(id_cols[c], object),
+                                     ctype=T_CAT if fr.col(c).is_categorical
+                                     else None))
+    out.add(vn, Column.from_numpy(np.asarray(var_col, object), ctype=T_CAT))
+    out.add(valn, Column.from_numpy(np.asarray(val_col, np.float64)))
+    return out
+
+
+@prim("pivot")
+def _pivot(env, fr, index, column, value):
+    iname = _s(index).strip('"')
+    cname = _s(column).strip('"')
+    vname = _s(value).strip('"')
+    iv = np.asarray(fr.col(iname).values(), object)
+    cv = np.asarray(fr.col(cname).values(), object)
+    vv = np.asarray(fr.col(vname).to_numpy(), np.float64)
+    uidx = sorted(set(iv.tolist()), key=lambda x: (x is None, x))
+    ucol = sorted(set(v for v in cv.tolist() if v is not None))
+    pos_i = {v: i for i, v in enumerate(uidx)}
+    pos_c = {v: i for i, v in enumerate(ucol)}
+    M = np.full((len(uidx), len(ucol)), np.nan)
+    for i in range(len(iv)):
+        if cv[i] is None:
+            continue
+        M[pos_i[iv[i]], pos_c[cv[i]]] = vv[i]
+    out = Frame()
+    out.add(iname, Column.from_numpy(
+        np.asarray(uidx, object),
+        ctype=T_CAT if fr.col(iname).is_categorical else None))
+    for j, cn in enumerate(ucol):
+        out.add(str(cn), Column.from_numpy(M[:, j]))
+    return out
+
+
+@prim("ddply")
+def _ddply(env, fr, group_cols, fun):
+    """AstDdply: apply an AST lambda per group; result row per group."""
+    from h2o3_tpu.ops.filters import take_rows
+
+    idx = _idx_list(group_cols, fr.ncols)
+    keys = [np.asarray(fr.col(int(i)).to_numpy()) for i in idx]
+    combo = {}
+    for r in range(fr.nrows):
+        combo.setdefault(tuple(k[r] for k in keys), []).append(r)
+    rows_out: List[List[float]] = []
+    width = 0
+    for key, rows in sorted(combo.items(),
+                            key=lambda kv: tuple(
+                                (x != x, x) if isinstance(x, float) else (False, x)
+                                for x in kv[0])):
+        sub = take_rows(fr, np.asarray(rows, np.int64))
+        res = _eval_lambda(env, fun, [sub])
+        if _is_fr(res):
+            vals = [float(v) for v in np.asarray(res.to_numpy(),
+                                                 np.float64).ravel()]
+        elif isinstance(res, (list, tuple)):
+            vals = [float(v) for v in res]
+        else:
+            vals = [float(res)]
+        rows_out.append(list(map(float, key)) + vals)
+        width = max(width, len(vals))
+        sub.delete()
+    ncols = len(idx) + width
+    M = np.full((len(rows_out), ncols), np.nan)
+    for i, row in enumerate(rows_out):
+        M[i, : len(row)] = row
+    out = Frame()
+    for j, i in enumerate(idx):
+        out.add(fr.names[int(i)], Column.from_numpy(M[:, j]))
+    for j in range(width):
+        out.add(f"ddply_C{j + 1}", Column.from_numpy(M[:, len(idx) + j]))
+    return out
+
+
+@prim("apply")
+def _apply(env, fr, margin, fun):
+    """AstApply: margin 2 = per column, 1 = per row."""
+    m = int(_scalar(margin))
+    if m == 2:
+        results = []
+        for n in fr.names:
+            res = _eval_lambda(env, fun, [_colfr(fr.col(n), n)])
+            results.append(float(_scalar(res)) if not _is_fr(res)
+                           else float(np.asarray(res.to_numpy()).ravel()[0]))
+        out = Frame()
+        for n, v in zip(fr.names, results):
+            out.add(n, Column.from_numpy(np.asarray([v])))
+        return out
+    # margin 1: per-row — vectorize by evaluating the lambda on the whole
+    # frame when possible is unsafe in general; do an explicit row loop
+    M = _num_matrix(fr)
+    vals = np.empty(M.shape[0])
+    row_fr = Frame()
+    for j, n in enumerate(fr.names):
+        row_fr.add(n, Column.from_numpy(M[0:1, j]))
+    for i in range(M.shape[0]):
+        rf = Frame()
+        for j, n in enumerate(fr.names):
+            rf.add(n, Column.from_numpy(M[i: i + 1, j]))
+        res = _eval_lambda(env, fun, [rf])
+        vals[i] = (float(_scalar(res)) if not _is_fr(res)
+                   else float(np.asarray(res.to_numpy()).ravel()[0]))
+    return _colfr(Column.from_numpy(vals), "apply")
+
+
+@prim("rank_within_groupby")
+def _rank_within_group(env, fr, group_cols, sort_cols, ascending, new_col, sort_orders_for_grouped=0):
+    gidx = _idx_list(group_cols, fr.ncols)
+    sidx = _idx_list(sort_cols, fr.ncols)
+    asc = ([bool(_scalar(a)) for a in ascending]
+           if isinstance(ascending, (list, NumList)) else
+           [True] * len(sidx))
+    gkeys = [np.asarray(fr.col(int(i)).to_numpy()) for i in gidx]
+    skeys = [np.asarray(fr.col(int(i)).to_numpy(), np.float64) for i in sidx]
+    order_keys = []
+    for k, a in zip(reversed(skeys), reversed(asc + [True] * len(sidx))):
+        order_keys.append(k if a else -k)
+    order = np.lexsort(tuple(order_keys) + tuple(reversed(gkeys)))
+    rank = np.full(fr.nrows, np.nan)
+    prev_g = None
+    r = 0
+    for pos in order:
+        gk = tuple(k[pos] for k in gkeys)
+        if any(np.isnan(np.asarray(skeys)[:, pos])):
+            continue
+        if gk != prev_g:
+            prev_g = gk
+            r = 0
+        r += 1
+        rank[pos] = r
+    out = fr.subframe(fr.names)
+    out.add(_s(new_col).strip('"'), Column.from_numpy(rank))
+    return out

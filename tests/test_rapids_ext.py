@@ -1,0 +1,290 @@
+"""Extended Rapids prim suites (water/rapids/ast/prims/{advmath,time,string,
+search,mungers,matrix,repeaters,timeseries}) — evaluated through the same
+exec_rapids entry h2o-py's POST /99/Rapids reaches."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame
+from h2o3_tpu.rapids import Session, exec_rapids
+
+
+@pytest.fixture()
+def sess(cl):
+    s = Session("t")
+    yield s
+    s.end()
+
+
+@pytest.fixture()
+def fr(cl, sess):
+    rng = np.random.default_rng(0)
+    n = 200
+    f = Frame(key="ext.hex")
+    f.add("a", Column.from_numpy(rng.normal(size=n)))
+    f.add("b", Column.from_numpy(2.0 * np.arange(n, dtype=float)))
+    f.add("g", Column.from_numpy(
+        np.array(["x", "y", "z"])[np.arange(n) % 3], ctype="enum"))
+    f.install()
+    return f
+
+
+def _run(sess, expr):
+    return exec_rapids(expr, sess)
+
+
+def test_cor_matches_numpy(fr, sess):
+    out = _run(sess, '(cor ext.hex ext.hex "complete.obs" "pearson")')
+    a = np.asarray(fr.col("a").to_numpy())
+    b = np.asarray(fr.col("b").to_numpy())
+    want = np.corrcoef(a, b)[0, 1]
+    got = np.asarray(out.col("b").to_numpy())[0]
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_distance_euclidean(fr, sess):
+    sub = fr.subframe(["a", "b"], key="dist.hex")
+    sub.install()
+    out = _run(sess, '(distance dist.hex dist.hex "l2")')
+    D = np.column_stack([np.asarray(out.col(i).to_numpy())
+                         for i in range(min(out.ncols, 5))])
+    assert abs(float(D[0, 0])) < 1e-4          # self-distance 0
+
+
+def test_hist(fr, sess):
+    out = _run(sess, '(hist (cols_py ext.hex "a") 10)')
+    counts = np.asarray(out.col("counts").to_numpy())
+    assert counts.sum() == 200
+
+
+def test_skew_kurt_mode(fr, sess):
+    from scipy import stats
+
+    a = np.asarray(fr.col("a").to_numpy())
+    sk = _run(sess, '(skewness (cols_py ext.hex "a") True)')
+    np.testing.assert_allclose(sk, stats.skew(a, bias=False) /
+                               (1 if True else 1), atol=0.05)
+    mode = _run(sess, '(mode (cols_py ext.hex "g"))')
+    assert mode in (0.0, 1.0, 2.0)
+
+
+def test_kfold_columns(fr, sess):
+    out = _run(sess, "(kfold_column ext.hex 5 42)")
+    v = np.asarray(out.col(0).to_numpy())
+    assert set(np.unique(v)) <= set(range(5))
+    out2 = _run(sess, "(modulo_kfold_column ext.hex 4)")
+    v2 = np.asarray(out2.col(0).to_numpy())
+    assert (v2 == np.arange(200) % 4).all()
+    out3 = _run(sess, '(stratified_kfold_column (cols_py ext.hex "g") 3 7)')
+    assert out3.nrows == 200
+
+
+def test_matrix_ops(fr, sess):
+    sub = fr.subframe(["a", "b"], key="m.hex")
+    sub.install()
+    t = _run(sess, "(t m.hex)")
+    assert t.nrows == 2 and t.ncols == 200
+    mm = _run(sess, "(x (t m.hex) m.hex)")
+    assert mm.nrows == 2 and mm.ncols == 2
+    M = np.column_stack([np.asarray(sub.col(i).to_numpy()) for i in range(2)])
+    want = M.T @ M
+    got = np.column_stack([np.asarray(mm.col(i).to_numpy()) for i in range(2)])
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_repeaters(sess, cl):
+    s = _run(sess, "(seq 1 5 1)")
+    np.testing.assert_allclose(np.asarray(s.col(0).to_numpy()),
+                               [1, 2, 3, 4, 5])
+    sl = _run(sess, "(seq_len 4)")
+    np.testing.assert_allclose(np.asarray(sl.col(0).to_numpy()), [1, 2, 3, 4])
+    rl = _run(sess, "(rep_len 7 3)")
+    np.testing.assert_allclose(np.asarray(rl.col(0).to_numpy()), [7, 7, 7])
+
+
+def test_search(fr, sess):
+    w = _run(sess, '(which (> (cols_py ext.hex "a") 100))')
+    assert w.nrows == 0
+    m = _run(sess, '(match (cols_py ext.hex "g") ["y"] NaN _)')
+    v = np.asarray(m.col(0).to_numpy())
+    g = fr.col("g").values()
+    assert np.isfinite(v[g == "y"]).all() and (v[g == "y"] == 1).all()
+    assert np.isnan(v[g == "x"]).all()
+    wm = _run(sess, "(which.max ext.hex True 1)")
+    assert wm.nrows == 200
+
+
+def test_string_suite(sess, cl):
+    f = Frame(key="str.hex")
+    f.add("s", Column.from_numpy(np.array(["  Apple ", "banana", "Cherry"]),
+                                 ctype="enum"))
+    f.install()
+    lo = _run(sess, "(tolower str.hex)")
+    assert set(lo.col("s").values()) == {"  apple ", "banana", "cherry"}
+    tr = _run(sess, "(trim (tolower str.hex))")
+    assert set(tr.col("s").values()) == {"apple", "banana", "cherry"}
+    ln = _run(sess, "(strlen str.hex)")
+    assert sorted(np.asarray(ln.col(0).to_numpy()).tolist()) == [6.0, 6.0, 8.0]
+    sub = _run(sess, "(substring (tolower (trim str.hex)) 0 3)")
+    assert "app" in set(sub.col("s").values())
+    ent = _run(sess, "(entropy str.hex)")
+    assert (np.asarray(ent.col(0).to_numpy()) > 0).all()
+    cm = _run(sess, '(countmatches str.hex ["an"])')
+    v = np.asarray(cm.col(0).to_numpy())
+    assert v.max() == 2.0            # "banana" has 2 "an"
+    g = _run(sess, '(grep str.hex "an" 0 0 1)')
+    assert np.asarray(g.col(0).to_numpy()).sum() == 1.0
+    sp = _run(sess, '(strsplit str.hex "n")')
+    assert sp.ncols >= 2
+    d = _run(sess, '(strDistance str.hex str.hex "lev" 1)')
+    np.testing.assert_allclose(np.asarray(d.col(0).to_numpy()), 0.0)
+
+
+def test_time_suite(sess, cl):
+    import datetime as dt
+
+    ts = [dt.datetime(2020, 3, 15, 14, 30, 45, tzinfo=dt.timezone.utc),
+          dt.datetime(1999, 12, 31, 23, 59, 59, tzinfo=dt.timezone.utc)]
+    ms = np.asarray([int(t.timestamp() * 1000) for t in ts], np.int64)
+    f = Frame(key="time.hex")
+    f.add("t", Column.from_numpy(ms, ctype="time"))
+    f.install()
+    assert np.allclose(np.asarray(_run(sess, "(year time.hex)").col(0).to_numpy()),
+                       [2020, 1999])
+    assert np.allclose(np.asarray(_run(sess, "(month time.hex)").col(0).to_numpy()),
+                       [3, 12])
+    assert np.allclose(np.asarray(_run(sess, "(day time.hex)").col(0).to_numpy()),
+                       [15, 31])
+    assert np.allclose(np.asarray(_run(sess, "(hour time.hex)").col(0).to_numpy()),
+                       [14, 23])
+    assert np.allclose(np.asarray(_run(sess, "(minute time.hex)").col(0).to_numpy()),
+                       [30, 59])
+    assert np.allclose(np.asarray(_run(sess, "(second time.hex)").col(0).to_numpy()),
+                       [45, 59])
+    # 2020-03-15 is a Sunday → reference convention Monday=0 ⇒ 6
+    assert np.allclose(np.asarray(_run(sess, "(dayOfWeek time.hex)").col(0).to_numpy()),
+                       [6, 4])
+    mk = _run(sess, "(mktime 2020 2 14 14 30 45 0)")   # month/day 0-based
+    np.testing.assert_allclose(np.asarray(mk.col(0).to_numpy())[0], ms[0],
+                               atol=1.0)
+
+
+def test_timeseries_difflag(fr, sess):
+    d = _run(sess, '(difflag1 (cols_py ext.hex "b"))')
+    v = np.asarray(d.col(0).to_numpy())
+    assert np.isnan(v[0]) and np.allclose(v[1:], 2.0)
+
+
+def test_cut(fr, sess):
+    out = _run(sess, '(cut (cols_py ext.hex "b") [0 100 400] ["lo" "hi"] 1 1 3)')
+    c = out.col(0)
+    assert c.is_categorical
+    vals = c.values()
+    b = np.asarray(fr.col("b").to_numpy())
+    assert all(v == "lo" for v in vals[(b > 0) & (b <= 100)])
+
+
+def test_fillna(sess, cl):
+    x = np.array([1.0, np.nan, np.nan, 4.0, np.nan])
+    f = Frame(key="na.hex")
+    f.add("x", Column.from_numpy(x))
+    f.install()
+    out = _run(sess, '(h2o.fillna na.hex "forward" 0 1)')
+    v = np.asarray(out.col(0).to_numpy())
+    np.testing.assert_allclose(v[[0, 1, 3, 4]], [1, 1, 4, 4])
+    assert np.isnan(v[2])            # maxlen=1 stops the fill
+
+
+def test_melt_pivot_roundtrip(sess, cl):
+    f = Frame(key="mp.hex")
+    f.add("id", Column.from_numpy(np.array(["r1", "r2"]), ctype="enum"))
+    f.add("c1", Column.from_numpy(np.array([1.0, 2.0])))
+    f.add("c2", Column.from_numpy(np.array([3.0, 4.0])))
+    f.install()
+    m = _run(sess, '(melt mp.hex [0] [1 2] "variable" "value" 0)')
+    assert m.nrows == 4 and set(m.names) == {"id", "variable", "value"}
+    m.key_str = str(m.key)
+    m.install()
+    p = _run(sess, f'(pivot {m.key} "id" "variable" "value")')
+    assert p.nrows == 2
+    assert set(p.names) == {"id", "c1", "c2"}
+    got = {(r, c): np.asarray(p.col(c).to_numpy())[i]
+           for i, r in enumerate(p.col("id").values()) for c in ("c1", "c2")}
+    assert got[("r1", "c1")] == 1.0 and got[("r2", "c2")] == 4.0
+
+
+def test_ddply_and_apply(fr, sess):
+    out = _run(sess, '(ddply ext.hex [2] { x . (mean (cols_py x "b") True 0) })')
+    assert out.nrows == 3            # three g levels
+    ap = _run(sess, '(apply (cols_py ext.hex [0 1]) 2 { x . (sd x) })')
+    assert ap.nrows == 1 and ap.ncols == 2
+
+
+def test_rank_within_groupby(fr, sess):
+    out = _run(sess, '(rank_within_groupby ext.hex [2] [1] [1] "rnk" 0)')
+    rnk = np.asarray(out.col("rnk").to_numpy())
+    g = fr.col("g").values()
+    b = np.asarray(fr.col("b").to_numpy())
+    sel = rnk[g == "x"]
+    assert sel.min() == 1.0 and len(set(sel.tolist())) == len(sel)
+
+
+def test_misc_mungers(fr, sess):
+    assert _run(sess, "(any.factor ext.hex)") == 1.0
+    isf = _run(sess, "(is.factor ext.hex)")
+    assert isf == [0.0, 0.0, 1.0]
+    nlv = _run(sess, "(nlevels ext.hex)")
+    assert nlv == [0.0, 0.0, 3.0]
+    cbt = _run(sess, '(columnsByType ext.hex "numeric")')
+    assert cbt == [0.0, 1.0]
+    fl = _run(sess, "(flatten (rows (cols_py ext.hex [1]) [0]))")
+    assert fl == 0.0
+    sig = _run(sess, "(signif (cols_py ext.hex [1]) 1)")
+    v = np.asarray(sig.col(0).to_numpy())
+    assert v[7] == 10.0              # 14 -> 1 sig digit -> 10
+    na = _run(sess, "(any.na ext.hex)")
+    assert na == 0.0
+
+
+def test_dropdup(sess, cl):
+    f = Frame(key="dd.hex")
+    f.add("k", Column.from_numpy(np.array([1.0, 1.0, 2.0, 2.0, 3.0])))
+    f.add("v", Column.from_numpy(np.arange(5.0)))
+    f.install()
+    out = _run(sess, '(dropdup dd.hex [0] "first")')
+    np.testing.assert_allclose(np.asarray(out.col("v").to_numpy()), [0, 2, 4])
+
+
+def test_topn(fr, sess):
+    out = _run(sess, "(topn ext.hex 1 5 1)")
+    vals = np.asarray(out.col(1).to_numpy())
+    b = np.asarray(fr.col("b").to_numpy())
+    assert vals[0] == b.max()
+    assert len(vals) == 10           # 5% of 200
+
+
+def test_session_refcounts(fr, cl):
+    s = Session("rc")
+    exec_rapids("(tmp= rc1 (cols_py ext.hex [0]))", s)
+    exec_rapids("(tmp= rc2 (cols_py ext.hex [0]))", s)
+    col = fr.col("a")
+    assert s.column_refs(col) == 2
+    exec_rapids("(rm rc1)", s)
+    assert s.column_refs(col) == 1
+    s.end()
+    assert s.column_refs(col) == 0
+
+
+def test_unary_extensions(fr, sess):
+    out = _run(sess, "(asinh (cols_py ext.hex [0]))")
+    a = np.asarray(fr.col("a").to_numpy())
+    np.testing.assert_allclose(np.asarray(out.col(0).to_numpy()),
+                               np.arcsinh(a), atol=1e-5)
+    tg = _run(sess, "(trigamma (cols_py ext.hex [1]))")
+    from scipy.special import polygamma
+
+    b = np.asarray(fr.col("b").to_numpy())
+    want = polygamma(1, np.where(b > 0, b, np.nan))
+    got = np.asarray(tg.col(0).to_numpy())
+    # central-difference approximation in f32 (elementwise.py trigamma note)
+    np.testing.assert_allclose(got[2:10], want[2:10], rtol=1e-2)
