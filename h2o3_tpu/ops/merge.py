@@ -1,49 +1,193 @@
-"""Frame joins.
+"""Frame joins — device sort-merge with searchsorted matching.
 
-Reference: distributed radix-order + BinaryMerge
-(water/rapids/BinaryMerge.java, Merge.java).
+Reference: distributed MSB radix order + merge-join
+(water/rapids/RadixOrder.java:20, BinaryMerge.java, Merge.java).
 
-Round-1 design: join keys are categorical codes or numerics — equality joins
-are executed host-side with a hash join over key tuples (keys are typically
-low-cardinality relative to rows), then both sides are gathered on device via
-the shared permutation path. A device merge path (sort + searchsorted) is the
-planned upgrade for billion-row joins."""
+TPU-native design: instead of radix buckets + per-node binary merges, join
+keys from BOTH frames are jointly DENSE-RANKED on device (per key column: a
+sort + searchsorted gives order-preserving int32 ranks; multi-column keys
+fold rank-by-rank via stable lexicographic order + group-change cumsum, so
+the composite stays < Nl+Nr with x64 disabled). Matching is then one
+sorted-side `searchsorted` per side:
+  lo/hi bounds per left row -> match counts -> prefix-sum offsets ->
+  the (l_idx, r_idx) pair list is materialized with a second device pass
+  (searchsorted over the offsets). One host sync reads the total match
+  count (XLA needs the static output size); everything else stays on
+  device. Inner/left/right/full joins come from appending the unmatched
+  rows of either side with a -1 partner index (NA-filled at gather).
+
+Categorical keys are joined on a shared union domain (host LUT remap of the
+codes — domains are metadata, never device data); string keys fall back to
+the host hash join.
+"""
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+import functools
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from h2o3_tpu.core.frame import Column, Frame, T_CAT
 from h2o3_tpu.ops.filters import take_rows
 
+def _key_arrays(left: Frame, right: Frame, bx: Sequence[str],
+                by: Sequence[str]):
+    """Per key column: (left f32-exact array, right array) with NAs as NaN
+    and categorical codes remapped onto a shared union domain."""
+    pairs = []
+    for ln, rn in zip(bx, by):
+        lc, rc = left.col(ln), right.col(rn)
+        if lc.is_string or rc.is_string:
+            return None                          # host fallback
+        if lc.is_categorical or rc.is_categorical:
+            if not (lc.is_categorical and rc.is_categorical):
+                return None
+            ld = list(lc.domain or [])
+            rd = list(rc.domain or [])
+            pos = {v: i for i, v in enumerate(ld)}
+            nxt = len(ld)
+            rmap_l = []
+            for v in rd:                         # O(|ld|+|rd|) union
+                if v not in pos:
+                    pos[v] = nxt
+                    nxt += 1
+                rmap_l.append(pos[v])
+            lmap = np.arange(max(len(ld), 1), dtype=np.float64)
+            rmap = np.asarray(rmap_l or [0], np.float64)
+            lcodes = np.asarray(lc.to_numpy())
+            rcodes = np.asarray(rc.to_numpy())
+            la = np.where(lcodes >= 0, lmap[np.maximum(lcodes, 0)], np.nan)
+            ra = np.where(rcodes >= 0, rmap[np.maximum(rcodes, 0)], np.nan)
+        else:
+            la = np.asarray(lc.to_numpy(), np.float64)
+            ra = np.asarray(rc.to_numpy(), np.float64)
+        pairs.append((la, ra))
+    return pairs
 
-def _key_tuples(frame: Frame, names: Sequence[str]) -> np.ndarray:
+
+@functools.lru_cache(maxsize=32)
+def _rank_fn(nl: int, nr: int, k: int):
+    """Joint dense-rank of key tuples across both frames, int32 end to end
+    (x64 stays disabled): per column a sort+searchsorted rank, multi-column
+    folds via stable lexicographic order + group-change cumsum."""
+    import jax
+    import jax.numpy as jnp
+
+    n = nl + nr
+
+    def dense_rank(v):
+        v = jnp.where(jnp.isnan(v), jnp.inf, v)
+        return jnp.searchsorted(jnp.sort(v), v, side="left").astype(jnp.int32)
+
+    def fold(r1, r2):
+        # lexicographic stable order by (r1, r2), then dense group ids
+        o = jnp.argsort(r2, stable=True)
+        o = o[jnp.argsort(r1[o], stable=True)]
+        r1s, r2s = r1[o], r2[o]
+        changed = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32),
+             ((r1s[1:] != r1s[:-1]) | (r2s[1:] != r2s[:-1])).astype(jnp.int32)])
+        grp = jnp.cumsum(changed)
+        return jnp.zeros(n, jnp.int32).at[o].set(grp)
+
+    def run(*cols):
+        combined = None
+        na = jnp.zeros(n, bool)
+        for j in range(k):
+            v = jnp.concatenate([cols[2 * j], cols[2 * j + 1]]).astype(
+                jnp.float32)
+            na = na | jnp.isnan(v)
+            rank = dense_rank(v)
+            combined = rank if combined is None else fold(combined, rank)
+        # NA keys never match: distinct sentinel ranks per side
+        lk = jnp.where(na[:nl], n + 1, combined[:nl])
+        rk = jnp.where(na[nl:], n + 3, combined[nl:])
+        # right side sorted once; bounds per left row
+        order_r = jnp.argsort(rk)
+        rs = rk[order_r]
+        lo = jnp.searchsorted(rs, lk, side="left")
+        hi = jnp.searchsorted(rs, lk, side="right")
+        cnt = (hi - lo).astype(jnp.int32)
+        # which right rows found a partner (for right/full joins)
+        ls = jnp.sort(lk)
+        r_lo = jnp.searchsorted(ls, rk, side="left")
+        r_hi = jnp.searchsorted(ls, rk, side="right")
+        r_matched = (r_hi - r_lo) > 0
+        return lo, cnt, order_r, r_matched
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=32)
+def _emit_fn(total: int):
+    """Materialize (l_idx, r_pos_in_sorted) for the `total` matched pairs."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(lo, cnt, order_r):
+        offsets = jnp.cumsum(cnt)
+        pos = jnp.arange(total, dtype=jnp.int32)
+        src = jnp.searchsorted(offsets, pos, side="right").astype(jnp.int32)
+        base = offsets[src] - cnt[src]
+        within = pos - base
+        r_idx = order_r[lo[src] + within]
+        return src, r_idx.astype(jnp.int32)
+
+    return jax.jit(run)
+
+
+def _device_pairs(pairs, nl: int, nr: int, all_x: bool, all_y: bool):
     cols = []
-    for n in names:
-        c = frame.col(n)
-        v = c.values() if c.is_categorical or c.is_string else c.to_numpy()
-        cols.append(np.asarray(v, dtype=object))
-    return np.array(list(zip(*cols)), dtype=object) if cols else np.empty((0,))
+    for la, ra in pairs:
+        cols.append(la)
+        cols.append(ra)
+    lo, cnt, order_r, r_matched = _rank_fn(nl, nr, len(pairs))(*cols)
+    cnt_np = np.asarray(cnt)
+    total = int(cnt_np.sum())             # the one host sync (static size)
+    if total:
+        l_idx, r_idx = (np.asarray(a) for a in
+                        _emit_fn(total)(lo, cnt, order_r))
+    else:
+        l_idx = np.zeros(0, np.int64)
+        r_idx = np.zeros(0, np.int64)
+    parts_l = [l_idx.astype(np.int64)]
+    parts_r = [r_idx.astype(np.int64)]
+    if all_x:
+        miss = np.nonzero(cnt_np == 0)[0]
+        parts_l.append(miss.astype(np.int64))
+        parts_r.append(np.full(len(miss), -1, np.int64))
+    if all_y:
+        missr = np.nonzero(~np.asarray(r_matched))[0]
+        parts_l.append(np.full(len(missr), -1, np.int64))
+        parts_r.append(missr.astype(np.int64))
+    return np.concatenate(parts_l), np.concatenate(parts_r)
 
 
-def merge(left: Frame, right: Frame, all_x=False, all_y=False,
-          by_x: Optional[Sequence[str]] = None, by_y: Optional[Sequence[str]] = None) -> Frame:
-    common = [n for n in left.names if n in right.names]
-    bx = list(by_x) if by_x else common
-    by = list(by_y) if by_y else common
-    if not bx:
-        raise ValueError("no join columns")
-    lk = _key_tuples(left, bx)
-    rk = _key_tuples(right, by)
-    rindex = {}
-    for i, k in enumerate(map(tuple, rk)):
-        rindex.setdefault(k, []).append(i)
+def _host_pairs(left: Frame, right: Frame, bx, by, all_x, all_y):
+    """Hash join over host key tuples — string keys / mixed types. NA keys
+    (None or NaN components) match NOTHING, like the device path."""
+    def tuples(frame, names):
+        cols = []
+        for n in names:
+            c = frame.col(n)
+            v = c.values() if c.is_categorical or c.is_string else c.to_numpy()
+            cols.append(np.asarray(v, dtype=object))
+        return list(zip(*cols)) if cols else []
+
+    def has_na(kk):
+        return any(v is None or (isinstance(v, float) and v != v) for v in kk)
+
+    lk = tuples(left, bx)
+    rk = tuples(right, by)
+    rindex: dict = {}
+    for i, kk in enumerate(rk):
+        if not has_na(kk):
+            rindex.setdefault(kk, []).append(i)
     lrows, rrows = [], []
     matched_r = set()
-    for i, k in enumerate(map(tuple, lk)):
-        hits = rindex.get(k)
+    for i, kk in enumerate(lk):
+        hits = None if has_na(kk) else rindex.get(kk)
         if hits:
             for j in hits:
                 lrows.append(i)
@@ -53,13 +197,28 @@ def merge(left: Frame, right: Frame, all_x=False, all_y=False,
             lrows.append(i)
             rrows.append(-1)
     if all_y:
-        for k, js in rindex.items():
-            for j in js:
-                if j not in matched_r:
-                    lrows.append(-1)
-                    rrows.append(j)
-    lrows = np.asarray(lrows, np.int64)
-    rrows = np.asarray(rrows, np.int64)
+        for j in range(len(rk)):          # NA-keyed right rows included
+            if j not in matched_r:
+                lrows.append(-1)
+                rrows.append(j)
+    return np.asarray(lrows, np.int64), np.asarray(rrows, np.int64)
+
+
+def merge(left: Frame, right: Frame, all_x=False, all_y=False,
+          by_x: Optional[Sequence[str]] = None,
+          by_y: Optional[Sequence[str]] = None) -> Frame:
+    common = [n for n in left.names if n in right.names]
+    bx = list(by_x) if by_x else common
+    by = list(by_y) if by_y else common
+    if not bx:
+        raise ValueError("no join columns")
+
+    pairs = _key_arrays(left, right, bx, by)
+    if pairs is not None:
+        lrows, rrows = _device_pairs(pairs, left.nrows, right.nrows,
+                                     all_x, all_y)
+    else:
+        lrows, rrows = _host_pairs(left, right, bx, by, all_x, all_y)
 
     lpart = take_rows(left, np.maximum(lrows, 0))
     rpart = take_rows(right, np.maximum(rrows, 0))
@@ -67,7 +226,12 @@ def merge(left: Frame, right: Frame, all_x=False, all_y=False,
     for n in left.names:
         col = lpart.col(n)
         if (lrows < 0).any():
-            col = _mask_rows(col, lrows < 0)
+            if n in bx and (rrows >= 0).any():
+                # key columns of right-only rows come from the right side
+                col = _patch_keys(col, right.col(by[bx.index(n)]),
+                                  lrows, rrows)
+            else:
+                col = _mask_rows(col, lrows < 0)
         out.add(n, col)
     for n in right.names:
         if n in by:
@@ -80,8 +244,35 @@ def merge(left: Frame, right: Frame, all_x=False, all_y=False,
     return out
 
 
+def _patch_keys(lcol: Column, rcol: Column, lrows: np.ndarray,
+                rrows: np.ndarray) -> Column:
+    """Full/right joins: key values for right-only rows (lrow == -1)."""
+    def host_vals(c: Column) -> np.ndarray:
+        if c.is_string:
+            return np.asarray([None if v is None else str(v)
+                               for v in c.host_data[: c.nrows]], object)
+        return np.asarray(c.values(), object)
+
+    lv = host_vals(lcol)          # already gathered to output length
+    rv = host_vals(rcol)
+    vals = lv.copy()
+    fill = lrows < 0
+    vals[fill] = rv[np.maximum(rrows[fill], 0)]
+    if lcol.is_categorical:
+        return Column.from_numpy(vals, ctype=T_CAT)
+    if lcol.is_string:
+        return Column._from_strings(vals)
+    return Column.from_numpy(np.asarray(
+        [np.nan if v is None else float(v) for v in vals], np.float64))
+
+
 def _mask_rows(col: Column, na_mask: np.ndarray) -> Column:
-    vals = col.to_numpy().astype(np.float64) if not col.is_categorical else col.to_numpy().astype(np.float64)
+    if col.is_string:
+        vals = np.asarray([None if v is None else str(v)
+                           for v in col.host_data[: col.nrows]], object)
+        vals[na_mask] = None
+        return Column._from_strings(vals)
+    vals = col.to_numpy().astype(np.float64)
     vals[na_mask] = np.nan
     if col.is_categorical:
         codes = np.where(np.isnan(vals), -1, vals).astype(np.int32)

@@ -223,9 +223,26 @@ def _colnames(env, fr, cols, names):
 def _sort(env, fr, by, *asc):
     from h2o3_tpu.ops.sort import sort_frame
 
-    idx = _idx_list(by, fr.ncols)
-    ascending = [bool(a) for a in _idx_list(asc[0], len(idx))] if asc else True
-    return sort_frame(fr, [fr.names[i] for i in idx], ascending=ascending)
+    def names_of(sel):
+        if isinstance(sel, str):
+            return [sel]
+        if isinstance(sel, StrLit):
+            return [sel.s]
+        if isinstance(sel, (int, float)):          # bare column index
+            return [fr.names[int(sel)]]
+        items = list(sel)
+        if items and isinstance(items[0], (str, StrLit)):
+            return [s.s if isinstance(s, StrLit) else s for s in items]
+        return [fr.names[i] for i in _idx_list(sel, fr.ncols)]
+
+    names = names_of(by)
+    if asc:
+        # h2o-py encodes direction as 1 (asc) / -1 (desc); 0 also = desc
+        ascending = [int(_scalar(a)) > 0 for a in
+                     (asc[0] if isinstance(asc[0], (list, NumList)) else [asc[0]])]
+    else:
+        ascending = True
+    return sort_frame(fr, names, ascending=ascending)
 
 
 @prim("merge")

@@ -1,0 +1,186 @@
+"""Device sort-merge join + shard-aware sample sort vs pandas ground truth.
+
+Reference: water/rapids/RadixOrder.java:20 (MSB radix + splitters),
+BinaryMerge.java (sorted-side matching). VERDICT r2 task #7 acceptance:
+a large inner join on the 8-device mesh, correctness vs pandas.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame
+from h2o3_tpu.ops.merge import merge
+
+
+def _to_pd(fr):
+    return fr.to_pandas()
+
+
+def _cmp_join(lfr, rfr, ldf, rdf, on, how, **kw):
+    got = _to_pd(merge(lfr, rfr, **kw)).sort_values(
+        on + [c for c in ldf.columns if c not in on])[
+        lambda d: sorted(d.columns)].reset_index(drop=True)
+    want = ldf.merge(rdf, on=on, how=how).sort_values(
+        on + [c for c in ldf.columns if c not in on])[
+        lambda d: sorted(d.columns)].reset_index(drop=True)
+    assert len(got) == len(want), (len(got), len(want))
+    for c in want.columns:
+        g = got[c].to_numpy()
+        w = want[c].to_numpy()
+        if w.dtype.kind in "fc":
+            np.testing.assert_allclose(
+                np.sort(g.astype(float)), np.sort(w.astype(float)),
+                atol=1e-5, equal_nan=True)
+        else:
+            assert sorted(map(str, g.tolist())) == sorted(map(str, w.tolist()))
+
+
+@pytest.fixture()
+def joinset(cl):
+    rng = np.random.default_rng(3)
+    nl, nr = 700, 500
+    lk = rng.integers(0, 200, nl).astype(float)
+    rk = rng.integers(0, 200, nr).astype(float)
+    # one-sided NA key only: pandas merges NaN==NaN, H2O does not — the
+    # H2O no-NA-match semantics get their own test below
+    lk[5] = np.nan
+    lfr = Frame()
+    lfr.add("k", Column.from_numpy(lk))
+    lfr.add("lv", Column.from_numpy(rng.normal(size=nl)))
+    rfr = Frame()
+    rfr.add("k", Column.from_numpy(rk))
+    rfr.add("rv", Column.from_numpy(rng.normal(size=nr)))
+    ldf = pd.DataFrame({"k": lk, "lv": np.asarray(lfr.col("lv").to_numpy(),
+                                                  float)})
+    rdf = pd.DataFrame({"k": rk, "rv": np.asarray(rfr.col("rv").to_numpy(),
+                                                  float)})
+    return lfr, rfr, ldf, rdf
+
+
+def test_inner_join(joinset):
+    lfr, rfr, ldf, rdf = joinset
+    _cmp_join(lfr, rfr, ldf, rdf, ["k"], "inner")
+
+
+def test_left_join(joinset):
+    lfr, rfr, ldf, rdf = joinset
+    _cmp_join(lfr, rfr, ldf, rdf, ["k"], "left", all_x=True)
+
+
+def test_right_join(joinset):
+    lfr, rfr, ldf, rdf = joinset
+    _cmp_join(lfr, rfr, ldf, rdf, ["k"], "right", all_y=True)
+
+
+def test_full_join(joinset):
+    lfr, rfr, ldf, rdf = joinset
+    _cmp_join(lfr, rfr, ldf, rdf, ["k"], "outer", all_x=True, all_y=True)
+
+
+def test_na_keys_never_match(cl):
+    """H2O semantics (BinaryMerge): NA join keys match NOTHING — including
+    the other side's NAs (pandas differs: it merges NaN with NaN)."""
+    lfr = Frame()
+    lfr.add("k", Column.from_numpy(np.array([1.0, np.nan])))
+    lfr.add("lv", Column.from_numpy(np.array([10.0, 20.0])))
+    rfr = Frame()
+    rfr.add("k", Column.from_numpy(np.array([np.nan, 1.0])))
+    rfr.add("rv", Column.from_numpy(np.array([7.0, 8.0])))
+    inner = merge(lfr, rfr)
+    assert inner.nrows == 1
+    assert float(np.asarray(inner.col("rv").to_numpy())[0]) == 8.0
+    full = merge(lfr, rfr, all_x=True, all_y=True)
+    assert full.nrows == 3               # match + left-NA row + right-NA row
+
+
+def test_multikey_join(cl):
+    rng = np.random.default_rng(5)
+    nl, nr = 400, 300
+    l1 = rng.integers(0, 12, nl).astype(float)
+    l2 = rng.integers(0, 9, nl).astype(float)
+    r1 = rng.integers(0, 12, nr).astype(float)
+    r2 = rng.integers(0, 9, nr).astype(float)
+    lfr = Frame()
+    lfr.add("a", Column.from_numpy(l1))
+    lfr.add("b", Column.from_numpy(l2))
+    lfr.add("lv", Column.from_numpy(np.arange(nl, dtype=float)))
+    rfr = Frame()
+    rfr.add("a", Column.from_numpy(r1))
+    rfr.add("b", Column.from_numpy(r2))
+    rfr.add("rv", Column.from_numpy(np.arange(nr, dtype=float)))
+    ldf = pd.DataFrame({"a": l1, "b": l2, "lv": np.arange(nl, dtype=float)})
+    rdf = pd.DataFrame({"a": r1, "b": r2, "rv": np.arange(nr, dtype=float)})
+    _cmp_join(lfr, rfr, ldf, rdf, ["a", "b"], "inner")
+
+
+def test_categorical_key_join_disjoint_domains(cl):
+    """Domains interned in different orders on the two sides must still join
+    by LABEL (union-domain remap)."""
+    lfr = Frame()
+    lfr.add("g", Column.from_numpy(np.array(["a", "b", "c", "a"]), ctype="enum"))
+    lfr.add("lv", Column.from_numpy(np.arange(4.0)))
+    rfr = Frame()
+    rfr.add("g", Column.from_numpy(np.array(["c", "d", "a"]), ctype="enum"))
+    rfr.add("rv", Column.from_numpy(np.array([10.0, 20.0, 30.0])))
+    out = _to_pd(merge(lfr, rfr))
+    got = sorted(zip(out["g"], out["rv"]))
+    assert got == [("a", 30.0), ("a", 30.0), ("c", 10.0)]
+
+
+def test_large_mesh_join_vs_pandas(cl):
+    """The VERDICT acceptance shape (scaled to CI budget): a large inner
+    join on the 8-device mesh, exact row-count and aggregate parity."""
+    rng = np.random.default_rng(11)
+    n = 200_000
+    lk = rng.integers(0, 50_000, n).astype(float)
+    rk = rng.integers(0, 50_000, n).astype(float)
+    lfr = Frame()
+    lfr.add("k", Column.from_numpy(lk))
+    lfr.add("lv", Column.from_numpy(np.ones(n)))
+    rfr = Frame()
+    rfr.add("k", Column.from_numpy(rk))
+    rfr.add("rv", Column.from_numpy(np.full(n, 2.0)))
+    out = merge(lfr, rfr)
+    want = pd.DataFrame({"k": lk}).merge(pd.DataFrame({"k": rk}), on="k")
+    assert out.nrows == len(want)
+    s = float(np.asarray(out.col("rv").to_numpy()).sum())
+    assert s == 2.0 * len(want)
+
+
+def test_sample_sort_matches_numpy(cl):
+    from h2o3_tpu.ops.sort import sample_sort_order
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from h2o3_tpu.core.runtime import cluster
+
+    cl_ = cluster()
+    rng = np.random.default_rng(0)
+    n = 64_000
+    x = rng.normal(size=n).astype(np.float32)
+    x[::97] = np.nan                     # NAs sort last
+    key = jax.device_put(jnp.asarray(x), NamedSharding(cl_.mesh, P("rows")))
+    order = sample_sort_order(key, n)
+    assert len(order) == n and len(set(order.tolist())) == n
+    got = x[order]
+    finite = got[~np.isnan(got)]
+    assert (np.diff(finite) >= 0).all()
+    assert np.isnan(got[len(finite):]).all()
+
+
+def test_sort_frame_sample_path(cl, monkeypatch):
+    import h2o3_tpu.ops.sort as S
+
+    monkeypatch.setattr(S, "SAMPLE_SORT_MIN_ROWS", 1000)
+    rng = np.random.default_rng(2)
+    n = 30_000
+    fr = Frame()
+    fr.add("k", Column.from_numpy(rng.normal(size=n)))
+    fr.add("v", Column.from_numpy(np.arange(n, dtype=float)))
+    out = S.sort_frame(fr, "k")
+    k = np.asarray(out.col("k").to_numpy())
+    assert (np.diff(k) >= 0).all()
+    # permutation integrity: every original row appears once
+    v = np.asarray(out.col("v").to_numpy())
+    assert len(set(v.astype(int).tolist())) == n
