@@ -84,6 +84,7 @@ class DeepLearningModel(Model):
         self.activation: str = "rectifier"
         self.nclasses: int = 1
         self.autoencoder: bool = False
+        self.epochs_trained: int = 0
 
     def _forward_frame(self, frame: Frame):
         import jax
@@ -149,6 +150,7 @@ class DeepLearningModel(Model):
 class DeepLearning(ModelBuilder):
     algo_name = "deeplearning"
     model_class = DeepLearningModel
+    supports_checkpoint = True
 
     @classmethod
     def default_params(cls):
@@ -189,11 +191,34 @@ class DeepLearning(ModelBuilder):
         p = self.params
         autoencoder = bool(p.get("autoencoder"))
         resp = p.get("response_column") if not autoencoder else None
-        di = DataInfo(train, response=resp,
-                      ignored=p.get("ignored_columns") or (),
-                      weights=p.get("weights_column"),
-                      standardize=bool(p.get("standardize", True)),
-                      use_all_factor_levels=bool(p.get("use_all_factor_levels", True)))
+        # training continuation (hex/Model.java:365; DL keeps the whole
+        # weight state in the model, so resume = start from its params_tree
+        # and its DataInfo — the standardization stats must be the ORIGINAL
+        # run's, or the resumed weights see shifted inputs)
+        prev = self._resolve_checkpoint()
+        if prev is not None:
+            if prev.params_tree is None:
+                raise ValueError("checkpoint model has no weights to continue")
+            # the resumed weights are only meaningful against the ORIGINAL
+            # expanded layout: predictor names and categorical domains must
+            # match (same guard SharedTree._fit applies)
+            skip = {resp, p.get("weights_column"), p.get("offset_column"),
+                    p.get("fold_column")} | set(p.get("ignored_columns") or [])
+            names = [c for c in train.names
+                     if c not in skip and not train.col(c).is_string]
+            doms = {c: list(train.col(c).domain) for c in names
+                    if train.col(c).is_categorical}
+            if names != prev._output.names or doms != prev._output.domains:
+                raise ValueError(
+                    "checkpoint: training frame columns/domains differ from "
+                    f"the original run ({prev._output.names} vs {names})")
+            di = prev.data_info
+        else:
+            di = DataInfo(train, response=resp,
+                          ignored=p.get("ignored_columns") or (),
+                          weights=p.get("weights_column"),
+                          standardize=bool(p.get("standardize", True)),
+                          use_all_factor_levels=bool(p.get("use_all_factor_levels", True)))
         n = train.nrows
         arrays = tuple(c.data for c in di.cols(train))
         activation = (p.get("activation") or "Rectifier").lower()
@@ -224,9 +249,16 @@ class DeepLearning(ModelBuilder):
             y = jnp.zeros(padded, jnp.float32)
 
         out_dim = di.fullN if autoencoder else (nclasses if nclasses > 1 else 1)
-        params0 = _init_params(di.fullN, hidden, out_dim, seed,
-                               p.get("initial_weight_distribution", "UniformAdaptive"),
-                               float(p.get("initial_weight_scale", 1.0)))
+        if prev is not None:
+            params0 = prev.params_tree
+            if params0[-1][0].shape[1] != out_dim:
+                raise ValueError(
+                    "checkpoint: response cardinality changed "
+                    f"({params0[-1][0].shape[1]} vs {out_dim})")
+        else:
+            params0 = _init_params(di.fullN, hidden, out_dim, seed,
+                                   p.get("initial_weight_distribution", "UniformAdaptive"),
+                                   float(p.get("initial_weight_scale", 1.0)))
 
         loss_name = (p.get("loss") or "Automatic").lower()
         if loss_name == "automatic":
@@ -245,6 +277,14 @@ class DeepLearning(ModelBuilder):
         epochs = float(p.get("epochs", 10.0))
         steps_per_epoch = max(int(math.ceil(n / batch)), 1)
         n_epochs = max(int(math.ceil(epochs)), 1)
+        ep_start = 0
+        if prev is not None:
+            # epochs is the TOTAL target and must exceed the checkpoint's
+            ep_start = int(getattr(prev, "epochs_trained", 0) or 0)
+            if n_epochs <= ep_start:
+                raise ValueError(
+                    f"checkpoint model already trained {ep_start} epochs; "
+                    f"epochs ({n_epochs}) must be greater")
 
         if p.get("adaptive_rate", True):
             opt = optax.adadelta(learning_rate=1.0, rho=float(p.get("rho", 0.99)),
@@ -323,8 +363,10 @@ class DeepLearning(ModelBuilder):
         stop_rounds = int(p.get("stopping_rounds", 0) or 0)
         tol = float(p.get("stopping_tolerance", 1e-3))
         history: List[float] = []
-        for ep in range(n_epochs):
+        ep_done = ep_start
+        for ep in range(ep_start, n_epochs):
             params_t, opt_state, key = run_epoch(params_t, opt_state, key)
+            ep_done = ep + 1
             tr_loss = float(loss_fn(params_t, X, y, row_w, None))
             model._output.scoring_history.append(
                 {"epoch": ep + 1, "training_loss": tr_loss})
@@ -338,6 +380,7 @@ class DeepLearning(ModelBuilder):
                 if best_recent > best_before * (1.0 - tol):
                     break
 
+        model.epochs_trained = ep_done
         model.params_tree = jax.tree.map(np.asarray, params_t)
         model.params_tree = [(jnp.asarray(W), jnp.asarray(b))
                              for W, b in model.params_tree]

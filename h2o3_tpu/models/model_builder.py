@@ -79,6 +79,11 @@ class ModelBuilder:
             "validation_frame": None,
             "training_frame": None,
             "categorical_encoding": "AUTO",
+            # training continuation (hex/Model.java:365 _checkpoint; param
+            # compatibility rules in hex/util/CheckpointUtils.java) and
+            # automatic model export (hex/Model.java:387 _export_checkpoints_dir)
+            "checkpoint": None,
+            "export_checkpoints_dir": None,
         }
 
     def _seed(self) -> int:
@@ -133,9 +138,22 @@ class ModelBuilder:
         return model
 
     # -- orchestration ----------------------------------------------------
+    # builders that implement training continuation set this True; everyone
+    # else must REJECT the param rather than silently train from scratch
+    supports_checkpoint = False
+
     def _train_impl(self, train: Frame, valid: Optional[Frame]) -> Model:
         nfolds = int(self.params.get("nfolds") or 0)
         fold_col = self.params.get("fold_column")
+        if self.params.get("checkpoint"):
+            if not self.supports_checkpoint:
+                raise ValueError(
+                    f"{self.algo_name} does not support checkpoint continuation")
+            # must fire BEFORE CV: fold models resuming from a full-data
+            # checkpoint would leak every holdout into training
+            if nfolds > 1 or fold_col:
+                raise ValueError(
+                    "checkpoint cannot be combined with cross-validation")
         cv_models: List[Model] = []
         cv_metrics: List = []
         cv_preds = None
@@ -166,7 +184,57 @@ class ModelBuilder:
         # frame / full-N device buffers after the model is done
         self._train_frame_ref = None
         self._oob_raw = None
+        ed = self.params.get("export_checkpoints_dir")
+        if ed:
+            # hex/Model.java:387 exportBinaryModel into _export_checkpoints_dir
+            # when training completes (AutoML uses this to retain every model)
+            import os
+
+            os.makedirs(ed, exist_ok=True)
+            model.save(os.path.join(ed, f"{model.key}.bin"))
         return model
+
+    # -- checkpoint (training continuation) -------------------------------
+    # params a continuation may change (hex/util/CheckpointUtils.java keeps a
+    # whitelist per algo; this is the union that matters here)
+    _CHECKPOINT_MODIFIABLE = frozenset({
+        "checkpoint", "model_id", "training_frame", "validation_frame",
+        "ntrees", "epochs", "max_runtime_secs", "seed",
+        "stopping_rounds", "stopping_metric", "stopping_tolerance",
+        "score_each_iteration", "score_tree_interval",
+        "export_checkpoints_dir", "keep_cross_validation_models",
+        "keep_cross_validation_predictions",
+    })
+
+    def _resolve_checkpoint(self) -> Optional[Model]:
+        """Fetch + validate the checkpoint model named by params['checkpoint'].
+        Non-modifiable params must match the original run (CheckpointUtils
+        analog); CV and checkpointing are mutually exclusive as in the
+        reference."""
+        ck = self.params.get("checkpoint")
+        if not ck:
+            return None
+        if int(self.params.get("nfolds") or 0) > 1 or self.params.get("fold_column"):
+            raise ValueError("checkpoint cannot be combined with cross-validation")
+        prev = ck if isinstance(ck, Model) else DKV.get(str(ck))
+        if prev is None:
+            raise ValueError(f"checkpoint model {ck!r} not found")
+        if prev.algo_name != self.algo_name:
+            raise ValueError(
+                f"checkpoint model is a {prev.algo_name}, not a {self.algo_name}")
+        for k, v in self.params.items():
+            if k in self._CHECKPOINT_MODIFIABLE or k not in prev._parms:
+                continue
+            pv = prev._parms[k]
+            if isinstance(pv, (list, tuple)) or isinstance(v, (list, tuple)):
+                same = list(pv or []) == list(v or [])
+            else:
+                same = pv == v
+            if not same:
+                raise ValueError(
+                    f"checkpoint: parameter {k!r} cannot be modified "
+                    f"(was {pv!r}, now {v!r})")
+        return prev
 
     def _cross_validate(self, train: Frame, nfolds: int, fold_col: Optional[str]):
         """hex/ModelBuilder CV: assign folds, train N fold models on
@@ -215,7 +283,8 @@ class ModelBuilder:
             ho = take_rows(train, ho_idx)
             sub = type(self)(**{k: v for k, v in self.params.items()
                                 if k not in ("nfolds", "fold_column", "training_frame",
-                                             "validation_frame", "model_id")})
+                                             "validation_frame", "model_id",
+                                             "checkpoint", "export_checkpoints_dir")})
             m = sub._fit(tr)
             # one predict pass serves both the fold metrics and the stacked
             # holdout predictions (review: avoid scoring each holdout twice)

@@ -95,6 +95,56 @@ class CompressedForest:
                                 max_depth=max_depth, init_f=init_f,
                                 nclasses=nclasses)
 
+    @staticmethod
+    def concat(a: "CompressedForest", b: "CompressedForest", *,
+               scale_a: float = 1.0, scale_b: float = 1.0
+               ) -> "CompressedForest":
+        """Append forest b's trees after forest a's (training continuation,
+        hex/Model.java:365 _checkpoint). Node tables are padded to the wider
+        forest; b's cat-subset rows are appended with their split indices
+        shifted. scale_a/scale_b rescale leaf values (DRF resume: leaves are
+        stored pre-divided by tree count, so both sides rescale to
+        n_side/n_total)."""
+        assert a.nclasses == b.nclasses, (a.nclasses, b.nclasses)
+        M = max(a.feat.shape[1], b.feat.shape[1])
+
+        def pad(x, fill):
+            T, m = x.shape
+            if m == M:
+                return np.asarray(x)
+            out = np.full((T, M), fill, np.asarray(x).dtype)
+            out[:, :m] = x
+            return out
+
+        maxB = max(a.cat_table.shape[1], b.cat_table.shape[1])
+
+        def padB(t):
+            if t.shape[1] == maxB:
+                return np.asarray(t)
+            out = np.zeros((t.shape[0], maxB), bool)
+            out[:, : t.shape[1]] = t
+            return out
+
+        b_cs = pad(b.cat_split, -1).copy()
+        b_cs[b_cs >= 0] += a.cat_table.shape[0]
+        cat = lambda fa, fb: np.concatenate([fa, fb], axis=0)  # noqa: E731
+        out = CompressedForest(
+            cat(pad(a.feat, -1), pad(b.feat, -1)),
+            cat(pad(a.thresh_bin, 0), pad(b.thresh_bin, 0)),
+            cat(pad(a.na_left, False), pad(b.na_left, False)),
+            cat(pad(a.left, 0), pad(b.left, 0)),
+            cat(pad(a.right, 0), pad(b.right, 0)),
+            cat(pad(a.leaf_val, 0).astype(np.float32) * np.float32(scale_a),
+                pad(b.leaf_val, 0).astype(np.float32) * np.float32(scale_b)),
+            cat(pad(a.cat_split, -1), b_cs),
+            cat(padB(a.cat_table), padB(b.cat_table)),
+            np.concatenate([np.asarray(a.tree_class), np.asarray(b.tree_class)]),
+            np.asarray(a.na_bins),
+            max_depth=max(a.max_depth, b.max_depth),
+            init_f=a.init_f, nclasses=a.nclasses)
+        out.init_class = a.init_class
+        return out
+
     # -- device scoring ----------------------------------------------------
     def arrays(self):
         import jax.numpy as jnp

@@ -156,8 +156,19 @@ class DRF(SharedTree):
         history = []
         stop_metric = []
         vs = self._vstate
-        v_sum = (jnp.zeros(vs["binned"].shape[0], jnp.float32)
-                 if vs is not None else None)
+        # checkpoint resume: prev forest leaves are stored pre-divided by its
+        # tree count, so its traversal yields the MEAN — times t_start gives
+        # the running validation SUM. OOB accumulators restart at zero (the
+        # per-tree bagging masks are not part of the model artifact), so
+        # post-resume OOB training metrics cover the NEW trees only.
+        t_start = self._ckpt_start(ntrees)
+        if vs is None:
+            v_sum = None
+        elif t_start:
+            v_sum = (self._ckpt.forest.predict_binned(vs["binned"])
+                     .astype(jnp.float32) * t_start)
+        else:
+            v_sum = jnp.zeros(vs["binned"].shape[0], jnp.float32)
         # OOB accumulation: sum of oob predictions and counts per row
         oob_sum = jnp.zeros(N, jnp.float32)
         oob_cnt = jnp.zeros(N, jnp.float32)
@@ -169,7 +180,7 @@ class DRF(SharedTree):
         root_key = jax.random.PRNGKey(self._seed())
         packs, leaf_means, leaf_wys = [], [], []
         mask = None
-        for t in range(ntrees):
+        for t in range(t_start, ntrees):
             mask, w_t = pre(w, root_key, np.int32(t), sample_rate) \
                 if sampling else (None, w)
             masks = [np.asarray(feat_mask_fn(2 ** d), bool)
@@ -220,15 +231,20 @@ class DRF(SharedTree):
         # stopping may truncate) so the summed traversal averages correctly
         from h2o3_tpu.models.tree.device_tree import assemble_trees
 
+        total = t_start + len(packs)
         trees = assemble_trees(packs, leaf_means, leaf_wys, spec, max_depth,
-                               scale=1.0 / len(packs))
-        varimp = {}
+                               scale=1.0 / total)
+        varimp = self._ckpt_varimp0()
         for tree in trees:
             self._accumulate_varimp(tree, varimp, model)
         model._output.scoring_history = history
         self._finalize_varimp(model, varimp)
         forest = CompressedForest.from_host_trees(
             trees, spec, max_depth=max_depth, init_f=0.0, nclasses=1)
+        if t_start:
+            # rescale: prev leaves are /t_start, target is /total
+            forest = CompressedForest.concat(self._ckpt.forest, forest,
+                                             scale_a=t_start / total)
         f = jnp.where(oob_cnt > 0, oob_sum / jnp.maximum(oob_cnt, 1.0), 0.0)
         self._oob_raw = None
         if float(jnp.max(oob_cnt)) > 0:
@@ -255,16 +271,22 @@ class DRF(SharedTree):
         feat_mask_fn = _node_feat_mask_fn(rng, spec.F, mtries)
 
         max_depth = int(self.params["max_depth"])
-        trees, varimp, history = [], {}, []
+        trees, varimp, history = [], self._ckpt_varimp0(), []
         leaf_means: list = []
         stop_metric = []
         vs = self._vstate
+        t_start = self._ckpt_start(ntrees)
         binned_v = np.asarray(vs["binned"]) if vs is not None else None
-        v_sum = np.zeros(binned_v.shape[0], np.float64) \
-            if vs is not None else None
+        if vs is None:
+            v_sum = None
+        elif t_start:
+            v_sum = np.asarray(self._ckpt.forest.predict_binned(vs["binned"]),
+                               np.float64) * t_start
+        else:
+            v_sum = np.zeros(binned_v.shape[0], np.float64)
         oob_sum = jnp.zeros(N, jnp.float32)
         oob_cnt = jnp.zeros(N, jnp.float32)
-        for t in range(ntrees):
+        for t in range(t_start, ntrees):
             mask, w_t = self._sample_rows(rng, N, w)
             tree, row_leaf = grow_tree_host(
                 binned, w_t, y, spec, max_depth=max_depth,
@@ -319,10 +341,14 @@ class DRF(SharedTree):
         model._output.scoring_history = history
         self._finalize_varimp(model, varimp)
         # scale leaves by the ACTUAL tree count (early stopping may truncate)
+        total = t_start + len(trees)
         for tree, mean in zip(trees, leaf_means):
-            tree.set_leaf_values(mean / len(trees))
+            tree.set_leaf_values(mean / total)
         forest = CompressedForest.from_host_trees(
             trees, spec, max_depth=max_depth, init_f=0.0, nclasses=1)
+        if t_start:
+            forest = CompressedForest.concat(self._ckpt.forest, forest,
+                                             scale_a=t_start / total)
         f = jnp.where(oob_cnt > 0, oob_sum / jnp.maximum(oob_cnt, 1.0), 0.0)
         self._oob_raw = None
         if float(jnp.max(oob_cnt)) > 0:
@@ -356,10 +382,11 @@ class DRF(SharedTree):
         min_rows = float(self.params["min_rows"])
         msi = float(self.params["min_split_improvement"])
         tree_class = []
+        t_start = self._ckpt_start(ntrees, per_iter=K)
         oob_sum = jnp.zeros((N, K), jnp.float32)
         oob_cnt = jnp.zeros(N, jnp.float32)
         packs, leaf_means, leaf_wys = [], [], []
-        for t in range(ntrees):
+        for t in range(t_start, ntrees):
             mask, w_t = self._sample_rows(rng, N, w)
             for k in range(K):
                 masks = [np.asarray(feat_mask_fn(2 ** d), bool)
@@ -386,15 +413,19 @@ class DRF(SharedTree):
                 self.job.update(progress=(t + 1) / ntrees, msg=f"iter {t + 1}")
         from h2o3_tpu.models.tree.device_tree import assemble_trees
 
+        total = t_start + len(packs) // K
         trees = assemble_trees(packs, leaf_means, leaf_wys, spec, max_depth,
-                               scale=1.0 / ntrees)
-        varimp = {}
+                               scale=1.0 / total)
+        varimp = self._ckpt_varimp0()
         for tree in trees:
             self._accumulate_varimp(tree, varimp, model)
         self._finalize_varimp(model, varimp)
         forest = CompressedForest.from_host_trees(
             trees, spec, tree_class=tree_class, max_depth=max_depth,
             nclasses=K)
+        if t_start:
+            forest = CompressedForest.concat(self._ckpt.forest, forest,
+                                             scale_a=t_start / total)
         self._oob_raw = None
         if float(jnp.max(oob_cnt)) > 0:
             p = jnp.clip(oob_sum / jnp.maximum(oob_cnt, 1.0)[:, None], 0.0, 1.0)
@@ -417,10 +448,11 @@ class DRF(SharedTree):
         feat_mask_fn = _node_feat_mask_fn(rng, spec.F, mtries)
 
         max_depth = int(self.params["max_depth"])
-        trees, tree_class, varimp = [], [], {}
+        trees, tree_class, varimp = [], [], self._ckpt_varimp0()
+        t_start = self._ckpt_start(ntrees, per_iter=K)
         oob_sum = jnp.zeros((N, K), jnp.float32)
         oob_cnt = jnp.zeros(N, jnp.float32)
-        for t in range(ntrees):
+        for t in range(t_start, ntrees):
             mask, w_t = self._sample_rows(rng, N, w)
             for k in range(K):
                 tree, row_leaf = grow_tree_host(
@@ -449,6 +481,11 @@ class DRF(SharedTree):
         forest = CompressedForest.from_host_trees(
             trees, spec, tree_class=tree_class, max_depth=max_depth,
             nclasses=K)
+        if t_start:
+            # leaves above are /ntrees (loop always completes here) and prev's
+            # are /t_start — rescale prev onto the same /ntrees denominator
+            forest = CompressedForest.concat(self._ckpt.forest, forest,
+                                             scale_a=t_start / ntrees)
         self._oob_raw = None
         if float(jnp.max(oob_cnt)) > 0:
             p = jnp.clip(oob_sum / jnp.maximum(oob_cnt, 1.0)[:, None], 0.0, 1.0)

@@ -52,6 +52,7 @@ class IsolationForestModel(SharedTreeModel):
 class IsolationForest(SharedTree):
     algo_name = "isolationforest"
     model_class = IsolationForestModel
+    supports_checkpoint = False      # reference IF has no _checkpoint path
     _intrain_valid = False   # overrides the fit loops; OOB/in-sample stopping
     supervised = False
 

@@ -156,6 +156,7 @@ class SharedTree(ModelBuilder):
     stopping, variable importances."""
 
     model_class = SharedTreeModel
+    supports_checkpoint = True
     # GBM consumes the in-training validation state; DRF/IF override the fit
     # loops without reading it (DRF's stopping metric is OOB, reference
     # doOOBScoring), so they skip building it
@@ -208,6 +209,27 @@ class SharedTree(ModelBuilder):
                          ln / jnp.maximum(ld + self._leaf_den_offset(), 1e-12),
                          0.0)
 
+    # checkpoint helpers ---------------------------------------------------
+    def _ckpt_start(self, ntrees: int, per_iter: int = 1) -> int:
+        """Iterations the checkpoint forest already holds (0 when training
+        fresh). ntrees is the TOTAL tree count and must exceed it
+        (hex/util/CheckpointUtils.java enforces the same)."""
+        prev = getattr(self, "_ckpt", None)
+        if prev is None:
+            return 0
+        done = prev.forest.n_trees // per_iter
+        if ntrees <= done:
+            raise ValueError(
+                f"checkpoint model already has {done} iterations; ntrees "
+                f"({ntrees}) must be greater")
+        return done
+
+    def _ckpt_varimp0(self) -> Dict[str, float]:
+        """Resume split-gain accumulation from the checkpoint model's raw
+        (unnormalized) importances."""
+        prev = getattr(self, "_ckpt", None)
+        return dict(getattr(prev, "_varimp_raw", {}) or {}) if prev else {}
+
     # driver --------------------------------------------------------------
     def _fit(self, train: Frame) -> SharedTreeModel:
         import jax
@@ -227,10 +249,25 @@ class SharedTree(ModelBuilder):
                                 quantile_alpha=float(self.params["quantile_alpha"]))
         model._distribution = dist
 
-        spec = BinSpec.build(train, out.names,
-                             nbins=int(self.params["nbins"]),
-                             nbins_cats=int(self.params["nbins_cats"]),
-                             seed=self._seed())
+        # training continuation (hex/Model.java:365): reuse the checkpoint
+        # model's BinSpec so continued trees bin identically, start margins
+        # from its forest, and append the new trees to it
+        prev = self._resolve_checkpoint()
+        if prev is not None:
+            if not isinstance(prev, SharedTreeModel) or prev.forest is None:
+                raise ValueError("checkpoint model has no forest to continue")
+            if prev._output.names != out.names \
+                    or prev._output.domains != out.domains:
+                raise ValueError(
+                    "checkpoint: training frame columns/domains differ from "
+                    f"the original run ({prev._output.names} vs {out.names})")
+            spec = prev.spec
+        else:
+            spec = BinSpec.build(train, out.names,
+                                 nbins=int(self.params["nbins"]),
+                                 nbins_cats=int(self.params["nbins_cats"]),
+                                 seed=self._seed())
+        self._ckpt = prev
         model.spec = spec
         binned = spec.bin_columns(train)
         N = binned.shape[0]
@@ -245,7 +282,11 @@ class SharedTree(ModelBuilder):
             oc = train.col(self.params["offset_column"]).data
             offset = jnp.where(jnp.isnan(oc), 0.0, oc).astype(jnp.float32)
 
-        rng = np.random.default_rng(self._seed())
+        # resumed runs seed the host RNG stream with (seed, trees_done) —
+        # reusing the bare seed would replay the original run's bootstrap /
+        # feature-mask draws and append byte-identical duplicate trees
+        rng = (np.random.default_rng([self._seed(), prev.forest.n_trees])
+               if prev is not None else np.random.default_rng(self._seed()))
         ntrees = int(self.params["ntrees"])
         self._train_frame_ref = train      # OOB metric routing (DRF)
         # in-training validation state for early stopping (ScoreKeeper stops
@@ -291,6 +332,7 @@ class SharedTree(ModelBuilder):
                                              spec, dist, rng, ntrees)
         finally:
             self._vstate = None
+            self._ckpt = None
         model.forest = forest
         model._output.run_time_ms = int((time.time() - t0) * 1000)
         return model
@@ -316,15 +358,22 @@ class SharedTree(ModelBuilder):
                                                       grow_tree_device)
 
         N = binned.shape[0]
-        # init f0: weighted argmin of deviance at constant margin
-        num = float(jnp.sum(dist.init_f_num(w, y, offset)))
-        den = float(jnp.sum(dist.init_f_denom(w, y, offset)))
-        init_f = float(dist.link(jnp.float32(num / max(den, 1e-12))))
-        if dist.name in ("bernoulli", "quasibinomial"):
-            # only the log-odds prior needs clamping (GBM.java getInitialValue);
-            # identity/log links must keep large means intact
-            init_f = float(np.clip(init_f, -19, 19))
-        f = jnp.full(N, init_f, jnp.float32) + offset
+        t_start = self._ckpt_start(ntrees)
+        if t_start:
+            # resume: margins restart from the checkpoint forest's predictions
+            pf = self._ckpt.forest
+            init_f = pf.init_f
+            f = pf.predict_binned(binned) + offset
+        else:
+            # init f0: weighted argmin of deviance at constant margin
+            num = float(jnp.sum(dist.init_f_num(w, y, offset)))
+            den = float(jnp.sum(dist.init_f_denom(w, y, offset)))
+            init_f = float(dist.link(jnp.float32(num / max(den, 1e-12))))
+            if dist.name in ("bernoulli", "quasibinomial"):
+                # only the log-odds prior needs clamping (GBM.java
+                # getInitialValue); identity/log links keep large means intact
+                init_f = float(np.clip(init_f, -19, 19))
+            f = jnp.full(N, init_f, jnp.float32) + offset
 
         leaf_clip = self._leaf_clip()
         history = []
@@ -334,7 +383,12 @@ class SharedTree(ModelBuilder):
         msi = float(self.params["min_split_improvement"])
         stop_metric: List[float] = []
         vs = self._vstate
-        f_valid = (init_f + vs["offset"] if vs is not None else None)
+        if vs is None:
+            f_valid = None
+        elif t_start:
+            f_valid = self._ckpt.forest.predict_binned(vs["binned"]) + vs["offset"]
+        else:
+            f_valid = init_f + vs["offset"]
         sample_rate = float(self.params.get("sample_rate", 1.0) or 1.0)
         sampling = sample_rate < 1.0
         pre = _pre_fn(dist, sampling)
@@ -343,7 +397,7 @@ class SharedTree(ModelBuilder):
 
         root_key = jax.random.PRNGKey(self._seed())
         packs, leaf_vals, leaf_wys = [], [], []
-        for t in range(ntrees):
+        for t in range(t_start, ntrees):
             z, w_t, num_r, den_r, _mask = pre(y, f, w, root_key,
                                               np.int32(t), sample_rate)
             feat_mask_fn = self._feat_mask_fn(rng, spec)
@@ -382,13 +436,15 @@ class SharedTree(ModelBuilder):
         from h2o3_tpu.models.tree.device_tree import assemble_trees
 
         trees = assemble_trees(packs, leaf_vals, leaf_wys, spec, max_depth)
-        varimp: Dict[str, float] = {}
+        varimp: Dict[str, float] = self._ckpt_varimp0()
         for tree in trees:
             self._accumulate_varimp(tree, varimp, model)
         model._output.scoring_history = history
         self._finalize_varimp(model, varimp)
         forest = CompressedForest.from_host_trees(
             trees, spec, max_depth=max_depth, init_f=init_f, nclasses=1)
+        if t_start:
+            forest = CompressedForest.concat(self._ckpt.forest, forest)
         return forest, f
 
     # multinomial: K trees per iteration ----------------------------------
@@ -405,12 +461,24 @@ class SharedTree(ModelBuilder):
 
         N = binned.shape[0]
         yi = y.astype(jnp.int32)
-        # init: log class priors
-        pri = np.asarray(jax.jit(
-            lambda: jnp.zeros(K).at[yi].add(w, mode="drop"))())
-        pri = np.maximum(pri / max(pri.sum(), 1e-12), 1e-9)
-        init = np.log(pri).astype(np.float32)
-        f = jnp.broadcast_to(jnp.asarray(init), (N, K)).astype(jnp.float32)
+        t_start = self._ckpt_start(ntrees, per_iter=K)
+        vs = self._vstate
+        if t_start:
+            pf = self._ckpt.forest
+            init = np.asarray(pf.init_class, np.float32)
+            f = pf.predict_binned(binned).astype(jnp.float32)
+            f_valid = (pf.predict_binned(vs["binned"]).astype(jnp.float32)
+                       if vs is not None else None)
+        else:
+            # init: log class priors
+            pri = np.asarray(jax.jit(
+                lambda: jnp.zeros(K).at[yi].add(w, mode="drop"))())
+            pri = np.maximum(pri / max(pri.sum(), 1e-12), 1e-9)
+            init = np.log(pri).astype(np.float32)
+            f = jnp.broadcast_to(jnp.asarray(init), (N, K)).astype(jnp.float32)
+            f_valid = (jnp.broadcast_to(jnp.asarray(init),
+                                        (vs["binned"].shape[0], K)).astype(jnp.float32)
+                       if vs is not None else None)
 
         leaf_clip = self._leaf_clip()
         tree_class, history = [], []
@@ -420,10 +488,6 @@ class SharedTree(ModelBuilder):
         msi = float(self.params["min_split_improvement"])
         stop_metric: List[float] = []
         onehot = jax.nn.one_hot(yi, K, dtype=jnp.float32)
-        vs = self._vstate
-        f_valid = (jnp.broadcast_to(jnp.asarray(init),
-                                    (vs["binned"].shape[0], K)).astype(jnp.float32)
-                   if vs is not None else None)
         # jitted per-class glue (same dispatch-latency motivation as _pre_fn)
         kpre = _STEP_FNS.get(("premk", K))
         if kpre is None:
@@ -456,7 +520,7 @@ class SharedTree(ModelBuilder):
         root_key = jax.random.PRNGKey(self._seed())
         sample_rate = float(self.params.get("sample_rate", 1.0) or 1.0)
         packs, leaf_vals, leaf_wys = [], [], []
-        for t in range(ntrees):
+        for t in range(t_start, ntrees):
             feat_mask_fn = self._feat_mask_fn(rng, spec)
             masks = ([np.asarray(feat_mask_fn(2 ** d), bool)
                       for d in range(max_depth)] if feat_mask_fn else None)
@@ -504,7 +568,7 @@ class SharedTree(ModelBuilder):
         from h2o3_tpu.models.tree.device_tree import assemble_trees
 
         trees = assemble_trees(packs, leaf_vals, leaf_wys, spec, max_depth)
-        varimp: Dict[str, float] = {}
+        varimp: Dict[str, float] = self._ckpt_varimp0()
         for tree in trees:
             self._accumulate_varimp(tree, varimp, model)
         model._output.scoring_history = history
@@ -513,6 +577,8 @@ class SharedTree(ModelBuilder):
             trees, spec, tree_class=tree_class, max_depth=max_depth,
             init_f=0.0, nclasses=K)
         forest.init_class = init          # added per-class at scoring
+        if t_start:
+            forest = CompressedForest.concat(self._ckpt.forest, forest)
         return forest, f
 
     # deep-tree fallback (host-orchestrated level loop, host_grow.py) ------
@@ -524,23 +590,32 @@ class SharedTree(ModelBuilder):
         from h2o3_tpu.models.tree.host_grow import grow_tree_host
 
         N = binned.shape[0]
-        num = float(jnp.sum(dist.init_f_num(w, y, offset)))
-        den = float(jnp.sum(dist.init_f_denom(w, y, offset)))
-        init_f = float(dist.link(jnp.float32(num / max(den, 1e-12))))
-        if dist.name in ("bernoulli", "quasibinomial"):
-            init_f = float(np.clip(init_f, -19, 19))
-        f = jnp.full(N, init_f, jnp.float32) + offset
+        t_start = self._ckpt_start(ntrees)
+        vs = self._vstate
+        binned_v = np.asarray(vs["binned"]) if vs is not None else None
+        if t_start:
+            pf = self._ckpt.forest
+            init_f = pf.init_f
+            f = pf.predict_binned(binned) + offset
+            f_valid = (np.asarray(pf.predict_binned(binned_v), np.float64)
+                       + np.asarray(vs["offset"], np.float64)
+                       if vs is not None else None)
+        else:
+            num = float(jnp.sum(dist.init_f_num(w, y, offset)))
+            den = float(jnp.sum(dist.init_f_denom(w, y, offset)))
+            init_f = float(dist.link(jnp.float32(num / max(den, 1e-12))))
+            if dist.name in ("bernoulli", "quasibinomial"):
+                init_f = float(np.clip(init_f, -19, 19))
+            f = jnp.full(N, init_f, jnp.float32) + offset
+            f_valid = (init_f + np.asarray(vs["offset"], np.float64)
+                       if vs is not None else None)
 
         leaf_clip = self._leaf_clip()
-        trees, varimp = [], {}
+        trees, varimp = [], self._ckpt_varimp0()
         history = []
         max_depth = int(self.params["max_depth"])
         stop_metric: List[float] = []
-        vs = self._vstate
-        binned_v = np.asarray(vs["binned"]) if vs is not None else None
-        f_valid = (init_f + np.asarray(vs["offset"], np.float64)
-                   if vs is not None else None)
-        for t in range(ntrees):
+        for t in range(t_start, ntrees):
             z = dist.neg_half_gradient(y, f)
             row_active, w_t = self._sample_rows(rng, N, w)
             feat_mask_fn = self._feat_mask_fn(rng, spec)
@@ -585,6 +660,8 @@ class SharedTree(ModelBuilder):
         self._finalize_varimp(model, varimp)
         forest = CompressedForest.from_host_trees(
             trees, spec, max_depth=max_depth, init_f=init_f, nclasses=1)
+        if t_start:
+            forest = CompressedForest.concat(self._ckpt.forest, forest)
         return forest, f
 
     def _fit_multinomial_deep(self, model, binned, y, w, offset, spec, K,
@@ -597,22 +674,30 @@ class SharedTree(ModelBuilder):
 
         N = binned.shape[0]
         yi = y.astype(jnp.int32)
-        pri = np.asarray(jax.jit(
-            lambda: jnp.zeros(K).at[yi].add(w, mode="drop"))())
-        pri = np.maximum(pri / max(pri.sum(), 1e-12), 1e-9)
-        init = np.log(pri).astype(np.float32)
-        f = jnp.broadcast_to(jnp.asarray(init), (N, K)).astype(jnp.float32)
+        t_start = self._ckpt_start(ntrees, per_iter=K)
+        vs = self._vstate
+        binned_v = np.asarray(vs["binned"]) if vs is not None else None
+        if t_start:
+            pf = self._ckpt.forest
+            init = np.asarray(pf.init_class, np.float32)
+            f = pf.predict_binned(binned).astype(jnp.float32)
+            f_valid = (np.asarray(pf.predict_binned(binned_v), np.float64)
+                       if vs is not None else None)
+        else:
+            pri = np.asarray(jax.jit(
+                lambda: jnp.zeros(K).at[yi].add(w, mode="drop"))())
+            pri = np.maximum(pri / max(pri.sum(), 1e-12), 1e-9)
+            init = np.log(pri).astype(np.float32)
+            f = jnp.broadcast_to(jnp.asarray(init), (N, K)).astype(jnp.float32)
+            f_valid = (np.broadcast_to(init, (binned_v.shape[0], K)).copy()
+                       .astype(np.float64) if vs is not None else None)
 
         leaf_clip = self._leaf_clip()
-        trees, tree_class, varimp, history = [], [], {}, []
+        trees, tree_class, varimp, history = [], [], self._ckpt_varimp0(), []
         max_depth = int(self.params["max_depth"])
         stop_metric: List[float] = []
         onehot = jax.nn.one_hot(yi, K, dtype=jnp.float32)
-        vs = self._vstate
-        binned_v = np.asarray(vs["binned"]) if vs is not None else None
-        f_valid = (np.broadcast_to(init, (binned_v.shape[0], K)).copy()
-                   .astype(np.float64) if vs is not None else None)
-        for t in range(ntrees):
+        for t in range(t_start, ntrees):
             P = jax.nn.softmax(f, axis=-1)
             row_active, w_t = self._sample_rows(rng, N, w)
             feat_mask_fn = self._feat_mask_fn(rng, spec)
@@ -668,6 +753,8 @@ class SharedTree(ModelBuilder):
             trees, spec, tree_class=tree_class, max_depth=max_depth,
             init_f=0.0, nclasses=K)
         forest.init_class = init          # added per-class at scoring
+        if t_start:
+            forest = CompressedForest.concat(self._ckpt.forest, forest)
         return forest, f
 
     # sampling ------------------------------------------------------------
@@ -731,6 +818,7 @@ class SharedTree(ModelBuilder):
                 varimp[nm] = varimp.get(nm, 0.0) + max(n.split.gain, 0.0)
 
     def _finalize_varimp(self, model, varimp: Dict[str, float]):
+        model._varimp_raw = dict(varimp)    # checkpoint continuation source
         if varimp:
             top = max(varimp.values()) or 1.0
             model._output.variable_importances = {

@@ -1,0 +1,167 @@
+"""Checkpoint/resume training continuation.
+
+Reference: hex/Model.java:365 (_checkpoint), :387 (_export_checkpoints_dir),
+hex/util/CheckpointUtils.java (param compatibility), hex/tree/SharedTree.java
+:131-134 (tree-count validation). resume(n1 then n2 total) must equal
+train(n2) when the algorithm path is deterministic (no row/col sampling).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame
+from h2o3_tpu.models.deeplearning import DeepLearning
+from h2o3_tpu.models.model import Model
+from h2o3_tpu.models.tree.drf import DRF
+from h2o3_tpu.models.tree.gbm import GBM
+
+
+def _frame(n=400, p=4, seed=7, nclasses=2):
+    rng = np.random.default_rng(seed)
+    fr = Frame()
+    X = rng.standard_normal((n, p))
+    for i in range(p):
+        fr.add(f"x{i}", Column.from_numpy(X[:, i]))
+    logit = 1.3 * X[:, 0] - 0.8 * X[:, 1] + 0.4 * X[:, 2]
+    if nclasses == 2:
+        y = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "Y", "N")
+        fr.add("y", Column.from_numpy(y, ctype="enum"))
+    elif nclasses > 2:
+        y = (np.digitize(logit, np.quantile(logit, [0.33, 0.66]))).astype(int)
+        fr.add("y", Column.from_numpy(np.array("abc")[y] if False else
+                                      np.array(list("abc"))[y], ctype="enum"))
+    else:
+        fr.add("y", Column.from_numpy(logit + rng.normal(0, 0.1, n)))
+    return fr
+
+
+def _p1(model, fr):
+    return model.predict(fr).col("Y").to_numpy()
+
+
+class TestGBMCheckpoint:
+    def test_resume_equals_fresh(self, cl):
+        """No sampling ⇒ boosting is deterministic: 6+6 ≡ 12."""
+        fr = _frame()
+        a = GBM(ntrees=6, max_depth=3, learn_rate=0.3, seed=5).train(
+            y="y", training_frame=fr)
+        b = GBM(ntrees=12, max_depth=3, learn_rate=0.3, seed=5,
+                checkpoint=a).train(y="y", training_frame=fr)
+        c = GBM(ntrees=12, max_depth=3, learn_rate=0.3, seed=5).train(
+            y="y", training_frame=fr)
+        assert b.forest.n_trees == 12
+        np.testing.assert_allclose(_p1(b, fr), _p1(c, fr), atol=1e-4)
+        # resumed model strictly extends the checkpoint
+        assert b._output.scoring_history[-1]["tree"] == 12
+
+    def test_resume_by_key(self, cl):
+        fr = _frame()
+        a = GBM(ntrees=4, max_depth=3, seed=5).train(y="y", training_frame=fr)
+        b = GBM(ntrees=8, max_depth=3, seed=5, checkpoint=str(a.key)).train(
+            y="y", training_frame=fr)
+        assert b.forest.n_trees == 8
+
+    def test_multinomial_resume(self, cl):
+        fr = _frame(nclasses=3)
+        a = GBM(ntrees=4, max_depth=3, learn_rate=0.3, seed=5).train(
+            y="y", training_frame=fr)
+        b = GBM(ntrees=8, max_depth=3, learn_rate=0.3, seed=5,
+                checkpoint=a).train(y="y", training_frame=fr)
+        c = GBM(ntrees=8, max_depth=3, learn_rate=0.3, seed=5).train(
+            y="y", training_frame=fr)
+        assert b.forest.n_trees == 8 * 3
+        pb = b.predict(fr).col("predict").to_numpy()
+        pc = c.predict(fr).col("predict").to_numpy()
+        assert np.mean(pb == pc) > 0.98
+
+    def test_param_guards(self, cl):
+        fr = _frame()
+        a = GBM(ntrees=4, max_depth=3, seed=5).train(y="y", training_frame=fr)
+        with pytest.raises(ValueError, match="cannot be modified"):
+            GBM(ntrees=8, max_depth=5, seed=5, checkpoint=a).train(
+                y="y", training_frame=fr)
+        with pytest.raises(ValueError, match="must be greater"):
+            GBM(ntrees=4, max_depth=3, seed=5, checkpoint=a).train(
+                y="y", training_frame=fr)
+        with pytest.raises(ValueError, match="cross-validation"):
+            GBM(ntrees=8, max_depth=3, seed=5, nfolds=3, checkpoint=a).train(
+                y="y", training_frame=fr)
+
+    def test_validation_stopping_continues(self, cl):
+        """Resume with a validation frame keeps scoring on it."""
+        fr, va = _frame(seed=7), _frame(seed=11)
+        a = GBM(ntrees=5, max_depth=3, seed=5).train(
+            y="y", training_frame=fr, validation_frame=va)
+        b = GBM(ntrees=10, max_depth=3, seed=5, checkpoint=a,
+                score_each_iteration=True).train(
+            y="y", training_frame=fr, validation_frame=va)
+        hist = b._output.scoring_history
+        assert hist[0]["tree"] == 6 and hist[-1]["tree"] == 10
+        assert all("validation_deviance" in h for h in hist)
+
+
+class TestDRFCheckpoint:
+    def test_deterministic_resume_preserves_mean(self, cl):
+        """With sample_rate=1 and mtries=F every tree is identical, so the
+        5-tree and 10-tree averages must agree — this pins the leaf
+        rescaling (prev/new tree-count weights) in the concat."""
+        fr = _frame(nclasses=1)
+        kw = dict(max_depth=4, sample_rate=1.0, mtries=4, min_rows=5.0, seed=3)
+        a = DRF(ntrees=5, **kw).train(y="y", training_frame=fr)
+        b = DRF(ntrees=10, checkpoint=a, **kw).train(y="y", training_frame=fr)
+        assert b.forest.n_trees == 10
+        pa = a.predict(fr).col("predict").to_numpy()
+        pb = b.predict(fr).col("predict").to_numpy()
+        np.testing.assert_allclose(pa, pb, atol=1e-4)
+
+    def test_binomial_resume(self, cl):
+        fr = _frame()
+        kw = dict(max_depth=4, seed=3)
+        a = DRF(ntrees=5, **kw).train(y="y", training_frame=fr)
+        b = DRF(ntrees=10, checkpoint=a, **kw).train(y="y", training_frame=fr)
+        assert b.forest.n_trees == 10
+        pb = _p1(b, fr)
+        assert np.all(np.isfinite(pb)) and pb.min() >= 0 and pb.max() <= 1
+        assert float(b._output.training_metrics.auc) > 0.6
+
+
+class TestDLCheckpoint:
+    def test_resume_continues_epochs(self, cl):
+        fr = _frame()
+        kw = dict(hidden=[16], mini_batch_size=64, seed=9,
+                  activation="Rectifier")
+        a = DeepLearning(epochs=3, **kw).train(y="y", training_frame=fr)
+        assert a.epochs_trained == 3
+        b = DeepLearning(epochs=6, checkpoint=a, **kw).train(
+            y="y", training_frame=fr)
+        assert b.epochs_trained == 6
+        # resumed training starts from a's weights: first resumed-epoch loss
+        # must be ≤ a's FIRST epoch loss (training from scratch would not be)
+        assert (b._output.scoring_history[0]["training_loss"]
+                <= a._output.scoring_history[0]["training_loss"] + 1e-6)
+        assert float(b._output.training_metrics.auc) > 0.5
+
+    def test_param_guard(self, cl):
+        fr = _frame()
+        a = DeepLearning(epochs=2, hidden=[8], mini_batch_size=64,
+                         seed=9).train(y="y", training_frame=fr)
+        with pytest.raises(ValueError, match="cannot be modified"):
+            DeepLearning(epochs=4, hidden=[16], mini_batch_size=64, seed=9,
+                         checkpoint=a).train(y="y", training_frame=fr)
+        with pytest.raises(ValueError, match="must be greater"):
+            DeepLearning(epochs=2, hidden=[8], mini_batch_size=64, seed=9,
+                         checkpoint=a).train(y="y", training_frame=fr)
+
+
+class TestExportCheckpointsDir:
+    def test_auto_export_and_reload(self, cl, tmp_path):
+        fr = _frame()
+        d = str(tmp_path / "ckpts")
+        a = GBM(ntrees=4, max_depth=3, seed=5,
+                export_checkpoints_dir=d).train(y="y", training_frame=fr)
+        path = os.path.join(d, f"{a.key}.bin")
+        assert os.path.exists(path)
+        re = Model.load(path)
+        np.testing.assert_allclose(_p1(a, fr), _p1(re, fr), atol=1e-6)
