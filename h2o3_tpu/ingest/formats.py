@@ -1,0 +1,294 @@
+"""Columnar & structured format readers: Parquet / ORC / Feather (pyarrow),
+ARFF, SVMLight.
+
+Reference: h2o-parsers/h2o-parquet-parser/ (VecParquetReader walks row
+groups into NewChunks), h2o-parsers/h2o-orc-parser/, water/parser/ARFFParser
+.java, water/parser/SVMLightParser.java.
+
+TPU-native design: columnar files are already typed and column-major — the
+exact layout the device Frame wants — so readers go straight from the
+format's column vectors to host numpy (zero row-wise materialization), and
+`Column.from_numpy` shards them onto the mesh. Types map: floating/int →
+f32 columns, dictionary/string → enum via the normal interning path,
+timestamp → int64 epoch-millis T_TIME, bool → 0/1 numeric."""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from h2o3_tpu.core.frame import T_CAT, T_NUM, T_STR, T_TIME
+
+# extensions -> parse type (ParseSetup._parse_type analog)
+COLUMNAR_EXT = {".parquet": "PARQUET", ".pq": "PARQUET", ".orc": "ORC",
+                ".feather": "FEATHER", ".arrow": "FEATHER"}
+STRUCTURED_EXT = {".arff": "ARFF", ".svm": "SVMLight",
+                  ".svmlight": "SVMLight"}
+
+
+def detect_parse_type(path: str) -> Optional[str]:
+    ext = os.path.splitext(path)[1].lower()
+    return COLUMNAR_EXT.get(ext) or STRUCTURED_EXT.get(ext)
+
+
+# ---------------------------------------------------------------------------
+# pyarrow-backed columnar formats
+# ---------------------------------------------------------------------------
+
+def _read_arrow_table(path: str, parse_type: str):
+    import pyarrow as pa
+
+    if parse_type == "PARQUET":
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path)
+    if parse_type == "ORC":
+        import pyarrow.orc as orc
+
+        return orc.read_table(path)
+    if parse_type == "FEATHER":
+        # Feather V2 IS the Arrow IPC file format (feather.read_table is
+        # deprecated in favor of this)
+        try:
+            with pa.ipc.open_file(path) as r:
+                return r.read_all()
+        except pa.ArrowInvalid:
+            import pyarrow.feather as feather    # Feather V1 fallback
+
+            return feather.read_table(path)
+    raise ValueError(parse_type)
+
+
+def arrow_to_host_cols(table) -> Tuple[Dict[str, np.ndarray], List[str]]:
+    """pyarrow Table -> (host column arrays, column types)."""
+    import pyarrow as pa
+
+    cols: Dict[str, np.ndarray] = {}
+    types: List[str] = []
+    for name, col in zip(table.column_names, table.columns):
+        t = col.type
+        if pa.types.is_dictionary(t):
+            col = col.cast(t.value_type)
+            t = col.type
+        if pa.types.is_timestamp(t) or pa.types.is_date(t):
+            ms = col.cast(pa.timestamp("ms")).cast(pa.int64())
+            arr = ms.to_numpy(zero_copy_only=False).astype(np.float64)
+            mask = np.asarray(col.is_null().combine_chunks())
+            arr[mask] = np.nan
+            cols[name] = arr
+            types.append(T_TIME)
+        elif pa.types.is_boolean(t):
+            arr = col.cast(pa.float64()).to_numpy(zero_copy_only=False)
+            cols[name] = np.asarray(arr, np.float64)
+            types.append(T_NUM)
+        elif pa.types.is_integer(t) or pa.types.is_floating(t) \
+                or pa.types.is_decimal(t):
+            arr = col.cast(pa.float64()).to_numpy(zero_copy_only=False)
+            cols[name] = np.asarray(arr, np.float64)
+            types.append(T_NUM)
+        elif pa.types.is_string(t) or pa.types.is_large_string(t):
+            pd_arr = col.to_pandas()
+            obj = pd_arr.to_numpy(dtype=object)
+            obj[pd_arr.isna().to_numpy()] = None
+            cols[name] = obj
+            types.append(T_CAT)
+        else:
+            # lists/structs/binary: stringified (reference skips with warn)
+            obj = np.array([None if v is None else str(v)
+                            for v in col.to_pylist()], object)
+            cols[name] = obj
+            types.append(T_STR)
+    return cols, types
+
+
+def parse_columnar_host(path: str, parse_type: str
+                        ) -> Tuple[Dict[str, np.ndarray], List[str], List[str]]:
+    """-> (cols, names, types)."""
+    table = _read_arrow_table(path, parse_type)
+    cols, types = arrow_to_host_cols(table)
+    return cols, list(table.column_names), types
+
+
+def coerce_col(arr: np.ndarray, t_from: str, t_to: str) -> np.ndarray:
+    """Apply a user type override (h2o-py col_types) to an already-parsed
+    host column: numeric -> enum renders labels (integral floats drop the
+    '.0', matching the CSV path's string view of the same data); object ->
+    numeric parses with NaN on failure."""
+    if t_to in (T_CAT, T_STR) and t_from in (T_NUM, T_TIME):
+        out = np.empty(len(arr), object)
+        for i, v in enumerate(arr):
+            if v is None or (isinstance(v, float) and np.isnan(v)):
+                out[i] = None
+            else:
+                fv = float(v)
+                out[i] = str(int(fv)) if fv == int(fv) else str(fv)
+        return out
+    if t_to in (T_NUM, T_TIME) and t_from in (T_CAT, T_STR):
+        out = np.empty(len(arr), np.float64)
+        for i, v in enumerate(arr):
+            try:
+                out[i] = float(v)
+            except (TypeError, ValueError):
+                out[i] = np.nan
+        return out
+    return arr
+
+
+def _arrow_field_type(t) -> str:
+    import pyarrow as pa
+
+    if pa.types.is_dictionary(t):
+        t = t.value_type
+    if pa.types.is_timestamp(t) or pa.types.is_date(t):
+        return T_TIME
+    if pa.types.is_boolean(t) or pa.types.is_integer(t) \
+            or pa.types.is_floating(t) or pa.types.is_decimal(t):
+        return T_NUM
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return T_CAT
+    return T_STR
+
+
+def columnar_schema(path: str, parse_type: str) -> Tuple[List[str], List[str]]:
+    """Schema-only read for ParseSetup guessing (cheap for Parquet/ORC)."""
+    if parse_type == "PARQUET":
+        import pyarrow.parquet as pq
+
+        schema = pq.read_schema(path)
+    elif parse_type == "ORC":
+        import pyarrow.orc as orc
+
+        schema = orc.ORCFile(path).schema
+    else:
+        import pyarrow as pa
+
+        try:
+            with pa.ipc.open_file(path) as r:    # Feather V2 = IPC: no data read
+                schema = r.schema
+        except pa.ArrowInvalid:
+            schema = _read_arrow_table(path, parse_type).schema
+    return ([f.name for f in schema],
+            [_arrow_field_type(f.type) for f in schema])
+
+
+# ---------------------------------------------------------------------------
+# ARFF (water/parser/ARFFParser.java behavior: @attribute typed header,
+# @data CSV body; {a,b,c} nominal specs -> enum)
+# ---------------------------------------------------------------------------
+
+_ARFF_ATTR = re.compile(r"@attribute\s+('(?:[^']*)'|\"(?:[^\"]*)\"|\S+)\s+(.+)",
+                        re.IGNORECASE)
+
+
+def _scan_arff(path: str, want_data: bool):
+    from h2o3_tpu.ingest.parse_setup import open_stream
+
+    names: List[str] = []
+    types: List[str] = []
+    data_lines: List[str] = []
+    in_data = False
+    with open_stream(path) as f:
+        for ln in f:
+            s = ln.strip()
+            if not s or s.startswith("%"):
+                continue
+            if in_data:
+                data_lines.append(s)
+                continue
+            low = s.lower()
+            if low.startswith("@data"):
+                if not want_data:
+                    break
+                in_data = True
+            elif low.startswith("@attribute"):
+                m = _ARFF_ATTR.match(s)
+                if not m:
+                    raise ValueError(f"bad ARFF attribute line: {s!r}")
+                nm, spec = m.group(1).strip("'\""), m.group(2).strip()
+                names.append(nm)
+                sl = spec.lower()
+                if spec.startswith("{"):
+                    types.append(T_CAT)
+                elif sl.startswith(("numeric", "real", "integer")):
+                    types.append(T_NUM)
+                elif sl.startswith("date"):
+                    types.append(T_TIME)
+                else:
+                    types.append(T_STR)
+    if not names:
+        raise ValueError(f"no @attribute declarations in {path}")
+    return names, types, data_lines
+
+
+def arff_header(path: str) -> Tuple[List[str], List[str]]:
+    names, types, _ = _scan_arff(path, want_data=False)
+    return names, types
+
+
+def parse_arff_host(path: str) -> Tuple[Dict[str, np.ndarray], List[str], List[str]]:
+    names, types, data_lines = _scan_arff(path, want_data=True)
+    import csv as _csv
+
+    rows = list(_csv.reader(data_lines))
+    ncols = len(names)
+    cols: Dict[str, np.ndarray] = {}
+    for i, (nm, t) in enumerate(zip(names, types)):
+        vals = [r[i].strip() if i < len(r) else "" for r in rows]
+        if t == T_NUM:
+            cols[nm] = np.array([float(v) if v not in ("", "?") else np.nan
+                                 for v in vals], np.float64)
+        elif t == T_TIME:
+            import pandas as pd
+
+            from h2o3_tpu.ingest.parser import _dt_to_ms
+
+            cols[nm] = _dt_to_ms(pd.to_datetime(
+                pd.Series(vals).replace("?", None), errors="coerce"))
+        else:
+            cols[nm] = np.array([None if v in ("", "?") else v.strip("'\"")
+                                 for v in vals], object)
+    return cols, names, types
+
+
+# ---------------------------------------------------------------------------
+# SVMLight (water/parser/SVMLightParser.java: "label idx:val idx:val ...",
+# 1-based indices, zero-default sparse -> dense here, the device layout)
+# ---------------------------------------------------------------------------
+
+def parse_svmlight_host(path: str) -> Tuple[Dict[str, np.ndarray], List[str], List[str]]:
+    from h2o3_tpu.ingest.parse_setup import open_stream
+
+    labels: List[float] = []
+    entries: List[List[Tuple[int, float]]] = []
+    max_idx = 0
+    with open_stream(path) as f:
+        for ln in f:
+            s = ln.split("#", 1)[0].strip()
+            if not s:
+                continue
+            toks = s.split()
+            labels.append(float(toks[0]))
+            row = []
+            for tk in toks[1:]:
+                if tk.startswith("qid:"):
+                    continue
+                idx, val = tk.split(":", 1)
+                i = int(idx)
+                if i < 1:
+                    raise ValueError(f"SVMLight indices are 1-based, got {i}")
+                row.append((i, float(val)))
+                max_idx = max(max_idx, i)
+            entries.append(row)
+    n = len(labels)
+    dense = np.zeros((n, max_idx), np.float64)
+    for r, row in enumerate(entries):
+        for i, v in row:
+            dense[r, i - 1] = v
+    names = ["C1"] + [f"C{i+2}" for i in range(max_idx)]
+    cols = {"C1": np.asarray(labels, np.float64)}
+    for i in range(max_idx):
+        cols[names[i + 1]] = dense[:, i]
+    return cols, names, [T_NUM] * len(names)

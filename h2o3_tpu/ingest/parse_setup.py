@@ -36,6 +36,9 @@ class ParseSetup:
     na_strings: List[str] = field(default_factory=lambda: ["", "NA", "N/A", "nan", "NaN", "null"])
     skipped_columns: List[int] = field(default_factory=list)
     quote_char: str = '"'
+    # CSV / PARQUET / ORC / FEATHER / ARFF / SVMLight
+    # (ParseSetup._parse_type analog; drives the reader dispatch)
+    parse_type: str = "CSV"
 
     def to_dict(self) -> dict:
         return {
@@ -44,6 +47,7 @@ class ParseSetup:
             "column_names": self.column_names,
             "column_types": self.column_types,
             "na_strings": self.na_strings,
+            "parse_type": self.parse_type,
         }
 
 
@@ -89,6 +93,22 @@ def guess_setup(path: str, sample_rows: int = 1000,
                 na_strings: Optional[List[str]] = None,
                 header: Optional[int] = None,
                 separator: Optional[str] = None) -> ParseSetup:
+    # non-CSV formats carry their own schema: no text sampling
+    from h2o3_tpu.ingest import formats
+
+    ptype = formats.detect_parse_type(path)
+    if ptype is not None:
+        setup = ParseSetup(parse_type=ptype)
+        if ptype in formats.COLUMNAR_EXT.values():
+            setup.column_names, setup.column_types = \
+                formats.columnar_schema(path, ptype)
+        elif ptype == "ARFF":
+            setup.column_names, setup.column_types = formats.arff_header(path)
+        # SVMLight: width only known after a full scan; filled at parse time
+        if column_types and setup.column_types:
+            _apply_type_overrides(setup.column_types, setup.column_names,
+                                  column_types)
+        return setup
     setup = ParseSetup()
     if na_strings:
         setup.na_strings = list(na_strings) + [""]
@@ -157,12 +177,17 @@ def guess_setup(path: str, sample_rows: int = 1000,
             types.append(T_NUM)
     # user overrides (by name or index)
     if column_types:
-        for k, t in column_types.items():
-            t = {"numeric": T_NUM, "real": T_NUM, "int": T_NUM, "enum": T_CAT,
-                 "factor": T_CAT, "string": T_STR, "time": T_TIME}.get(t, t)
-            if isinstance(k, int):
-                types[k] = t
-            elif k in setup.column_names:
-                types[setup.column_names.index(k)] = t
+        _apply_type_overrides(types, setup.column_names, column_types)
     setup.column_types = types
     return setup
+
+
+def _apply_type_overrides(types: List[str], names: List[str],
+                          column_types: Dict) -> None:
+    for k, t in column_types.items():
+        t = {"numeric": T_NUM, "real": T_NUM, "int": T_NUM, "enum": T_CAT,
+             "factor": T_CAT, "string": T_STR, "time": T_TIME}.get(t, t)
+        if isinstance(k, int):
+            types[k] = t
+        elif k in names:
+            types[names.index(k)] = t

@@ -44,39 +44,121 @@ def _parse_csv_host(path: str, setup: ParseSetup) -> Dict[str, np.ndarray]:
     na = [s for s in setup.na_strings if s != ""]
     # python string storage + object dtype: pandas 3's arrow-backed
     # StringDtype construction has segfaulted on REST worker threads under
-    # concurrent XLA activity; option_context keeps the override scoped
-    with pd.option_context("mode.string_storage", "python"):
-        df = pd.read_csv(
-            path, sep=setup.separator,
-            header=0 if setup.check_header == 1 else None,
-            names=setup.column_names,
-            na_values=na, keep_default_na=True, skipinitialspace=True,
-            dtype={n: (object if t in (T_CAT, T_STR) else np.float64)
-                   for n, t in zip(setup.column_names, setup.column_types) if t != T_TIME},
-            engine="c",
-        )
+    # concurrent XLA activity. Set the option GLOBALLY (idempotent): a scoped
+    # option_context would race when the thread-pool parses files
+    # concurrently — one thread's __exit__ restores arrow storage while
+    # another is still inside read_csv
+    pd.set_option("mode.string_storage", "python")
+    df = pd.read_csv(
+        path, sep=setup.separator,
+        header=0 if setup.check_header == 1 else None,
+        names=setup.column_names,
+        na_values=na, keep_default_na=True, skipinitialspace=True,
+        dtype={n: (object if t in (T_CAT, T_STR) else np.float64)
+               for n, t in zip(setup.column_names, setup.column_types) if t != T_TIME},
+        engine="c",
+    )
     out = {}
     for name, t in zip(setup.column_names, setup.column_types):
         s = df[name]
         if t in (T_CAT, T_STR):
             out[name] = s.to_numpy(dtype=object)
         elif t == T_TIME:
-            out[name] = pd.to_datetime(s, errors="coerce").astype("int64").to_numpy()
+            out[name] = _dt_to_ms(pd.to_datetime(s, errors="coerce"))
         else:
             out[name] = s.to_numpy(dtype=np.float64)
     return out
 
 
+def _dt_to_ms(dt_series) -> np.ndarray:
+    """datetime series -> float64 epoch-MILLIS with NaN for NaT. The T_TIME
+    column convention everywhere (rapids time prims, MOJO export) is ms.
+    The raw int64 view's unit follows the series dtype (ns in pandas 2, us
+    in pandas 3) — casting to datetime64[ms] first pins the unit."""
+    ms = (dt_series.astype("datetime64[ms]").astype("int64")
+          .to_numpy().astype(np.float64))
+    ms[dt_series.isna().to_numpy()] = np.nan
+    return ms
+
+
+def _parse_one(path: str, setup: ParseSetup):
+    """-> (cols, names, types) for one file, dispatched on parse_type."""
+    from h2o3_tpu.ingest import formats
+
+    pt = setup.parse_type
+    if pt == "CSV":
+        return _parse_csv_host(path, setup), list(setup.column_names), \
+            list(setup.column_types)
+    if pt in ("PARQUET", "ORC", "FEATHER"):
+        cols, names, types = formats.parse_columnar_host(path, pt)
+    elif pt == "ARFF":
+        cols, names, types = formats.parse_arff_host(path)
+    elif pt == "SVMLight":
+        cols, names, types = formats.parse_svmlight_host(path)
+    else:
+        raise ValueError(f"unknown parse_type {pt!r}")
+    # honor user col_types overrides carried on the setup (the CSV path
+    # applies them at read time; here the file's own schema parsed first)
+    if setup.column_types and len(setup.column_types) == len(types):
+        for i, nm in enumerate(names):
+            want = setup.column_types[i]
+            if want != types[i]:
+                cols[nm] = formats.coerce_col(cols[nm], types[i], want)
+        types = list(setup.column_types)
+    return cols, names, types
+
+
 def parse(paths: Sequence[str], setup: ParseSetup,
           destination_frame: Optional[str] = None) -> H2OFrame:
-    host_cols: Dict[str, List[np.ndarray]] = {n: [] for n in setup.column_names}
-    for p in paths:
-        parsed = _parse_csv_host(p, setup)
-        for n in setup.column_names:
-            host_cols[n].append(parsed[n])
+    """Multi-file parse: files parse CONCURRENTLY on host threads (pandas'
+    C engine, pyarrow and the native C++ parser all release the GIL in
+    their hot loops — the ParseDataset fork-join analog), then each column
+    concatenates and ships. `Column.from_numpy`'s device_put is async, so
+    the H2D transfer of early columns overlaps host work on later ones
+    (SURVEY.md §7 hard part 7: parse/H2D overlap)."""
+    from h2o3_tpu import persist
+
+    paths = persist.resolve_all(list(paths))
+    if setup.parse_type == "CSV" and setup.check_header == 1 and len(paths) > 1:
+        # every file must carry the SAME header row as the first file —
+        # pandas would silently rename mismatched columns to the setup's
+        # names otherwise. Compared against file 0's own header (not
+        # setup.column_names, which the user may have overridden)
+        import csv as _csv
+
+        def _hdr(p):
+            with open_stream(p) as f:
+                first = f.readline().rstrip("\n")
+            return [c.strip() for c in
+                    next(_csv.reader([first], delimiter=setup.separator))]
+
+        hdr0 = _hdr(paths[0])
+        for p in paths[1:]:
+            hdr = _hdr(p)
+            if hdr != hdr0:
+                raise ValueError(f"column mismatch across files: {p} has "
+                                 f"{hdr}, expected {hdr0}")
+    if len(paths) == 1:
+        results = [_parse_one(paths[0], setup)]
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(8, len(paths))) as pool:
+            results = list(pool.map(lambda p: _parse_one(p, setup), paths))
+    _, names, types = results[0]
+    for p, (_, n_i, t_i) in zip(paths[1:], results[1:]):
+        if n_i != names:
+            raise ValueError(
+                f"column mismatch across files: {p} has {n_i}, "
+                f"expected {names}")
+        if t_i != types:
+            raise ValueError(
+                f"column type mismatch across files: {p} has {t_i}, "
+                f"expected {types}")
     fr = H2OFrame(destination_frame=destination_frame)
-    for name, t in zip(setup.column_names, setup.column_types):
-        arr = np.concatenate(host_cols[name]) if len(host_cols[name]) > 1 else host_cols[name][0]
+    for name, t in zip(names, types):
+        parts = [r[0][name] for r in results]
+        arr = np.concatenate(parts) if len(parts) > 1 else parts[0]
         if t == T_CAT:
             fr.add(name, Column.from_numpy(arr, ctype=T_CAT))
         elif t == T_STR:
@@ -94,13 +176,19 @@ def import_file(path: str, destination_frame: Optional[str] = None,
                 col_names: Optional[List[str]] = None,
                 col_types=None, na_strings=None, **kw) -> H2OFrame:
     """h2o.import_file parity (h2o-py/h2o/h2o.py import_file): resolves
-    globs/dirs, guesses setup, parses."""
-    paths = sorted(_glob.glob(path)) if any(ch in path for ch in "*?[") else [path]
-    if len(paths) == 1 and os.path.isdir(paths[0]):
-        paths = sorted(
-            os.path.join(paths[0], f) for f in os.listdir(paths[0])
-            if not f.startswith(".")
-        )
+    remote URIs through the persist registry (water/persist/PersistManager
+    .java importFiles), then globs/dirs, guesses setup, parses."""
+    from h2o3_tpu import persist
+
+    if persist.is_remote(path):
+        paths = [persist.resolve(path)]      # fetched to the local cache
+    else:
+        paths = sorted(_glob.glob(path)) if any(ch in path for ch in "*?[") else [path]
+        if len(paths) == 1 and os.path.isdir(paths[0]):
+            paths = sorted(
+                os.path.join(paths[0], f) for f in os.listdir(paths[0])
+                if not f.startswith(".")
+            )
     if not paths:
         raise FileNotFoundError(path)
     ct = None

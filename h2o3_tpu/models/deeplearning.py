@@ -348,6 +348,10 @@ class DeepLearning(ModelBuilder):
 
         opt_state = opt.init(params0)
         key = jax.random.PRNGKey(seed)
+        if ep_start:
+            # resumed runs must not replay the original epochs' batch/dropout
+            # draws (same reseeding rule as the tree path's host RNG)
+            key = jax.random.fold_in(key, ep_start)
         params_t = params0
 
         model = DeepLearningModel(parms=dict(p))

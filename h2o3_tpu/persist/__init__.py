@@ -1,0 +1,108 @@
+"""Persist backends: URI scheme registry for remote data sources.
+
+Reference: water/persist/PersistManager.java — a scheme-keyed registry of
+Persist implementations (PersistNFS, PersistHTTP, PersistS3, PersistGCS,
+PersistHdfs) behind one importFiles/open facade; every ingest path resolves
+URIs through it.
+
+TPU-native design: schemes resolve to LOCAL file paths (remote objects are
+fetched once into a process-local cache dir, then the normal parse path —
+including the native C++ CSV parser and pyarrow columnar readers — runs on
+the local copy). The registry is open: `register_scheme` installs new
+backends at runtime (the Extension SPI analog for storage). Cloud schemes
+whose SDKs are not installed raise actionable errors instead of importing
+dead weight."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import urllib.parse
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+_CACHE_DIR: Optional[str] = None
+
+# scheme -> fetch(uri) -> local path
+_SCHEMES: Dict[str, Callable[[str], str]] = {}
+
+
+def cache_dir() -> str:
+    global _CACHE_DIR
+    if _CACHE_DIR is None:
+        _CACHE_DIR = tempfile.mkdtemp(prefix="h2o3_tpu_persist_")
+    return _CACHE_DIR
+
+
+def register_scheme(scheme: str, fetch: Callable[[str], str]) -> None:
+    """Install a storage backend: fetch(uri) must return a local file path."""
+    _SCHEMES[scheme.lower()] = fetch
+
+
+def _local_name(uri: str) -> str:
+    """Stable cache filename keeping the remote basename (extension drives
+    format dispatch in the parser)."""
+    import hashlib
+
+    base = os.path.basename(urllib.parse.urlparse(uri).path) or "download"
+    h = hashlib.sha1(uri.encode()).hexdigest()[:12]
+    return os.path.join(cache_dir(), f"{h}_{base}")
+
+
+def _fetch_http(uri: str) -> str:
+    """PersistHTTP analog: stream the object to the local cache once."""
+    dest = _local_name(uri)
+    if os.path.exists(dest):
+        return dest
+    tmp = dest + ".part"
+    with urllib.request.urlopen(uri, timeout=60) as r, open(tmp, "wb") as f:
+        shutil.copyfileobj(r, f)
+    os.replace(tmp, dest)
+    return dest
+
+
+def _fetch_file(uri: str) -> str:
+    p = urllib.parse.urlparse(uri)
+    return urllib.request.url2pathname(p.path)
+
+
+def _gated(scheme: str, pkg: str, ref: str):
+    def fetch(uri: str) -> str:
+        raise NotImplementedError(
+            f"{scheme}:// URIs need the {pkg} SDK, which is not installed in "
+            f"this environment. Fetch the object to a local path (or an "
+            f"http(s) endpoint) and import that instead. Reference analog: "
+            f"{ref}.")
+
+    return fetch
+
+
+register_scheme("http", _fetch_http)
+register_scheme("https", _fetch_http)
+register_scheme("file", _fetch_file)
+register_scheme("s3", _gated("s3", "boto3", "h2o-persist-s3/PersistS3.java"))
+register_scheme("gs", _gated("gs", "google-cloud-storage",
+                             "h2o-persist-gcs/PersistGcs.java"))
+register_scheme("hdfs", _gated("hdfs", "pyarrow HadoopFileSystem",
+                               "h2o-persist-hdfs/PersistHdfs.java"))
+
+
+def is_remote(path: str) -> bool:
+    return "://" in path
+
+
+def resolve(path: str) -> str:
+    """URI -> local path (identity for plain paths)."""
+    if not is_remote(path):
+        return path
+    scheme = path.split("://", 1)[0].lower()
+    fetch = _SCHEMES.get(scheme)
+    if fetch is None:
+        raise ValueError(f"no persist backend registered for scheme "
+                         f"{scheme!r} (have: {sorted(_SCHEMES)})")
+    return fetch(path)
+
+
+def resolve_all(paths: List[str]) -> List[str]:
+    return [resolve(p) for p in paths]

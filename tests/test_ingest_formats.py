@@ -1,0 +1,226 @@
+"""Columnar/structured format ingest + persist URI registry + parallel parse.
+
+Reference: h2o-parsers/h2o-parquet-parser/, h2o-orc-parser/,
+water/parser/ARFFParser.java, SVMLightParser.java,
+water/persist/PersistManager.java (+ PersistHTTP).
+"""
+
+import http.server
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu import persist
+from h2o3_tpu.ingest.parser import import_file
+
+
+@pytest.fixture(autouse=True)
+def _boot(cl):
+    pass
+
+
+def _pq_file(tmp_path, name="t.parquet", n=500):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(0)
+    cats = np.array(["lo", "mid", "hi"], object)[rng.integers(0, 3, n)]
+    cats[5] = None
+    x = rng.standard_normal(n)
+    x[3] = np.nan
+    table = pa.table({
+        "x": pa.array(x),
+        "i": pa.array(rng.integers(0, 100, n)),
+        "b": pa.array(rng.random(n) < 0.5),
+        "cat": pa.array(cats),
+        "ts": pa.array(np.array(["2024-01-01", "2024-06-15"], "datetime64[ms]")[
+            rng.integers(0, 2, n)]),
+    })
+    p = str(tmp_path / name)
+    pq.write_table(table, p)
+    return p, table
+
+
+class TestParquet:
+    def test_roundtrip(self, tmp_path):
+        p, table = _pq_file(tmp_path)
+        fr = import_file(p)
+        assert fr.nrows == 500 and fr.ncols == 5
+        assert fr.col("cat").is_categorical
+        assert sorted(fr.col("cat").domain) == ["hi", "lo", "mid"]
+        x = fr.col("x").to_numpy()
+        np.testing.assert_allclose(
+            np.nanmean(x), np.nanmean(table["x"].to_numpy(zero_copy_only=False)),
+            rtol=1e-5)
+        assert np.isnan(x[3])
+        assert fr.col("ts").ctype == "time"
+        # bool -> numeric 0/1
+        b = fr.col("b").to_numpy()
+        assert set(np.unique(b)) <= {0.0, 1.0}
+
+    def test_trains_a_model(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        rng = np.random.default_rng(1)
+        n = 600
+        x1 = rng.standard_normal(n)
+        y = np.where(rng.random(n) < 1 / (1 + np.exp(-2 * x1)), "Y", "N")
+        p = str(tmp_path / "train.parquet")
+        pq.write_table(pa.table({"x1": x1, "y": y}), p)
+        fr = import_file(p)
+        m = GBM(ntrees=5, max_depth=3, seed=1).train(y="y", training_frame=fr)
+        assert float(m._output.training_metrics.auc) > 0.7
+
+
+class TestOrcFeather:
+    def test_orc(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.orc as orc
+
+        p = str(tmp_path / "t.orc")
+        orc.write_table(pa.table({"a": [1.0, 2.0, 3.5],
+                                  "s": ["u", "v", "u"]}), p)
+        fr = import_file(p)
+        assert fr.nrows == 3
+        np.testing.assert_allclose(fr.col("a").to_numpy(), [1.0, 2.0, 3.5])
+        assert fr.col("s").domain == ["u", "v"]
+
+    def test_feather(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.feather as feather
+
+        p = str(tmp_path / "t.feather")
+        feather.write_feather(pa.table({"a": [1, 2, 3]}), p)
+        fr = import_file(p)
+        assert fr.nrows == 3 and fr.col("a").to_numpy()[2] == 3.0
+
+
+class TestArff:
+    def test_parse(self, tmp_path):
+        p = str(tmp_path / "t.arff")
+        with open(p, "w") as f:
+            f.write("% comment\n@relation demo\n"
+                    "@attribute age numeric\n"
+                    "@attribute grade {A,B,C}\n"
+                    "@attribute note string\n"
+                    "@data\n"
+                    "34,A,'hello'\n?,B,'x'\n12,?,'y'\n")
+        fr = import_file(p)
+        assert fr.names == ["age", "grade", "note"]
+        a = fr.col("age").to_numpy()
+        assert a[0] == 34 and np.isnan(a[1])
+        assert fr.col("grade").is_categorical
+        g = fr.col("grade").to_numpy()
+        assert g[2] < 0        # '?' -> NA
+
+
+class TestSVMLight:
+    def test_parse(self, tmp_path):
+        p = str(tmp_path / "t.svm")
+        with open(p, "w") as f:
+            f.write("1 1:0.5 3:2.0 # comment\n-1 2:1.5\n1 qid:4 1:1.0\n")
+        fr = import_file(p)
+        assert fr.ncols == 4         # label + 3 features
+        np.testing.assert_allclose(fr.col("C1").to_numpy(), [1, -1, 1])
+        np.testing.assert_allclose(fr.col("C2").to_numpy(), [0.5, 0.0, 1.0])
+        np.testing.assert_allclose(fr.col("C4").to_numpy(), [2.0, 0.0, 0.0])
+
+
+class TestPersist:
+    def test_http_import(self, tmp_path):
+        csv = tmp_path / "web.csv"
+        csv.write_text("a,b\n1,x\n2,y\n3,x\n")
+        handler = lambda *a, **kw: http.server.SimpleHTTPRequestHandler(  # noqa: E731
+            *a, directory=str(tmp_path), **kw)
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            uri = f"http://127.0.0.1:{srv.server_port}/web.csv"
+            fr = import_file(uri)
+            assert fr.nrows == 3
+            assert fr.col("b").domain == ["x", "y"]
+            # second fetch hits the cache (same resolved path)
+            assert persist.resolve(uri) == persist.resolve(uri)
+        finally:
+            srv.shutdown()
+
+    def test_gated_schemes(self):
+        with pytest.raises(NotImplementedError, match="boto3"):
+            persist.resolve("s3://bucket/key.csv")
+        with pytest.raises(ValueError, match="no persist backend"):
+            persist.resolve("weird://x")
+
+    def test_custom_scheme(self, tmp_path):
+        p = tmp_path / "c.csv"
+        p.write_text("a\n5\n")
+        persist.register_scheme("unittest", lambda uri: str(p))
+        try:
+            fr = import_file("unittest://anything")
+            assert fr.nrows == 1 and fr.col("a").to_numpy()[0] == 5.0
+        finally:
+            persist._SCHEMES.pop("unittest", None)
+
+
+class TestParallelMultiFile:
+    def test_glob_parse_matches_sequential_order(self, tmp_path):
+        for i in range(6):
+            (tmp_path / f"part{i}.csv").write_text(
+                "v,g\n" + "".join(f"{i * 100 + j},g{j % 2}\n" for j in range(50)))
+        fr = import_file(str(tmp_path / "part*.csv"))
+        assert fr.nrows == 300
+        v = fr.col("v").to_numpy()
+        # files concatenate in sorted order regardless of thread timing
+        expect = np.concatenate([i * 100 + np.arange(50) for i in range(6)])
+        np.testing.assert_allclose(v, expect)
+
+    def test_mismatched_columns_raise(self, tmp_path):
+        (tmp_path / "a1.csv").write_text("x,y\n1,2\n")
+        (tmp_path / "a2.csv").write_text("x,z\n1,2\n")
+        with pytest.raises(ValueError, match="column mismatch"):
+            import_file(str(tmp_path / "a?.csv"))
+
+    def test_custom_col_names_multi_file(self, tmp_path):
+        # user col_names override must not trip the cross-file header check
+        (tmp_path / "b1.csv").write_text("x,y\n1,2\n")
+        (tmp_path / "b2.csv").write_text("x,y\n3,4\n")
+        fr = import_file(str(tmp_path / "b?.csv"), col_names=["a", "b"])
+        assert fr.names == ["a", "b"] and fr.nrows == 2
+
+
+class TestOverridesAndTime:
+    def test_parquet_col_types_override(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        p = str(tmp_path / "o.parquet")
+        pq.write_table(pa.table({"k": [1.0, 2.0, 1.0, np.nan]}), p)
+        fr = import_file(p, col_types={"k": "enum"})
+        c = fr.col("k")
+        assert c.is_categorical and c.domain == ["1", "2"]
+        assert c.to_numpy()[3] < 0          # NaN -> NA code
+
+    def test_csv_time_is_epoch_millis(self, tmp_path, cl):
+        p = tmp_path / "t.csv"
+        p.write_text("d,v\n2024-01-01,1\n2024-06-15 12:00:00,2\n")
+        fr = import_file(str(p))
+        assert fr.col("d").ctype == "time"
+        ms = fr.col("d").to_numpy()
+        # 2024-01-01 epoch ms ≈ 1.704e12 (a ns value would be ≈1.7e18)
+        assert abs(ms[0] - 1704067200000.0) < 1e6
+
+    def test_arff_date_is_epoch_millis(self, tmp_path):
+        p = str(tmp_path / "d.arff")
+        with open(p, "w") as f:
+            f.write("@relation r\n@attribute when date\n@attribute v numeric\n"
+                    "@data\n2024-01-01,1\n?,2\n")
+        fr = import_file(p)
+        ms = fr.col("when").to_numpy()
+        assert abs(ms[0] - 1704067200000.0) < 1e6
+        assert np.isnan(ms[1])
