@@ -612,6 +612,17 @@ def h_predict_v3(ctx: Ctx):
     m = _model_or_404(ctx.params["model_id"])
     fr = _frame_or_404(ctx.params["frame_id"])
     dest = str(ctx.arg("predictions_frame", "") or "").strip('"') or None
+    if str(ctx.arg("predict_contributions", "")).lower() in ("1", "true"):
+        # genmodel TreeSHAP surfaced over REST (h2o-py predict_contributions)
+        pred = m.predict_contributions(fr)
+        if dest:
+            from h2o3_tpu.core.dkv import Key
+
+            pred._key = Key(dest)
+        pred.install()
+        return {"__meta": S.meta("ModelMetricsListSchemaV3"),
+                "predictions_frame": {"name": str(pred.key)},
+                "model_metrics": []}
     pred = m.predict(fr, key=dest)
     pred.install()
     mm = m.model_performance(fr)
@@ -635,6 +646,60 @@ def h_predict_v4(ctx: Ctx):
 
     job.start(run, background=True)
     return {"__meta": S.meta("JobV4"), "job": S.job_v3(job)}
+
+
+def h_pdp_post(ctx: Ctx):
+    """POST /3/PartialDependences (hex/PartialDependence.java; h2o-py
+    partial_plot). Runs synchronously; results land in DKV under the
+    destination key for the follow-up GET."""
+    from h2o3_tpu import explain
+    from h2o3_tpu.core.dkv import DKV as _DKV
+
+    m = _model_or_404(str(ctx.arg("model_id", "")).strip('"'))
+    fr = _frame_or_404(str(ctx.arg("frame_id", "")).strip('"'))
+    cols = _parse_list(ctx.arg("cols")) or None
+    nbins = int(ctx.arg("nbins", 20) or 20)
+    ri = ctx.arg("row_index", -1)
+    # explicit None/empty check: row_index=0 (ICE for the first row) is falsy
+    row_index = int(ri) if ri not in (None, "") else -1
+    wc = str(ctx.arg("weight_column", "") or "").strip('"') or None
+    dest = (str(ctx.arg("destination_key", "") or "").strip('"')
+            or f"pdp_{m.key}_{fr.key}")
+    tables = explain.partial_dependence(m, fr, cols, nbins=nbins,
+                                        weight_column=wc, row_index=row_index)
+    _DKV.put(dest, tables)
+    job = Job(description="PartialDependence")
+    job.dest_key = dest
+    job.status = Job.DONE
+    job.progress = 1.0
+    return {"__meta": S.meta("PartialDependenceV3"), "job": S.job_v3(job),
+            "destination_key": dest}
+
+
+def h_pdp_get(ctx: Ctx):
+    from h2o3_tpu.core.dkv import DKV as _DKV
+
+    tables = _DKV.get(ctx.params["key"])
+    if tables is None:
+        raise ApiError(f"no partial dependence result {ctx.params['key']!r}", 404)
+    out = [{"name": t["column"],
+            "columns": [{"name": t["column"]}, {"name": "mean_response"},
+                        {"name": "stddev_response"}],
+            "data": [t["values"], t["mean_response"], t["stddev_response"]]}
+           for t in tables]
+    return {"__meta": S.meta("PartialDependenceV3"),
+            "partial_dependence_data": out}
+
+
+def h_feature_interaction(ctx: Ctx):
+    """POST /3/FeatureInteraction (hex/tree FeatureInteraction analog)."""
+    from h2o3_tpu import explain
+
+    m = _model_or_404(str(ctx.arg("model_id", "")).strip('"'))
+    depth = int(ctx.arg("max_interaction_depth", 2) or 2)
+    rows = explain.feature_interactions(m, max_interaction_depth=depth)
+    return {"__meta": S.meta("FeatureInteractionV3"),
+            "feature_interaction": rows}
 
 
 def h_model_metrics(ctx: Ctx):
@@ -781,6 +846,10 @@ ROUTES: List[Tuple[str, str, Callable, str]] = [
      "Score a frame (async job)"),
     ("POST", "/3/ModelMetrics/models/{model_id}/frames/{frame_id}", h_model_metrics,
      "Compute model metrics on a frame"),
+    ("POST", "/3/PartialDependences", h_pdp_post, "Compute partial dependence"),
+    ("GET", "/3/PartialDependences/{key}", h_pdp_get, "Partial dependence result"),
+    ("POST", "/3/FeatureInteraction", h_feature_interaction,
+     "Tree-path feature interaction statistics"),
     ("GET", "/3/TargetEncoderTransform", h_te_transform,
      "Apply a trained TargetEncoder to a frame"),
     ("GET", "/3/Metadata/endpoints", h_metadata_endpoints, "List REST endpoints"),

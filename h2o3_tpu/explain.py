@@ -1,0 +1,365 @@
+"""Explanation suite: partial dependence, TreeSHAP contributions, feature
+interactions, multi-model varimp/correlation matrices.
+
+Reference: hex/PartialDependence.java (grid sweep -> mean/stddev response),
+h2o-genmodel/src/main/java/hex/genmodel/algos/tree/TreeSHAP.java (Lundberg
+path-dependent algorithm; surfaced as predict_contributions),
+hex/tree/FeatureInteraction*.java (XGBoost-style path pair statistics),
+h2o-py/h2o/explanation/_explain.py (varimp_heatmap / model_correlation
+matrix data — plotting stays client-side).
+
+TPU split of work: the PDP sweep and model-correlation matrices run the
+normal device scoring path per grid value / model (each predict is one
+fused XLA program over the row-sharded frame); TreeSHAP and interaction
+statistics are host-side walks over the compressed forest's (T, M) node
+tables — tree-shaped recursion with per-row path state is exactly the
+data-dependent control flow XLA cannot tile, and the reference runs it on
+the genmodel CPU path for the same reason.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Column, Frame, T_CAT, T_NUM
+from h2o3_tpu.models.model import Model, ModelCategory
+
+
+# ---------------------------------------------------------------------------
+# partial dependence (hex/PartialDependence.java)
+# ---------------------------------------------------------------------------
+
+def _response_vector(model: Model, frame: Frame) -> np.ndarray:
+    """The PDP response: P(class 1) for binomial, prediction for regression
+    (PartialDependence.java uses the same)."""
+    raw = model._predict_raw(model.adapt_test(frame))
+    if "probs" in raw:
+        return np.asarray(raw["probs"])[: frame.nrows, 1]
+    return np.asarray(raw["value"])[: frame.nrows]
+
+
+def _grid_for(col: Column, nbins: int) -> List:
+    if col.is_categorical:
+        return list(col.domain or [])
+    vals = col.to_numpy()
+    vals = vals[np.isfinite(vals)]
+    if len(vals) == 0:
+        return []
+    lo, hi = float(vals.min()), float(vals.max())
+    if lo == hi:
+        return [lo]
+    return list(np.linspace(lo, hi, nbins))
+
+
+def _with_value(frame: Frame, col_name: str, value, is_cat: bool,
+                domain) -> Frame:
+    out = Frame()
+    n = frame.nrows
+    for name in frame.names:
+        if name != col_name:
+            out.add(name, frame.col(name))
+            continue
+        if is_cat:
+            code = (domain.index(value) if value in domain else -1)
+            out.add(name, Column.from_numpy(
+                np.full(n, code, np.int32), ctype=T_CAT, domain=list(domain)))
+        else:
+            out.add(name, Column.from_numpy(np.full(n, value, np.float64)))
+    return out
+
+
+def partial_dependence(model: Model, frame: Frame,
+                       cols: Optional[Sequence[str]] = None,
+                       nbins: int = 20,
+                       weight_column: Optional[str] = None,
+                       row_index: int = -1) -> List[dict]:
+    """One table per column: {column, values, mean_response, stddev_response}.
+    row_index >= 0 computes an ICE curve for that single row instead of the
+    data average (PartialDependence.java _row_index)."""
+    cols = list(cols) if cols else list(model._output.names)
+    w = None
+    if weight_column and weight_column in frame:
+        w = frame.col(weight_column).to_numpy()
+    # grids always come from the FULL frame's value range; an ICE request
+    # then scores just the one row over that grid
+    grids = {c: _grid_for(frame.col(c), nbins) for c in cols if c in frame}
+    if row_index >= 0:
+        from h2o3_tpu.ops.filters import take_rows
+
+        frame = take_rows(frame, np.array([row_index]))
+        w = None
+    tables = []
+    for cname in cols:
+        if cname not in frame:
+            continue
+        col = frame.col(cname)
+        grid = grids[cname]
+        means, stds = [], []
+        for v in grid:
+            fr_v = _with_value(frame, cname, v, col.is_categorical,
+                               col.domain or [])
+            resp = _response_vector(model, fr_v)
+            if w is not None:
+                wm = float(np.sum(w * resp) / max(np.sum(w), 1e-12))
+                var = float(np.sum(w * (resp - wm) ** 2) / max(np.sum(w), 1e-12))
+                means.append(wm)
+                stds.append(np.sqrt(var))
+            else:
+                means.append(float(np.mean(resp)))
+                stds.append(float(np.std(resp)))
+        tables.append({"column": cname, "values": grid,
+                       "mean_response": means, "stddev_response": stds})
+    return tables
+
+
+def partial_dependence_2d(model: Model, frame: Frame,
+                          col_pairs: Sequence[Tuple[str, str]],
+                          nbins: int = 20) -> List[dict]:
+    """2D PDP (PartialDependence.java _col_pairs_2dpdp)."""
+    tables = []
+    for c1, c2 in col_pairs:
+        g1 = _grid_for(frame.col(c1), nbins)
+        g2 = _grid_for(frame.col(c2), nbins)
+        is1, is2 = frame.col(c1).is_categorical, frame.col(c2).is_categorical
+        d1, d2 = frame.col(c1).domain or [], frame.col(c2).domain or []
+        rows = []
+        for v1 in g1:
+            fr1 = _with_value(frame, c1, v1, is1, d1)
+            for v2 in g2:
+                fr12 = _with_value(fr1, c2, v2, is2, d2)
+                resp = _response_vector(model, fr12)
+                rows.append((v1, v2, float(np.mean(resp)),
+                             float(np.std(resp))))
+        tables.append({"columns": (c1, c2), "rows": rows})
+    return tables
+
+
+# ---------------------------------------------------------------------------
+# TreeSHAP (genmodel algos/tree/TreeSHAP.java — Lundberg alg. 2, the
+# path-dependent formulation over node covers)
+# ---------------------------------------------------------------------------
+
+def _shap_one_tree(x: np.ndarray, t: int, forest, phi: np.ndarray):
+    """Accumulate SHAP values of one binned row through tree t into phi
+    (size F+1; last slot collects the bias via the expected value)."""
+    feat = forest.feat[t]
+    thresh = forest.thresh_bin[t]
+    na_left = forest.na_left[t]
+    left = forest.left[t]
+    right = forest.right[t]
+    leaf_val = forest.leaf_val[t]
+    cat_split = forest.cat_split[t]
+    cover = forest.cover[t]
+    na_bins = forest.na_bins
+
+    def goes_left(node: int) -> bool:
+        f = feat[node]
+        b = x[f]
+        if b == na_bins[f]:
+            return bool(na_left[node])
+        cs = cat_split[node]
+        if cs >= 0:
+            return bool(forest.cat_table[cs, min(b, forest.cat_table.shape[1] - 1)])
+        return b <= thresh[node]
+
+    # path elements: lists of feature index d, zero fraction z, one fraction
+    # o, permutation weight w (Lundberg's m)
+    def extend(m, pz, po, pi):
+        # element lists are COPIED: the hot and cold recursions each extend
+        # the same parent path, and the weight updates below mutate in place
+        l = len(m)
+        m = [e[:] for e in m] + [[pi, pz, po, 1.0 if l == 0 else 0.0]]
+        for i in range(l - 1, -1, -1):
+            m[i + 1][3] += po * m[i][3] * (i + 1) / (l + 1)
+            m[i][3] = pz * m[i][3] * (l - i) / (l + 1)
+        return m
+
+    def unwind(m, i):
+        l = len(m) - 1
+        n = m[l][3]
+        out = [e[:] for e in m[:-1]]
+        for j in range(l - 1, -1, -1):
+            if m[i][2] != 0:
+                t_ = out[j][3]
+                out[j][3] = n * (l + 1) / ((j + 1) * m[i][2])
+                n = t_ - out[j][3] * m[i][1] * (l - j) / (l + 1)
+            else:
+                out[j][3] = out[j][3] * (l + 1) / (m[i][1] * (l - j))
+        for j in range(i, l):
+            out[j][0], out[j][1], out[j][2] = m[j + 1][0], m[j + 1][1], m[j + 1][2]
+        return out
+
+    def unwound_sum(m, i):
+        l = len(m) - 1
+        if m[i][2] != 0:
+            n = m[l][3]
+            tot = 0.0
+            for j in range(l - 1, -1, -1):
+                tmp = n / ((j + 1) * m[i][2])
+                tot += tmp
+                n = m[j][3] - tmp * m[i][1] * (l - j)
+            return tot * (l + 1)
+        tot = 0.0
+        for j in range(l):
+            tot += m[j][3] / (m[i][1] * (l - j))
+        return tot * (l + 1)
+
+    def recurse(node, m, pz, po, pi):
+        m = extend(m, pz, po, pi)
+        if feat[node] < 0:
+            v = leaf_val[node]
+            for i in range(1, len(m)):
+                w = unwound_sum(m, i)
+                phi[m[i][0]] += w * (m[i][2] - m[i][1]) * v
+            return
+        h, c = (left[node], right[node]) if goes_left(node) \
+            else (right[node], left[node])
+        iz, io = 1.0, 1.0
+        k = next((i for i in range(1, len(m)) if m[i][0] == feat[node]), -1)
+        if k >= 0:
+            iz, io = m[k][1], m[k][2]
+            m = unwind(m, k)
+        rj = max(float(cover[node]), 1e-12)
+        recurse(h, m, iz * float(cover[h]) / rj, io, int(feat[node]))
+        recurse(c, m, iz * float(cover[c]) / rj, 0.0, int(feat[node]))
+
+    recurse(0, [], 1.0, 1.0, -1)
+
+
+def _expected_value(forest, t: int) -> float:
+    """Cover-weighted mean leaf value of tree t (the per-tree bias)."""
+    feat, cover, lv = forest.feat[t], forest.cover[t], forest.leaf_val[t]
+    leaves = feat < 0
+    used = leaves & (cover > 0)
+    root = max(float(cover[0]), 1e-12)
+    return float(np.sum(cover[used] * lv[used]) / root)
+
+
+def predict_contributions(model, frame: Frame) -> Frame:
+    """Per-row, per-feature SHAP contributions in margin space + BiasTerm
+    (Model.scoreContributions contract: rowSum(contribs) + BiasTerm ==
+    raw prediction). Binomial contributions are log-odds, as in the
+    reference."""
+    forest = getattr(model, "forest", None)
+    if forest is None or getattr(forest, "cover", None) is None:
+        raise ValueError("predict_contributions needs a tree model trained "
+                         "with node covers (GBM/DRF)")
+    if forest.nclasses > 2:
+        raise ValueError("predict_contributions supports binomial/regression "
+                         "models only (reference restriction)")
+    adapted = model.adapt_test(frame)
+    binned = np.asarray(model.spec.bin_columns(adapted))[: frame.nrows]
+    names = model._output.names
+    F = len(names)
+    n = binned.shape[0]
+    phi = np.zeros((n, F + 1), np.float64)
+    bias = forest.init_f
+    for t in range(forest.n_trees):
+        bias += _expected_value(forest, t)
+        for r in range(n):
+            _shap_one_tree(binned[r], t, forest, phi[r])
+    out = Frame()
+    for j, nm in enumerate(names):
+        out.add(nm, Column.from_numpy(phi[:, j]))
+    out.add("BiasTerm", Column.from_numpy(np.full(n, bias, np.float64)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# feature interactions (hex/tree FeatureInteraction — XGBoost-style path
+# pair statistics)
+# ---------------------------------------------------------------------------
+
+def feature_interactions(model, max_interaction_depth: int = 2) -> List[dict]:
+    """Ranked interaction table over all trees:
+
+    - depth-0 rows: one per FEATURE — gain/cover/count summed over exactly
+      that feature's split nodes (so singleton gains total the forest's
+      split gain, with no double counting);
+    - depth-1 rows: one per unordered FEATURE PAIR — for each split node v
+      with an ancestor split a on a different feature, v's gain/cover is
+      attributed once to the pair {feat(a), feat(v)} (the gain realized by
+      splitting on one feature conditioned on the other).
+
+    max_interaction_depth currently bounds pairs (the reference's deeper
+    combinations reduce to repeated application of the same attribution).
+    """
+    forest = getattr(model, "forest", None)
+    if forest is None or getattr(forest, "gain", None) is None:
+        raise ValueError("feature_interactions needs a tree model with "
+                         "recorded split gains")
+    names = model._output.names
+    stats: Dict[Tuple[str, ...], List[float]] = {}
+
+    def record(combo: Tuple[str, ...], gain: float, cover: float):
+        s = stats.setdefault(combo, [0.0, 0.0, 0])
+        s[0] += gain
+        s[1] += cover
+        s[2] += 1
+
+    for t in range(forest.n_trees):
+        feat, left, right = forest.feat[t], forest.left[t], forest.right[t]
+        gain, cover = forest.gain[t], forest.cover[t]
+
+        def walk(node, anc_feats):
+            if feat[node] < 0:
+                return
+            fname = names[feat[node]]
+            record((fname,), float(gain[node]), float(cover[node]))
+            if max_interaction_depth >= 2:
+                for af in set(anc_feats):
+                    if af != fname:
+                        record(tuple(sorted((af, fname))),
+                               float(gain[node]), float(cover[node]))
+            nxt = anc_feats + [fname]
+            walk(int(left[node]), nxt)
+            walk(int(right[node]), nxt)
+
+        walk(0, [])
+    rows = [{"interaction": " | ".join(k), "depth": len(k) - 1,
+             "gain": v[0], "cover": v[1], "count": v[2]}
+            for k, v in stats.items()]
+    rows.sort(key=lambda r: -r["gain"])
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# multi-model explanation matrices (h2o-py explanation/_explain.py data)
+# ---------------------------------------------------------------------------
+
+def varimp_matrix(models: Sequence[Model]) -> dict:
+    """Aligned variable-importance matrix across models (the varimp_heatmap
+    data): {features, models, matrix} with NaN where a model lacks a
+    feature."""
+    feats: List[str] = []
+    for m in models:
+        for f in (m.varimp() or {}):
+            if f not in feats:
+                feats.append(f)
+    mat = np.full((len(feats), len(models)), np.nan)
+    for j, m in enumerate(models):
+        vi = m.varimp() or {}
+        for i, f in enumerate(feats):
+            if f in vi:
+                mat[i, j] = vi[f]
+    return {"features": feats,
+            "models": [str(m.key) for m in models],
+            "matrix": mat}
+
+
+def model_correlation(models: Sequence[Model], frame: Frame) -> dict:
+    """Pairwise Spearman-free prediction correlation matrix (the
+    model_correlation_heatmap data): binomial models correlate P(class 1),
+    regression models their predictions."""
+    preds = []
+    for m in models:
+        raw = m._predict_raw(m.adapt_test(frame))
+        if "probs" in raw:
+            preds.append(np.asarray(raw["probs"])[: frame.nrows, 1])
+        else:
+            preds.append(np.asarray(raw["value"])[: frame.nrows])
+    P = np.stack(preds)
+    C = np.corrcoef(P)
+    return {"models": [str(m.key) for m in models], "matrix": C}

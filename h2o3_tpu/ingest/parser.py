@@ -145,6 +145,17 @@ def parse(paths: Sequence[str], setup: ParseSetup,
 
         with ThreadPoolExecutor(max_workers=min(8, len(paths))) as pool:
             results = list(pool.map(lambda p: _parse_one(p, setup), paths))
+    if setup.parse_type == "SVMLight" and len(results) > 1:
+        # sparse files densify to their own max feature index; unify widths
+        # (zero-default) before the cross-file consistency check
+        widest = max(results, key=lambda r: len(r[1]))
+        wnames, wtypes = widest[1], widest[2]
+        for cols_i, n_i, _t in results:
+            nr = len(cols_i[n_i[0]]) if n_i else 0
+            for nm in wnames[len(n_i):]:
+                cols_i[nm] = np.zeros(nr, np.float64)
+            n_i[:] = wnames
+            _t[:] = wtypes
     _, names, types = results[0]
     for p, (_, n_i, t_i) in zip(paths[1:], results[1:]):
         if n_i != names:
@@ -155,18 +166,24 @@ def parse(paths: Sequence[str], setup: ParseSetup,
             raise ValueError(
                 f"column type mismatch across files: {p} has {t_i}, "
                 f"expected {types}")
+    # user col_names renames apply to every format (the CSV reader honors
+    # them at read time; columnar/ARFF/SVMLight files carry their own names,
+    # renamed here position-for-position)
+    final_names = (list(setup.column_names)
+                   if setup.column_names and len(setup.column_names) == len(names)
+                   else list(names))
     fr = H2OFrame(destination_frame=destination_frame)
-    for name, t in zip(names, types):
+    for name, final, t in zip(names, final_names, types):
         parts = [r[0][name] for r in results]
         arr = np.concatenate(parts) if len(parts) > 1 else parts[0]
         if t == T_CAT:
-            fr.add(name, Column.from_numpy(arr, ctype=T_CAT))
+            fr.add(final, Column.from_numpy(arr, ctype=T_CAT))
         elif t == T_STR:
-            fr.add(name, Column.from_numpy(arr.astype(object)))
+            fr.add(final, Column.from_numpy(arr.astype(object)))
         elif t == T_TIME:
-            fr.add(name, Column.from_numpy(arr, ctype=T_TIME))
+            fr.add(final, Column.from_numpy(arr, ctype=T_TIME))
         else:
-            fr.add(name, Column.from_numpy(arr))
+            fr.add(final, Column.from_numpy(arr))
     log.info(f"parsed {len(paths)} file(s) -> {fr.nrows}x{fr.ncols} [{fr.frame_id}]")
     return fr
 

@@ -232,6 +232,34 @@ class Model(Keyed):
                                              distribution=dist)
         return None
 
+    # -- explanation (hex/PartialDependence, genmodel TreeSHAP,
+    #    FeatureInteraction; h2o-py Model API names) ------------------------
+    def partial_plot(self, data: Frame, cols: Optional[List[str]] = None,
+                     nbins: int = 20, plot: bool = False,
+                     weight_column: Optional[str] = None,
+                     row_index: int = -1, col_pairs_2dpdp=None):
+        """Partial-dependence tables (plotting stays client-side)."""
+        from h2o3_tpu import explain
+
+        if col_pairs_2dpdp:
+            return explain.partial_dependence_2d(self, data, col_pairs_2dpdp,
+                                                 nbins=nbins)
+        return explain.partial_dependence(self, data, cols, nbins=nbins,
+                                          weight_column=weight_column,
+                                          row_index=row_index)
+
+    def predict_contributions(self, test_data: Frame) -> Frame:
+        """Per-feature SHAP contributions + BiasTerm (tree models)."""
+        from h2o3_tpu import explain
+
+        return explain.predict_contributions(self, test_data)
+
+    def feature_interaction(self, max_interaction_depth: int = 2):
+        from h2o3_tpu import explain
+
+        return explain.feature_interactions(
+            self, max_interaction_depth=max_interaction_depth)
+
     # -- persistence ------------------------------------------------------
     def download_mojo(self, path: str) -> str:
         """Export this model as a MOJO zip (hex/genmodel MojoWriter analog;

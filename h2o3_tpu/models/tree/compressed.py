@@ -48,6 +48,10 @@ class CompressedForest:
         self.init_f = float(init_f)
         self.nclasses = int(nclasses)
         self.init_class = None        # (K,) per-class prior margins (multinomial)
+        # host-only explanation metadata (TreeSHAP covers, FeatureInteraction
+        # gains): (T, M) or None for forests built before they were recorded
+        self.gain = None
+        self.cover = None
 
     @property
     def n_trees(self) -> int:
@@ -68,8 +72,11 @@ class CompressedForest:
         cat_split = np.full((T, M), -1, np.int32)
         cat_rows = []
         maxB = int(spec.nbins.max())
+        gain = np.zeros((T, M), np.float32)
+        cover = np.zeros((T, M), np.float32)
         for ti, tree in enumerate(trees):
             for n in tree.nodes:
+                cover[ti, n.nid] = n.weight
                 if n.split is None:
                     leaf_val[ti, n.nid] = n.leaf_value
                     continue
@@ -78,6 +85,7 @@ class CompressedForest:
                 na_left[ti, n.nid] = s.na_left
                 left[ti, n.nid] = n.left
                 right[ti, n.nid] = n.right
+                gain[ti, n.nid] = max(s.gain, 0.0)
                 if s.is_cat:
                     row = np.zeros(maxB, bool)
                     row[: len(s.left_bins)] = s.left_bins
@@ -89,11 +97,14 @@ class CompressedForest:
                      else np.zeros((1, maxB), bool))
         tc = (np.asarray(tree_class, np.int32) if tree_class is not None
               else np.zeros(T, np.int32))
-        return CompressedForest(feat, thresh, na_left, left, right, leaf_val,
-                                cat_split, cat_table, tc,
-                                (spec.nbins - 1).astype(np.int32),
-                                max_depth=max_depth, init_f=init_f,
-                                nclasses=nclasses)
+        out = CompressedForest(feat, thresh, na_left, left, right, leaf_val,
+                               cat_split, cat_table, tc,
+                               (spec.nbins - 1).astype(np.int32),
+                               max_depth=max_depth, init_f=init_f,
+                               nclasses=nclasses)
+        out.gain = gain
+        out.cover = cover
+        return out
 
     @staticmethod
     def concat(a: "CompressedForest", b: "CompressedForest", *,
@@ -143,6 +154,11 @@ class CompressedForest:
             max_depth=max(a.max_depth, b.max_depth),
             init_f=a.init_f, nclasses=a.nclasses)
         out.init_class = a.init_class
+        ga = getattr(a, "gain", None)
+        gb = getattr(b, "gain", None)
+        if ga is not None and gb is not None:
+            out.gain = cat(pad(ga, 0), pad(gb, 0))
+            out.cover = cat(pad(a.cover, 0), pad(b.cover, 0))
         return out
 
     # -- device scoring ----------------------------------------------------

@@ -1,0 +1,177 @@
+"""Explanation suite: PDP, TreeSHAP contributions, feature interactions,
+multi-model matrices.
+
+Reference: hex/PartialDependence.java, genmodel algos/tree/TreeSHAP.java
+(local accuracy: contributions + bias == raw margin), hex/tree
+FeatureInteraction, h2o-py explanation/_explain.py.
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import explain
+from h2o3_tpu.core.frame import Column, Frame
+from h2o3_tpu.models.glm import GLM
+from h2o3_tpu.models.tree.drf import DRF
+from h2o3_tpu.models.tree.gbm import GBM
+
+
+@pytest.fixture(scope="module")
+def setup(cl):
+    rng = np.random.default_rng(5)
+    n = 800
+    x1 = rng.standard_normal(n)
+    x2 = rng.standard_normal(n)
+    g = np.array(["a", "b"], object)[rng.integers(0, 2, n)]
+    logit = 2.0 * x1 + 0.5 * x2 * (g == "a")
+    y = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "Y", "N")
+    fr = Frame()
+    fr.add("x1", Column.from_numpy(x1))
+    fr.add("x2", Column.from_numpy(x2))
+    fr.add("g", Column.from_numpy(g, ctype="enum"))
+    fr.add("y", Column.from_numpy(y, ctype="enum"))
+    gbm = GBM(ntrees=10, max_depth=3, seed=1).train(y="y", training_frame=fr)
+    return fr, gbm
+
+
+class TestPDP:
+    def test_tables_and_monotonicity(self, setup):
+        fr, gbm = setup
+        tables = gbm.partial_plot(fr, cols=["x1", "g"], nbins=8)
+        assert [t["column"] for t in tables] == ["x1", "g"]
+        t1 = tables[0]
+        assert len(t1["values"]) == 8
+        # response is P(Y): must rise with x1 (the dominant positive effect)
+        assert t1["mean_response"][-1] > t1["mean_response"][0] + 0.3
+        tg = tables[1]
+        assert tg["values"] == ["a", "b"]
+
+    def test_ice_row(self, setup):
+        fr, gbm = setup
+        tables = gbm.partial_plot(fr, cols=["x1"], nbins=5, row_index=3)
+        assert len(tables[0]["mean_response"]) == 5
+        assert all(s == 0.0 for s in tables[0]["stddev_response"])
+
+    def test_2d(self, setup):
+        fr, gbm = setup
+        tabs = gbm.partial_plot(fr, col_pairs_2dpdp=[("x1", "g")], nbins=4)
+        assert tabs[0]["columns"] == ("x1", "g")
+        assert len(tabs[0]["rows"]) == 4 * 2
+
+
+class TestTreeSHAP:
+    def test_local_accuracy_gbm(self, setup):
+        """Lundberg local accuracy: sum(phi) + bias == margin, per row."""
+        fr, gbm = setup
+        sub = 40
+        from h2o3_tpu.ops.filters import take_rows
+
+        fs = take_rows(fr, np.arange(sub))
+        contribs = gbm.predict_contributions(fs)
+        assert contribs.names == ["x1", "x2", "g", "BiasTerm"]
+        mat = np.stack([contribs.col(c).to_numpy() for c in contribs.names], 1)
+        total = mat.sum(axis=1)
+        binned = gbm.spec.bin_columns(gbm.adapt_test(fs))
+        margin = np.asarray(gbm.forest.predict_binned(binned))[:sub] + 0.0
+        np.testing.assert_allclose(total, margin, atol=2e-3)
+        # x1 drives the signal: its mean |phi| dominates
+        ax1 = np.abs(contribs.col("x1").to_numpy()).mean()
+        ax2 = np.abs(contribs.col("x2").to_numpy()).mean()
+        assert ax1 > 3 * ax2
+
+    def test_local_accuracy_drf_regression(self, cl):
+        rng = np.random.default_rng(9)
+        n = 400
+        X = rng.standard_normal((n, 3))
+        yv = 3 * X[:, 0] - X[:, 1] + rng.normal(0, 0.1, n)
+        fr = Frame.from_numpy(X, names=["a", "b", "c"])
+        fr.add("y", Column.from_numpy(yv))
+        m = DRF(ntrees=5, max_depth=4, seed=2, sample_rate=1.0,
+                mtries=3).train(y="y", training_frame=fr)
+        from h2o3_tpu.ops.filters import take_rows
+
+        fs = take_rows(fr, np.arange(25))
+        contribs = m.predict_contributions(fs)
+        mat = np.stack([contribs.col(c).to_numpy() for c in contribs.names], 1)
+        binned = m.spec.bin_columns(m.adapt_test(fs))
+        margin = np.asarray(m.forest.predict_binned(binned))[:25]
+        np.testing.assert_allclose(mat.sum(axis=1), margin, atol=2e-3)
+
+    def test_rejects_non_tree(self, setup):
+        fr, _ = setup
+        glm = GLM(family="binomial", seed=1).train(y="y", training_frame=fr)
+        with pytest.raises(ValueError, match="tree model"):
+            glm.predict_contributions(fr)
+
+
+class TestFeatureInteraction:
+    def test_ranked_table(self, setup):
+        fr, gbm = setup
+        rows = gbm.feature_interaction()
+        assert rows and rows[0]["gain"] >= rows[-1]["gain"]
+        singles = {r["interaction"] for r in rows if r["depth"] == 0}
+        assert "x1" in singles
+        # x2 only matters jointly with g: a pair row must exist
+        pairs = {r["interaction"] for r in rows if r["depth"] == 1}
+        assert pairs, rows[:5]
+
+    def test_singleton_gain_exact(self, setup):
+        """Singleton rows must sum exactly to the per-feature split gains
+        (no path double counting)."""
+        fr, gbm = setup
+        rows = gbm.feature_interaction()
+        f = gbm.forest
+        expect = {}
+        names = gbm._output.names
+        for t in range(f.n_trees):
+            for node in range(f.feat.shape[1]):
+                ft = f.feat[t, node]
+                if ft >= 0:
+                    expect[names[ft]] = expect.get(names[ft], 0.0) \
+                        + float(f.gain[t, node])
+        got = {r["interaction"]: r["gain"] for r in rows if r["depth"] == 0}
+        for k, v in expect.items():
+            assert abs(got[k] - v) < 1e-6 * max(1.0, abs(v)), (k, got[k], v)
+
+
+class TestMultiModel:
+    def test_varimp_matrix_and_correlation(self, setup):
+        fr, gbm = setup
+        drf = DRF(ntrees=5, max_depth=5, seed=2).train(y="y", training_frame=fr)
+        vm = explain.varimp_matrix([gbm, drf])
+        assert vm["matrix"].shape == (len(vm["features"]), 2)
+        assert "x1" in vm["features"]
+        mc = explain.model_correlation([gbm, drf], fr)
+        C = mc["matrix"]
+        assert C.shape == (2, 2)
+        np.testing.assert_allclose(np.diag(C), 1.0, atol=1e-6)
+        assert C[0, 1] > 0.7      # both models learn the same signal
+
+
+class TestExplainREST:
+    def test_pdp_and_contributions_endpoints(self, setup):
+        fr, gbm = setup
+        fr.install()
+        from h2o3_tpu import client
+        from h2o3_tpu.api.server import start_server
+
+        srv = start_server(port=0)
+        try:
+            client.connect(port=srv.port)
+            body = client._req(
+                "POST", "/3/PartialDependences",
+                data={"model_id": str(gbm.key), "frame_id": str(fr.key),
+                      "cols": '["x1"]', "nbins": "5"})
+            dest = body["destination_key"]
+            body = client._req("GET", f"/3/PartialDependences/{dest}")
+            assert len(body["partial_dependence_data"]) == 1
+            body = client._req(
+                "POST", f"/3/Predictions/models/{gbm.key}/frames/{fr.key}",
+                data={"predict_contributions": "true"})
+            assert body["predictions_frame"]["name"]
+            body = client._req(
+                "POST", "/3/FeatureInteraction",
+                data={"model_id": str(gbm.key), "max_interaction_depth": "2"})
+            assert body["feature_interaction"]
+        finally:
+            srv.stop()

@@ -194,6 +194,24 @@ class TestParallelMultiFile:
         assert fr.names == ["a", "b"] and fr.nrows == 2
 
 
+class TestReviewFixes:
+    def test_parquet_col_names_rename(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        p = str(tmp_path / "r.parquet")
+        pq.write_table(pa.table({"k": [1.0], "j": [2.0]}), p)
+        fr = import_file(p, col_names=["a", "b"])
+        assert fr.names == ["a", "b"]
+
+    def test_svmlight_multifile_widths(self, tmp_path):
+        (tmp_path / "s1.svm").write_text("1 1:1.0 5:2.0\n")
+        (tmp_path / "s2.svm").write_text("0 2:3.0\n")
+        fr = import_file(str(tmp_path / "s?.svm"))
+        assert fr.ncols == 6 and fr.nrows == 2
+        np.testing.assert_allclose(fr.col("C6").to_numpy(), [2.0, 0.0])
+
+
 class TestOverridesAndTime:
     def test_parquet_col_types_override(self, tmp_path):
         import pyarrow as pa
