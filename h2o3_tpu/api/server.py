@@ -202,7 +202,21 @@ def h_logs(ctx: Ctx):
 
 
 def h_timeline(ctx: Ctx):
-    return {"__meta": S.meta("TimelineV3"), "events": list(_TIMELINE)}
+    """REST request ring merged with the framework TimeLine (task profiles,
+    XLA traces, boot probes) — water/TimeLine.java:22 + TimelineHandler."""
+    from h2o3_tpu.utils import timeline
+
+    evs = ([dict(e, kind="rest") for e in _TIMELINE] + timeline.events())
+    evs.sort(key=lambda e: e.get("time_ms", 0))
+    return {"__meta": S.meta("TimelineV3"), "events": evs}
+
+
+def h_profiler(ctx: Ctx):
+    """GET /3/Profiler — per-device HBM gauges (the reference's JVM stack
+    profiles map to device memory pressure here)."""
+    from h2o3_tpu.utils import timeline
+
+    return {"__meta": S.meta("ProfilerV3"), "nodes": timeline.device_memory()}
 
 
 # -- import / parse ---------------------------------------------------------
@@ -614,10 +628,12 @@ def h_predict_v3(ctx: Ctx):
     dest = str(ctx.arg("predictions_frame", "") or "").strip('"') or None
     if str(ctx.arg("predict_contributions", "")).lower() in ("1", "true"):
         # genmodel TreeSHAP surfaced over REST (h2o-py predict_contributions)
+        if fr.nrows > 100_000:
+            raise ApiError("predict_contributions over REST is capped at "
+                           "100k rows (host-side TreeSHAP); subset the "
+                           "frame first", 400)
         pred = m.predict_contributions(fr)
         if dest:
-            from h2o3_tpu.core.dkv import Key
-
             pred._key = Key(dest)
         pred.install()
         return {"__meta": S.meta("ModelMetricsListSchemaV3"),
@@ -653,7 +669,6 @@ def h_pdp_post(ctx: Ctx):
     partial_plot). Runs synchronously; results land in DKV under the
     destination key for the follow-up GET."""
     from h2o3_tpu import explain
-    from h2o3_tpu.core.dkv import DKV as _DKV
 
     m = _model_or_404(str(ctx.arg("model_id", "")).strip('"'))
     fr = _frame_or_404(str(ctx.arg("frame_id", "")).strip('"'))
@@ -667,7 +682,7 @@ def h_pdp_post(ctx: Ctx):
             or f"pdp_{m.key}_{fr.key}")
     tables = explain.partial_dependence(m, fr, cols, nbins=nbins,
                                         weight_column=wc, row_index=row_index)
-    _DKV.put(dest, tables)
+    DKV.put(dest, tables)
     job = Job(description="PartialDependence")
     job.dest_key = dest
     job.status = Job.DONE
@@ -677,9 +692,7 @@ def h_pdp_post(ctx: Ctx):
 
 
 def h_pdp_get(ctx: Ctx):
-    from h2o3_tpu.core.dkv import DKV as _DKV
-
-    tables = _DKV.get(ctx.params["key"])
+    tables = DKV.get(ctx.params["key"])
     if tables is None:
         raise ApiError(f"no partial dependence result {ctx.params['key']!r}", 404)
     out = [{"name": t["column"],
@@ -812,6 +825,7 @@ ROUTES: List[Tuple[str, str, Callable, str]] = [
     ("POST", "/3/Shutdown", h_shutdown, "Shut the server down"),
     ("GET", "/3/Logs", h_logs, "Server log tail"),
     ("GET", "/3/Timeline", h_timeline, "Recent request timeline"),
+    ("GET", "/3/Profiler", h_profiler, "Per-device memory gauges"),
     ("GET", "/3/ImportFiles", h_importfiles, "List importable files"),
     ("POST", "/3/ImportFilesMulti", h_importfiles_multi, "List files for many paths"),
     ("POST", "/3/PostFile", h_postfile, "Upload a raw file"),

@@ -35,6 +35,9 @@ class OptArgs:
     # row shard padding multiple (static shapes: ESPC replaced by padding,
     # SURVEY.md §7 "ESPC ragged chunks -> equal shard sizes with tail padding")
     row_align: int = 8
+    # device storage dtype for numeric columns: "float32" (default) or
+    # "bfloat16" (halves HBM; ops upcast at their boundaries)
+    numeric_dtype: str = "float32"
     log_level: str = "INFO"
     ice_root: str = field(default_factory=lambda: os.environ.get("H2O_TPU_ICE_ROOT", "/tmp/h2o3_tpu"))
     # multi-host
@@ -48,7 +51,8 @@ class OptArgs:
     @staticmethod
     def from_env() -> "OptArgs":
         args = OptArgs()
-        for f in ("name", "log_level", "ice_root", "coordinator_address"):
+        for f in ("name", "log_level", "ice_root", "coordinator_address",
+                  "numeric_dtype"):
             v = os.environ.get("H2O_TPU_" + f.upper())
             if v is not None:
                 setattr(args, f, v)
@@ -157,10 +161,13 @@ class Cluster:
         }
 
     def self_benchmark(self, size: int = 1024) -> dict:
-        """Boot-probe analog of water/init/Linpack.java — measures device
-        matmul GFLOPs and HBM copy bandwidth."""
+        """Boot probes, the analogs of water/init/Linpack.java (matmul
+        GFLOPs), water/init/MemoryBandwidth.java (HBM stream GB/s) and
+        water/init/NetworkBench.java (collective latency over the mesh —
+        ICI on real pods)."""
         import jax
         import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
 
         x = jnp.ones((size, size), jnp.float32)
         f = jax.jit(lambda a: a @ a)
@@ -173,7 +180,38 @@ class Cluster:
         y.block_until_ready()
         dt = time.perf_counter() - t0
         gflops = 2 * size**3 * reps / dt / 1e9
-        return {"matmul_gflops": gflops, "size": size}
+
+        # HBM stream: out = a + b reads 2 arrays and writes 1
+        n = 4 * size * size
+        a = jnp.ones(n, jnp.float32)
+        b = jnp.ones(n, jnp.float32)
+        g = jax.jit(lambda u, v: u + v)
+        g(a, b).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            c = g(a, b)
+        c.block_until_ready()
+        dt = time.perf_counter() - t0
+        membw = 3 * n * 4 * reps / dt / 1e9
+
+        # collective round: psum of a scalar-per-shard over the rows axis
+        ps = jax.jit(jax.shard_map(lambda v: jax.lax.psum(v, "rows"),
+                                   mesh=self.mesh, in_specs=P("rows"),
+                                   out_specs=P()))
+        vec = jnp.ones(self.n_devices, jnp.float32)
+        ps(vec).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(50):
+            r = ps(vec)
+        r.block_until_ready()
+        psum_us = (time.perf_counter() - t0) / 50 * 1e6
+        out = {"matmul_gflops": gflops, "membw_gbps": membw,
+               "psum_latency_us": psum_us, "size": size}
+        from h2o3_tpu.utils import timeline
+
+        timeline.record("self_benchmark", "boot_probe", **{
+            k: round(v, 2) for k, v in out.items() if k != "size"})
+        return out
 
 
 _LOCK = threading.Lock()

@@ -1,0 +1,121 @@
+"""TimeLine event ring + task profiling + jax.profiler wiring.
+
+Reference: water/TimeLine.java:22 — a per-node lock-free ring of wire events
+snapshotted over REST; water/MRTask.java:188-192,314-376 — opt-in `.profile()`
+phase timings (setup/map/reduce/remote-block) per distributed task.
+
+TPU-native mapping: the interesting events are no longer UDP packets but XLA
+dispatches — per-task host-side phases (build/trace lookup, device run,
+blocking fetch) — plus HBM gauges and the XLA profiler's own trace files.
+The ring is process-wide and cheap enough to stay always-on; per-phase task
+timing is opt-in via H2O_TPU_PROFILE=1 (it forces a device sync per task,
+which the async dispatch pipeline must not pay by default)."""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+_RING: collections.deque = collections.deque(maxlen=4096)
+_LOCK = threading.Lock()
+
+
+def record(kind: str, what: str, ms: Optional[float] = None, **meta) -> None:
+    ev = {"time_ms": int(time.time() * 1000), "kind": kind, "what": what}
+    if ms is not None:
+        ev["ms"] = round(float(ms), 3)
+    if meta:
+        ev.update(meta)
+    with _LOCK:
+        _RING.append(ev)
+
+
+def events(n: Optional[int] = None) -> List[dict]:
+    with _LOCK:
+        evs = list(_RING)
+    return evs[-n:] if n else evs
+
+
+def clear() -> None:
+    with _LOCK:
+        _RING.clear()
+
+
+def profiling_enabled() -> bool:
+    return bool(os.environ.get("H2O_TPU_PROFILE", ""))
+
+
+@contextlib.contextmanager
+def task(kind: str, what: str, **meta):
+    """Time a host-side phase into the ring (always-on; no device sync)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record(kind, what, ms=(time.perf_counter() - t0) * 1000, **meta)
+
+
+class TaskProfile:
+    """MRTask.profile() analog: per-phase wall times of one distributed task.
+    Collected only under H2O_TPU_PROFILE=1 (the fetch phase forces a device
+    sync)."""
+
+    __slots__ = ("what", "build_ms", "run_ms", "sync_ms")
+
+    def __init__(self, what: str):
+        self.what = what
+        self.build_ms = 0.0   # program lookup/trace (compile on cache miss)
+        self.run_ms = 0.0     # dispatch
+        self.sync_ms = 0.0    # block_until_ready
+
+    def emit(self):
+        record("task_profile", self.what, ms=self.build_ms + self.run_ms + self.sync_ms,
+               build_ms=round(self.build_ms, 3), run_ms=round(self.run_ms, 3),
+               sync_ms=round(self.sync_ms, 3))
+
+
+# -- XLA profiler wiring (reference: opt-in MRTask profiling; here the real
+#    hardware story is the XLA trace, viewable in xprof/tensorboard) ---------
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture an XLA profiler trace around a code block."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        record("xla_trace", log_dir, ms=(time.perf_counter() - t0) * 1000)
+
+
+def annotate(name: str):
+    """Named region inside a captured trace (TraceAnnotation)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def device_memory() -> List[Dict]:
+    """Per-device HBM gauges (the per-node memory columns of /3/Cloud;
+    water.Cleaner's MemoryManager numbers are the reference analog)."""
+    import jax
+
+    out = []
+    for d in jax.local_devices():
+        stats = {}
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:   # noqa: BLE001 — not all backends implement it
+            pass
+        out.append({"device": str(d),
+                    "bytes_in_use": stats.get("bytes_in_use"),
+                    "bytes_limit": stats.get("bytes_limit"),
+                    "peak_bytes_in_use": stats.get("peak_bytes_in_use")})
+    return out

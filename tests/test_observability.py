@@ -1,0 +1,92 @@
+"""Observability: TimeLine ring, MRTask phase profiling, boot probes,
+profiler REST surfaces.
+
+Reference: water/TimeLine.java:22, water/MRTask.java:188-192 (.profile),
+water/init/Linpack.java / MemoryBandwidth.java / NetworkBench.java,
+water/api/TimelineHandler + ProfilerHandler.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.utils import timeline
+
+
+class TestRing:
+    def test_record_and_fetch(self):
+        timeline.clear()
+        timeline.record("test", "hello", ms=1.5, extra=7)
+        evs = timeline.events()
+        assert evs[-1]["kind"] == "test" and evs[-1]["extra"] == 7
+
+    def test_task_context(self):
+        timeline.clear()
+        with timeline.task("phase", "work"):
+            pass
+        ev = timeline.events()[-1]
+        assert ev["what"] == "work" and ev["ms"] >= 0
+
+
+class TestTaskProfiling:
+    def test_map_reduce_phases(self, cl, monkeypatch):
+        monkeypatch.setenv("H2O_TPU_PROFILE", "1")
+        timeline.clear()
+        import jax.numpy as jnp
+
+        from h2o3_tpu.core.frame import Column
+        from h2o3_tpu.core.mrtask import map_reduce
+
+        c = Column.from_numpy(np.arange(64, dtype=np.float64))
+        total = map_reduce(lambda x: jnp.nansum(x), [c])
+        assert float(total) == float(np.arange(64).sum())
+        profs = [e for e in timeline.events() if e["kind"] == "task_profile"]
+        assert profs, timeline.events()
+        p = profs[-1]
+        assert {"build_ms", "run_ms", "sync_ms"} <= set(p)
+
+
+class TestBootProbes:
+    def test_self_benchmark(self, cl):
+        b = cl.self_benchmark(size=256)
+        assert b["matmul_gflops"] > 0
+        assert b["membw_gbps"] > 0
+        assert b["psum_latency_us"] > 0
+        assert any(e["kind"] == "self_benchmark" for e in timeline.events())
+
+
+class TestDeviceMemory:
+    def test_gauges_shape(self, cl):
+        mem = timeline.device_memory()
+        assert len(mem) >= 1
+        assert "device" in mem[0]
+
+
+class TestRESTSurfaces:
+    def test_timeline_and_profiler(self, cl):
+        from h2o3_tpu import client
+        from h2o3_tpu.api.server import start_server
+
+        srv = start_server(port=0)
+        try:
+            client.connect(port=srv.port)
+            timeline.record("marker", "from_test")
+            body = client._req("GET", "/3/Timeline")
+            kinds = {e.get("kind") for e in body["events"]}
+            assert "marker" in kinds and "rest" in kinds
+            body = client._req("GET", "/3/Profiler")
+            assert body["nodes"]
+        finally:
+            srv.stop()
+
+
+class TestXLATrace:
+    def test_trace_writes_files(self, cl, tmp_path):
+        import jax.numpy as jnp
+
+        d = str(tmp_path / "prof")
+        with timeline.trace(d):
+            (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
+        assert os.path.isdir(d) and os.listdir(d)
+        assert any(e["kind"] == "xla_trace" for e in timeline.events())
