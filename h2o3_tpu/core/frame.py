@@ -40,6 +40,32 @@ T_BAD = "bad"
 NA_CAT = np.int32(-1)
 
 
+def _code_dtype(n_levels: int):
+    """Narrowest signed code dtype that fits the domain plus the -1 NA
+    sentinel (SURVEY §7 narrow-dtype design — the replacement for the
+    reference's 19-codec chunk zoo, water/fvec/NewChunk.java compress()).
+    Ops upcast at their boundaries (binning/DataInfo cast to int32/f32)."""
+    if n_levels <= 126:
+        return np.int8
+    if n_levels <= 32766:
+        return np.int16
+    return np.int32
+
+
+def _numeric_dtype():
+    """Device storage dtype for numeric columns: float32 default, bfloat16
+    when the cluster opts in (halves HBM per column; compute still runs in
+    f32 via the MXU's preferred_element_type / DataInfo's casts)."""
+    from h2o3_tpu.core.runtime import cluster
+
+    name = getattr(cluster().args, "numeric_dtype", "float32")
+    if name in ("bfloat16", "bf16"):
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(np.float32)
+
+
 def _cluster():
     from h2o3_tpu.core.runtime import cluster
 
@@ -90,19 +116,29 @@ class Column:
                 raise TypeError(f"unsupported dtype {arr.dtype}")
 
         if ctype == T_CAT:
-            buf = np.full(padded, NA_CAT, np.int32)
             a = np.asarray(arr)
             if a.dtype.kind in "OUS":
                 dom, codes = _intern_domain(a)
                 domain = dom
-                buf[:n] = codes
             else:
-                buf[:n] = np.where(np.isnan(a.astype(np.float64)), NA_CAT,
-                                   a.astype(np.float64)).astype(np.int32) if a.dtype.kind == "f" else a.astype(np.int32)
-        elif ctype in (T_NUM, T_INT, T_TIME):
+                codes = (np.where(np.isnan(a.astype(np.float64)), NA_CAT,
+                                  a.astype(np.float64)).astype(np.int32)
+                         if a.dtype.kind == "f" else a.astype(np.int32))
+            card = len(domain) if domain is not None \
+                else int(max(codes.max(initial=0) + 1, 1))
+            buf = np.full(padded, NA_CAT, _code_dtype(card))
+            buf[:n] = codes
+        elif ctype in (T_TIME, T_INT):
+            # times and integer columns stay f32: epoch-millis already strain
+            # f32, and bf16's 8 mantissa bits would conflate distinct int
+            # keys (IDs/counts) above 256
             buf = np.full(padded, np.nan, np.float32)
+            buf[:n] = np.asarray(arr, np.float64).astype(np.float32)
+        elif ctype == T_NUM:
+            dt = _numeric_dtype()
+            buf = np.full(padded, np.nan, dt)
             a = np.asarray(arr, np.float64)
-            buf[:n] = a.astype(np.float32)
+            buf[:n] = a.astype(dt)
         else:
             raise TypeError(f"cannot device-store ctype {ctype}")
 

@@ -31,12 +31,19 @@ from h2o3_tpu.models.model import Model, ModelCategory
 # partial dependence (hex/PartialDependence.java)
 # ---------------------------------------------------------------------------
 
-def _response_vector(model: Model, frame: Frame) -> np.ndarray:
-    """The PDP response: P(class 1) for binomial, prediction for regression
-    (PartialDependence.java uses the same)."""
+def _response_vector(model: Model, frame: Frame,
+                     target: Optional[str] = None) -> np.ndarray:
+    """The PDP response: P(class 1) for binomial, P(target) for multinomial
+    (hex/PartialDependence requires _targets for multiclass), prediction for
+    regression."""
     raw = model._predict_raw(model.adapt_test(frame))
     if "probs" in raw:
-        return np.asarray(raw["probs"])[: frame.nrows, 1]
+        dom = model._output.response_domain or []
+        if len(dom) > 2 and target is None:
+            raise ValueError("multinomial partial dependence needs a target "
+                             f"class (one of {dom})")
+        k = dom.index(target) if target is not None else 1
+        return np.asarray(raw["probs"])[: frame.nrows, k]
     return np.asarray(raw["value"])[: frame.nrows]
 
 
@@ -74,7 +81,8 @@ def partial_dependence(model: Model, frame: Frame,
                        cols: Optional[Sequence[str]] = None,
                        nbins: int = 20,
                        weight_column: Optional[str] = None,
-                       row_index: int = -1) -> List[dict]:
+                       row_index: int = -1,
+                       target: Optional[str] = None) -> List[dict]:
     """One table per column: {column, values, mean_response, stddev_response}.
     row_index >= 0 computes an ICE curve for that single row instead of the
     data average (PartialDependence.java _row_index)."""
@@ -100,7 +108,7 @@ def partial_dependence(model: Model, frame: Frame,
         for v in grid:
             fr_v = _with_value(frame, cname, v, col.is_categorical,
                                col.domain or [])
-            resp = _response_vector(model, fr_v)
+            resp = _response_vector(model, fr_v, target)
             if w is not None:
                 wm = float(np.sum(w * resp) / max(np.sum(w), 1e-12))
                 var = float(np.sum(w * (resp - wm) ** 2) / max(np.sum(w), 1e-12))
@@ -116,7 +124,8 @@ def partial_dependence(model: Model, frame: Frame,
 
 def partial_dependence_2d(model: Model, frame: Frame,
                           col_pairs: Sequence[Tuple[str, str]],
-                          nbins: int = 20) -> List[dict]:
+                          nbins: int = 20,
+                          target: Optional[str] = None) -> List[dict]:
     """2D PDP (PartialDependence.java _col_pairs_2dpdp)."""
     tables = []
     for c1, c2 in col_pairs:
@@ -129,7 +138,7 @@ def partial_dependence_2d(model: Model, frame: Frame,
             fr1 = _with_value(frame, c1, v1, is1, d1)
             for v2 in g2:
                 fr12 = _with_value(fr1, c2, v2, is2, d2)
-                resp = _response_vector(model, fr12)
+                resp = _response_vector(model, fr12, target)
                 rows.append((v1, v2, float(np.mean(resp)),
                              float(np.std(resp))))
         tables.append({"columns": (c1, c2), "rows": rows})
@@ -353,13 +362,6 @@ def model_correlation(models: Sequence[Model], frame: Frame) -> dict:
     """Pairwise Spearman-free prediction correlation matrix (the
     model_correlation_heatmap data): binomial models correlate P(class 1),
     regression models their predictions."""
-    preds = []
-    for m in models:
-        raw = m._predict_raw(m.adapt_test(frame))
-        if "probs" in raw:
-            preds.append(np.asarray(raw["probs"])[: frame.nrows, 1])
-        else:
-            preds.append(np.asarray(raw["value"])[: frame.nrows])
-    P = np.stack(preds)
+    P = np.stack([_response_vector(m, frame) for m in models])
     C = np.corrcoef(P)
     return {"models": [str(m.key) for m in models], "matrix": C}

@@ -160,7 +160,8 @@ class DataInfo:
         TPU-static-shape replacement for H2O's skipped NA-response rows."""
         import jax.numpy as jnp
 
-        valid = (y >= 0) if y.dtype in (jnp.int32, jnp.int64) else ~jnp.isnan(y)
+        valid = (y >= 0) if jnp.issubdtype(y.dtype, jnp.integer) \
+            else ~jnp.isnan(y)
         base = jnp.where(valid, 1.0, 0.0).astype(jnp.float32)
         if w is not None:
             base = base * jnp.where(jnp.isnan(w), 0.0, w).astype(jnp.float32)
@@ -172,6 +173,6 @@ class DataInfo:
         already 0 there)."""
         import jax.numpy as jnp
 
-        if y.dtype in (jnp.int32, jnp.int64):
+        if jnp.issubdtype(y.dtype, jnp.integer):   # any code width (int8/16/32)
             return jnp.maximum(y, 0)
         return jnp.where(jnp.isnan(y), 0.0, y)

@@ -40,11 +40,13 @@ def _codes_and_levels(frame: Frame, by: Sequence[str]) -> Tuple[jnp.ndarray, Lis
             code_arrays.append(jnp.asarray(full))
             sizes.append(max(len(uniq), 1))
             levels.append(uniq)
-    flat = jnp.zeros_like(code_arrays[0])
+    # pack in int32 regardless of code width — narrow (int8/int16) cat codes
+    # would overflow the product key for multi-column groups
+    flat = jnp.zeros(code_arrays[0].shape, jnp.int32)
     any_na = jnp.zeros(code_arrays[0].shape, bool)
     for arr, size in zip(code_arrays, sizes):
         any_na = any_na | (arr < 0)
-        flat = flat * size + jnp.maximum(arr, 0)
+        flat = flat * size + jnp.maximum(arr, 0).astype(jnp.int32)
     flat = jnp.where(any_na, -1, flat)
     total = int(np.prod(sizes))
     return flat, levels, total

