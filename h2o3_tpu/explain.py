@@ -358,10 +358,20 @@ def varimp_matrix(models: Sequence[Model]) -> dict:
             "matrix": mat}
 
 
-def model_correlation(models: Sequence[Model], frame: Frame) -> dict:
-    """Pairwise Spearman-free prediction correlation matrix (the
+def model_correlation(models: Sequence[Model], frame: Frame,
+                      target: Optional[str] = None) -> dict:
+    """Pairwise prediction correlation matrix (the
     model_correlation_heatmap data): binomial models correlate P(class 1),
-    regression models their predictions."""
-    P = np.stack([_response_vector(m, frame) for m in models])
+    multinomial P(target) — defaulting to the second response level so a
+    mixed model list never raises — regression models their predictions."""
+
+    def _resp(m):
+        t = target
+        dom = m._output.response_domain or []
+        if t is None and len(dom) > 2:
+            t = dom[1]
+        return _response_vector(m, frame, t)
+
+    P = np.stack([_resp(m) for m in models])
     C = np.corrcoef(P)
     return {"models": [str(m.key) for m in models], "matrix": C}

@@ -152,7 +152,8 @@ def pack_width(maxB: int) -> int:
 @functools.lru_cache(maxsize=32)
 def _grow_fn(max_depth: int, F: int, maxB: int, nbins: tuple, is_cat: tuple,
              min_rows: float, min_split_improvement: float,
-             has_masks: bool, mesh, n_shard: int, blk: int):
+             has_masks: bool, mesh, n_shard: int, blk: int,
+             use_pallas: bool = False):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -165,7 +166,22 @@ def _grow_fn(max_depth: int, F: int, maxB: int, nbins: tuple, is_cat: tuple,
     K = pack_width(maxB)
 
     def hist_level(binned, row_node, live, w, y, S):
-        """(S, F, maxB, 3) via blocked bf16 one-hot matmul + psum."""
+        """(S, F, maxB, 3) via blocked bf16 one-hot matmul + psum. With
+        H2O_TPU_PALLAS_HIST set, the block loop runs as the fused Pallas
+        kernel (pallas_hist.py) that never materializes the one-hots in
+        HBM; the XLA fallback below materializes O per block."""
+        from h2o3_tpu.models.tree import pallas_hist
+
+        # use_pallas is part of the _grow_fn cache key: the env flag is read
+        # at CALL time in grow_tree_device, so toggling it mid-process picks
+        # the right compiled program instead of a stale cache entry
+        if use_pallas:
+            w_live = jnp.where(live, w, 0.0)
+            acc = pallas_hist.hist_pallas(
+                binned, row_node, w_live, y, F=F, maxB=maxB, S=S,
+                blk=pallas_hist.pick_blk(F, maxB, S), vma=("rows",))
+            acc = jax.lax.psum(acc, "rows")
+            return acc.reshape(F, maxB, S, 3).transpose(2, 0, 1, 3)
 
         def body(i, acc):
             sl = lambda a: jax.lax.dynamic_slice_in_dim(a, i * blk, blk, 0)
@@ -285,9 +301,14 @@ def _grow_fn(max_depth: int, F: int, maxB: int, nbins: tuple, is_cat: tuple,
 
     in_specs = (P("rows", None), P("rows"), P("rows"), P("rows"), P("rows"),
                 tuple(P() for _ in range(max_depth)) if has_masks else P())
+    # pallas interpret mode (CPU tests) lowers pallas_call to slices whose
+    # internal index constants carry empty vma sets, tripping check_vma;
+    # compiled TPU lowering annotates properly, so only interpret relaxes it
+    check_vma = not (use_pallas and jax.default_backend() != "tpu")
     fn = jax.shard_map(tree_program, mesh=mesh,
                        in_specs=in_specs,
-                       out_specs=(P(), P(), P("rows")))
+                       out_specs=(P(), P(), P("rows")),
+                       check_vma=check_vma)
     return jax.jit(fn)
 
 
@@ -326,9 +347,12 @@ def grow_tree_device(binned, w, y, spec, *, max_depth: int, min_rows: float,
     maxB = int(spec.nbins.max())
     blk = _pick_blk(n_shard, F, maxB)
     has_masks = feat_masks is not None
+    from h2o3_tpu.models.tree import pallas_hist
+
     fn = _grow_fn(int(max_depth), F, maxB, tuple(int(b) for b in spec.nbins),
                   tuple(bool(c) for c in spec.is_cat), float(min_rows),
-                  float(min_split_improvement), has_masks, mesh, n_shard, blk)
+                  float(min_split_improvement), has_masks, mesh, n_shard, blk,
+                  use_pallas=pallas_hist.enabled())
     w = w.astype(jnp.float32)
     y = y.astype(jnp.float32)
     if num is None:

@@ -1,0 +1,133 @@
+"""Pallas TPU kernel for the tree histogram build — the SURVEY §7
+"hist-style tree booster" centerpiece kernel.
+
+Reference behavior: hex/tree/ScoreBuildHistogram2.java:60 — per-row
+accumulation of (w, w·y, w·y²) into per-(node, feature, bin) buckets.
+
+Why a kernel at all: the XLA formulation (device_tree.hist_level) computes
+hist = Oᵀ·V on the MXU but must MATERIALIZE the bin one-hot
+O (blk, F·maxB) bf16 through HBM every level — at default shapes that is
+~40× the traffic of the binned matrix itself, and the histogram build is
+bandwidth-bound (round-2 profile: 57% of training time). This kernel
+generates both one-hots INSIDE VMEM per row-block and leaves only
+binned (n, F) + node/w/y vectors as HBM reads:
+
+  grid = (row blocks,); per step:
+    V  = one_hot(node) ⊗ (w, w·y, w·y²)        built in VMEM  (blk, S·3)
+    for f < F:  O_f = (binned[:, f] == iota)    built in VMEM  (blk, maxB)
+                out[f] += O_fᵀ · V              MXU, f32 accumulation
+  out (F·maxB, S·3) accumulates across sequential grid steps in VMEM.
+
+The public entry `hist_pallas` is shape-compatible with hist_level's
+per-shard accumulation loop (the psum across mesh shards stays with the
+caller). CPU tests run the same kernel via interpret mode."""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+
+def enabled() -> bool:
+    """Opt-in until the TPU-vs-XLA winner is measured on hardware
+    (H2O_TPU_PALLAS_HIST=1); 'auto' reserves the future default."""
+    return os.environ.get("H2O_TPU_PALLAS_HIST", "") in ("1", "true", "auto")
+
+
+@functools.lru_cache(maxsize=64)
+def _build(n_rows: int, F: int, maxB: int, S: int, blk: int, interpret: bool,
+           vma: tuple):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    C = S * 3
+    nblk = n_rows // blk
+    assert nblk * blk == n_rows, (n_rows, blk)
+
+    def kernel(b_ref, node_ref, w_ref, y_ref, o_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _init():
+            o_ref[:] = jnp.zeros_like(o_ref)
+
+        node = node_ref[:, 0]                                  # (blk,)
+        w = w_ref[:, 0]
+        y = y_ref[:, 0]
+        # V = node one-hot ⊗ (w, wy, wyy), built in VMEM
+        node_oh = (node[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (blk, S), 1)).astype(jnp.float32)       # (blk, S)
+        vals = jnp.stack([w, w * y, w * y * y], axis=-1)       # (blk, 3)
+        V = (node_oh[:, :, None] * vals[:, None, :]).reshape(blk, C)
+        Vb = V.astype(jnp.bfloat16)
+
+        def per_feature(f, _):
+            bins = b_ref[:, f]                                 # (blk,)
+            oh = (bins[:, None] == jax.lax.broadcasted_iota(
+                jnp.int32, (blk, maxB), 1)).astype(jnp.bfloat16)
+            part = jnp.dot(oh.T, Vb, preferred_element_type=jnp.float32)
+            o_ref[pl.ds(f * maxB, maxB), :] += part
+            return 0
+
+        jax.lax.fori_loop(0, F, per_feature, 0)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((blk, F), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((blk, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((blk, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((blk, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((F * maxB, C), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        # under shard_map the per-shard partial varies over the mesh axes
+        # (check_vma requires the annotation); plain calls pass vma=()
+        out_shape=jax.ShapeDtypeStruct((F * maxB, C), jnp.float32,
+                                       vma=set(vma) if vma else None),
+        interpret=interpret,
+    )
+
+
+def hist_pallas(binned, node, w, y, *, F: int, maxB: int, S: int, blk: int,
+                vma: tuple = ()):
+    """(n, F) int bins + per-row node/w/y -> (F*maxB, S*3) f32 histogram.
+    Rows with w == 0 (dead/sampled-out/padding) contribute nothing; the
+    caller pre-zeroes w for non-live rows."""
+    import jax
+    import jax.numpy as jnp
+
+    n = binned.shape[0]
+    blk = int(min(blk, n))
+    if n % blk:                  # static pad to a whole number of blocks
+        pad = blk - n % blk
+        binned = jnp.pad(binned, ((0, pad), (0, 0)))
+        node = jnp.pad(node, (0, pad))
+        w = jnp.pad(w, (0, pad))          # w=0 ⇒ no contribution
+        y = jnp.pad(y, (0, pad))
+        n += pad
+    interpret = jax.default_backend() != "tpu"
+    call = _build(n, F, maxB, S, blk, interpret, tuple(vma))
+    return call(binned.astype(jnp.int32),
+                node.astype(jnp.int32)[:, None],
+                w.astype(jnp.float32)[:, None],
+                y.astype(jnp.float32)[:, None])
+
+
+def pick_blk(F: int, maxB: int, S: int) -> int:
+    """Row-block size under a ~4 MB VMEM working-set budget for the
+    per-block tiles (binned + one-hots + V); the (F·maxB, S·3) f32
+    accumulator is resident on top of this."""
+    per_row = 4 * F + 2 * maxB + 6 * S + 16
+    budget = 4 * 1024 * 1024
+    blk = 1 << int(np.floor(np.log2(max(budget // per_row, 256))))
+    return int(min(blk, 4096))
