@@ -110,4 +110,17 @@ def frame(frame_id):
 
 
 def flow():
-    raise NotImplementedError("Flow UI is not bundled; use the REST API (h2o3_tpu.api)")
+    """Open (or print) the status dashboard URL served at / by the REST
+    server (the full Flow notebook of h2o-web/ is not bundled; the landing
+    page links every live REST surface)."""
+    from h2o3_tpu import client as _client
+
+    base = getattr(_client, "_BASE", None) or "http://127.0.0.1:54321"
+    url = f"{base}/flow/index.html"
+    try:
+        import webbrowser
+
+        webbrowser.open(url)
+    except Exception:   # noqa: BLE001 — headless
+        pass
+    return url

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import re
 import threading
 import time
@@ -217,6 +218,37 @@ def h_profiler(ctx: Ctx):
     from h2o3_tpu.utils import timeline
 
     return {"__meta": S.meta("ProfilerV3"), "nodes": timeline.device_memory()}
+
+
+def h_flow(ctx: Ctx):
+    """Minimal Flow landing page (reference ships the full Flow notebook,
+    h2o-web/; here a live cluster/model/frame dashboard over the same REST
+    endpoints so / isn't a 404 for browsers)."""
+    from h2o3_tpu.core.runtime import cluster_info
+
+    import html as _html
+
+    esc = _html.escape
+    info = cluster_info()
+    frames = [str(k) for k in DKV.keys() if isinstance(DKV.get(k), Frame)]
+    models = [str(k) for k in DKV.keys() if isinstance(DKV.get(k), Model)]
+    # keys are caller-controlled strings: escape everything interpolated
+    rows_f = "".join(f"<li><code>{esc(f)}</code></li>" for f in frames[:50])
+    rows_m = "".join(f"<li><code>{esc(m)}</code></li>" for m in models[:50])
+    html = f"""<!doctype html><html><head><title>h2o3-tpu</title>
+<style>body{{font-family:sans-serif;margin:2em}}code{{background:#eee}}</style>
+</head><body>
+<h1>h2o3-tpu</h1>
+<p>cloud <b>{esc(str(info['cloud_name']))}</b> — {info['cloud_size']} devices on
+<b>{esc(str(info['platform']))}</b>, healthy: {info['cloud_healthy']}</p>
+<h2>Frames ({len(frames)})</h2><ul>{rows_f or '<li>none</li>'}</ul>
+<h2>Models ({len(models)})</h2><ul>{rows_m or '<li>none</li>'}</ul>
+<p>REST: <a href="/3/Cloud">/3/Cloud</a> ·
+<a href="/3/Frames">/3/Frames</a> · <a href="/3/Models">/3/Models</a> ·
+<a href="/3/Timeline">/3/Timeline</a> ·
+<a href="/3/Metadata/endpoints">/3/Metadata/endpoints</a></p>
+</body></html>"""
+    return RawReply(html.encode(), "text/html")
 
 
 # -- import / parse ---------------------------------------------------------
@@ -826,6 +858,8 @@ ROUTES: List[Tuple[str, str, Callable, str]] = [
     ("GET", "/3/Logs", h_logs, "Server log tail"),
     ("GET", "/3/Timeline", h_timeline, "Recent request timeline"),
     ("GET", "/3/Profiler", h_profiler, "Per-device memory gauges"),
+    ("GET", "/", h_flow, "Status dashboard (Flow landing)"),
+    ("GET", "/flow/index.html", h_flow, "Status dashboard (Flow landing)"),
     ("GET", "/3/ImportFiles", h_importfiles, "List importable files"),
     ("POST", "/3/ImportFilesMulti", h_importfiles_multi, "List files for many paths"),
     ("POST", "/3/PostFile", h_postfile, "Upload a raw file"),
@@ -995,12 +1029,36 @@ class _Handler(BaseHTTPRequestHandler):
                      stack: Optional[List[str]] = None):
         self._reply_json(S.error_v3(msg, code, stacktrace=stack, schema=schema), code)
 
+    # -- auth (reference: hash-file basic auth, water.webserver
+    #    BasicAuth/-hash_login; enabled via H2O_TPU_AUTH_FILE) -------------
+    def _authorized(self) -> bool:
+        auth = getattr(self.server_ref, "auth", None)
+        if not auth:
+            return True
+        import base64
+        import hashlib
+
+        hdr = self.headers.get("Authorization", "")
+        if not hdr.startswith("Basic "):
+            return False
+        try:
+            user, _, pw = base64.b64decode(hdr[6:]).decode().partition(":")
+        except Exception:   # noqa: BLE001 — malformed header
+            return False
+        want = auth.get(user)
+        return bool(want) and hashlib.sha256(pw.encode()).hexdigest() == want
+
     # -- dispatch ---------------------------------------------------------
     def _handle(self):
         t0 = time.time()
         status = 200
         u = urlparse(self.path)
         try:
+            if not self._authorized():
+                status = 401
+                return self._send(401, b'{"error":"unauthorized"}',
+                                  "application/json",
+                                  {"WWW-Authenticate": 'Basic realm="h2o3"'})
             handler, params = _match(self.command, u.path)
             if handler is None:
                 status = 404
@@ -1031,10 +1089,27 @@ class _Handler(BaseHTTPRequestHandler):
 class ApiServer:
     """Owns the HTTP thread (reference: water.webserver jetty adapters)."""
 
-    def __init__(self, port: int = 54321):
+    def __init__(self, port: int = 54321,
+                 auth_file: Optional[str] = None):
         self.port = port
         self.httpd: Optional[ThreadingHTTPServer] = None
         self.thread: Optional[threading.Thread] = None
+        # {user: sha256(password) hex} from "user:hash" lines
+        self.auth: Optional[Dict[str, str]] = None
+        path = auth_file or os.environ.get("H2O_TPU_AUTH_FILE")
+        if path:
+            self.auth = {}
+            with open(path) as f:
+                for ln in f:
+                    ln = ln.strip()
+                    if ln and not ln.startswith("#"):
+                        user, _, h = ln.partition(":")
+                        self.auth[user] = h.strip()
+            if not self.auth:
+                # fail CLOSED: a configured-but-empty hash file must not
+                # silently disable auth (template files, bad parses)
+                raise ValueError(f"auth file {path!r} contains no "
+                                 "user:sha256hex entries")
 
     def start(self) -> "ApiServer":
         handler = type("_BoundHandler", (_Handler,), {"server_ref": self})
@@ -1050,5 +1125,5 @@ class ApiServer:
             self.httpd = None
 
 
-def start_server(port: int = 54321) -> ApiServer:
-    return ApiServer(port).start()
+def start_server(port: int = 54321, auth_file: Optional[str] = None) -> ApiServer:
+    return ApiServer(port, auth_file=auth_file).start()

@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional
 
 _BASE: Optional[str] = None
 _SESSION: Optional[str] = None
+_AUTH: Optional[str] = None            # precomputed Basic auth header
 
 
 class H2OServerError(RuntimeError):
@@ -38,6 +39,8 @@ def _req(method: str, path: str, data: Optional[dict] = None,
         url += "?" + urllib.parse.urlencode(query)
     body = None
     headers = {}
+    if _AUTH:
+        headers["Authorization"] = _AUTH
     if data is not None:
         body = json.dumps(data).encode()
         headers["Content-Type"] = "application/json"
@@ -53,9 +56,18 @@ def _req(method: str, path: str, data: Optional[dict] = None,
             raise H2OServerError(str(e)) from None
 
 
-def connect(ip: str = "127.0.0.1", port: int = 54321) -> dict:
-    global _BASE, _SESSION
+def connect(ip: str = "127.0.0.1", port: int = 54321,
+            username: Optional[str] = None,
+            password: Optional[str] = None) -> dict:
+    global _BASE, _SESSION, _AUTH
     _BASE = f"http://{ip}:{port}"
+    if username is not None:
+        import base64
+
+        _AUTH = "Basic " + base64.b64encode(
+            f"{username}:{password or ''}".encode()).decode()
+    else:
+        _AUTH = None
     cloud = _req("GET", "/3/Cloud")
     _SESSION = _req("GET", "/4/sessions")["session_key"]
     return cloud

@@ -213,14 +213,19 @@ class ModelMetricsBinomial(ModelMetrics):
     pr_auc: float = float("nan")
     gini: float = float("nan")
     mean_per_class_error: float = float("nan")
+    ks: float = float("nan")              # Kolmogorov-Smirnov (GainsLift.java)
     cm: Optional[ConfusionMatrix] = None
     auc_data: Optional[AUCData] = None
+    gains_lift_table = None               # TwoDimTable
 
     def to_dict(self):
         d = self._base_dict()
         d.update({"logloss": self.logloss, "AUC": self.auc, "pr_auc": self.pr_auc,
                   "Gini": self.gini, "mean_per_class_error": self.mean_per_class_error,
-                  "cm": self.cm.to_dict() if self.cm else None})
+                  "ks": self.ks,
+                  "cm": self.cm.to_dict() if self.cm else None,
+                  "gains_lift_table": (self.gains_lift_table.to_dict()
+                                       if self.gains_lift_table else None)})
         return d
 
 
@@ -252,6 +257,51 @@ class ModelMetricsClustering(ModelMetrics):
         d.update({"tot_withinss": self.tot_withinss, "betweenss": self.betweenss,
                   "totss": self.totss})
         return d
+
+
+def gains_lift(pos_hist: np.ndarray, neg_hist: np.ndarray, groups: int = 16):
+    """Gains/lift table from the score histograms (hex/GainsLift.java:
+    quantile groups over descending predicted probability; per-group and
+    cumulative response rate / lift / capture / gain, plus the KS statistic).
+    Built from the same NBINS histograms the AUC uses — one device pass
+    serves both. Returns (TwoDimTable, ks)."""
+    from h2o3_tpu.utils.twodim import TwoDimTable
+
+    pos = np.asarray(pos_hist, np.float64)[::-1]      # descending p
+    tot = pos + np.asarray(neg_hist, np.float64)[::-1]
+    W = tot.sum()
+    P = pos.sum()
+    t = TwoDimTable("Gains/Lift Table",
+                    ["group", "cumulative_data_fraction",
+                     "lower_threshold", "response_rate", "lift",
+                     "cumulative_response_rate", "cumulative_lift",
+                     "capture_rate", "cumulative_capture_rate", "gain",
+                     "cumulative_gain", "kolmogorov_smirnov"],
+                    ["int"] + ["double"] * 11)
+    if W <= 0 or P <= 0 or P >= W:
+        return t, float("nan")
+    rate = P / W
+    nb = len(tot)
+    cw = np.cumsum(tot)
+    cp = np.cumsum(pos)
+    ks_all = np.max(np.abs(cp / P - (cw - cp) / (W - P)))
+    prev_w = prev_p = 0.0
+    for g in range(1, groups + 1):
+        target = W * g / groups
+        i = int(np.searchsorted(cw, target - 1e-9))
+        i = min(i, nb - 1)
+        cum_w, cum_p = float(cw[i]), float(cp[i])
+        if cum_w <= prev_w:
+            continue
+        gw, gp = cum_w - prev_w, cum_p - prev_p
+        resp = gp / gw
+        cum_resp = cum_p / cum_w
+        ks = abs(cum_p / P - (cum_w - cum_p) / (W - P))
+        t.add_row(g, cum_w / W, 1.0 - (i + 1) / nb, resp, resp / rate,
+                  cum_resp, cum_resp / rate, gp / P, cum_p / P,
+                  100 * (resp / rate - 1), 100 * (cum_resp / rate - 1), ks)
+        prev_w, prev_p = cum_w, cum_p
+    return t, float(ks_all)
 
 
 # ---------------------------------------------------------------------------
@@ -293,10 +343,13 @@ def make_binomial_metrics(y, p, w, domain: Optional[List[str]] = None) -> ModelM
     cm = auc.confusion_matrix(domain=domain)
     mpce = float(np.mean(cm.errors_per_class()))
     mse = parts["se"] / wsum
-    return ModelMetricsBinomial(
+    gl, ks = gains_lift(np.asarray(pos), np.asarray(neg))
+    mm = ModelMetricsBinomial(
         mse=mse, rmse=float(np.sqrt(mse)), nobs=wsum,
         logloss=parts["logloss"] / wsum, auc=auc.auc, pr_auc=auc.pr_auc,
-        gini=auc.gini, mean_per_class_error=mpce, cm=cm, auc_data=auc)
+        gini=auc.gini, mean_per_class_error=mpce, ks=ks, cm=cm, auc_data=auc)
+    mm.gains_lift_table = gl
+    return mm
 
 
 def make_multinomial_metrics(y, probs, w, domain: List[str]) -> ModelMetricsMultinomial:

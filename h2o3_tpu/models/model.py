@@ -232,6 +232,16 @@ class Model(Keyed):
                                              distribution=dist)
         return None
 
+    def gains_lift(self, test_data: Optional[Frame] = None):
+        """Gains/lift TwoDimTable (hex/GainsLift.java; h2o-py
+        model.gains_lift). Training metrics' table when no frame given."""
+        mm = self.model_performance(test_data)
+        return getattr(mm, "gains_lift_table", None)
+
+    def kolmogorov_smirnov(self) -> float:
+        mm = self._output.training_metrics
+        return float(getattr(mm, "ks", float("nan")))
+
     # -- explanation (hex/PartialDependence, genmodel TreeSHAP,
     #    FeatureInteraction; h2o-py Model API names) ------------------------
     def partial_plot(self, data: Frame, cols: Optional[List[str]] = None,

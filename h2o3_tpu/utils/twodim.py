@@ -1,0 +1,61 @@
+"""TwoDimTable — the tabular display/value container every reference
+summary uses (water/util/TwoDimTable.java: header + typed columns + cell
+grid, rendered by toString and serialized in schemas as {name, columns,
+data}).
+
+Host-side only: tables hold final small results (gains/lift, varimp,
+scoring history); device arrays never pass through here."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+
+class TwoDimTable:
+    def __init__(self, name: str, col_names: Sequence[str],
+                 col_types: Optional[Sequence[str]] = None,
+                 description: str = ""):
+        self.name = name
+        self.description = description
+        self.col_names = list(col_names)
+        self.col_types = list(col_types or ["double"] * len(self.col_names))
+        self.rows: List[List[Any]] = []
+
+    def add_row(self, *cells) -> "TwoDimTable":
+        if len(cells) == 1 and isinstance(cells[0], (list, tuple)):
+            cells = tuple(cells[0])
+        assert len(cells) == len(self.col_names), (cells, self.col_names)
+        self.rows.append(list(cells))
+        return self
+
+    def col(self, name: str) -> List[Any]:
+        i = self.col_names.index(name)
+        return [r[i] for r in self.rows]
+
+    def to_dict(self) -> dict:
+        """The water/api/schemas3/TwoDimTableV3 wire shape (columnar)."""
+        return {
+            "name": self.name,
+            "description": self.description,
+            "columns": [{"name": n, "type": t}
+                        for n, t in zip(self.col_names, self.col_types)],
+            "data": [[r[i] for r in self.rows]
+                     for i in range(len(self.col_names))],
+        }
+
+    def as_data_frame(self):
+        import pandas as pd
+
+        return pd.DataFrame(self.rows, columns=self.col_names)
+
+    def __repr__(self):
+        head = f"{self.name}: " + ", ".join(self.col_names)
+        body = "\n".join(
+            "  " + " | ".join(f"{c:.5g}" if isinstance(c, float) else str(c)
+                              for c in r)
+            for r in self.rows[:20])
+        more = f"\n  ... {len(self.rows) - 20} more rows" if len(self.rows) > 20 else ""
+        return head + "\n" + body + more
+
+    def __len__(self):
+        return len(self.rows)

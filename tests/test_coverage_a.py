@@ -1,0 +1,117 @@
+"""Coverage sweep A: GainsLift/KS, TwoDimTable, basic auth, Flow landing.
+
+Reference: hex/GainsLift.java, water/util/TwoDimTable.java, water.webserver
+hash-file basic auth, h2o-web Flow.
+"""
+
+import hashlib
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame
+from h2o3_tpu.models.tree.gbm import GBM
+from h2o3_tpu.utils.twodim import TwoDimTable
+
+
+@pytest.fixture(scope="module")
+def model(cl):
+    rng = np.random.default_rng(4)
+    n = 2000
+    x = rng.standard_normal(n)
+    y = np.where(rng.random(n) < 1 / (1 + np.exp(-2 * x)), "Y", "N")
+    fr = Frame()
+    fr.add("x", Column.from_numpy(x))
+    fr.add("y", Column.from_numpy(y, ctype="enum"))
+    return GBM(ntrees=8, max_depth=3, seed=1).train(y="y", training_frame=fr), fr
+
+
+class TestGainsLift:
+    def test_table_invariants(self, model):
+        m, fr = model
+        t = m.gains_lift()
+        assert t is not None and len(t) > 0
+        frac = t.col("cumulative_data_fraction")
+        assert frac == sorted(frac) and frac[-1] == pytest.approx(1.0)
+        # capture rates sum to 1; cumulative capture ends at 1
+        assert sum(t.col("capture_rate")) == pytest.approx(1.0, abs=1e-6)
+        assert t.col("cumulative_capture_rate")[-1] == pytest.approx(1.0)
+        # a discriminative model lifts the top group well above 1
+        assert t.col("lift")[0] > 1.5
+        # cumulative lift decays toward 1
+        cl_ = t.col("cumulative_lift")
+        assert cl_[0] >= cl_[-1] and cl_[-1] == pytest.approx(1.0, abs=1e-6)
+
+    def test_ks_statistic(self, model):
+        m, fr = model
+        ks = m.kolmogorov_smirnov()
+        assert 0.3 < ks <= 1.0     # strongly separable synthetic task
+        # KS equals the max group-level KS within table resolution
+        t = m.gains_lift()
+        assert max(t.col("kolmogorov_smirnov")) <= ks + 1e-9
+
+    def test_on_new_frame(self, model):
+        m, fr = model
+        t = m.gains_lift(fr)
+        assert len(t) > 0
+
+
+class TestTwoDimTable:
+    def test_roundtrip(self):
+        t = TwoDimTable("T", ["a", "b"], ["int", "double"])
+        t.add_row(1, 0.5).add_row(2, 0.25)
+        d = t.to_dict()
+        assert d["columns"][0]["name"] == "a"
+        assert d["data"] == [[1, 2], [0.5, 0.25]]
+        df = t.as_data_frame()
+        assert list(df["b"]) == [0.5, 0.25]
+
+
+class TestAuth:
+    def test_basic_auth_gate(self, cl, tmp_path):
+        from h2o3_tpu import client
+        from h2o3_tpu.api.server import start_server
+
+        pw_hash = hashlib.sha256(b"secret").hexdigest()
+        af = tmp_path / "realm.properties"
+        af.write_text(f"# users\nalice:{pw_hash}\n")
+        srv = start_server(port=0, auth_file=str(af))
+        try:
+            url = f"http://127.0.0.1:{srv.port}/3/Cloud"
+            with pytest.raises(urllib.request.HTTPError):
+                urllib.request.urlopen(url, timeout=10)
+            cloud = client.connect(port=srv.port, username="alice",
+                                   password="secret")
+            assert cloud["cloud_healthy"]
+            with pytest.raises(Exception):
+                client.connect(port=srv.port, username="alice",
+                               password="wrong")
+        finally:
+            client._AUTH = None
+            srv.stop()
+
+    def test_no_auth_by_default(self, cl):
+        from h2o3_tpu import client
+        from h2o3_tpu.api.server import start_server
+
+        srv = start_server(port=0)
+        try:
+            assert client.connect(port=srv.port)["cloud_healthy"]
+        finally:
+            srv.stop()
+
+
+class TestFlowLanding:
+    def test_dashboard_html(self, cl):
+        from h2o3_tpu.api.server import start_server
+
+        srv = start_server(port=0)
+        try:
+            for path in ("/", "/flow/index.html"):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}{path}", timeout=10) as r:
+                    body = r.read().decode()
+                    assert "h2o3-tpu" in body and "/3/Cloud" in body
+        finally:
+            srv.stop()
