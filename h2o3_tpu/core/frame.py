@@ -81,17 +81,69 @@ class Column:
     None — TPUs never touch strings (SURVEY.md §7).
     """
 
-    __slots__ = ("data", "ctype", "domain", "host_data", "nrows", "_rollups", "_chunks")
+    __slots__ = ("_data", "_evicted", "_touch", "ctype", "domain",
+                 "host_data", "nrows", "_rollups", "_chunks")
 
     def __init__(self, data, ctype: str, nrows: int,
                  domain: Optional[List[str]] = None,
                  host_data: Optional[np.ndarray] = None):
-        self.data = data
+        self._data = data
+        self._evicted = None       # host copy while swapped out of HBM
+        self._touch = 0            # LRU clock (core/cleaner.py)
         self.ctype = ctype
         self.domain = domain
         self.host_data = host_data
         self.nrows = int(nrows)
         self._rollups = None
+
+    # -- HBM residency (water/Cleaner.java analog: cold columns swap to
+    #    host RAM; access faults them back in) ----------------------------
+    @property
+    def data(self):
+        from h2o3_tpu.core import cleaner
+
+        d = self._data
+        if d is None and self._evicted is not None:
+            # fault-in under the swap lock (a background Cleaner sweep may
+            # race); concurrent readers fault in once
+            with cleaner.SWAP_LOCK:
+                if self._data is None and self._evicted is not None:
+                    self._data = _cluster().put_rows(self._evicted)
+                    self._evicted = None
+                d = self._data
+        self._touch = cleaner.tick()
+        # returning the local binding keeps this safe against an evict()
+        # landing between the check and the return: the caller's reference
+        # pins the device buffer it already obtained
+        return d
+
+    @data.setter
+    def data(self, v):
+        self._data = v
+        self._evicted = None
+
+    def evict(self) -> int:
+        """Swap the device buffer to host RAM; returns bytes freed. No-op
+        for multi-process shardings (remote shards are not addressable
+        here) and for host-resident string columns."""
+        from h2o3_tpu.core import cleaner
+
+        with cleaner.SWAP_LOCK:
+            if self._data is None or \
+                    not getattr(self._data, "is_fully_addressable", True):
+                return 0
+            freed = int(self._data.nbytes)
+            self._evicted = np.asarray(self._data)
+            self._data = None
+            return freed
+
+    @property
+    def is_evicted(self) -> bool:
+        return self._data is None and self._evicted is not None
+
+    @property
+    def device_nbytes(self) -> int:
+        return int(self._data.nbytes) if self._data is not None else 0
 
     # -- constructors -----------------------------------------------------
     @staticmethod
