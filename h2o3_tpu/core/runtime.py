@@ -92,6 +92,10 @@ class Cluster:
         self.mesh = jax.sharding.Mesh(dev_grid, tuple(args.mesh_axes[: dev_grid.ndim]))
         self.n_devices = n
         self.locked = False  # parity flag; membership is always static here
+        # extension SPI hooks (water/ExtensionManager.extensionsLoaded)
+        from h2o3_tpu import extensions as _ext
+
+        _ext.run_extension_hooks(self)
 
     # -- sharding helpers -------------------------------------------------
     def row_sharding(self):

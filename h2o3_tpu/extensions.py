@@ -1,0 +1,77 @@
+"""Extension SPI + user-defined Rapids functions.
+
+Reference: water/AbstractH2OExtension.java + water/ExtensionManager.java —
+extensions discovered on the classpath get init hooks at cloud boot and can
+register REST endpoints; water/rapids/ast/AstFunction + AstApply give
+Rapids user-defined functions.
+
+TPU mapping: extensions are plain callables registered before (or after)
+init — `register_extension` runs the hook immediately if the cluster is
+already up, else at the next `h2o3_tpu.init()`. UDFs register as Rapids
+prims that execute HOST-side on the gathered column values (strings or
+numerics) and re-shard the result — the escape hatch for logic outside the
+device op set, like the reference's AstApply running user ASTs per row."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import numpy as np
+
+_EXTENSIONS: Dict[str, Callable] = {}
+_INITIALIZED: List[str] = []
+
+
+def register_extension(name: str, init_hook: Callable) -> None:
+    """Install an extension; its hook runs with the Cluster at boot (or now,
+    if the cluster is already booted)."""
+    _EXTENSIONS[name] = init_hook
+    from h2o3_tpu.core import runtime
+
+    if runtime._CLUSTER is not None:
+        init_hook(runtime._CLUSTER)
+        if name not in _INITIALIZED:
+            _INITIALIZED.append(name)
+
+
+def run_extension_hooks(cluster) -> None:
+    """Called by Cluster boot (ExtensionManager.extensionsLoaded analog)."""
+    for name, hook in _EXTENSIONS.items():
+        if name not in _INITIALIZED:
+            hook(cluster)
+            _INITIALIZED.append(name)
+
+
+def extensions() -> List[str]:
+    return sorted(_EXTENSIONS)
+
+
+def register_udf(name: str, fn: Callable, ctype: str = "real") -> None:
+    """Register `(udf.<name> frame)` as a Rapids prim: fn receives one host
+    numpy array per input column and returns one array (row-aligned).
+    ctype: 'real' | 'enum' | 'string' for the result column."""
+    from h2o3_tpu.core.frame import Column, Frame
+    from h2o3_tpu.rapids.eval import PRIMS, _is_fr
+
+    def run(env, *args):
+        cols = []
+        for a in args:
+            if _is_fr(a):
+                for c in a.columns:
+                    cols.append(c.to_numpy() if not c.is_string
+                                else c.host_data)
+            else:
+                cols.append(a)
+        result = np.asarray(fn(*cols))
+        out = Frame()
+        out.add(name, Column.from_numpy(
+            result, ctype=None if ctype == "real" else ctype))
+        return out
+
+    PRIMS[f"udf.{name}"] = run
+
+
+def udfs() -> List[str]:
+    from h2o3_tpu.rapids.eval import PRIMS
+
+    return sorted(p[4:] for p in PRIMS if p.startswith("udf."))

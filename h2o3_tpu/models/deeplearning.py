@@ -171,6 +171,11 @@ class DeepLearning(ModelBuilder):
             "distribution": "AUTO",
             "standardize": True,
             "autoencoder": False,
+            # reference DeepLearningTask model averaging: nodes train local
+            # replicas for ~train_samples_per_iteration samples, then
+            # average. 0/-1/-2 (auto modes) = synchronous data-parallel SGD
+            # (averaging period of one batch, the deterministic equivalent)
+            "train_samples_per_iteration": 0,
             "use_all_factor_levels": True,
             "initial_weight_distribution": "UniformAdaptive",
             "initial_weight_scale": 1.0,
@@ -345,6 +350,60 @@ class DeepLearning(ModelBuilder):
             (params, opt_state, key), _ = jax.lax.scan(
                 step, (params, opt_state, key), None, length=steps_per_epoch)
             return params, opt_state, key
+
+        # per-device model averaging (DeepLearningTask.java:19,180 — local
+        # replicas train independently, reduce = weighted average): each
+        # mesh device runs `avg_period` minibatches on ITS row shard, then
+        # params (and optimizer moments) pmean over the rows axis
+        tspi = int(p.get("train_samples_per_iteration", 0) or 0)
+        from h2o3_tpu.core.runtime import cluster as _cluster
+
+        n_dev = int(_cluster().mesh.shape["rows"])
+        avg_period = max(1, tspi // max(batch * n_dev, 1)) if tspi > 0 else 1
+        if avg_period > 1 and n_dev > 1:
+            from jax.sharding import PartitionSpec as P
+
+            shard_rows = padded // n_dev
+            n_rounds = max(int(math.ceil(steps_per_epoch / avg_period)), 1)
+
+            def epoch_avg_body(params, opt_state, sub, Xs, ys, ws):
+                key_l = jax.random.fold_in(sub, jax.lax.axis_index("rows"))
+
+                def local(carry, _):
+                    params, opt_state, key_l = carry
+                    key_l, kidx, kdrop = jax.random.split(key_l, 3)
+                    idx = jax.random.randint(kidx, (batch,), 0, shard_rows)
+                    grads = grad_fn(params, Xs[idx], ys[idx], ws[idx], kdrop)
+                    updates, opt_state = opt.update(grads, opt_state, params)
+                    params = optax.apply_updates(params, updates)
+                    return (params, opt_state, key_l), None
+
+                def sync_round(carry, _):
+                    (params, opt_state, key_l), _ = jax.lax.scan(
+                        local, carry, None, length=avg_period)
+                    # average weights AND moments so the carried state is
+                    # mesh-invariant (the reference averages the whole
+                    # DeepLearningModelInfo, momenta included)
+                    params, opt_state = jax.tree.map(
+                        lambda v: jax.lax.pmean(v, "rows"),
+                        (params, opt_state))
+                    return (params, opt_state, key_l), None
+
+                (params, opt_state, _), _ = jax.lax.scan(
+                    sync_round, (params, opt_state, key_l), None,
+                    length=n_rounds)
+                return params, opt_state
+
+            epoch_avg = jax.jit(jax.shard_map(
+                epoch_avg_body, mesh=_cluster().mesh,
+                in_specs=(P(), P(), P(), P("rows", None), P("rows"), P("rows")),
+                out_specs=(P(), P())))
+
+            def run_epoch(params, opt_state, key):  # noqa: F811 — override
+                key, sub = jax.random.split(key)
+                params, opt_state = epoch_avg(params, opt_state, sub,
+                                              X, y, row_w)
+                return params, opt_state, key
 
         opt_state = opt.init(params0)
         key = jax.random.PRNGKey(seed)

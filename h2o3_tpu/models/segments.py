@@ -1,0 +1,100 @@
+"""SegmentModels — bulk training of one model per data segment.
+
+Reference: h2o-core/src/main/java/hex/segments/SegmentModels.java +
+SegmentModelsBuilder.java — `train_segments` enumerates the distinct
+combinations of the segment columns, trains the same algo/params on each
+row subset, and returns a results frame (segment values, model key,
+status, errors).
+
+TPU mapping: segments come from the device group-by machinery; each
+segment trains on a `take_rows` sub-frame (row-resharded onto the full
+mesh — small segments still use every chip). Failures are captured per
+segment, not raised, matching the reference's fire-and-record behavior."""
+
+from __future__ import annotations
+
+import traceback
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.core.dkv import Keyed
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.utils.twodim import TwoDimTable
+
+
+class SegmentModels(Keyed):
+    """Result container (hex/segments/SegmentModels.java): one row per
+    segment with the trained model's key or the captured error."""
+
+    def __init__(self, segment_columns: List[str], key: Optional[str] = None):
+        super().__init__(key)
+        self.segment_columns = list(segment_columns)
+        self.rows: List[dict] = []
+        self.install()
+
+    def add(self, values: tuple, model=None, error: Optional[str] = None,
+            warnings: Optional[List[str]] = None):
+        self.rows.append({
+            "segment": dict(zip(self.segment_columns, values)),
+            "model_id": str(model.key) if model is not None else None,
+            "status": "SUCCEEDED" if model is not None else "FAILED",
+            "errors": error,
+            "warnings": warnings or [],
+        })
+
+    def as_frame(self) -> TwoDimTable:
+        t = TwoDimTable("segment_models",
+                        self.segment_columns + ["model", "status", "errors"],
+                        ["string"] * (len(self.segment_columns) + 3))
+        for r in self.rows:
+            t.add_row(*[r["segment"][c] for c in self.segment_columns],
+                      r["model_id"], r["status"], r["errors"] or "")
+        return t
+
+    def __len__(self):
+        return len(self.rows)
+
+
+def train_segments(builder_cls, params: dict, frame: Frame,
+                   segment_columns: Sequence[str],
+                   y: Optional[str] = None,
+                   max_segments: int = 0) -> SegmentModels:
+    """Train builder_cls(**params) once per distinct combination of
+    segment_columns (h2o-py H2OSegmentModelsBuilder / train_segments).
+    Segment columns are excluded from the predictors automatically."""
+    from h2o3_tpu.ops.filters import take_rows
+
+    seg_cols = list(segment_columns)
+    for c in seg_cols:
+        if c not in frame:
+            raise ValueError(f"segment column {c!r} not in frame")
+    codes = np.stack([np.asarray(frame.col(c).to_numpy()) for c in seg_cols],
+                     axis=1)
+    uniq, inverse = np.unique(codes, axis=0, return_inverse=True)
+    if max_segments and len(uniq) > max_segments:
+        raise ValueError(f"{len(uniq)} segments exceed max_segments="
+                         f"{max_segments}")
+    out = SegmentModels(seg_cols)
+    for si in range(len(uniq)):
+        # human-readable segment values (domain labels for enums)
+        vals = []
+        for j, c in enumerate(seg_cols):
+            col = frame.col(c)
+            v = uniq[si, j]
+            if col.is_categorical and col.domain is not None and v >= 0:
+                vals.append(col.domain[int(v)])
+            else:
+                vals.append(v)
+        try:
+            sub = take_rows(frame, np.nonzero(inverse == si)[0])
+            p = dict(params)
+            p.setdefault("ignored_columns", [])
+            p["ignored_columns"] = list(p["ignored_columns"]) + seg_cols
+            b = builder_cls(**p)
+            m = b.train(y=y, training_frame=sub)
+            out.add(tuple(vals), model=m)
+            sub.delete()
+        except Exception:   # noqa: BLE001 — per-segment capture, not raise
+            out.add(tuple(vals), error=traceback.format_exc(limit=3))
+    return out

@@ -173,20 +173,46 @@ class Word2Vec(ModelBuilder):
             breaks.append(len(corpus))
         corpus_a = np.asarray(corpus, np.int32)
 
-        # ---- host: skip-gram pair generation (vectorized windows) --------
         window = int(p.get("window_size", 5))
-        centers, contexts = [], []
-        for s, e in zip(breaks[:-1], breaks[1:]):
-            sent = corpus_a[s:e]
-            L = len(sent)
-            for off in range(1, window + 1):
-                if L > off:
-                    centers.append(sent[:-off]); contexts.append(sent[off:])
-                    centers.append(sent[off:]);  contexts.append(sent[:-off])
-        if not centers:
-            raise ValueError("corpus has no co-occurrence pairs (check window/min_word_freq)")
-        centers_a = np.concatenate(centers)
-        contexts_a = np.concatenate(contexts)
+        word_model = (p.get("word_model") or "SkipGram").lower()
+        cbow = word_model == "cbow"
+        contexts_a = None
+        if not cbow:
+            # ---- host: skip-gram pair generation (vectorized windows) ----
+            centers, contexts = [], []
+            for s, e in zip(breaks[:-1], breaks[1:]):
+                sent = corpus_a[s:e]
+                L = len(sent)
+                for off in range(1, window + 1):
+                    if L > off:
+                        centers.append(sent[:-off]); contexts.append(sent[off:])
+                        centers.append(sent[off:]);  contexts.append(sent[:-off])
+            if not centers:
+                raise ValueError("corpus has no co-occurrence pairs (check window/min_word_freq)")
+            centers_a = np.concatenate(centers)
+            contexts_a = np.concatenate(contexts)
+
+        # ---- CBOW windows (Word2Vec.java:16 SkipGram/CBOW): per corpus
+        # position, the up-to-2w context codes with -1 padding ------------
+        if cbow:
+            ctx_rows = []
+            cen_rows = []
+            for s, e in zip(breaks[:-1], breaks[1:]):
+                sent = corpus_a[s:e]
+                L = len(sent)
+                if L < 2:
+                    continue
+                C = np.full((L, 2 * window), -1, np.int32)
+                for off in range(1, window + 1):
+                    if L > off:
+                        C[off:, off - 1] = sent[:-off]
+                        C[:-off, window + off - 1] = sent[off:]
+                ctx_rows.append(C)
+                cen_rows.append(sent)
+            if not ctx_rows:
+                raise ValueError("corpus has no CBOW windows")
+            centers_a = np.concatenate(cen_rows)
+            ctx_windows = np.concatenate(ctx_rows, axis=0)
 
         dim = int(p.get("vec_size", 100))
         neg = int(p.get("negative_samples", 5))
@@ -203,7 +229,10 @@ class Word2Vec(ModelBuilder):
         Win = jnp.asarray(rng.uniform(-0.5 / dim, 0.5 / dim, (V, dim)), jnp.float32)
         Wout = jnp.zeros((V, dim), jnp.float32)
         cen_d = jnp.asarray(centers_a)
-        ctx_d = jnp.asarray(contexts_a)
+        if cbow:
+            ctx_d = jnp.asarray(ctx_windows)                # (Npos, 2w)
+        else:
+            ctx_d = jnp.asarray(contexts_a)
 
         @jax.jit
         def run_epoch(Win, Wout, key, lr):
@@ -211,19 +240,37 @@ class Word2Vec(ModelBuilder):
                 Win, Wout, key = carry
                 key, k1, k2 = jax.random.split(key, 3)
                 idx = jax.random.randint(k1, (batch,), 0, n_pairs)
-                c, o = cen_d[idx], ctx_d[idx]
                 negs = jax.random.choice(k2, V, (batch, neg), p=ns_probs)
-                h = Win[c]                                  # (B, d)
-                # positive pair + negatives in one batched matmul
-                tgt = jnp.concatenate([o[:, None], negs], axis=1)   # (B, 1+neg)
-                out = Wout[tgt]                             # (B, 1+neg, d)
+                if cbow:
+                    # h = mean of context embeddings; target = CENTER word
+                    ctx = ctx_d[idx]                         # (B, 2w)
+                    mask = (ctx >= 0).astype(jnp.float32)
+                    cnt = jnp.maximum(mask.sum(axis=1, keepdims=True), 1.0)
+                    hvecs = Win[jnp.maximum(ctx, 0)] * mask[:, :, None]
+                    h = hvecs.sum(axis=1) / cnt              # (B, d)
+                    pos = cen_d[idx]
+                else:
+                    h = Win[cen_d[idx]]                      # (B, d)
+                    pos = ctx_d[idx]
+                tgt = jnp.concatenate([pos[:, None], negs], axis=1)  # (B, 1+neg)
+                out = Wout[tgt]                              # (B, 1+neg, d)
                 scores = jnp.einsum("bd,bkd->bk", h, out)
                 labels = jnp.concatenate(
                     [jnp.ones((batch, 1)), jnp.zeros((batch, neg))], axis=1)
-                g = (jax.nn.sigmoid(scores) - labels) * lr  # (B, 1+neg)
+                g = (jax.nn.sigmoid(scores) - labels) * lr   # (B, 1+neg)
                 grad_h = jnp.einsum("bk,bkd->bd", g, out)
                 grad_out = jnp.einsum("bk,bd->bkd", g, h)
-                Win = Win.at[c].add(-grad_h)
+                if cbow:
+                    # spread the input gradient over the contributing
+                    # context rows (each got weight 1/cnt in h)
+                    ctx = ctx_d[idx]
+                    mask = (ctx >= 0).astype(jnp.float32)
+                    cnt = jnp.maximum(mask.sum(axis=1, keepdims=True), 1.0)
+                    gctx = (grad_h[:, None, :] * (mask / cnt)[:, :, None])
+                    Win = Win.at[jnp.where(ctx >= 0, ctx, V - 1).reshape(-1)] \
+                        .add(-(gctx * mask[:, :, None]).reshape(-1, dim))
+                else:
+                    Win = Win.at[cen_d[idx]].add(-grad_h)
                 Wout = Wout.at[tgt.reshape(-1)].add(
                     -grad_out.reshape(-1, dim))
                 return (Win, Wout, key), None
