@@ -58,11 +58,28 @@ def bench_glm(n_rows: int = 1_000_000, p: int = 32, iters: int = 20) -> float:
     return n_rows * iters / dt
 
 
+def _flagship_watchdog(timeout_s: int = 1500):
+    """Run the flagship bench in a SUBPROCESS with a hard timeout: a wedged
+    accelerator tunnel or a pathological compile must degrade to the GLM
+    fallback metric, not hang the driver's bench step."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "h2o3_tpu.bench"],
+        capture_output=True, timeout=timeout_s, text=True,
+        cwd=__import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+    for ln in proc.stdout.splitlines():
+        if ln.startswith("H2O3_BENCH "):
+            _, metric, value = ln.split()
+            return float(value), metric
+    raise RuntimeError(f"flagship bench produced no result "
+                       f"(rc={proc.returncode}): {proc.stderr[-2000:]}")
+
+
 def main():
     try:
-        from h2o3_tpu.bench import run_flagship
-
-        value, metric = run_flagship()
+        value, metric = _flagship_watchdog()
     except Exception:
         # keep the one-JSON-line contract, but surface the flagship failure
         import sys

@@ -43,3 +43,13 @@ def run_flagship(n_rows: int = 1_000_000, n_num: int = 8, n_cat: int = 2,
     GBM(ntrees=ntrees, max_depth=max_depth).train(y="y", training_frame=fr)
     dt = time.perf_counter() - t0
     return n_rows * ntrees / dt, "gbm_rows_per_sec"
+
+
+if __name__ == "__main__":
+    # subprocess entry for the watchdog in the repo-root bench.py
+    import os
+
+    value, metric = run_flagship(
+        n_rows=int(os.environ.get("H2O3_BENCH_ROWS", 1_000_000)),
+        ntrees=int(os.environ.get("H2O3_BENCH_TREES", 20)))
+    print(f"H2O3_BENCH {metric} {value}", flush=True)

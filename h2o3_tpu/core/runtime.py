@@ -92,10 +92,6 @@ class Cluster:
         self.mesh = jax.sharding.Mesh(dev_grid, tuple(args.mesh_axes[: dev_grid.ndim]))
         self.n_devices = n
         self.locked = False  # parity flag; membership is always static here
-        # extension SPI hooks (water/ExtensionManager.extensionsLoaded)
-        from h2o3_tpu import extensions as _ext
-
-        _ext.run_extension_hooks(self)
 
     # -- sharding helpers -------------------------------------------------
     def row_sharding(self):
@@ -226,13 +222,22 @@ def init(args: Optional[OptArgs] = None, **kw) -> Cluster:
     """Boot (or return) the runtime. h2o.init() parity
     (reference: h2o-py/h2o/h2o.py h2o.init)."""
     global _CLUSTER
+    booted = False
     with _LOCK:
         if _CLUSTER is None:
             a = args or OptArgs.from_env()
             for k, v in kw.items():
                 setattr(a, k, v)
             _CLUSTER = Cluster(a)
-        return _CLUSTER
+            booted = True
+    if booted:
+        # extension SPI hooks (water/ExtensionManager.extensionsLoaded) run
+        # AFTER _CLUSTER is published and OUTSIDE the boot lock — hooks may
+        # use the full public API (Frames, DKV, nested cluster() calls)
+        from h2o3_tpu import extensions as _ext
+
+        _ext.run_extension_hooks(_CLUSTER)
+    return _CLUSTER
 
 
 def cluster() -> Cluster:
@@ -251,3 +256,7 @@ def shutdown() -> None:
     with _LOCK:
         DKV.clear()
         _CLUSTER = None
+    # registered extensions re-run their hooks against the next cluster
+    from h2o3_tpu import extensions as _ext
+
+    _ext._INITIALIZED.clear()

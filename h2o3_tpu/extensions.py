@@ -64,8 +64,10 @@ def register_udf(name: str, fn: Callable, ctype: str = "real") -> None:
                 cols.append(a)
         result = np.asarray(fn(*cols))
         out = Frame()
-        out.add(name, Column.from_numpy(
-            result, ctype=None if ctype == "real" else ctype))
+        # 'string' results live host-side (the ctype=None object path);
+        # there is deliberately no device storage for strings
+        ct = None if ctype in ("real", "string") else ctype
+        out.add(name, Column.from_numpy(result, ctype=ct))
         return out
 
     PRIMS[f"udf.{name}"] = run

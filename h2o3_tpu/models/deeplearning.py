@@ -381,11 +381,15 @@ class DeepLearning(ModelBuilder):
                 def sync_round(carry, _):
                     (params, opt_state, key_l), _ = jax.lax.scan(
                         local, carry, None, length=avg_period)
-                    # average weights AND moments so the carried state is
-                    # mesh-invariant (the reference averages the whole
-                    # DeepLearningModelInfo, momenta included)
+                    # average weights AND float moments so the carried state
+                    # is mesh-invariant (the reference averages the whole
+                    # DeepLearningModelInfo, momenta included). Integer
+                    # leaves (optax step counters) must keep their dtype —
+                    # pmean would float-ify them and break the scan carry
                     params, opt_state = jax.tree.map(
-                        lambda v: jax.lax.pmean(v, "rows"),
+                        lambda v: (jax.lax.pmean(v, "rows")
+                                   if jnp.issubdtype(v.dtype, jnp.floating)
+                                   else v),
                         (params, opt_state))
                     return (params, opt_state, key_l), None
 

@@ -86,6 +86,7 @@ def train_segments(builder_cls, params: dict, frame: Frame,
                 vals.append(col.domain[int(v)])
             else:
                 vals.append(v)
+        sub = None
         try:
             sub = take_rows(frame, np.nonzero(inverse == si)[0])
             p = dict(params)
@@ -94,7 +95,9 @@ def train_segments(builder_cls, params: dict, frame: Frame,
             b = builder_cls(**p)
             m = b.train(y=y, training_frame=sub)
             out.add(tuple(vals), model=m)
-            sub.delete()
         except Exception:   # noqa: BLE001 — per-segment capture, not raise
             out.add(tuple(vals), error=traceback.format_exc(limit=3))
+        finally:
+            if sub is not None:
+                sub.delete()     # failed segments must also free their HBM
     return out

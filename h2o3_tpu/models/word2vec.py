@@ -266,9 +266,11 @@ class Word2Vec(ModelBuilder):
                     ctx = ctx_d[idx]
                     mask = (ctx >= 0).astype(jnp.float32)
                     cnt = jnp.maximum(mask.sum(axis=1, keepdims=True), 1.0)
-                    gctx = (grad_h[:, None, :] * (mask / cnt)[:, :, None])
+                    # mask/cnt both weights the contribution AND zeroes the
+                    # padded slots (their scatter rows are then no-ops)
+                    gctx = grad_h[:, None, :] * (mask / cnt)[:, :, None]
                     Win = Win.at[jnp.where(ctx >= 0, ctx, V - 1).reshape(-1)] \
-                        .add(-(gctx * mask[:, :, None]).reshape(-1, dim))
+                        .add(-gctx.reshape(-1, dim))
                 else:
                     Win = Win.at[cen_d[idx]].add(-grad_h)
                 Wout = Wout.at[tgt.reshape(-1)].add(

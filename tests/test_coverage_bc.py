@@ -184,3 +184,10 @@ class TestDLModelAveraging:
         assert float(m._output.training_metrics.auc) > 0.6
         p = m.predict(fr).col("Y").to_numpy()
         assert np.all(np.isfinite(p))
+        # SGD-with-schedule optimizer carries an int step counter: the
+        # averaging pmean must not float-ify it (scan carry contract)
+        m2 = DeepLearning(epochs=2, hidden=[8], mini_batch_size=32,
+                          adaptive_rate=False, rate=0.01,
+                          train_samples_per_iteration=2048,
+                          seed=5).train(y="y", training_frame=fr)
+        assert np.isfinite(float(m2._output.training_metrics.auc))
