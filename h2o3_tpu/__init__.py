@@ -15,7 +15,41 @@ so users of the reference find the same entry points.
 
 __version__ = "0.1.0"
 
-from h2o3_tpu.core.runtime import init, cluster, shutdown, cluster_info
+from h2o3_tpu.core.runtime import init as _local_init, cluster, shutdown, cluster_info
+
+
+def init(*args, url: str = None, ip: str = None, port: int = None,
+         username: str = None, password: str = None, **kw):
+    """Boot the local runtime — or, given url/ip/port, CONNECT to a running
+    server as a client node (reference client mode: -client nodes join the
+    cloud without hosting data; h2o-py h2o.init(url=...) connects instead
+    of launching). Returns the Cluster (local) or the connected client
+    module (remote)."""
+    if url or ip or port:
+        if args or kw:
+            raise ValueError(
+                f"client-mode init(url/ip/port) does not accept extra "
+                f"arguments: {list(kw) or args}")
+        from urllib.parse import urlparse
+
+        from h2o3_tpu import client as _client
+
+        if url:
+            u = urlparse(url)
+            ip, port = u.hostname or "127.0.0.1", u.port or 54321
+        _client.connect(ip=ip or "127.0.0.1", port=port or 54321,
+                        username=username, password=password)
+        return _client
+    return _local_init(*args, **kw)
+
+
+def connect(ip: str = "127.0.0.1", port: int = 54321, **kw):
+    """h2o.connect parity: attach this process as a client of a remote
+    REST server."""
+    from h2o3_tpu import client as _client
+
+    _client.connect(ip=ip, port=port, **kw)
+    return _client
 from h2o3_tpu.core.dkv import DKV, Key, Scope
 from h2o3_tpu.core.frame import Frame, Column
 from h2o3_tpu.core.job import Job

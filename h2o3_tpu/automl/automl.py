@@ -115,47 +115,62 @@ class H2OAutoML:
         """Score with the leader, applying the AutoML preprocessing stage
         first when one was trained (reference: the TE preprocessor is part
         of the scoring pipeline)."""
+        if self.leader is None:
+            raise RuntimeError("AutoML has no models")
         if self.te_model is not None:
             frame = self.te_model.transform(frame)
         return self.leader.predict(frame)
 
     # -- step registry (ModelingStepsRegistry analog) ----------------------
+    # step = (name, algo, weight, params). Weights are the WorkAllocations
+    # work units (ai.h2o.automl.WorkAllocations: defaults get more budget
+    # than grid exploration entries; the SE steps are budgeted separately)
     def _steps(self, classification: bool):
-        """Ordered (algo, params) candidates: defaults first, then grid
-        variants — mirrors the reference's default + random-grid phases."""
+        """Ordered candidates: defaults first, then random-grid variants —
+        the reference's default + grid phases with per-step work weights."""
         rng = np.random.default_rng(self.seed)
         steps = []
 
-        def add(algo, **params):
-            steps.append((algo, params))
+        def add(name, algo, weight, **params):
+            steps.append({"name": name, "algo": algo, "weight": weight,
+                          "params": params})
 
-        add("glm", family=("binomial" if classification else "gaussian"),
+        add("def_glm", "glm", 10,
+            family=("binomial" if classification else "gaussian"),
             alpha=0.5, lambda_search=True)
-        add("gbm", ntrees=50, max_depth=6, learn_rate=0.1, sample_rate=0.8,
-            col_sample_rate_per_tree=0.8)
-        add("xgboost", ntrees=50, max_depth=8, learn_rate=0.1, sample_rate=0.8)
-        add("drf", ntrees=50)
-        add("deeplearning", hidden=[64, 64], epochs=20)
-        add("gbm", ntrees=100, max_depth=4, learn_rate=0.05, sample_rate=0.9)
-        add("xgboost", ntrees=100, max_depth=5, learn_rate=0.05,
-            reg_lambda=2.0)
-        add("drf", ntrees=100, max_depth=25)
-        # random grid phase
-        for _ in range(20):
-            add("gbm",
+        add("def_gbm_1", "gbm", 10, ntrees=50, max_depth=6, learn_rate=0.1,
+            sample_rate=0.8, col_sample_rate_per_tree=0.8)
+        add("def_xgb_1", "xgboost", 10, ntrees=50, max_depth=8,
+            learn_rate=0.1, sample_rate=0.8)
+        add("def_drf", "drf", 10, ntrees=50)
+        add("def_dl_1", "deeplearning", 10, hidden=[64, 64], epochs=20)
+        add("def_gbm_2", "gbm", 10, ntrees=100, max_depth=4, learn_rate=0.05,
+            sample_rate=0.9)
+        add("def_xgb_2", "xgboost", 10, ntrees=100, max_depth=5,
+            learn_rate=0.05, reg_lambda=2.0)
+        add("def_drf_xrt", "drf", 10, ntrees=100, max_depth=25)
+        # random grid phase (lower per-step weight, like the reference's
+        # grid WorkAllocations)
+        for gi in range(20):
+            add(f"grid_gbm_{gi}", "gbm", 5,
                 ntrees=int(rng.choice([30, 50, 100])),
                 max_depth=int(rng.integers(3, 10)),
                 learn_rate=float(rng.choice([0.03, 0.05, 0.1, 0.2])),
                 sample_rate=float(rng.uniform(0.6, 1.0)),
                 col_sample_rate_per_tree=float(rng.uniform(0.5, 1.0)))
         filt = []
-        for algo, params in steps:
-            if self.include_algos and algo not in self.include_algos:
+        for st in steps:
+            if self.include_algos and st["algo"] not in self.include_algos:
                 continue
-            if algo in self.exclude_algos:
+            if st["algo"] in self.exclude_algos:
                 continue
-            filt.append((algo, params))
+            filt.append(st)
         return filt
+
+    @property
+    def modeling_plan(self) -> List[Dict[str, Any]]:
+        """The executed (or to-execute) step list (h2o-py modeling_plan)."""
+        return getattr(self, "_plan", [])
 
     def _log(self, msg: str):
         self.event_log.append({"timestamp": time.time(), "message": msg})
@@ -187,16 +202,32 @@ class H2OAutoML:
 
         t0 = time.time()
         self._log(f"AutoML start: project={self.project_name}")
-        for algo, params in self._steps(classification):
+        plan = self._steps(classification)
+        self._plan = plan
+        # WorkAllocations: the remaining time budget splits over remaining
+        # step weights, so a slow early model shrinks what later steps may
+        # spend instead of starving them outright (WorkAllocations.java)
+        total_weight = sum(st["weight"] for st in plan) or 1
+        spent_weight = 0
+        for st in plan:
+            algo, params = st["algo"], dict(st["params"])
             if self.max_models and len(self.models) >= self.max_models:
                 break
-            if self.max_runtime_secs and time.time() - t0 > self.max_runtime_secs:
-                self._log("time budget exhausted")
-                break
+            elapsed = time.time() - t0
+            if self.max_runtime_secs:
+                remaining = self.max_runtime_secs - elapsed
+                if remaining <= 0:
+                    self._log("time budget exhausted")
+                    break
+                rem_weight = max(total_weight - spent_weight, 1)
+                alloc = remaining * st["weight"] / rem_weight
+                params["max_runtime_secs"] = alloc
+                self._log(f"step {st['name']}: allocated {alloc:.1f}s "
+                          f"of {remaining:.1f}s remaining")
+            spent_weight += st["weight"]
             cls = BUILDERS.get(algo)
             if cls is None:
                 continue
-            params = dict(params)
             params.update(nfolds=self.nfolds,
                           keep_cross_validation_predictions=True,
                           seed=self.seed)
@@ -207,10 +238,12 @@ class H2OAutoML:
                 m = b.train(x=x, y=y, training_frame=training_frame,
                             validation_frame=validation_frame)
                 self.models.append(m)
-                self._log(f"built {algo}: {self._metric_name}="
+                st["model_id"] = str(m.key)
+                self._log(f"built {st['name']} ({algo}): {self._metric_name}="
                           f"{_metric(m, self._metric_name):.4f}")
             except Exception as e:       # noqa: BLE001 — AutoML keeps going
-                self._log(f"FAILED {algo}: {type(e).__name__}: {e}")
+                self._log(f"FAILED {st['name']} ({algo}): "
+                          f"{type(e).__name__}: {e}")
 
         # stacked ensembles (best-of-family + all), reference SE steps —
         # honoring include/exclude_algos like any other algo step
@@ -281,7 +314,3 @@ class H2OAutoML:
             })
         return rows
 
-    def predict(self, frame: Frame):
-        if self.leader is None:
-            raise RuntimeError("AutoML has no models")
-        return self.leader.predict(frame)

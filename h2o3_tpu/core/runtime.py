@@ -214,7 +214,10 @@ class Cluster:
         return out
 
 
-_LOCK = threading.Lock()
+# reentrant: extension hooks run under the boot lock (so no other thread
+# sees a cluster whose extensions haven't loaded) and may themselves call
+# cluster()/init()
+_LOCK = threading.RLock()
 _CLUSTER: Optional[Cluster] = None
 
 
@@ -222,22 +225,20 @@ def init(args: Optional[OptArgs] = None, **kw) -> Cluster:
     """Boot (or return) the runtime. h2o.init() parity
     (reference: h2o-py/h2o/h2o.py h2o.init)."""
     global _CLUSTER
-    booted = False
     with _LOCK:
         if _CLUSTER is None:
             a = args or OptArgs.from_env()
             for k, v in kw.items():
                 setattr(a, k, v)
             _CLUSTER = Cluster(a)
-            booted = True
-    if booted:
-        # extension SPI hooks (water/ExtensionManager.extensionsLoaded) run
-        # AFTER _CLUSTER is published and OUTSIDE the boot lock — hooks may
-        # use the full public API (Frames, DKV, nested cluster() calls)
-        from h2o3_tpu import extensions as _ext
+            # extension SPI hooks (ExtensionManager.extensionsLoaded): after
+            # _CLUSTER is assigned (hooks use the full public API through
+            # the reentrant lock) but before any OTHER thread can observe
+            # the cluster — failures are isolated inside the runner
+            from h2o3_tpu import extensions as _ext
 
-        _ext.run_extension_hooks(_CLUSTER)
-    return _CLUSTER
+            _ext.run_extension_hooks(_CLUSTER)
+        return _CLUSTER
 
 
 def cluster() -> Cluster:

@@ -35,11 +35,20 @@ def register_extension(name: str, init_hook: Callable) -> None:
 
 
 def run_extension_hooks(cluster) -> None:
-    """Called by Cluster boot (ExtensionManager.extensionsLoaded analog)."""
+    """Called at cluster boot (ExtensionManager.extensionsLoaded analog).
+    A failing hook is logged and recorded as attempted — it neither kills
+    the boot nor leaves the runtime half-published; it re-arms only after
+    shutdown() like every other hook."""
+    from h2o3_tpu.utils import log
+
     for name, hook in _EXTENSIONS.items():
         if name not in _INITIALIZED:
-            hook(cluster)
             _INITIALIZED.append(name)
+            try:
+                hook(cluster)
+            except Exception as e:   # noqa: BLE001 — extension isolation
+                log.warn(f"extension {name!r} init failed: "
+                         f"{type(e).__name__}: {e}")
 
 
 def extensions() -> List[str]:

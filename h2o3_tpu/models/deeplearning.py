@@ -446,6 +446,8 @@ class DeepLearning(ModelBuilder):
                 best_before = min(history[:-stop_rounds])
                 if best_recent > best_before * (1.0 - tol):
                     break
+            if self._out_of_time():
+                break
 
         model.epochs_trained = ep_done
         model.params_tree = jax.tree.map(np.asarray, params_t)

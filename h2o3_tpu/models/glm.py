@@ -613,6 +613,8 @@ class GLM(ModelBuilder):
                         and dev > prev_dev * (1 - 1e-4)):
                     break  # improvement stalled: keep previous lambda's fit
                 beta, prev_dev, chosen = beta_new, dev, lv
+                if self._out_of_time():
+                    break  # wall budget: keep the path fit so far
             dev = prev_dev
             model.iterations = fitted
             self.params["lambda_"] = float(chosen)

@@ -86,6 +86,10 @@ class ModelBuilder:
             "export_checkpoints_dir": None,
         }
 
+    def _out_of_time(self) -> bool:
+        d = getattr(self, "_deadline", None)
+        return d is not None and time.time() > d
+
     def _seed(self) -> int:
         s = int(self.params.get("seed", -1) or -1)
         return s if s >= 0 else random_seed()
@@ -120,6 +124,10 @@ class ModelBuilder:
 
         self.job = Job(description=f"{self.algo_name} train", dest=self.params.get("model_id"))
         t0 = time.time()
+        # wall-clock budget (hex/ModelBuilder _max_runtime_secs): iterative
+        # fit loops poll _out_of_time() and keep the model built so far
+        mrt = float(self.params.get("max_runtime_secs") or 0.0)
+        self._deadline = (t0 + mrt) if mrt > 0 else None
         self.job.status = Job.RUNNING
         self.job.start_time = t0
         try:
@@ -285,6 +293,9 @@ class ModelBuilder:
                                 if k not in ("nfolds", "fold_column", "training_frame",
                                              "validation_frame", "model_id",
                                              "checkpoint", "export_checkpoints_dir")})
+            # fold fits bypass train(), so the wall-clock budget must be
+            # handed down — CV is the dominant cost under AutoML allocations
+            sub._deadline = getattr(self, "_deadline", None)
             m = sub._fit(tr)
             # one predict pass serves both the fold metrics and the stacked
             # holdout predictions (review: avoid scoring each holdout twice)

@@ -224,6 +224,8 @@ class DRF(SharedTree):
                 history.append(entry)
                 if self._early_stop(stop_metric):
                     break
+            if self._out_of_time():
+                break
             if self.job:
                 self.job.update(progress=(t + 1) / ntrees, msg=f"tree {t + 1}")
 
@@ -336,6 +338,8 @@ class DRF(SharedTree):
                 history.append(entry)
                 if self._early_stop(stop_metric):
                     break
+            if self._out_of_time():
+                break
             if self.job:
                 self.job.update(progress=(t + 1) / ntrees, msg=f"tree {t + 1}")
         model._output.scoring_history = history
@@ -409,6 +413,8 @@ class DRF(SharedTree):
                     oob_sum = oob_sum.at[:, k].add(jnp.where(oob, pred_t, 0.0))
             if mask is not None:
                 oob_cnt = oob_cnt + ((~mask) & (w > 0)).astype(jnp.float32)
+            if self._out_of_time():
+                break
             if self.job:
                 self.job.update(progress=(t + 1) / ntrees, msg=f"iter {t + 1}")
         from h2o3_tpu.models.tree.device_tree import assemble_trees
@@ -475,6 +481,8 @@ class DRF(SharedTree):
                     oob_sum = oob_sum.at[:, k].add(jnp.where(oob, pred_t, 0.0))
             if mask is not None:
                 oob_cnt = oob_cnt + ((~mask) & (w > 0)).astype(jnp.float32)
+            if self._out_of_time():
+                break
             if self.job:
                 self.job.update(progress=(t + 1) / ntrees, msg=f"iter {t + 1}")
         self._finalize_varimp(model, varimp)

@@ -429,6 +429,8 @@ class SharedTree(ModelBuilder):
                 history.append(entry)
                 if self._early_stop(stop_metric):
                     break
+            if self._out_of_time():
+                break
             if self.job:
                 self.job.update(progress=(t + 1) / ntrees, msg=f"tree {t + 1}")
 
@@ -562,6 +564,8 @@ class SharedTree(ModelBuilder):
                 history.append(entry)
                 if self._early_stop(stop_metric):
                     break
+            if self._out_of_time():
+                break
             if self.job:
                 self.job.update(progress=(t + 1) / ntrees, msg=f"iter {t + 1}")
 
@@ -654,6 +658,8 @@ class SharedTree(ModelBuilder):
                 history.append(entry)
                 if self._early_stop(stop_metric):
                     break
+            if self._out_of_time():
+                break
             if self.job:
                 self.job.update(progress=(t + 1) / ntrees, msg=f"tree {t + 1}")
         model._output.scoring_history = history
@@ -745,6 +751,8 @@ class SharedTree(ModelBuilder):
                 history.append(entry)
                 if self._early_stop(stop_metric):
                     break
+            if self._out_of_time():
+                break
             if self.job:
                 self.job.update(progress=(t + 1) / ntrees, msg=f"iter {t + 1}")
         model._output.scoring_history = history

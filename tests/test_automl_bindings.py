@@ -1,0 +1,116 @@
+"""AutoML WorkAllocations + step registry, max_runtime_secs enforcement,
+bindings codegen, client-mode init.
+
+Reference: ai.h2o.automl.WorkAllocations/ModelingStepsRegistry,
+hex/ModelBuilder _max_runtime_secs, h2o-bindings/bin/gen_python.py,
+H2O client mode (-client) / h2o-py h2o.init(url=...).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame
+
+
+def _frame(n=600, seed=0):
+    rng = np.random.default_rng(seed)
+    x1, x2 = rng.standard_normal((2, n))
+    y = np.where(rng.random(n) < 1 / (1 + np.exp(-(2 * x1 - x2))), "Y", "N")
+    fr = Frame()
+    fr.add("x1", Column.from_numpy(x1))
+    fr.add("x2", Column.from_numpy(x2))
+    fr.add("y", Column.from_numpy(y, ctype="enum"))
+    return fr
+
+
+class TestWorkAllocations:
+    def test_plan_and_allocations(self, cl):
+        from h2o3_tpu.automl.automl import H2OAutoML
+
+        am = H2OAutoML(max_models=3, max_runtime_secs=120, seed=42, nfolds=2,
+                       include_algos=["gbm", "glm"])
+        am.train(y="y", training_frame=_frame())
+        assert am.leader is not None
+        plan = am.modeling_plan
+        assert plan and all("weight" in st for st in plan)
+        # built steps record their model; allocation messages logged
+        built = [st for st in plan if st.get("model_id")]
+        assert built
+        assert any("allocated" in e["message"] for e in am.event_log)
+
+    def test_te_predict_preprocesses(self, cl):
+        """The (previously shadowed) predict() must apply TE before the
+        leader scores."""
+        from h2o3_tpu.automl.automl import H2OAutoML
+
+        rng = np.random.default_rng(1)
+        n = 400
+        g = np.array(["a", "b", "c"], object)[rng.integers(0, 3, n)]
+        y = np.where(rng.random(n) < (0.2 + 0.3 * (g == "a")), "Y", "N")
+        fr = Frame()
+        fr.add("g", Column.from_numpy(g, ctype="enum"))
+        fr.add("y", Column.from_numpy(y, ctype="enum"))
+        am = H2OAutoML(max_models=1, seed=7, nfolds=2,
+                       include_algos=["gbm"],
+                       preprocessing=["target_encoding"])
+        am.train(y="y", training_frame=fr)
+        preds = am.predict(fr)          # must not raise on raw (un-encoded) frame
+        assert preds.nrows == n
+
+
+class TestMaxRuntime:
+    def test_gbm_budget_truncates(self, cl):
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        fr = _frame(2000)
+        m = GBM(ntrees=2000, max_depth=3, seed=1,
+                max_runtime_secs=3.0).train(y="y", training_frame=fr)
+        # far fewer trees than requested, and a working model
+        assert 0 < m.forest.n_trees < 2000
+        assert float(m._output.training_metrics.auc) > 0.5
+
+    def test_dl_budget_truncates(self, cl):
+        from h2o3_tpu.models.deeplearning import DeepLearning
+
+        fr = _frame(1500)
+        m = DeepLearning(epochs=100000, hidden=[16], seed=1,
+                         max_runtime_secs=3.0).train(y="y", training_frame=fr)
+        assert m.epochs_trained < 100000
+
+
+class TestBindings:
+    def test_generate_and_train(self, cl):
+        from h2o3_tpu import bindings
+
+        src = bindings.generate_python()
+        assert "class H2OGradientBoostingEstimator" in src
+        classes = bindings.load_generated()
+        est = classes["H2OGradientBoostingEstimator"](ntrees=3, max_depth=3,
+                                                      seed=1)
+        m = est.train(y="y", training_frame=_frame())
+        assert float(m._output.training_metrics.auc) > 0.5
+
+    def test_write_module(self, cl, tmp_path):
+        from h2o3_tpu import bindings
+
+        p = bindings.write_python(str(tmp_path / "estimators_gen.py"))
+        text = open(p).read()
+        assert "__all__" in text and "H2OKMeansEstimator" in text
+
+
+class TestClientModeInit:
+    def test_init_url_connects(self, cl):
+        import h2o3_tpu
+        from h2o3_tpu import client
+        from h2o3_tpu.api.server import start_server
+
+        srv = start_server(port=0)
+        try:
+            c = h2o3_tpu.init(url=f"http://127.0.0.1:{srv.port}")
+            assert c.cluster_status()["cloud_healthy"]
+            c2 = h2o3_tpu.connect(port=srv.port)
+            assert c2 is client
+        finally:
+            srv.stop()
