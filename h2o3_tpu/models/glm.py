@@ -359,6 +359,79 @@ def _multinomial_fit(arrays, y, w, beta0, lam_l2, *, expand, nclasses, max_iter)
     return B, iters, loss(B) * wsum
 
 
+def _ordinal_class_probs(X, v):
+    """Shared fit/predict math: parameter vector (p coefs, K-1 raw
+    threshold params) -> (N, K) class probabilities. Thresholds resolve as
+    theta_0 + cumsum(softplus(d_j)) — ordered by construction."""
+    import jax
+    import jax.numpy as jnp
+
+    p = X.shape[1]
+    beta, traw = v[:p], v[p:]
+    th = traw[0] + jnp.concatenate(
+        [jnp.zeros(1), jnp.cumsum(jax.nn.softplus(traw[1:]))])
+    eta = X @ beta
+    cum = jax.nn.sigmoid(th[None, :] - eta[:, None])           # (N, K-1)
+    N = X.shape[0]
+    cf = jnp.concatenate([jnp.zeros((N, 1), cum.dtype), cum,
+                          jnp.ones((N, 1), cum.dtype)], 1)
+    return cf[:, 1:] - cf[:, :-1]                              # (N, K)
+
+
+@functools.partial(__import__("jax").jit,
+                   static_argnames=("expand", "nclasses", "max_iter"))
+def _ordinal_fit(arrays, y, w, lam_l2, *, expand, nclasses, max_iter):
+    """Proportional-odds cumulative-logit fit (hex/glm Family.ordinal,
+    GLM.java ordinal solver): P(y <= k) = sigmoid(theta_k - x*beta) with
+    monotone thresholds, one shared beta, full-batch L-BFGS like
+    multinomial."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    X = expand(*arrays)
+    N, p = X.shape
+    K = nclasses
+    yi = y.astype(jnp.int32)
+    wsum = jnp.maximum(jnp.sum(w), EPS)
+
+    def loss(v):
+        pk = _ordinal_class_probs(X, v)
+        nll = -jnp.sum(w * jnp.log(jnp.maximum(
+            pk[jnp.arange(N), yi], 1e-12))) / wsum
+        return nll + 0.5 * lam_l2 * jnp.sum(v[:p] ** 2) / wsum
+
+    v0 = jnp.zeros(p + K - 1, jnp.float32)
+    # spread initial thresholds so classes start distinguishable
+    v0 = v0.at[p].set(-1.0)
+    opt = optax.lbfgs()
+
+    def step(carry):
+        v, state, it = carry
+        value, grad = optax.value_and_grad_from_state(loss)(v, state=state)
+        updates, state = opt.update(grad, state, v, value=value, grad=grad,
+                                    value_fn=loss)
+        return optax.apply_updates(v, updates), state, it + 1
+
+    def cond(carry):
+        v, state, it = carry
+        g = optax.tree_utils.tree_get(state, "grad")
+        return (it < max_iter) & ((it == 0) |
+                                  (optax.tree_utils.tree_norm(g) > 1e-6))
+
+    v, state, iters = jax.lax.while_loop(cond, step,
+                                         (v0, opt.init(v0), jnp.int32(0)))
+    return v, iters, loss(v) * wsum
+
+
+@functools.partial(__import__("jax").jit, static_argnames=("expand",))
+def _ordinal_predict(arrays, v, *, expand):
+    import jax.numpy as jnp
+
+    X = expand(*arrays)
+    return jnp.maximum(_ordinal_class_probs(X, v), 0.0)
+
+
 @functools.partial(__import__("jax").jit, static_argnames=("expand", "linkname", "link_power", "nclasses"))
 def _glm_predict(arrays, beta, offset, *, expand, linkname, link_power=0.0, nclasses=1):
     import jax
@@ -399,6 +472,9 @@ class GLMModel(Model):
         arrays = tuple(c.data for c in cols)
         K = self._output.nclasses
         if K > 2:
+            if self.linkname == "ordinal":
+                return {"probs": _ordinal_predict(arrays, self.beta,
+                                                  expand=self.dinfo.expand)}
             probs = _glm_predict(arrays, self.beta, 0.0, expand=self.dinfo.expand,
                                  linkname=self.linkname, nclasses=K)
             return {"probs": probs}
@@ -414,6 +490,8 @@ class GLMModel(Model):
     def coef(self) -> Dict[str, float]:
         """De-standardized coefficients keyed by expanded name + Intercept
         (GLMModel.coefficients())."""
+        if self.linkname == "ordinal":
+            return self._coef_ordinal(destandardize=True)
         names = self.dinfo.coef_names() + ["Intercept"]
         b = np.asarray(self.beta, np.float64)
         if self.dinfo.standardize:
@@ -433,7 +511,33 @@ class GLMModel(Model):
             return {n: b[i].tolist() for i, n in enumerate(names)}
         return {n: float(b[i]) for i, n in enumerate(names)}
 
+    def _coef_ordinal(self, destandardize: bool) -> Dict[str, float]:
+        """Ordinal layout is (p coefs, K-1 raw threshold params); report
+        coefs + RESOLVED thresholds theta_k. De-standardization: the cum
+        logit is theta_k - x·beta, so beta_j /= sigma_j and every theta
+        shifts by +sum(beta_j mu_j / sigma_j) (spacings unchanged)."""
+        p = len(self.dinfo.coef_names())
+        v = np.asarray(self.beta, np.float64)
+        beta, traw = v[:p].copy(), v[p:]
+        th = traw[0] + np.concatenate(
+            [[0.0], np.cumsum(np.logaddexp(0.0, traw[1:]))])   # softplus
+        if destandardize and self.dinfo.standardize:
+            k = self.dinfo.num_offset
+            s = np.asarray(self.dinfo.num_sigmas, np.float64)
+            m = np.asarray(self.dinfo.num_means, np.float64)
+            nn = len(self.dinfo.num_names)
+            if nn:
+                th = th + float(np.sum(beta[k:k + nn] * m / s))
+                beta[k:k + nn] = beta[k:k + nn] / s
+        out = {n: float(beta[i])
+               for i, n in enumerate(self.dinfo.coef_names())}
+        for j, t in enumerate(th):
+            out[f"theta_{j}"] = float(t)
+        return out
+
     def coef_norm(self) -> Dict[str, float]:
+        if self.linkname == "ordinal":
+            return self._coef_ordinal(destandardize=False)
         names = self.dinfo.coef_names() + ["Intercept"]
         b = np.asarray(self.beta, np.float64)
         return {n: float(b[i]) for i, n in enumerate(names)}
@@ -494,9 +598,12 @@ class GLM(ModelBuilder):
             # information-matrix std errors statistically invalid
             raise ValueError("compute_p_values requires lambda=0 and no lambda_search")
 
+        if fam == "ordinal" and (resp_dom is None or len(resp_dom) < 3):
+            raise ValueError("family='ordinal' needs a categorical response "
+                             "with at least 3 ordered levels")
         model = GLMModel(parms=dict(self.params))
         self._init_output(model, train)
-        if fam == "multinomial":
+        if fam in ("multinomial", "ordinal"):
             model._output.model_category = ModelCategory.Multinomial
         elif fam in ("binomial", "quasibinomial", "fractionalbinomial"):
             # numeric 0/1 response is accepted for binomial (GLM.java allows
@@ -540,6 +647,23 @@ class GLM(ModelBuilder):
         if isinstance(lam, (list, tuple)):
             lam = lam[0]
         nobs = float(jnp.sum(wts))
+
+        if fam == "ordinal":
+            if not bool(self.params.get("intercept", True)) or \
+                    bool(self.params.get("non_negative")):
+                raise ValueError("intercept=False / non_negative are not "
+                                 "supported for family='ordinal'")
+            K = len(y_col.domain or [])
+            lam = 0.0 if lam is None else float(lam)
+            v, iters, dev = _ordinal_fit(
+                arrays, y, wts, lam * (1 - alpha) * nobs,
+                expand=dinfo.expand, nclasses=K,
+                max_iter=int(self.params["max_iterations"]))
+            model.beta = v
+            model.iterations = int(iters)
+            model.residual_deviance = 2 * float(dev)
+            model.linkname = "ordinal"
+            return model
 
         if fam == "multinomial":
             if not bool(self.params.get("intercept", True)) or \

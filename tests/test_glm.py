@@ -152,3 +152,58 @@ def test_weights_column(cl):
     fr.add("w", __import__("h2o3_tpu").core.frame.Column.from_numpy(w))
     m = GLM(family="gaussian", lambda_=0.0, weights_column="w").train(y="y", training_frame=fr)
     assert m._output.training_metrics.nobs == 500
+
+
+class TestOrdinalGLM:
+    """family='ordinal': proportional-odds cumulative logit
+    (hex/glm GLMParameters.Family.ordinal)."""
+
+    def test_recovers_ordered_structure(self, cl):
+        import numpy as np
+
+        from h2o3_tpu.core.frame import Column, Frame
+        from h2o3_tpu.models.glm import GLM
+
+        rng = np.random.default_rng(3)
+        n = 1500
+        x = rng.standard_normal(n)
+        eta = 2.0 * x
+        u = rng.logistic(0, 1, n)
+        lat = eta + u
+        yv = np.digitize(lat, [-1.0, 1.0])          # 3 ordered levels
+        fr = Frame()
+        fr.add("x", Column.from_numpy(x))
+        # the DOMAIN (code) order defines the ordinal order, exactly as in
+        # the reference — labels must sort in the true level order
+        fr.add("y", Column.from_numpy(np.array(["l0_lo", "l1_mid", "l2_hi"])[yv],
+                                      ctype="enum"))
+        m = GLM(family="ordinal", seed=1).train(y="y", training_frame=fr)
+        raw = m._predict_raw(m.adapt_test(fr))
+        probs = np.asarray(raw["probs"])[:n]
+        # rows sum to one, all finite
+        np.testing.assert_allclose(probs.sum(1), 1.0, atol=1e-5)
+        dom = m._output.response_domain
+        hi = dom.index("l2_hi")
+        lo = dom.index("l0_lo")
+        top = probs[x > 1.5]
+        bot = probs[x < -1.5]
+        assert top[:, hi].mean() > 0.6 > top[:, lo].mean()
+        assert bot[:, lo].mean() > 0.6 > bot[:, hi].mean()
+        # proportional odds: single beta + K-1 thresholds
+        assert m.beta.shape[0] == 1 + 2
+        # metrics flow through the multinomial machinery
+        assert np.isfinite(float(m._output.training_metrics.logloss))
+
+    def test_requires_3_levels(self, cl):
+        import numpy as np
+
+        from h2o3_tpu.core.frame import Column, Frame
+        from h2o3_tpu.models.glm import GLM
+
+        fr = Frame()
+        fr.add("x", Column.from_numpy(np.arange(50, dtype=np.float64)))
+        fr.add("y", Column.from_numpy(np.array(["a", "b"] * 25), ctype="enum"))
+        import pytest
+
+        with pytest.raises(ValueError, match="3 ordered levels"):
+            GLM(family="ordinal").train(y="y", training_frame=fr)
