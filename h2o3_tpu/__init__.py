@@ -80,6 +80,7 @@ _ESTIMATORS = {
     "H2OAggregatorEstimator": "h2o3_tpu.estimators",
     "H2OTargetEncoderEstimator": "h2o3_tpu.models.target_encoder",
     "H2OGenericEstimator": "h2o3_tpu.models.generic",
+    "H2OIsotonicRegressionEstimator": "h2o3_tpu.models.isotonic",
     "H2OSupportVectorMachineEstimator": "h2o3_tpu.estimators",
     "H2OGridSearch": "h2o3_tpu.grid",
     "H2OAutoML": "h2o3_tpu.automl.automl",

@@ -16,7 +16,8 @@ def _register_all():
     for mod in ("gbm", "drf", "isofor", "deeplearning", "kmeans", "pca",
                 "naive_bayes", "svd", "glrm", "word2vec", "ensemble",
                 "rulefit", "coxph", "gam", "aggregator", "extended_isofor",
-                "psvm", "xgboost"):
+                "psvm", "xgboost", "isotonic",
+                "target_encoder", "generic", "segments"):
         try:
             __import__(f"h2o3_tpu.models.{mod}")
         except ImportError:

@@ -653,6 +653,9 @@ class GLM(ModelBuilder):
                     bool(self.params.get("non_negative")):
                 raise ValueError("intercept=False / non_negative are not "
                                  "supported for family='ordinal'")
+            if self.params.get("offset_column"):
+                raise ValueError("offset_column is not supported for "
+                                 "family='ordinal'")
             K = len(y_col.domain or [])
             lam = 0.0 if lam is None else float(lam)
             v, iters, dev = _ordinal_fit(

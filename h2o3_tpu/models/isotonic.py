@@ -1,0 +1,132 @@
+"""Isotonic regression — pool-adjacent-violators on one feature.
+
+Reference: h2o-algos/src/main/java/hex/isotonic/IsotonicRegression.java +
+PoolAdjacentViolatorsDriver.java — distributed PAVA over (x, y, w) triples,
+scored by linear interpolation between the fitted thresholds, with
+out-of-range x clipped (clip_by_bounds).
+
+TPU split of work: PAVA is inherently sequential merging (O(n) after sort),
+so the FIT runs on gathered host arrays — it happens once, on aggregated
+data. SCORING is the hot path and is a device searchsorted + gather-
+interpolate over the row-sharded frame, like every other model here."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Column, Frame
+from h2o3_tpu.models.model import Model, ModelCategory
+from h2o3_tpu.models.model_builder import ModelBuilder, register
+
+
+def pava(x: np.ndarray, y: np.ndarray, w: Optional[np.ndarray] = None):
+    """Weighted PAVA: -> (thresholds_x, fitted_y) with strictly increasing
+    x knots and non-decreasing fitted values."""
+    order = np.argsort(x, kind="stable")
+    xs, ys = x[order], y[order]
+    ws = (w[order] if w is not None else np.ones_like(xs))
+    # collapse duplicate x to their weighted mean first (ties must map to
+    # one knot or interpolation is ill-defined)
+    ux, inv = np.unique(xs, return_inverse=True)
+    wsum = np.bincount(inv, weights=ws)
+    ysum = np.bincount(inv, weights=ws * ys)
+    vals = ysum / np.maximum(wsum, 1e-12)
+    # pool adjacent violators (stack of blocks)
+    bv: list = []      # block value
+    bw: list = []      # block weight
+    bn: list = []      # block count of knots
+    for v, wt in zip(vals, wsum):
+        bv.append(v)
+        bw.append(wt)
+        bn.append(1)
+        while len(bv) > 1 and bv[-2] > bv[-1]:
+            v2, w2, n2 = bv.pop(), bw.pop(), bn.pop()
+            bv[-1] = (bv[-1] * bw[-1] + v2 * w2) / (bw[-1] + w2)
+            bw[-1] += w2
+            bn[-1] += n2
+    fitted = np.repeat(bv, bn)
+    return ux.astype(np.float64), fitted.astype(np.float64)
+
+
+def interpolate(thresholds_x, thresholds_y, x):
+    """Device piecewise-linear interpolation over the PAVA knots with
+    clipping to the knot range (the one shared scoring primitive — also
+    used by tree-model isotonic calibration). NaN x stays NaN."""
+    import jax.numpy as jnp
+
+    tx = jnp.asarray(thresholds_x, jnp.float32)
+    ty = jnp.asarray(thresholds_y, jnp.float32)
+    if len(thresholds_x) == 1:
+        out = jnp.full(x.shape, float(thresholds_y[0]), jnp.float32)
+        return jnp.where(jnp.isnan(x), jnp.nan, out)
+    xc = jnp.clip(x, tx[0], tx[-1])
+    hi = jnp.clip(jnp.searchsorted(tx, xc, side="right"), 1, len(tx) - 1)
+    lo = hi - 1
+    x0, x1 = tx[lo], tx[hi]
+    t = jnp.where(x1 > x0, (xc - x0) / jnp.maximum(x1 - x0, 1e-12), 0.0)
+    out = ty[lo] + t * (ty[hi] - ty[lo])
+    return jnp.where(jnp.isnan(x), jnp.nan, out)
+
+
+class IsotonicRegressionModel(Model):
+    algo_name = "isotonicregression"
+
+    def __init__(self, key=None, parms=None):
+        super().__init__(key, parms)
+        self.thresholds_x: Optional[np.ndarray] = None
+        self.thresholds_y: Optional[np.ndarray] = None
+
+    def _predict_raw(self, frame: Frame):
+        import jax.numpy as jnp
+
+        xname = self._output.names[0]
+        x = frame.col(xname).data
+        out = interpolate(self.thresholds_x, self.thresholds_y, x)
+        if str(self._parms.get("out_of_bounds", "clip")).lower() == "na":
+            # reference out_of_bounds=NA: outside the training range -> NA
+            out = jnp.where((x < float(self.thresholds_x[0]))
+                            | (x > float(self.thresholds_x[-1])),
+                            jnp.nan, out)
+        return {"value": out}
+
+
+@register
+class IsotonicRegression(ModelBuilder):
+    algo_name = "isotonicregression"
+    model_class = IsotonicRegressionModel
+
+    @classmethod
+    def default_params(cls):
+        p = super().default_params()
+        p.update({"out_of_bounds": "clip"})
+        return p
+
+    def _fit(self, train: Frame) -> IsotonicRegressionModel:
+        model = IsotonicRegressionModel(parms=dict(self.params))
+        out = self._init_output(model, train)
+        numeric = [n for n in out.names if train.col(n).is_numeric]
+        if len(numeric) != 1:
+            raise ValueError("IsotonicRegression needs exactly one numeric "
+                             f"predictor, got {numeric}")
+        out.names = numeric
+        out.model_category = ModelCategory.Regression
+        resp = self.params["response_column"]
+        x = train.col(numeric[0]).to_numpy().astype(np.float64)
+        y = train.col(resp).to_numpy().astype(np.float64)
+        w = None
+        if self.params.get("weights_column"):
+            w = train.col(self.params["weights_column"]).to_numpy()
+        ok = np.isfinite(x) & np.isfinite(y)
+        if w is not None:
+            ok &= np.isfinite(w) & (w > 0)
+            w = w[ok]
+        tx, ty = pava(x[ok], y[ok], w)
+        model.thresholds_x = tx
+        model.thresholds_y = ty
+        return model
+
+
+# h2o-py estimator-name alias (estimators/isotonicregression.py)
+H2OIsotonicRegressionEstimator = IsotonicRegression

@@ -86,6 +86,8 @@ class Model(Keyed):
         super().__init__(key)
         self._parms: dict = dict(parms or {})
         self._output = ModelOutput()
+        # probability calibrator ("platt", (a, b)) | ("isotonic", (tx, ty))
+        self._calibrator = None
         self.install()
 
     # -- per-algo hook ----------------------------------------------------
@@ -175,6 +177,11 @@ class Model(Keyed):
             out.add("predict", Column(label, T_CAT, n, domain=list(dom)))
             for k, lvl in enumerate(dom):
                 out.add(str(lvl), Column(probs[:, k], T_NUM, n))
+            if self._calibrator is not None and cat == ModelCategory.Binomial:
+                # hex/tree CalibrationHelper appends cal_<level> columns
+                pc = self._calibrated_p1(probs[:, 1])
+                out.add(f"cal_{dom[0]}", Column(1.0 - pc, T_NUM, n))
+                out.add(f"cal_{dom[1]}", Column(pc, T_NUM, n))
         elif cat == ModelCategory.Clustering:
             out.add("predict", Column(raw["cluster"].astype(np.int32), T_CAT, n,
                                       domain=[str(i) for i in range(int(self._parms.get("k", 0)) or
@@ -186,6 +193,20 @@ class Model(Keyed):
         else:
             out.add("predict", Column(raw["value"], T_NUM, n))
         return out
+
+    def _calibrated_p1(self, p1):
+        import jax.numpy as jnp
+
+        kind, parms = self._calibrator
+        if kind == "platt":
+            a, b = parms
+            z = jnp.log(jnp.clip(p1, 1e-7, 1 - 1e-7)
+                        / (1 - jnp.clip(p1, 1e-7, 1 - 1e-7)))
+            return 1.0 / (1.0 + jnp.exp(-(a * z + b)))
+        from h2o3_tpu.models.isotonic import interpolate
+
+        tx, ty = parms       # isotonic knots over raw probability
+        return jnp.clip(interpolate(tx, ty, p1), 0.0, 1.0)
 
     def model_performance(self, test_data: Optional[Frame] = None):
         """h2o-py model_performance(): compute metrics on a frame."""

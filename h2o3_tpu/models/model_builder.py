@@ -153,6 +153,14 @@ class ModelBuilder:
     def _train_impl(self, train: Frame, valid: Optional[Frame]) -> Model:
         nfolds = int(self.params.get("nfolds") or 0)
         fold_col = self.params.get("fold_column")
+        if self.params.get("calibrate_model"):
+            # fail BEFORE training: these use only params + response type
+            if self.params.get("calibration_frame") is None:
+                raise ValueError("calibrate_model=True requires a "
+                                 "calibration_frame")
+            rc = train.col(self.params.get("response_column"))
+            if not (rc.is_categorical and len(rc.domain or []) == 2):
+                raise ValueError("model calibration supports binomial models")
         if self.params.get("checkpoint"):
             if not self.supports_checkpoint:
                 raise ValueError(
@@ -192,6 +200,7 @@ class ModelBuilder:
         # frame / full-N device buffers after the model is done
         self._train_frame_ref = None
         self._oob_raw = None
+        self._maybe_calibrate(model)
         ed = self.params.get("export_checkpoints_dir")
         if ed:
             # hex/Model.java:387 exportBinaryModel into _export_checkpoints_dir
@@ -201,6 +210,42 @@ class ModelBuilder:
             os.makedirs(ed, exist_ok=True)
             model.save(os.path.join(ed, f"{model.key}.bin"))
         return model
+
+    # -- probability calibration (hex/tree CalibrationHelper: Platt scaling
+    #    or isotonic regression fit on a held-out calibration_frame) -------
+    def _maybe_calibrate(self, model: Model) -> None:
+        if not self.params.get("calibrate_model"):
+            return
+        frame = self.params.get("calibration_frame")
+        if frame is None:
+            raise ValueError("calibrate_model=True requires a "
+                             "calibration_frame")
+        if model._output.model_category != ModelCategory.Binomial:
+            raise ValueError("model calibration supports binomial models")
+        from h2o3_tpu.models.data_info import DataInfo
+
+        raw = model._predict_raw(model.adapt_test(frame))
+        p = np.asarray(raw["probs"])[: frame.nrows, 1].astype(np.float64)
+        y_col = model._adapt_response(frame.col(model._output.response_name))
+        y = np.asarray(DataInfo.clean_response(y_col.data))[: frame.nrows]
+        wc = self.params.get("weights_column")
+        w_user = (frame.col(wc).data if wc and wc in frame else None)
+        w = np.asarray(DataInfo.response_weight(y_col.data, w_user))[: frame.nrows]
+        ok = w > 0
+        method = str(self.params.get("calibration_method")
+                     or "PlattScaling").lower()
+        if method in ("auto", "plattscaling", "platt"):
+            model._calibrator = ("platt", _fit_platt(p[ok], y[ok], w=w[ok]))
+        elif method in ("isotonicregression", "isotonic"):
+            from h2o3_tpu.models.isotonic import pava
+
+            model._calibrator = ("isotonic",
+                                 pava(p[ok], y[ok].astype(float), w[ok]))
+        else:
+            raise ValueError(f"unknown calibration_method {method!r}")
+        # the calibration frame must not ride along in the model artifact
+        # (it would pin HBM and bloat pickles); keep its key for provenance
+        model._parms["calibration_frame"] = str(getattr(frame, "key", ""))
 
     # -- checkpoint (training continuation) -------------------------------
     # params a continuation may change (hex/util/CheckpointUtils.java keeps a
@@ -347,6 +392,31 @@ class ModelBuilder:
 
     def _fit(self, train: Frame) -> Model:
         raise NotImplementedError
+
+
+def _fit_platt(p: np.ndarray, y: np.ndarray,
+               w: Optional[np.ndarray] = None, iters: int = 30):
+    """Platt scaling: fit sigmoid(a*z + b) on z = logit(p) by Newton on the
+    WEIGHTED 2-parameter logistic log-likelihood (CalibrationHelper's GLM
+    collapses to exactly this 1-feature fit)."""
+    z = np.log(np.clip(p, 1e-7, 1 - 1e-7) / (1 - np.clip(p, 1e-7, 1 - 1e-7)))
+    if w is None:
+        w = np.ones_like(z)
+    a, b = 1.0, 0.0
+    for _ in range(iters):
+        mu = 1.0 / (1.0 + np.exp(-(a * z + b)))
+        g = np.array([np.sum(w * (mu - y) * z), np.sum(w * (mu - y))])
+        s = np.maximum(mu * (1 - mu), 1e-9) * w
+        H = np.array([[np.sum(s * z * z), np.sum(s * z)],
+                      [np.sum(s * z), np.sum(s)]])
+        try:
+            step = np.linalg.solve(H + 1e-9 * np.eye(2), g)
+        except np.linalg.LinAlgError:
+            break
+        a, b = a - step[0], b - step[1]
+        if np.abs(step).max() < 1e-10:
+            break
+    return float(a), float(b)
 
 
 def _mean_metrics(mets: List):

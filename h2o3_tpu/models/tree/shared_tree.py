@@ -171,7 +171,8 @@ class SharedTree(ModelBuilder):
             "min_split_improvement": 1e-5,
             "sample_rate": 1.0, "col_sample_rate_per_tree": 1.0,
             "score_each_iteration": False, "score_tree_interval": 0,
-            "calibrate_model": False, "distribution": "AUTO",
+            "calibrate_model": False, "calibration_frame": None,
+            "calibration_method": "AUTO", "distribution": "AUTO",
             "tweedie_power": 1.5, "quantile_alpha": 0.5,
             "huber_alpha": 0.9,
         })

@@ -191,3 +191,89 @@ class TestDLModelAveraging:
                           train_samples_per_iteration=2048,
                           seed=5).train(y="y", training_frame=fr)
         assert np.isfinite(float(m2._output.training_metrics.auc))
+
+
+class TestIsotonicAndCalibration:
+    def test_pava_monotone_fit(self, cl):
+        from h2o3_tpu.models.isotonic import IsotonicRegression, pava
+
+        rng = np.random.default_rng(8)
+        n = 1200
+        x = rng.uniform(-3, 3, n)
+        y = np.tanh(x) + rng.normal(0, 0.3, n)
+        fr = Frame()
+        fr.add("x", Column.from_numpy(x))
+        fr.add("y", Column.from_numpy(y))
+        m = IsotonicRegression().train(y="y", training_frame=fr)
+        # fitted values are non-decreasing
+        assert np.all(np.diff(m.thresholds_y) >= -1e-12)
+        pred = m.predict(fr).col("predict").to_numpy()
+        # monotone in x and close to tanh
+        order = np.argsort(x)
+        assert np.all(np.diff(pred[order]) >= -1e-5)
+        assert np.mean((pred - np.tanh(x)) ** 2) < 0.05
+        # out-of-range clips
+        fr2 = Frame()
+        fr2.add("x", Column.from_numpy(np.array([-100.0, 100.0])))
+        p2 = m.predict(fr2).col("predict").to_numpy()
+        assert p2[0] == pytest.approx(m.thresholds_y[0], abs=1e-5)
+        assert p2[1] == pytest.approx(m.thresholds_y[-1], abs=1e-5)
+
+    def test_tree_calibration(self, cl):
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        rng = np.random.default_rng(9)
+        n = 1500
+        x = rng.standard_normal(n)
+        y = np.where(rng.random(n) < 1 / (1 + np.exp(-2 * x)), "Y", "N")
+        fr = Frame()
+        fr.add("x", Column.from_numpy(x))
+        fr.add("y", Column.from_numpy(y, ctype="enum"))
+        tr_idx = np.arange(0, n, 2)
+        cal_idx = np.arange(1, n, 2)
+        from h2o3_tpu.ops.filters import take_rows
+
+        tr, cal = take_rows(fr, tr_idx), take_rows(fr, cal_idx)
+        m = GBM(ntrees=10, max_depth=3, seed=1, calibrate_model=True,
+                calibration_frame=cal).train(y="y", training_frame=tr)
+        pred = m.predict(cal)
+        assert "cal_Y" in pred.names and "cal_N" in pred.names
+        pc = pred.col("cal_Y").to_numpy()
+        assert np.all((pc >= 0) & (pc <= 1))
+        # calibrated probabilities track outcomes at least as well (logloss)
+        yb = (cal.col("y").to_numpy() ==
+              m._output.response_domain.index("Y")).astype(float)
+        praw = pred.col("Y").to_numpy()
+        ll = lambda p: -np.mean(yb * np.log(np.clip(p, 1e-9, 1)) +  # noqa: E731
+                                (1 - yb) * np.log(np.clip(1 - p, 1e-9, 1)))
+        assert ll(pc) <= ll(praw) + 0.02
+
+    def test_isotonic_calibration_method(self, cl):
+        from h2o3_tpu.models.tree.gbm import GBM
+        from h2o3_tpu.ops.filters import take_rows
+
+        rng = np.random.default_rng(10)
+        n = 1000
+        x = rng.standard_normal(n)
+        y = np.where(rng.random(n) < 1 / (1 + np.exp(-2 * x)), "Y", "N")
+        fr = Frame()
+        fr.add("x", Column.from_numpy(x))
+        fr.add("y", Column.from_numpy(y, ctype="enum"))
+        tr = take_rows(fr, np.arange(0, n, 2))
+        cal = take_rows(fr, np.arange(1, n, 2))
+        m = GBM(ntrees=5, max_depth=3, seed=1, calibrate_model=True,
+                calibration_frame=cal,
+                calibration_method="IsotonicRegression").train(
+            y="y", training_frame=tr)
+        pc = m.predict(cal).col("cal_Y").to_numpy()
+        assert np.all(np.isfinite(pc)) and pc.min() >= 0 and pc.max() <= 1
+
+    def test_calibrate_requires_frame(self, cl):
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        fr = Frame()
+        fr.add("x", Column.from_numpy(np.arange(100, dtype=np.float64)))
+        fr.add("y", Column.from_numpy(
+            np.array(["Y", "N"] * 50, object), ctype="enum"))
+        with pytest.raises(ValueError, match="calibration_frame"):
+            GBM(ntrees=2, calibrate_model=True).train(y="y", training_frame=fr)
