@@ -263,12 +263,19 @@ def predict_contributions(model, frame: Frame) -> Frame:
     names = model._output.names
     F = len(names)
     n = binned.shape[0]
-    phi = np.zeros((n, F + 1), np.float64)
     bias = forest.init_f
     for t in range(forest.n_trees):
         bias += _expected_value(forest, t)
-        for r in range(n):
-            _shap_one_tree(binned[r], t, forest, phi[r])
+    # native C++ walk (threads over rows) when built; Python fallback is
+    # the algorithm-of-record the native path is parity-tested against
+    from h2o3_tpu.native.loader import native_treeshap
+
+    phi = native_treeshap(binned, forest)
+    if phi is None:
+        phi = np.zeros((n, F + 1), np.float64)
+        for t in range(forest.n_trees):
+            for r in range(n):
+                _shap_one_tree(binned[r], t, forest, phi[r])
     out = Frame()
     for j, nm in enumerate(names):
         out.add(nm, Column.from_numpy(phi[:, j]))

@@ -214,14 +214,11 @@ class ModelBuilder:
     # -- probability calibration (hex/tree CalibrationHelper: Platt scaling
     #    or isotonic regression fit on a held-out calibration_frame) -------
     def _maybe_calibrate(self, model: Model) -> None:
+        # preconditions (frame present, binomial response) were validated in
+        # _train_impl BEFORE training started — the only caller
         if not self.params.get("calibrate_model"):
             return
         frame = self.params.get("calibration_frame")
-        if frame is None:
-            raise ValueError("calibrate_model=True requires a "
-                             "calibration_frame")
-        if model._output.model_category != ModelCategory.Binomial:
-            raise ValueError("model calibration supports binomial models")
         from h2o3_tpu.models.data_info import DataInfo
 
         raw = model._predict_raw(model.adapt_test(frame))

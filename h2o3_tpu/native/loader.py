@@ -25,15 +25,22 @@ _TRIED = False
 
 
 def _build() -> bool:
-    src = os.path.join(_HERE, "csv_parser.cpp")
-    if not os.path.exists(src):
+    srcs = [os.path.join(_HERE, f) for f in ("csv_parser.cpp", "treeshap.cpp")
+            if os.path.exists(os.path.join(_HERE, f))]
+    if not srcs:
         return False
     try:
+        # build to a temp name then rename: an in-place relink would reuse
+        # the inode, and glibc dlopen dedupes by dev/inode — a stale mapped
+        # handle would be returned by the next CDLL (and truncating a mapped
+        # .so can SIGBUS calls into the old mapping)
+        tmp = _LIB_PATH + ".build"
         subprocess.run(
             ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-             "-pthread", "-o", _LIB_PATH, src],
+             "-pthread", "-o", tmp] + srcs,
             check=True, capture_output=True, timeout=120,
         )
+        os.replace(tmp, _LIB_PATH)
         return True
     except Exception:
         return False
@@ -49,6 +56,25 @@ def get_lib():
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
+            if not hasattr(lib, "h2o_treeshap"):
+                # stale .so from before treeshap.cpp existed: rebuild once
+                # (the rename in _build gives the new lib a fresh inode, so
+                # this CDLL loads it instead of the deduped old mapping)
+                if not _build():
+                    return None
+                lib = ctypes.CDLL(_LIB_PATH)
+            lib.h2o_treeshap.restype = None
+            lib.h2o_treeshap.argtypes = [
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_longlong, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+            ]
             lib.h2o_parse_csv.restype = ctypes.c_longlong
             lib.h2o_parse_csv.argtypes = [
                 ctypes.c_char_p,          # path
@@ -63,9 +89,51 @@ def get_lib():
             lib.h2o_count_rows.restype = ctypes.c_longlong
             lib.h2o_count_rows.argtypes = [ctypes.c_char_p]
             _LIB = lib
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a checkout missing one of the .cpp sources
+            # builds a lib without that symbol — honor the None contract
+            # (callers fall back to their pure-Python paths)
             _LIB = None
         return _LIB
+
+
+def native_treeshap(binned: np.ndarray, forest, nthreads: int = 0
+                    ) -> Optional[np.ndarray]:
+    """Run the C++ TreeSHAP over a (n, F) int32 binned matrix and a
+    CompressedForest; returns (n, F+1) float64 phi (bias column untouched)
+    or None when the native lib is unavailable."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "h2o_treeshap"):
+        return None
+    n, F = binned.shape
+    T, M = forest.feat.shape
+    b = np.ascontiguousarray(binned, np.int32)
+    feat = np.ascontiguousarray(forest.feat, np.int32)
+    thresh = np.ascontiguousarray(forest.thresh_bin, np.int32)
+    na_left = np.ascontiguousarray(forest.na_left, np.uint8)
+    left = np.ascontiguousarray(forest.left, np.int32)
+    right = np.ascontiguousarray(forest.right, np.int32)
+    leaf_val = np.ascontiguousarray(forest.leaf_val, np.float32)
+    cat_split = np.ascontiguousarray(forest.cat_split, np.int32)
+    cat_table = np.ascontiguousarray(forest.cat_table, np.uint8)
+    na_bins = np.ascontiguousarray(forest.na_bins, np.int32)
+    cover = np.ascontiguousarray(forest.cover, np.float32)
+    phi = np.zeros((n, F + 1), np.float64)
+
+    def P(a, ct):
+        return a.ctypes.data_as(ctypes.POINTER(ct))
+
+    lib.h2o_treeshap(
+        P(b, ctypes.c_int32), n, F,
+        P(feat, ctypes.c_int32), P(thresh, ctypes.c_int32),
+        P(na_left, ctypes.c_uint8), P(left, ctypes.c_int32),
+        P(right, ctypes.c_int32), P(leaf_val, ctypes.c_float),
+        P(cat_split, ctypes.c_int32), P(cat_table, ctypes.c_uint8),
+        int(cat_table.shape[1]), P(na_bins, ctypes.c_int32),
+        P(cover, ctypes.c_float), T, M,
+        P(phi, ctypes.c_double),
+        nthreads or min(os.cpu_count() or 4, 16))
+    return phi
 
 
 def native_parse_csv(path: str, setup) -> Optional[Dict[str, np.ndarray]]:

@@ -175,3 +175,36 @@ class TestExplainREST:
             assert body["feature_interaction"]
         finally:
             srv.stop()
+
+
+class TestNativeTreeSHAP:
+    def test_native_matches_python(self, setup):
+        """C++ walk must agree with the Python algorithm-of-record."""
+        from h2o3_tpu.native.loader import native_treeshap
+
+        fr, gbm = setup
+        binned = np.asarray(gbm.spec.bin_columns(gbm.adapt_test(fr)))[:30]
+        phi_native = native_treeshap(binned, gbm.forest)
+        assert phi_native is not None, "native lib should build in this env"
+        F = len(gbm._output.names)
+        phi_py = np.zeros((30, F + 1), np.float64)
+        from h2o3_tpu.explain import _shap_one_tree
+
+        for t in range(gbm.forest.n_trees):
+            for r in range(30):
+                _shap_one_tree(binned[r], t, gbm.forest, phi_py[r])
+        # differences are float-accumulation order only (observed ~2e-8)
+        np.testing.assert_allclose(phi_native, phi_py, rtol=1e-5, atol=1e-7)
+
+    def test_throughput_sane(self, setup):
+        import time
+
+        from h2o3_tpu.native.loader import native_treeshap
+
+        fr, gbm = setup
+        binned = np.asarray(gbm.spec.bin_columns(gbm.adapt_test(fr)))
+        big = np.tile(binned, (5, 1))[:4000]
+        t0 = time.perf_counter()
+        phi = native_treeshap(big, gbm.forest)
+        dt = time.perf_counter() - t0
+        assert phi is not None and dt < 10.0, dt
