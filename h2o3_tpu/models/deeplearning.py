@@ -114,6 +114,19 @@ class DeepLearningModel(Model):
             return {"probs": jax.nn.softmax(out, axis=-1)}
         return {"value": out[:, 0]}
 
+    def _make_metrics(self, frame, raw, extra_weight=None):
+        if not self.autoencoder:
+            return super()._make_metrics(frame, raw, extra_weight)
+        import numpy as np
+
+        from h2o3_tpu.models import metrics as M
+
+        per_row = np.asarray(raw["score"])[: frame.nrows]
+        mse = float(np.nanmean(per_row))
+        return M.ModelMetricsAutoEncoder(
+            mse=mse, rmse=float(np.sqrt(mse)), nobs=float(frame.nrows),
+            description="autoencoder reconstruction error")
+
     def anomaly(self, frame: Frame) -> Frame:
         """Per-row reconstruction MSE (autoencoder anomaly detection —
         reference DeepLearningModel.scoreAutoEncoder)."""

@@ -23,6 +23,23 @@ from h2o3_tpu.models.model import Model, ModelCategory
 from h2o3_tpu.models.model_builder import ModelBuilder, register
 
 
+def _thinplate_basis(knots: np.ndarray):
+    """1-D thin-plate spline basis (hex/gam thin-plate bs=1): radial cubics
+    |x - k_j|^3 scaled to knot range + the linear term."""
+    import jax.numpy as jnp
+
+    kf = jnp.asarray(knots, jnp.float32)
+    span = jnp.maximum(kf[-1] - kf[0], 1e-12)
+
+    def basis(x):
+        cols = [x]
+        for j in range(len(knots)):
+            cols.append(jnp.abs((x - kf[j]) / span) ** 3)
+        return jnp.stack(cols, axis=-1)
+
+    return basis
+
+
 def _nspline_basis(knots: np.ndarray):
     """Natural cubic spline basis functions for given knots (ESL 5.2.1):
     returns fn(x) -> (n, K-1) columns [x, N_1..N_{K-2}]."""
@@ -53,6 +70,14 @@ class GAMModel(Model):
         super().__init__(key, parms)
         self.glm_model = None
         self.knots: Dict[str, np.ndarray] = {}
+        self.bs_types: Dict[str, int] = {}     # 0=cr (default), 1=thin plate
+
+    def _basis_for(self, gcol: str):
+        # getattr: pre-upgrade artifacts restored via __dict__.update lack
+        # bs_types (they were all cr)
+        if getattr(self, "bs_types", {}).get(gcol, 0) == 1:
+            return _thinplate_basis(self.knots[gcol])
+        return _nspline_basis(self.knots[gcol])
 
     def _expand_frame(self, frame: Frame) -> Frame:
         """Append spline basis columns for each gam column (device map)."""
@@ -62,12 +87,17 @@ class GAMModel(Model):
         for nm in frame.names:
             out.add(nm, frame.col(nm))
         for gcol, knots in self.knots.items():
-            basis = _nspline_basis(knots)
             x = frame.col(gcol).data
-            B = jax.jit(basis)(x)
+            B = jax.jit(self._basis_for(gcol))(x)
             for j in range(B.shape[1]):
                 out.add(f"{gcol}_gam{j}", Column(B[:, j], T_NUM, frame.nrows))
         return out
+
+    def get_knot_locations(self, gam_column: Optional[str] = None):
+        """h2o-py get_knot_locations parity."""
+        if gam_column is not None:
+            return list(map(float, self.knots[gam_column]))
+        return {c: list(map(float, k)) for c, k in self.knots.items()}
 
     def adapt_test(self, test: Frame) -> Frame:
         return self.glm_model.adapt_test(self._expand_frame(test))
@@ -122,15 +152,29 @@ class GAM(ModelBuilder):
         # knots at quantiles of each gam column (GamUtils.generateKnots)
         from h2o3_tpu.ops.quantile import quantile_column
 
-        for gcol, nk in zip(gam_cols, num_knots):
+        bs = p.get("bs")
+        if bs is None:
+            bs = [0] * len(gam_cols)
+        elif isinstance(bs, int):
+            bs = [bs] * len(gam_cols)
+        for nm_, lst in (("num_knots", num_knots), ("bs", bs),
+                         ("scale", scales)):
+            if len(lst) != len(gam_cols):
+                raise ValueError(
+                    f"{nm_} has {len(lst)} entries for {len(gam_cols)} "
+                    "gam_columns")
+        for gcol, nk, b in zip(gam_cols, num_knots, bs):
             if gcol not in train:
                 raise ValueError(f"gam column {gcol!r} not in frame")
+            if int(b) not in (0, 1):
+                raise ValueError(f"bs={b} unsupported (0=cr, 1=thin plate)")
             probs = np.linspace(0.02, 0.98, int(nk))
             qs = quantile_column(train.col(gcol), probs.tolist())
             knots = np.unique(np.asarray(qs, np.float64))
             if len(knots) < 3:
                 raise ValueError(f"gam column {gcol!r} has too few distinct values")
             model.knots[gcol] = knots
+            model.bs_types[gcol] = int(b)
 
         expanded = model._expand_frame(train)
         # the basis replaces the raw column (reference keeps gam cols out of

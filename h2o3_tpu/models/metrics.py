@@ -246,6 +246,12 @@ class ModelMetricsMultinomial(ModelMetrics):
 
 
 @dataclass
+class ModelMetricsAutoEncoder(ModelMetrics):
+    """Reconstruction error (hex/ModelMetricsAutoEncoder: MSE over the
+    expanded input space); the shared base fields are the whole surface."""
+
+
+@dataclass
 class ModelMetricsClustering(ModelMetrics):
     tot_withinss: float = float("nan")
     betweenss: float = float("nan")

@@ -299,20 +299,46 @@ class Model(Keyed):
 
         return mojo.export_mojo(self, path)
 
+    # binary artifact format (the Iced/AutoBuffer stable-serialization
+    # analog, water/Iced.java + AutoBuffer.java): an 8-byte magic + u16
+    # format version ahead of the payload, so future layout changes stay
+    # loadable and foreign files fail fast with a clear error
+    _SAVE_MAGIC = b"H2O3TPUM"
+    _SAVE_VERSION = 1
+
     def save(self, path: str) -> str:
         import pickle
+        import struct
 
         state = self.__getstate__() if hasattr(self, "__getstate__") else self.__dict__
         with open(path, "wb") as f:
+            f.write(self._SAVE_MAGIC)
+            f.write(struct.pack("<H", self._SAVE_VERSION))
             pickle.dump((type(self), state), f)
         return path
 
     @staticmethod
     def load(path: str) -> "Model":
         import pickle
+        import struct
 
         with open(path, "rb") as f:
-            cls, state = pickle.load(f)
+            head = f.read(8)
+            if head == Model._SAVE_MAGIC:
+                (ver,) = struct.unpack("<H", f.read(2))
+                if ver > Model._SAVE_VERSION:
+                    raise ValueError(
+                        f"model artifact version {ver} is newer than this "
+                        f"build supports ({Model._SAVE_VERSION})")
+                cls, state = pickle.load(f)
+            else:
+                # pre-versioning artifact (round <= 3 headerless pickle)
+                f.seek(0)
+                try:
+                    cls, state = pickle.load(f)
+                except Exception as e:
+                    raise ValueError(
+                        f"{path!r} is not an h2o3_tpu model artifact") from e
         obj = cls.__new__(cls)
         obj.__dict__.update(state)
         DKV.put(obj._key, obj)

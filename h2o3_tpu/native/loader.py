@@ -46,6 +46,21 @@ def _build() -> bool:
         return False
 
 
+def _wire_treeshap(lib) -> None:
+    lib.h2o_treeshap.restype = None
+    lib.h2o_treeshap.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_longlong, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+    ]
+
+
 def get_lib():
     global _LIB, _TRIED
     with _LOCK:
@@ -56,25 +71,15 @@ def get_lib():
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
-            if not hasattr(lib, "h2o_treeshap"):
+            if not hasattr(lib, "h2o_treeshap") and \
+                    os.path.exists(os.path.join(_HERE, "treeshap.cpp")):
                 # stale .so from before treeshap.cpp existed: rebuild once
                 # (the rename in _build gives the new lib a fresh inode, so
                 # this CDLL loads it instead of the deduped old mapping)
-                if not _build():
-                    return None
-                lib = ctypes.CDLL(_LIB_PATH)
-            lib.h2o_treeshap.restype = None
-            lib.h2o_treeshap.argtypes = [
-                ctypes.POINTER(ctypes.c_int32), ctypes.c_longlong, ctypes.c_int,
-                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
-                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
-                ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_float),
-                ctypes.c_int, ctypes.c_int,
-                ctypes.POINTER(ctypes.c_double), ctypes.c_int,
-            ]
+                if _build():
+                    lib = ctypes.CDLL(_LIB_PATH)
+            if hasattr(lib, "h2o_treeshap"):
+                _wire_treeshap(lib)
             lib.h2o_parse_csv.restype = ctypes.c_longlong
             lib.h2o_parse_csv.argtypes = [
                 ctypes.c_char_p,          # path

@@ -80,3 +80,36 @@ def test_dl_deepfeatures_shape(cl):
                      mini_batch_size=32).train(y="y", training_frame=fr)
     df = m.deepfeatures(fr, 1)
     assert df.ncols == 4 and df.nrows == 500
+
+
+def test_autoencoder_metrics_and_versioned_save(cl, tmp_path):
+    """ModelMetricsAutoEncoder (reconstruction MSE) + versioned artifact
+    header (Iced/AutoBuffer analog)."""
+    import numpy as np
+
+    from h2o3_tpu.core.frame import Column, Frame
+    from h2o3_tpu.models.deeplearning import DeepLearning
+    from h2o3_tpu.models.model import Model
+
+    rng = np.random.default_rng(4)
+    X = rng.standard_normal((400, 5))
+    fr = Frame.from_numpy(X, names=[f"x{i}" for i in range(5)])
+    m = DeepLearning(autoencoder=True, hidden=[3], epochs=3,
+                     seed=1).train(training_frame=fr)
+    mm = m._output.training_metrics
+    assert mm is not None and np.isfinite(mm.mse) and mm.mse > 0
+    assert "reconstruction" in mm.description
+    # versioned save round-trip + foreign-file rejection
+    p = str(tmp_path / "ae.bin")
+    m.save(p)
+    with open(p, "rb") as f:
+        assert f.read(8) == b"H2O3TPUM"
+    re = Model.load(p)
+    assert float(re._output.training_metrics.mse) == float(mm.mse)
+    bad = str(tmp_path / "bad.bin")
+    with open(bad, "wb") as f:
+        f.write(b"garbage-not-a-model")
+    import pytest
+
+    with pytest.raises(ValueError, match="not an h2o3_tpu model"):
+        Model.load(bad)

@@ -124,3 +124,30 @@ def test_drf_early_stop_keeps_scale(cl):
             stopping_tolerance=0.2, seed=7).train(y="y", training_frame=fr)
     pred = m.predict(fr).col("predict").to_numpy()
     assert abs(pred.mean() - 5.0) < 0.3
+
+
+def test_gam_thinplate_and_knots(cl):
+    """bs=1 thin-plate basis + get_knot_locations (hex/gam bs types)."""
+    import numpy as np
+
+    from h2o3_tpu.core.frame import Column, Frame
+    from h2o3_tpu.models.gam import GAM
+
+    rng = np.random.default_rng(11)
+    n = 800
+    x = rng.uniform(-3, 3, n)
+    y = np.sin(x) + rng.normal(0, 0.15, n)
+    fr = Frame()
+    fr.add("x", Column.from_numpy(x))
+    fr.add("y", Column.from_numpy(y))
+    m = GAM(gam_columns=["x"], num_knots=8, bs=1, scale=0.001).train(
+        y="y", training_frame=fr)
+    pred = m.predict(fr).col("predict").to_numpy()
+    assert np.mean((pred - np.sin(x)) ** 2) < 0.05   # captures the nonlinearity
+    ks = m.get_knot_locations("x")
+    assert len(ks) == 8 and ks == sorted(ks)
+    assert m.bs_types["x"] == 1
+    import pytest
+
+    with pytest.raises(ValueError, match="unsupported"):
+        GAM(gam_columns=["x"], bs=7).train(y="y", training_frame=fr)
