@@ -113,6 +113,17 @@ def show_progress():
     log.PROGRESS = True
 
 
+def export_file(frame, path: str, force: bool = False) -> str:
+    """Write a Frame to a local CSV (h2o.export_file parity; remote URI
+    export would go through the persist registry)."""
+    import os as _os
+
+    if _os.path.exists(path) and not force:
+        raise FileExistsError(f"{path} exists (use force=True)")
+    frame.to_pandas().to_csv(path, index=False)
+    return path
+
+
 def ls():
     """List keys in the DKV (h2o.ls parity)."""
     return sorted(DKV.keys())

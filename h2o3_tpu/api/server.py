@@ -696,6 +696,57 @@ def h_predict_v4(ctx: Ctx):
     return {"__meta": S.meta("JobV4"), "job": S.job_v3(job)}
 
 
+def h_create_frame(ctx: Ctx):
+    """POST /3/CreateFrame (hex/createframe/CreateFrameHandler — synthetic
+    frame generation; h2o.create_frame)."""
+    from h2o3_tpu.frame_factory import create_frame
+
+    kw = {}
+    # templates drive _coerce's type parsing — fractions coerce as FLOATS
+    # even though their unset default is None
+    for name, template in (("rows", 100), ("cols", 4), ("randomize", True),
+                           ("real_fraction", 0.0), ("categorical_fraction", 0.0),
+                           ("integer_fraction", 0.0), ("binary_fraction", 0.0),
+                           ("factors", 2), ("real_range", 100.0),
+                           ("integer_range", 100), ("missing_fraction", 0.0),
+                           ("has_response", False), ("seed", -1)):
+        v = ctx.arg(name)
+        if v is not None:
+            kw[name] = _coerce(v, template)
+    if int(kw.get("seed", -1)) < 0:
+        kw.pop("seed", None)     # h2o's -1 sentinel = pick a random seed
+    dest = str(ctx.arg("dest", "") or ctx.arg("destination_frame", "") or "")
+    if dest.strip('"'):
+        kw["key"] = dest.strip('"')
+    fr = create_frame(**kw)
+    job = Job(description="CreateFrame")
+    job.dest_key = str(fr.key)
+    job.status = Job.DONE
+    job.progress = 1.0
+    return {"__meta": S.meta("JobV3"), "job": S.job_v3(job),
+            "key": S.key_ref(str(fr.key))}
+
+
+def h_split_frame(ctx: Ctx):
+    """POST /3/SplitFrame (hex/splitframe/SplitFrameHandler;
+    h2o.split_frame non-rapids path)."""
+    fr = _frame_or_404(str(ctx.arg("dataset", "")).strip('"'))
+    ratios = [float(r) for r in (_parse_list(ctx.arg("ratios")) or [0.75])]
+    dests = _parse_list(ctx.arg("destination_frames")) or None
+    from h2o3_tpu.frame_factory import H2OFrame
+
+    if not isinstance(fr, H2OFrame):
+        fr = H2OFrame._wrap(fr)
+    parts = fr.split_frame(ratios=ratios, destination_frames=dests)
+    for p in parts:
+        p.install()
+    job = Job(description="SplitFrame")
+    job.status = Job.DONE
+    job.progress = 1.0
+    return {"__meta": S.meta("SplitFrameV3"), "job": S.job_v3(job),
+            "destination_frames": [S.key_ref(str(p.key)) for p in parts]}
+
+
 def h_pdp_post(ctx: Ctx):
     """POST /3/PartialDependences (hex/PartialDependence.java; h2o-py
     partial_plot). Runs synchronously; results land in DKV under the
@@ -894,6 +945,8 @@ ROUTES: List[Tuple[str, str, Callable, str]] = [
      "Score a frame (async job)"),
     ("POST", "/3/ModelMetrics/models/{model_id}/frames/{frame_id}", h_model_metrics,
      "Compute model metrics on a frame"),
+    ("POST", "/3/CreateFrame", h_create_frame, "Generate a synthetic frame"),
+    ("POST", "/3/SplitFrame", h_split_frame, "Split a frame by ratios"),
     ("POST", "/3/PartialDependences", h_pdp_post, "Compute partial dependence"),
     ("GET", "/3/PartialDependences/{key}", h_pdp_get, "Partial dependence result"),
     ("POST", "/3/FeatureInteraction", h_feature_interaction,

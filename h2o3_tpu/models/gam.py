@@ -141,10 +141,14 @@ class GAM(ModelBuilder):
         gam_cols = list(p.get("gam_columns") or [])
         if not gam_cols:
             raise ValueError("gam requires gam_columns")
-        num_knots = p.get("num_knots") or [6] * len(gam_cols)
+        # explicit None checks: scale=0 (disable the smoothness penalty) is
+        # a legitimate setting a falsy `or` would silently overwrite
+        num_knots = p.get("num_knots")
+        num_knots = [6] * len(gam_cols) if num_knots is None else num_knots
         if isinstance(num_knots, int):
             num_knots = [num_knots] * len(gam_cols)
-        scales = p.get("scale") or [0.01] * len(gam_cols)
+        scales = p.get("scale")
+        scales = [0.01] * len(gam_cols) if scales is None else scales
         if isinstance(scales, (int, float)):
             scales = [float(scales)] * len(gam_cols)
 

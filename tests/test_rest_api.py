@@ -109,3 +109,47 @@ def test_xgboost_param_mapping(cl):
         y="y", training_frame=fr)
     assert m.algo_name == "xgboost"
     assert m._output.training_metrics.r2 > 0.85
+
+
+def test_create_and_split_frame_routes(server):
+    """POST /3/CreateFrame + /3/SplitFrame (CreateFrameHandler /
+    SplitFrameHandler analogs)."""
+    body = client._req("POST", "/3/CreateFrame",
+                       data={"rows": "200", "cols": "3", "seed": "7",
+                             "dest": "cf_test"})
+    assert body["job"]["status"] == "DONE"
+    info = client._req("GET", "/3/Frames/cf_test/light")
+    assert info["frames"][0]["rows"] == 200
+    body = client._req("POST", "/3/SplitFrame",
+                       data={"dataset": "cf_test", "ratios": "[0.5]"})
+    keys = [k["name"] for k in body["destination_frames"]]
+    assert len(keys) == 2
+    n0 = client._req("GET", f"/3/Frames/{keys[0]}/light")["frames"][0]["rows"]
+    n1 = client._req("GET", f"/3/Frames/{keys[1]}/light")["frames"][0]["rows"]
+    assert n0 + n1 == 200
+
+
+def test_export_file(server, tmp_path, csv_path):
+    import h2o3_tpu as h2o
+
+    fr = h2o.import_file(csv_path)
+    out = str(tmp_path / "exported.csv")
+    h2o.export_file(fr, out)
+    fr2 = h2o.import_file(out)
+    assert fr2.nrows == fr.nrows and fr2.ncols == fr.ncols
+    import pytest
+
+    with pytest.raises(FileExistsError):
+        h2o.export_file(fr, out)
+
+
+def test_create_frame_fractions_and_sentinel_seed(server):
+    body = client._req("POST", "/3/CreateFrame",
+                       data={"rows": "50", "cols": "4", "seed": "-1",
+                             "categorical_fraction": "0.5",
+                             "real_fraction": "0.5", "factors": "3",
+                             "dest": "cf_frac"})
+    assert body["job"]["status"] == "DONE"
+    cols = client._req("GET", "/3/Frames/cf_frac")["frames"][0]["columns"]
+    types = {c["type"] for c in cols}
+    assert "enum" in types     # categorical_fraction honored
