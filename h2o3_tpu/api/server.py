@@ -709,12 +709,17 @@ def h_create_frame(ctx: Ctx):
                            ("integer_fraction", 0.0), ("binary_fraction", 0.0),
                            ("factors", 2), ("real_range", 100.0),
                            ("integer_range", 100), ("missing_fraction", 0.0),
-                           ("has_response", False), ("seed", -1)):
+                           ("has_response", False), ("response_factors", 2),
+                           ("seed", -1)):
         v = ctx.arg(name)
         if v is not None:
             kw[name] = _coerce(v, template)
     if int(kw.get("seed", -1)) < 0:
         kw.pop("seed", None)     # h2o's -1 sentinel = pick a random seed
+    if kw.pop("randomize", True) is False:
+        # frame_factory's generator is always randomized; honor the contract
+        # by rejecting rather than silently ignoring
+        raise ApiError("randomize=false is not supported", 400)
     dest = str(ctx.arg("dest", "") or ctx.arg("destination_frame", "") or "")
     if dest.strip('"'):
         kw["key"] = dest.strip('"')
@@ -737,9 +742,8 @@ def h_split_frame(ctx: Ctx):
 
     if not isinstance(fr, H2OFrame):
         fr = H2OFrame._wrap(fr)
+    # split parts are installed by H2OFrame._wrap inside split_frame
     parts = fr.split_frame(ratios=ratios, destination_frames=dests)
-    for p in parts:
-        p.install()
     job = Job(description="SplitFrame")
     job.status = Job.DONE
     job.progress = 1.0
@@ -1126,6 +1130,10 @@ class _Handler(BaseHTTPRequestHandler):
         except ApiError as e:
             status = e.status
             return self._reply_error(str(e), e.status, e.schema)
+        except NotImplementedError as e:
+            # deliberate capability gates (XLS/Avro parsers, cloud SDKs)
+            status = 501
+            return self._reply_error(str(e), 501)
         except BrokenPipeError:
             status = 499
         except Exception as e:          # noqa: BLE001 — API boundary

@@ -29,8 +29,22 @@ STRUCTURED_EXT = {".arff": "ARFF", ".svm": "SVMLight",
                   ".svmlight": "SVMLight"}
 
 
+GATED_EXT = {".xls": "XLS", ".xlsx": "XLSX", ".avro": "Avro"}
+
+
 def detect_parse_type(path: str) -> Optional[str]:
+    """Extension -> parse type; None = fall back to CSV text sniffing.
+    Raises NotImplementedError for known-binary formats whose decoders are
+    not present (surfaced as HTTP 501 by the REST layer)."""
     ext = os.path.splitext(path)[1].lower()
+    if ext in GATED_EXT:
+        # fail fast with the reason — sniffing these binaries as CSV would
+        # produce garbage columns (reference ships h2o-parsers/h2o-avro-
+        # parser and XlsParser; their decoders need libs this image lacks)
+        raise NotImplementedError(
+            f"{GATED_EXT[ext]} parsing needs a decoder library not present "
+            "in this environment (openpyxl/fastavro). Convert to CSV or "
+            "Parquet and import that instead.")
     return COLUMNAR_EXT.get(ext) or STRUCTURED_EXT.get(ext)
 
 

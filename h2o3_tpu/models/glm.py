@@ -449,8 +449,60 @@ def _glm_predict(arrays, beta, offset, *, expand, linkname, link_power=0.0, ncla
 # model + builder
 # ---------------------------------------------------------------------------
 
+def _interaction_frame(frame: Frame, interactions, response=None) -> Frame:
+    """Append pairwise interaction columns (hex/DataInfo interaction/Wrapped
+    Vec analog): every unordered pair of the listed columns gets a device
+    product column.  numeric x numeric -> product; pairs involving an enum
+    get per-LEVEL slicing (numeric masked by level / indicator products),
+    the reference's expanded-interaction semantics."""
+    import jax.numpy as jnp
+
+    from h2o3_tpu.core.frame import Column, T_NUM
+
+    cols = [c for c in interactions if c != response]
+    missing = [c for c in cols if c not in frame]
+    if missing:
+        raise ValueError(f"interactions column(s) {missing} not in frame")
+    out = Frame()
+    for nm in frame.names:
+        out.add(nm, frame.col(nm))
+    nan = jnp.float32(jnp.nan)
+    for i in range(len(cols)):
+        for j in range(i + 1, len(cols)):
+            a, b = cols[i], cols[j]
+            ca, cb = frame.col(a), frame.col(b)
+            if ca.is_categorical and cb.is_categorical:
+                # NA in either factor propagates as NA (reference NA rules),
+                # not as an all-zero indicator row
+                na = (ca.data < 0) | (cb.data < 0)
+                for la, lev_a in enumerate(ca.domain or []):
+                    for lb, lev_b in enumerate(cb.domain or []):
+                        v = ((ca.data == la) & (cb.data == lb)).astype(jnp.float32)
+                        out.add(f"{a}_{lev_a}:{b}_{lev_b}",
+                                Column(jnp.where(na, nan, v), T_NUM, frame.nrows))
+            elif ca.is_categorical or cb.is_categorical:
+                cat, num = (ca, cb) if ca.is_categorical else (cb, ca)
+                catn, numn = (a, b) if ca.is_categorical else (b, a)
+                na = cat.data < 0
+                for li, lev in enumerate(cat.domain or []):
+                    v = jnp.where(cat.data == li, num.data, 0.0)
+                    out.add(f"{catn}_{lev}:{numn}",
+                            Column(jnp.where(na, nan, v), T_NUM, frame.nrows))
+            else:
+                out.add(f"{a}:{b}",
+                        Column(ca.data * cb.data, T_NUM, frame.nrows))
+    return out
+
+
 class GLMModel(Model):
     algo_name = "glm"
+
+    def adapt_test(self, test: Frame) -> Frame:
+        ints = self._parms.get("interactions")
+        if ints:
+            test = _interaction_frame(test, list(ints),
+                                      self._output.response_name)
+        return super().adapt_test(test)
 
     def __init__(self, parms=None):
         super().__init__(parms=parms)
@@ -578,6 +630,13 @@ class GLM(ModelBuilder):
         import jax
         import jax.numpy as jnp
 
+        ints = self.params.get("interactions")
+        if ints:
+            # expanded interaction columns join the design BEFORE the output
+            # schema is captured, so scoring's adapt_test re-expands test
+            # frames identically (GLMModel.adapt_test)
+            train = _interaction_frame(train, list(ints),
+                                       self.params.get("response_column"))
         fam = self._resolve_family(train)
         resp = self.params["response_column"]
         # validate BEFORE constructing the model (Keyed.__init__ installs it

@@ -242,3 +242,12 @@ class TestOverridesAndTime:
         ms = fr.col("when").to_numpy()
         assert abs(ms[0] - 1704067200000.0) < 1e6
         assert np.isnan(ms[1])
+
+
+class TestGatedBinaryFormats:
+    def test_xlsx_avro_fail_fast(self, tmp_path):
+        for ext in (".xlsx", ".avro"):
+            p = tmp_path / f"d{ext}"
+            p.write_bytes(b"\x00\x01binary")
+            with pytest.raises(NotImplementedError, match="decoder"):
+                import_file(str(p))
