@@ -1131,9 +1131,17 @@ class _Handler(BaseHTTPRequestHandler):
             status = e.status
             return self._reply_error(str(e), e.status, e.schema)
         except NotImplementedError as e:
-            # deliberate capability gates (XLS/Avro parsers, cloud SDKs)
-            status = 501
-            return self._reply_error(str(e), 501)
+            from h2o3_tpu.errors import CapabilityGate
+
+            if isinstance(e, CapabilityGate):
+                # deliberate capability gates (XLS/Avro parsers, cloud SDKs)
+                status = 501
+                return self._reply_error(str(e), 501)
+            # abstract-hook NotImplementedError is a server bug, not a gate
+            status = 500
+            return self._reply_error(
+                f"{type(e).__name__}: {e}", 500,
+                stack=traceback.format_exc().splitlines()[-12:])
         except BrokenPipeError:
             status = 499
         except Exception as e:          # noqa: BLE001 — API boundary

@@ -68,6 +68,74 @@ class _DKV:
             self._store.clear()
             self._rw.clear()
 
+    # -- cross-process control plane (water/DKV.java's distributed half) --
+    # In a multi-process cloud, puts ANNOUNCE key metadata cloud-wide over
+    # the coordination-service KV (parallel/distributed.py); small host
+    # objects can opt into full payload replication so any process can
+    # fetch_remote them. Device data never travels here — columns are
+    # already globally-sharded jax.Arrays.
+    _META_PREFIX = "h2o3/dkv/meta/"
+    _BLOB_PREFIX = "h2o3/dkv/blob/"
+    _MAX_BLOB = 8 * 1024 * 1024
+
+    def publish(self, key: str, value: Any = None,
+                replicate: bool = False) -> bool:
+        """Announce a key cloud-wide; with replicate=True also ship the
+        pickled payload (small host objects only). False in local mode."""
+        import json as _json
+
+        from h2o3_tpu.parallel import distributed as D
+
+        blob_b64 = None
+        if replicate and value is not None:
+            # validate the payload BEFORE announcing the key — a meta entry
+            # without its blob would be an unfetchable ghost cloud-wide
+            import base64
+            import pickle
+
+            blob = pickle.dumps(value)
+            if len(blob) > self._MAX_BLOB:
+                raise ValueError(
+                    f"object {key!r} is {len(blob)}B — too large for "
+                    "control-plane replication (cap "
+                    f"{self._MAX_BLOB}B); device data replicates via "
+                    "sharded arrays, not the KV")
+            blob_b64 = base64.b64encode(blob).decode()
+        meta = {"type": type(value).__name__ if value is not None else "?",
+                "proc": __import__("jax").process_index()}
+        if not D.kv_put(self._META_PREFIX + str(key), _json.dumps(meta)):
+            return False
+        if blob_b64 is not None:
+            D.kv_put(self._BLOB_PREFIX + str(key), blob_b64)
+        return True
+
+    def global_keys(self) -> List[str]:
+        """Cloud-wide announced keys merged with local ones."""
+        from h2o3_tpu.parallel import distributed as D
+
+        remote = [k[len(self._META_PREFIX):] if k.startswith(self._META_PREFIX)
+                  else k
+                  for k, _v in D.kv_dir(self._META_PREFIX)]
+        return sorted(set(self.keys()) | set(remote))
+
+    def fetch_remote(self, key: str, timeout_ms: int = 5000) -> Any:
+        """Get a key from anywhere in the cloud: local store first, then the
+        replicated control-plane payload (publish(..., replicate=True))."""
+        local = self.get(key)
+        if local is not None:
+            return local
+        from h2o3_tpu.parallel import distributed as D
+
+        raw = D.kv_get(self._BLOB_PREFIX + str(key), timeout_ms)
+        if raw is None:
+            return None
+        import base64
+        import pickle
+
+        value = pickle.loads(base64.b64decode(raw))
+        self.put(key, value)       # cache locally, like Value caching
+        return value
+
     def atomic(self, key: str, fn: Callable[[Any], Any]) -> Any:
         """Compare-and-set style update on the stored value
         (water/TAtomic.java): fn runs under the store lock."""

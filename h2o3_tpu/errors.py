@@ -1,0 +1,7 @@
+"""Shared error types."""
+
+
+class CapabilityGate(NotImplementedError):
+    """A DELIBERATE capability gate (missing optional decoder/SDK), as
+    opposed to an unimplemented abstract hook. The REST layer maps this —
+    and only this — to HTTP 501."""

@@ -36,12 +36,14 @@ def detect_parse_type(path: str) -> Optional[str]:
     """Extension -> parse type; None = fall back to CSV text sniffing.
     Raises NotImplementedError for known-binary formats whose decoders are
     not present (surfaced as HTTP 501 by the REST layer)."""
+    from h2o3_tpu.errors import CapabilityGate
+
     ext = os.path.splitext(path)[1].lower()
     if ext in GATED_EXT:
         # fail fast with the reason — sniffing these binaries as CSV would
         # produce garbage columns (reference ships h2o-parsers/h2o-avro-
         # parser and XlsParser; their decoders need libs this image lacks)
-        raise NotImplementedError(
+        raise CapabilityGate(
             f"{GATED_EXT[ext]} parsing needs a decoder library not present "
             "in this environment (openpyxl/fastavro). Convert to CSV or "
             "Parquet and import that instead.")

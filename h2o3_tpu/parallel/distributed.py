@@ -43,3 +43,81 @@ def process_count() -> int:
     import jax
 
     return jax.process_count()
+
+
+# ---------------------------------------------------------------------------
+# cloud-wide key/value channel (water/DKV.java's control plane)
+#
+# The JAX coordination service ships a distributed KV store (the same one
+# jax uses for topology exchange at init). It is exactly the "host-side
+# object store + RPC" SURVEY §7 maps the reference DKV onto: small control-
+# plane values, replicated through the coordinator, visible to every
+# process. Device DATA never travels here — columns are already globally
+# sharded jax.Arrays; this channel carries metadata and small host objects.
+# ---------------------------------------------------------------------------
+
+def _kv_client():
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client
+    except Exception:   # noqa: BLE001 — not initialized / API moved
+        return None
+
+
+def kv_put(key: str, value: str) -> bool:
+    """Publish a small value cloud-wide; False when not in a multi-process
+    cloud (callers treat local mode as a no-op). Upsert semantics like
+    DKV.put — re-publishing a key overwrites."""
+    c = _kv_client()
+    if c is None:
+        return False
+    try:
+        c.key_value_set(key, value, allow_overwrite=True)
+    except TypeError:      # older client without the kwarg
+        try:
+            c.key_value_set(key, value)
+        except Exception:  # noqa: BLE001 — ALREADY_EXISTS: delete + retry
+            kv_delete(key)
+            c.key_value_set(key, value)
+    return True
+
+
+def kv_get(key: str, timeout_ms: int = 5000) -> Optional[str]:
+    c = _kv_client()
+    if c is None:
+        return None
+    try:
+        return c.blocking_key_value_get(key, timeout_ms)
+    except Exception:   # noqa: BLE001 — absent key times out
+        return None
+
+
+def kv_try_get(key: str) -> Optional[str]:
+    c = _kv_client()
+    if c is None:
+        return None
+    try:
+        return c.key_value_try_get(key)
+    except Exception:   # noqa: BLE001 — absent
+        return None
+
+
+def kv_dir(prefix: str):
+    """List (key, value) pairs under a prefix (key_value_dir_get)."""
+    c = _kv_client()
+    if c is None:
+        return []
+    try:
+        return list(c.key_value_dir_get(prefix))
+    except Exception:   # noqa: BLE001
+        return []
+
+
+def kv_delete(key: str) -> None:
+    c = _kv_client()
+    if c is not None:
+        try:
+            c.key_value_delete(key)
+        except Exception:   # noqa: BLE001
+            pass

@@ -69,7 +69,9 @@ def _fetch_file(uri: str) -> str:
 
 def _gated(scheme: str, pkg: str, ref: str):
     def fetch(uri: str) -> str:
-        raise NotImplementedError(
+        from h2o3_tpu.errors import CapabilityGate
+
+        raise CapabilityGate(
             f"{scheme}:// URIs need the {pkg} SDK, which is not installed in "
             f"this environment. Fetch the object to a local path (or an "
             f"http(s) endpoint) and import that instead. Reference analog: "

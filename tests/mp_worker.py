@@ -90,7 +90,25 @@ def main():
     gp = gm.predict(fr)
     gs = float(gp.col("Y").data.sum())
     assert np.isfinite(gs)
-    print(f"proc {pid}: OK auc={auc:.4f} gbm_auc={gauc:.4f}", flush=True)
+
+    # cross-process DKV control plane (round-2 weakness W4): keys announce
+    # cloud-wide over the coordination-service KV; small host objects opt
+    # into payload replication and any process can fetch them
+    from h2o3_tpu.core.dkv import DKV
+
+    assert DKV.publish(m.key)          # metadata announce (distributed mode)
+    if pid == 0:
+        cfg = {"alpha": 0.5, "origin": 0}
+        DKV.put("shared_cfg", cfg)
+        DKV.publish("shared_cfg", cfg, replicate=True)
+    else:
+        assert not DKV.contains("shared_cfg")      # not local before fetch
+    cfg = DKV.fetch_remote("shared_cfg", timeout_ms=60000)
+    assert cfg is not None and cfg["alpha"] == 0.5, cfg
+    gk = DKV.global_keys()
+    assert "shared_cfg" in gk and str(m.key) in gk
+    print(f"proc {pid}: OK auc={auc:.4f} gbm_auc={gauc:.4f} "
+          f"dkv_keys={len(gk)}", flush=True)
 
 
 if __name__ == "__main__":

@@ -139,3 +139,35 @@ def test_job_failure(cl):
 def test_self_benchmark(cl):
     b = cl.self_benchmark(size=256)
     assert b["matmul_gflops"] > 0
+
+
+def test_dkv_control_plane_local_mode(cl):
+    """publish/global_keys/fetch_remote degrade gracefully without a
+    multi-process cloud (water/DKV.java distributed half; the 2-process
+    tier exercises the real coordination-service KV)."""
+    from h2o3_tpu.core.dkv import DKV
+
+    DKV.put("local_thing", {"v": 1})
+    try:
+        assert DKV.publish("local_thing", {"v": 1}) is False   # no cloud KV
+        assert "local_thing" in DKV.global_keys()              # local merge
+        assert DKV.fetch_remote("local_thing") == {"v": 1}     # local hit
+        assert DKV.fetch_remote("never_existed", timeout_ms=10) is None
+    finally:
+        DKV.remove("local_thing")
+
+
+def test_dkv_blob_size_cap(cl, monkeypatch):
+    """The size check must fire BEFORE the meta announce (no ghost keys)."""
+    import numpy as np
+    import pytest
+
+    from h2o3_tpu.core.dkv import DKV
+    from h2o3_tpu.parallel import distributed as D
+
+    calls = []
+    monkeypatch.setattr(D, "kv_put", lambda k, v: calls.append(k) or True)
+    big = np.zeros(3_000_000)          # pickles to ~24 MB > 8 MiB cap
+    with pytest.raises(ValueError, match="too large"):
+        DKV.publish("big_thing", big, replicate=True)
+    assert calls == []                 # nothing announced for the ghost key

@@ -257,3 +257,32 @@ class TestGLMInteractions:
                 interactions=["g", "x"]).train(y="y", training_frame=fr)
         pred = m.predict(fr).col("predict").to_numpy()
         assert np.mean((pred - y) ** 2) < 0.01   # per-level slopes captured
+
+
+def test_interaction_missing_test_level_scores_zero(cl):
+    """A training enum level absent from the test frame yields all-zero
+    interaction indicators, not NA backfill."""
+    import numpy as np
+
+    from h2o3_tpu.core.frame import Column, Frame
+    from h2o3_tpu.models.glm import GLM
+
+    rng = np.random.default_rng(5)
+    n = 900
+    g = np.array(["u", "v"], object)[rng.integers(0, 2, n)]
+    x = rng.standard_normal(n)
+    y = np.where(g == "u", 2.0 * x, -1.0 * x) + rng.normal(0, 0.05, n)
+    fr = Frame()
+    fr.add("g", Column.from_numpy(g, ctype="enum"))
+    fr.add("x", Column.from_numpy(x))
+    fr.add("y", Column.from_numpy(y))
+    m = GLM(family="gaussian", lambda_=0.0,
+            interactions=["g", "x"]).train(y="y", training_frame=fr)
+    # test frame with ONLY level u
+    fu = Frame()
+    xu = np.linspace(-2, 2, 50)
+    fu.add("g", Column.from_numpy(np.array(["u"] * 50, object), ctype="enum"))
+    fu.add("x", Column.from_numpy(xu))
+    pred = m.predict(fu).col("predict").to_numpy()
+    assert np.all(np.isfinite(pred))
+    np.testing.assert_allclose(pred, 2.0 * xu, atol=0.1)   # u-slope only
