@@ -152,9 +152,16 @@ class Ctx:
 
 
 def h_cloud(ctx: Ctx):
+    from h2o3_tpu.core.failure import cluster_health
     from h2o3_tpu.core.runtime import cluster_info
 
-    return S.cloud_v3(cluster_info())
+    out = S.cloud_v3(cluster_info())
+    hb = cluster_health()
+    if hb:          # multi-process cloud: liveness table per process
+        out["process_health"] = hb
+        out["cloud_healthy"] = bool(out.get("cloud_healthy", True)) and \
+            all(r["healthy"] for r in hb)
+    return out
 
 
 def h_about(ctx: Ctx):

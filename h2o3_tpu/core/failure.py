@@ -1,0 +1,116 @@
+"""Failure detection + fault injection.
+
+Reference: water/HeartBeatThread.java:16 — every node gossips a heartbeat;
+peers that miss enough beats are declared dead and the cloud locks/fails
+jobs against them. Fault injection in the reference lives in the test tree
+(water/runner chaos flags) to exercise those paths.
+
+TPU mapping: process liveness is ALREADY policed by the JAX coordination
+service (a dead process fails collectives for everyone — there is no
+half-alive cloud the way a UDP mesh allows). What this module adds:
+- a heartbeat table over the coordination KV so OBSERVABILITY can show
+  per-process liveness before a collective trips (`heartbeat()` /
+  `cluster_health()`), surfaced in /3/Cloud's node listing;
+- deterministic fault injection (`inject`, `faultpoint`) so tests can
+  drive the error paths (Job FAILED propagation, per-segment capture,
+  AutoML keep-going) without a real dead chip."""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Dict, List, Optional
+
+_HB_PREFIX = "h2o3/heartbeat/"
+HEARTBEAT_STALE_S = 30.0
+
+# fault injection registry: name -> remaining trigger count
+_FAULTS: Dict[str, int] = {}
+
+
+def heartbeat() -> bool:
+    """Publish this process's liveness beat (HeartBeatThread analog).
+    False in single-process mode (nothing to police)."""
+    import jax
+
+    from h2o3_tpu.parallel import distributed as D
+
+    return D.kv_put(_HB_PREFIX + str(jax.process_index()),
+                    json.dumps({"ts": time.time(),
+                                "proc": jax.process_index()}))
+
+
+def cluster_health(stale_after_s: float = HEARTBEAT_STALE_S) -> List[dict]:
+    """Per-process liveness from the heartbeat table: one row per process
+    that has ever beat, with age and a healthy flag."""
+    from h2o3_tpu.parallel import distributed as D
+
+    now = time.time()
+    out = []
+    for key, val in D.kv_dir(_HB_PREFIX):
+        try:
+            rec = json.loads(val)
+        except ValueError:
+            continue
+        age = now - float(rec.get("ts", 0))
+        out.append({"process": rec.get("proc"), "age_s": round(age, 3),
+                    "healthy": age < stale_after_s})
+    return sorted(out, key=lambda r: (r["process"] is None, r["process"]))
+
+
+class HeartbeatThread:
+    """Background beater (the reference runs one per node)."""
+
+    def __init__(self, interval_s: float = 5.0):
+        import threading
+
+        self.interval = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HeartbeatThread":
+        import threading
+
+        def run():
+            while not self._stop.wait(self.interval):
+                heartbeat()
+
+        heartbeat()
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="h2o3-heartbeat")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+
+# ---------------------------------------------------------------------------
+# fault injection (test-only chaos hooks)
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def inject(name: str, times: int = 1):
+    """Arm the named fault point for `times` triggers within the block."""
+    _FAULTS[name] = int(times)
+    try:
+        yield
+    finally:
+        _FAULTS.pop(name, None)
+
+
+class InjectedFault(RuntimeError):
+    pass
+
+
+def faultpoint(name: str) -> None:
+    """Raise InjectedFault if the named fault is armed (cheap no-op dict
+    lookup otherwise). Production code sprinkles these at the few places
+    whose failure paths need deterministic coverage."""
+    left = _FAULTS.get(name)
+    if left:
+        _FAULTS[name] = left - 1
+        if _FAULTS[name] <= 0:
+            _FAULTS.pop(name, None)
+        raise InjectedFault(f"injected fault: {name}")

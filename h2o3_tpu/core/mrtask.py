@@ -62,8 +62,10 @@ def map_reduce(fn: Callable, cols: Sequence[Column]):
     returns a pytree of reduction partials; result is the psum over shards.
     Under H2O_TPU_PROFILE=1, per-phase timings land in the TimeLine ring
     (MRTask.profile analog; the sync phase forces a device wait)."""
+    from h2o3_tpu.core.failure import faultpoint
     from h2o3_tpu.utils import timeline
 
+    faultpoint("mrtask.map_reduce")     # chaos hook (core/failure.py)
     arrays = tuple(c.data for c in cols)
     if not timeline.profiling_enabled():
         return _build_map_reduce(fn, len(arrays), _mesh())(*arrays)

@@ -92,6 +92,13 @@ class Cluster:
         self.mesh = jax.sharding.Mesh(dev_grid, tuple(args.mesh_axes[: dev_grid.ndim]))
         self.n_devices = n
         self.locked = False  # parity flag; membership is always static here
+        # multi-process clouds run the liveness beater (HeartBeatThread
+        # analog) so /3/Cloud's process_health stays fresh
+        self._heartbeat = None
+        if jax.process_count() > 1:
+            from h2o3_tpu.core.failure import HeartbeatThread
+
+            self._heartbeat = HeartbeatThread(interval_s=5.0).start()
 
     # -- sharding helpers -------------------------------------------------
     def row_sharding(self):
@@ -255,6 +262,8 @@ def shutdown() -> None:
     from h2o3_tpu.core.dkv import DKV
 
     with _LOCK:
+        if _CLUSTER is not None and getattr(_CLUSTER, "_heartbeat", None):
+            _CLUSTER._heartbeat.stop()
         DKV.clear()
         _CLUSTER = None
     # registered extensions re-run their hooks against the next cluster

@@ -504,20 +504,12 @@ class GLMModel(Model):
             # the expansion emits every training level's column (a level
             # absent from the test frame must become an all-zero indicator,
             # not an NA-backfilled missing column)
-            from h2o3_tpu.core.frame import Column as _Col, T_CAT as _TC
-            from h2o3_tpu.models.model import _remap_to_domain
-
             pre = Frame()
             for nm in test.names:
                 c = test.col(nm)
-                dom = self._output.domains.get(nm)
-                if nm in ints and c.is_categorical and dom is not None \
-                        and (c.domain or []) != dom:
-                    pre.add(nm, _Col(_remap_to_domain(c.data, c.domain or [],
-                                                      dom),
-                                     _TC, c.nrows, domain=list(dom)))
-                else:
-                    pre.add(nm, c)
+                if nm in ints:
+                    c = self._remap_col(c, self._output.domains.get(nm))
+                pre.add(nm, c)
             test = _interaction_frame(pre, list(ints),
                                       self._output.response_name)
         return super().adapt_test(test)

@@ -146,15 +146,21 @@ class Model(Keyed):
                 out.add(cn, test.col(cn))
         return out
 
+    @staticmethod
+    def _remap_col(c: Column, train_dom: Optional[List[str]]) -> Column:
+        """Remap one categorical column onto a training domain (identity
+        when already aligned) — the single home of unseen-level semantics."""
+        if train_dom is None or not c.is_categorical \
+                or (c.domain or []) == train_dom:
+            return c
+        return Column(_remap_to_domain(c.data, c.domain or [], train_dom),
+                      T_CAT, c.nrows, domain=list(train_dom))
+
     def _adapt_response(self, c: Column) -> Column:
         """Remap a categorical response's codes onto the TRAINING response
         domain (adaptTestForTrain handles the response too, Model.java:1052 —
         a test frame may intern the same labels in a different order)."""
-        train_dom = self._output.response_domain
-        if train_dom is None or not c.is_categorical or (c.domain or []) == train_dom:
-            return c
-        return Column(_remap_to_domain(c.data, c.domain or [], train_dom),
-                      T_CAT, c.nrows, domain=list(train_dom))
+        return self._remap_col(c, self._output.response_domain)
 
     # -- public scoring (hex/Model.score) ---------------------------------
     def predict(self, frame: Frame, key: Optional[str] = None) -> Frame:

@@ -73,7 +73,9 @@ class TargetEncoderModel(Model):
     # TE's "prediction" is the transform (hex/generic semantics: transform
     # is the product; predict delegates to it for API uniformity)
     def _predict_raw(self, frame: Frame):
-        raise NotImplementedError("TargetEncoder has no predict; use transform()")
+        from h2o3_tpu.errors import CapabilityGate
+
+        raise CapabilityGate("TargetEncoder has no predict; use transform()")
 
     def predict(self, frame: Frame, key: Optional[str] = None) -> Frame:
         return self.transform(frame, key=key)

@@ -398,7 +398,10 @@ class SharedTree(ModelBuilder):
 
         root_key = jax.random.PRNGKey(self._seed())
         packs, leaf_vals, leaf_wys = [], [], []
+        from h2o3_tpu.core.failure import faultpoint
+
         for t in range(t_start, ntrees):
+            faultpoint("tree.fit_tree")     # chaos hook (core/failure.py)
             z, w_t, num_r, den_r, _mask = pre(y, f, w, root_key,
                                               np.int32(t), sample_rate)
             feat_mask_fn = self._feat_mask_fn(rng, spec)

@@ -98,7 +98,9 @@ class Word2VecModel(Model):
         return fr
 
     def _predict_raw(self, frame: Frame):
-        raise NotImplementedError("use transform()/find_synonyms()")
+        from h2o3_tpu.errors import CapabilityGate
+
+        raise CapabilityGate("use transform()/find_synonyms()")
 
     def _make_metrics(self, frame, raw):
         return None

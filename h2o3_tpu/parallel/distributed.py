@@ -79,7 +79,10 @@ def kv_put(key: str, value: str) -> bool:
             c.key_value_set(key, value)
         except Exception:  # noqa: BLE001 — ALREADY_EXISTS: delete + retry
             kv_delete(key)
-            c.key_value_set(key, value)
+            try:
+                c.key_value_set(key, value)
+            except Exception:   # noqa: BLE001 — lost a concurrent re-publish
+                pass            # race: the winner's value is in place
     return True
 
 

@@ -107,6 +107,21 @@ def main():
     assert cfg is not None and cfg["alpha"] == 0.5, cfg
     gk = DKV.global_keys()
     assert "shared_cfg" in gk and str(m.key) in gk
+
+    # heartbeat table (water/HeartBeatThread analog): both processes beat,
+    # health shows 2 live rows
+    import time as _time
+
+    from h2o3_tpu.core import failure
+
+    assert failure.heartbeat()
+    deadline = _time.time() + 30
+    while _time.time() < deadline:
+        health = failure.cluster_health()
+        if len(health) >= 2:
+            break
+        _time.sleep(0.25)
+    assert len(health) >= 2 and all(r["healthy"] for r in health), health
     print(f"proc {pid}: OK auc={auc:.4f} gbm_auc={gauc:.4f} "
           f"dkv_keys={len(gk)}", flush=True)
 

@@ -1,0 +1,90 @@
+"""Failure detection + fault injection (SURVEY §5.3).
+
+Reference: water/HeartBeatThread.java (liveness gossip), the reference
+test-tree chaos flags (kill-node runners). The 2-process tier
+(tests/mp_worker.py) exercises the real heartbeat table; these tests cover
+the injection hooks and failure propagation through the Job machinery.
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core import failure
+from h2o3_tpu.core.frame import Column, Frame
+
+
+def _frame(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal(n)
+    y = np.where(rng.random(n) < 1 / (1 + np.exp(-2 * x)), "Y", "N")
+    fr = Frame()
+    fr.add("x", Column.from_numpy(x))
+    fr.add("y", Column.from_numpy(y, ctype="enum"))
+    return fr
+
+
+class TestFaultInjection:
+    def test_faultpoint_noop_when_unarmed(self):
+        failure.faultpoint("never.armed")       # must be free + silent
+
+    def test_inject_fires_n_times(self):
+        with failure.inject("x.y", times=2):
+            with pytest.raises(failure.InjectedFault):
+                failure.faultpoint("x.y")
+            with pytest.raises(failure.InjectedFault):
+                failure.faultpoint("x.y")
+            failure.faultpoint("x.y")           # disarmed after 2
+        failure.faultpoint("x.y")               # context cleanup
+
+    def test_tree_fit_failure_fails_job(self, cl):
+        """An injected mid-training fault must surface as a FAILED job with
+        the exception recorded (hex Job failure propagation)."""
+        from h2o3_tpu.core.job import Job
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        b = GBM(ntrees=5, max_depth=3, seed=1)
+        with failure.inject("tree.fit_tree", times=1):
+            with pytest.raises(failure.InjectedFault):
+                b.train(y="y", training_frame=_frame())
+        assert b.job.status == Job.FAILED
+        assert "injected fault" in (b.job.exception or "")
+
+    def test_mrtask_failure(self, cl):
+        import jax.numpy as jnp
+
+        from h2o3_tpu.core.mrtask import map_reduce
+
+        c = Column.from_numpy(np.arange(32, dtype=np.float64))
+        with failure.inject("mrtask.map_reduce"):
+            with pytest.raises(failure.InjectedFault):
+                map_reduce(lambda x: jnp.nansum(x), [c])
+        # and the harness recovers afterwards
+        assert float(map_reduce(lambda x: jnp.nansum(x), [c])) == \
+            float(np.arange(32).sum())
+
+    def test_automl_keeps_going_past_faulted_step(self, cl):
+        """AutoML's fire-and-record loop must survive a model that dies
+        mid-train (the reference logs the failure and moves on)."""
+        from h2o3_tpu.automl.automl import H2OAutoML
+
+        am = H2OAutoML(max_models=2, seed=3, nfolds=2,
+                       include_algos=["gbm"])
+        with failure.inject("tree.fit_tree", times=1):
+            am.train(y="y", training_frame=_frame(600))
+        assert am.leader is not None            # later steps still trained
+        assert any("FAILED" in e["message"] for e in am.event_log)
+
+
+class TestHealth:
+    def test_single_process_health_empty(self, cl):
+        assert failure.heartbeat() is False     # no cloud KV locally
+        assert failure.cluster_health() == []
+
+    def test_heartbeat_thread_lifecycle(self, cl):
+        hb = failure.HeartbeatThread(interval_s=0.1).start()
+        try:
+            import time
+
+            time.sleep(0.3)
+        finally:
+            hb.stop()
