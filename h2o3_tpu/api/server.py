@@ -703,6 +703,43 @@ def h_predict_v4(ctx: Ctx):
     return {"__meta": S.meta("JobV4"), "job": S.job_v3(job)}
 
 
+def h_import_sql(ctx: Ctx):
+    """POST /99/ImportSQLTable (water/jdbc SQLManager; h2o-py
+    import_sql_table/import_sql_select)."""
+    from h2o3_tpu.ingest.sql import import_sql_select, import_sql_table
+
+    url = str(ctx.arg("connection_url", "") or "").strip('"')
+    user = str(ctx.arg("username", "") or "").strip('"') or None
+    pw = str(ctx.arg("password", "") or "").strip('"') or None
+    select = str(ctx.arg("select_query", "") or "").strip('"')
+    table = str(ctx.arg("table", "") or "").strip('"')
+    if not url or not (select or table):
+        raise ApiError("connection_url and table/select_query required", 400)
+    if select:
+        fr = import_sql_select(url, select, username=user, password=pw)
+    else:
+        cols = _parse_list(ctx.arg("columns")) or None
+        fr = import_sql_table(url, table, columns=cols,
+                              username=user, password=pw)
+    fr.install()
+    job = Job(description="ImportSQLTable")
+    job.dest_key = str(fr.key)
+    job.status = Job.DONE
+    job.progress = 1.0
+    return {"__meta": S.meta("JobV3"), "job": S.job_v3(job),
+            "key": S.key_ref(str(fr.key))}
+
+
+def h_network_test(ctx: Ctx):
+    """GET /3/NetworkTest (water/api/NetworkTestHandler + NetworkBench):
+    the mesh's boot probes — matmul GFLOPs, HBM stream, psum latency."""
+    from h2o3_tpu.core.runtime import cluster
+
+    b = cluster().self_benchmark(size=min(int(ctx.arg("size", 512) or 512),
+                                          4096))
+    return {"__meta": S.meta("NetworkTestV3"), "bench": b}
+
+
 def h_create_frame(ctx: Ctx):
     """POST /3/CreateFrame (hex/createframe/CreateFrameHandler — synthetic
     frame generation; h2o.create_frame)."""
@@ -956,6 +993,8 @@ ROUTES: List[Tuple[str, str, Callable, str]] = [
      "Score a frame (async job)"),
     ("POST", "/3/ModelMetrics/models/{model_id}/frames/{frame_id}", h_model_metrics,
      "Compute model metrics on a frame"),
+    ("POST", "/99/ImportSQLTable", h_import_sql, "Import a SQL table/query"),
+    ("GET", "/3/NetworkTest", h_network_test, "Mesh compute/BW/latency probes"),
     ("POST", "/3/CreateFrame", h_create_frame, "Generate a synthetic frame"),
     ("POST", "/3/SplitFrame", h_split_frame, "Split a frame by ratios"),
     ("POST", "/3/PartialDependences", h_pdp_post, "Compute partial dependence"),

@@ -72,11 +72,17 @@ class HeartbeatThread:
     def start(self) -> "HeartbeatThread":
         import threading
 
+        def beat_once():
+            try:
+                heartbeat()
+            except Exception:   # noqa: BLE001 — a transient coordination
+                pass            # hiccup must not kill the beater for good
+
         def run():
             while not self._stop.wait(self.interval):
-                heartbeat()
+                beat_once()
 
-        heartbeat()
+        beat_once()
         self._thread = threading.Thread(target=run, daemon=True,
                                         name="h2o3-heartbeat")
         self._thread.start()

@@ -81,8 +81,10 @@ def kv_put(key: str, value: str) -> bool:
             kv_delete(key)
             try:
                 c.key_value_set(key, value)
-            except Exception:   # noqa: BLE001 — lost a concurrent re-publish
-                pass            # race: the winner's value is in place
+            except Exception:   # noqa: BLE001
+                # a CONCURRENT writer winning leaves a value in place —
+                # success; a missing value means a real write failure
+                return kv_try_get(key) is not None
     return True
 
 

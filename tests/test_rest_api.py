@@ -153,3 +153,22 @@ def test_create_frame_fractions_and_sentinel_seed(server):
     cols = client._req("GET", "/3/Frames/cf_frac")["frames"][0]["columns"]
     types = {c["type"] for c in cols}
     assert "enum" in types     # categorical_fraction honored
+
+
+def test_import_sql_and_network_test_routes(server, tmp_path):
+    import sqlite3
+
+    db = str(tmp_path / "r.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (a REAL, b TEXT)")
+    conn.executemany("INSERT INTO t VALUES (?,?)",
+                     [(i, "xy"[i % 2]) for i in range(40)])
+    conn.commit(); conn.close()
+    body = client._req("POST", "/99/ImportSQLTable",
+                       data={"connection_url": f"sqlite:///{db}",
+                             "table": "t"})
+    key = body["key"]["name"]
+    info = client._req("GET", f"/3/Frames/{key}/light")["frames"][0]
+    assert info["rows"] == 40
+    bench = client._req("GET", "/3/NetworkTest", query={"size": "128"})["bench"]
+    assert bench["matmul_gflops"] > 0 and bench["psum_latency_us"] > 0
