@@ -83,6 +83,7 @@ _ESTIMATORS = {
     "H2OIsotonicRegressionEstimator": "h2o3_tpu.models.isotonic",
     "H2OSupportVectorMachineEstimator": "h2o3_tpu.estimators",
     "H2OGridSearch": "h2o3_tpu.grid",
+    "H2OAssembly": "h2o3_tpu.assembly",
     "H2OAutoML": "h2o3_tpu.automl.automl",
     "start_server": "h2o3_tpu.api.server",
     "exec_rapids": "h2o3_tpu.rapids",

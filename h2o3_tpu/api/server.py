@@ -735,8 +735,8 @@ def h_network_test(ctx: Ctx):
     the mesh's boot probes — matmul GFLOPs, HBM stream, psum latency."""
     from h2o3_tpu.core.runtime import cluster
 
-    b = cluster().self_benchmark(size=min(int(ctx.arg("size", 512) or 512),
-                                          4096))
+    b = cluster().self_benchmark(
+        size=max(16, min(int(ctx.arg("size", 512) or 512), 4096)))
     return {"__meta": S.meta("NetworkTestV3"), "bench": b}
 
 
@@ -1205,7 +1205,11 @@ class ApiServer:
     """Owns the HTTP thread (reference: water.webserver jetty adapters)."""
 
     def __init__(self, port: int = 54321,
-                 auth_file: Optional[str] = None):
+                 auth_file: Optional[str] = None,
+                 host: Optional[str] = None):
+        # bind address: loopback by default; containers/pods set
+        # H2O_TPU_BIND=0.0.0.0 (deploy/ manifests do)
+        self.host = host or os.environ.get("H2O_TPU_BIND", "127.0.0.1")
         self.port = port
         self.httpd: Optional[ThreadingHTTPServer] = None
         self.thread: Optional[threading.Thread] = None
@@ -1228,7 +1232,7 @@ class ApiServer:
 
     def start(self) -> "ApiServer":
         handler = type("_BoundHandler", (_Handler,), {"server_ref": self})
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", self.port), handler)
+        self.httpd = ThreadingHTTPServer((self.host, self.port), handler)
         self.port = self.httpd.server_address[1]
         self.thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         self.thread.start()
@@ -1240,5 +1244,6 @@ class ApiServer:
             self.httpd = None
 
 
-def start_server(port: int = 54321, auth_file: Optional[str] = None) -> ApiServer:
-    return ApiServer(port, auth_file=auth_file).start()
+def start_server(port: int = 54321, auth_file: Optional[str] = None,
+                 host: Optional[str] = None) -> ApiServer:
+    return ApiServer(port, auth_file=auth_file, host=host).start()

@@ -1,0 +1,204 @@
+"""Assembly — fit/apply munging pipelines.
+
+Reference: h2o-core/src/main/java/water/rapids/Assembly.java + h2o-py's
+h2o/assembly.py (H2OAssembly) and h2o/transforms/preprocessing.py
+(H2OColSelect / H2OColOp / H2OScaler / H2OBinaryOp): an ordered list of
+named frame transforms that fits once, applies to any frame, and persists
+as a scoring artifact (the reference compiles it to a munging POJO).
+
+TPU mapping: every step runs the normal device column ops (each transform
+is one fused XLA program over the sharded frame); the fitted pipeline
+pickles with the same versioned header models use, so it ships alongside
+model artifacts for end-to-end scoring pipelines."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Column, Frame, T_NUM
+
+
+class H2OColSelect:
+    """Keep only the named columns (h2o-py H2OColSelect)."""
+
+    def __init__(self, cols: Sequence[str]):
+        self.cols = list(cols)
+
+    def fit_transform(self, fr: Frame) -> Frame:
+        return self.transform(fr)
+
+    def transform(self, fr: Frame) -> Frame:
+        missing = [c for c in self.cols if c not in fr]
+        if missing:
+            raise ValueError(f"H2OColSelect: missing columns {missing}")
+        return fr.subframe(self.cols)
+
+
+class H2OColOp:
+    """Apply a unary device op to one column (h2o-py H2OColOp): op is a
+    callable on jax arrays (e.g. jnp.cos) or the name of one."""
+
+    def __init__(self, op, col: str, new_col_name: Optional[str] = None,
+                 inplace: bool = True):
+        # callables normalize to their NAME at construction: the pipeline
+        # must pickle (jax ufunc objects do not) and derived column names
+        # must be stable across processes
+        if callable(op):
+            op = getattr(op, "__name__", None) or str(op)
+        self.op = str(op)
+        import jax.numpy as jnp
+
+        if not callable(getattr(jnp, self.op, None)):
+            raise ValueError(f"H2OColOp: unknown op {self.op!r} "
+                             "(must name a jax.numpy function)")
+        self.col = col
+        self.new_col_name = new_col_name
+        self.inplace = bool(inplace)
+
+    def _fn(self) -> Callable:
+        import jax.numpy as jnp
+
+        return getattr(jnp, self.op)
+
+    def fit_transform(self, fr: Frame) -> Frame:
+        return self.transform(fr)
+
+    def transform(self, fr: Frame) -> Frame:
+        import jax
+
+        c = fr.col(self.col)
+        out_data = jax.jit(self._fn())(c.data)
+        name = self.new_col_name or (self.col if self.inplace
+                                     else f"{self.op}_{self.col}")
+        out = Frame()
+        for nm in fr.names:
+            if nm == self.col and self.inplace:
+                out.add(name, Column(out_data, T_NUM, c.nrows))
+            else:
+                out.add(nm, fr.col(nm))
+        if not self.inplace:
+            out.add(name, Column(out_data, T_NUM, c.nrows))
+        return out
+
+
+class H2OScaler:
+    """Standardize numeric columns with TRAINING means/sds (h2o-py
+    H2OScaler): statistics fit once, reused at apply time."""
+
+    def __init__(self, center: bool = True, scale: bool = True):
+        self.center = bool(center)
+        self.scale = bool(scale)
+        self.means: Dict[str, float] = {}
+        self.sds: Dict[str, float] = {}
+
+    def fit_transform(self, fr: Frame) -> Frame:
+        for nm in fr.names:
+            c = fr.col(nm)
+            if c.is_numeric:
+                vals = c.to_numpy()
+                self.means[nm] = float(np.nanmean(vals))
+                sd = float(np.nanstd(vals))
+                self.sds[nm] = sd if sd > 0 else 1.0
+        return self.transform(fr)
+
+    def transform(self, fr: Frame) -> Frame:
+        out = Frame()
+        for nm in fr.names:
+            c = fr.col(nm)
+            if nm in self.means:
+                d = c.data
+                if self.center:
+                    d = d - self.means[nm]
+                if self.scale:
+                    d = d / self.sds[nm]
+                out.add(nm, Column(d, T_NUM, c.nrows))
+            else:
+                out.add(nm, c)
+        return out
+
+
+class H2OBinaryOp:
+    """colA <op> colB -> new column (h2o-py H2OBinaryOp)."""
+
+    _OPS = {"+": "add", "-": "subtract", "*": "multiply", "/": "divide"}
+
+    def __init__(self, op: str, left: str, right: str,
+                 new_col_name: Optional[str] = None):
+        if op not in self._OPS:
+            raise ValueError(f"H2OBinaryOp: op must be one of {list(self._OPS)}")
+        self.op = op
+        self.left = left
+        self.right = right
+        self.new_col_name = new_col_name or f"{left}{op}{right}"
+
+    def fit_transform(self, fr: Frame) -> Frame:
+        return self.transform(fr)
+
+    def transform(self, fr: Frame) -> Frame:
+        import jax.numpy as jnp
+
+        a, b = fr.col(self.left).data, fr.col(self.right).data
+        v = getattr(jnp, self._OPS[self.op])(a, b)
+        out = Frame()
+        for nm in fr.names:
+            out.add(nm, fr.col(nm))
+        out.add(self.new_col_name, Column(v, T_NUM, fr.nrows))
+        return out
+
+
+class H2OAssembly:
+    """Ordered named steps; fit() runs fit_transform through the chain,
+    transform() replays with frozen statistics (water/rapids/Assembly.java
+    fit + the munging-artifact replay)."""
+
+    _SAVE_MAGIC = b"H2O3TPUA"
+    _SAVE_VERSION = 1
+
+    def __init__(self, steps: Sequence[Tuple[str, Any]]):
+        self.steps = list(steps)
+        self.fitted = False
+
+    def fit(self, frame: Frame) -> Frame:
+        out = frame
+        for _name, step in self.steps:
+            out = step.fit_transform(out)
+        self.fitted = True
+        return out
+
+    def transform(self, frame: Frame) -> Frame:
+        if not self.fitted:
+            raise RuntimeError("assembly not fitted — call fit() first")
+        out = frame
+        for _name, step in self.steps:
+            out = step.transform(out)
+        return out
+
+    @property
+    def names(self) -> List[str]:
+        return [n for n, _s in self.steps]
+
+    # -- persistence (the munging-POJO analog: a replayable artifact) -----
+    def save(self, path: str) -> str:
+        import pickle
+        import struct
+
+        with open(path, "wb") as f:
+            f.write(self._SAVE_MAGIC)
+            f.write(struct.pack("<H", self._SAVE_VERSION))
+            pickle.dump(self, f)
+        return path
+
+    @staticmethod
+    def load(path: str) -> "H2OAssembly":
+        import pickle
+        import struct
+
+        with open(path, "rb") as f:
+            if f.read(8) != H2OAssembly._SAVE_MAGIC:
+                raise ValueError(f"{path!r} is not an assembly artifact")
+            (ver,) = struct.unpack("<H", f.read(2))
+            if ver > H2OAssembly._SAVE_VERSION:
+                raise ValueError(f"assembly artifact version {ver} too new")
+            return pickle.load(f)

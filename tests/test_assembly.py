@@ -1,0 +1,109 @@
+"""H2OAssembly munging pipelines (water/rapids/Assembly.java + h2o-py
+h2o/assembly.py): fit/transform chains with frozen statistics and a
+replayable artifact."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.assembly import (H2OAssembly, H2OBinaryOp, H2OColOp,
+                               H2OColSelect, H2OScaler)
+from h2o3_tpu.core.frame import Column, Frame
+
+
+@pytest.fixture()
+def fr(cl):
+    rng = np.random.default_rng(0)
+    f = Frame()
+    f.add("a", Column.from_numpy(rng.uniform(1, 10, 500)))
+    f.add("b", Column.from_numpy(rng.standard_normal(500) * 5 + 20))
+    f.add("junk", Column.from_numpy(rng.standard_normal(500)))
+    return f
+
+
+class TestAssembly:
+    def test_fit_transform_chain(self, fr):
+        asm = H2OAssembly(steps=[
+            ("select", H2OColSelect(["a", "b"])),
+            ("log_a", H2OColOp("log", col="a", inplace=True)),
+            ("scale", H2OScaler()),
+            ("sum", H2OBinaryOp("+", "a", "b", new_col_name="ab")),
+        ])
+        out = asm.fit(fr)
+        assert out.names == ["a", "b", "ab"]
+        a = out.col("a").to_numpy()
+        assert abs(a.mean()) < 1e-5 and abs(a.std() - 1) < 1e-4
+        np.testing.assert_allclose(
+            out.col("ab").to_numpy(),
+            a + out.col("b").to_numpy(), atol=1e-5)
+
+    def test_frozen_statistics_on_new_frame(self, fr, cl):
+        """Scaler must reuse TRAINING stats at apply time."""
+        asm = H2OAssembly(steps=[("scale", H2OScaler())])
+        asm.fit(fr)
+        shifted = Frame()
+        for nm in fr.names:
+            shifted.add(nm, Column.from_numpy(
+                fr.col(nm).to_numpy() + 100.0))
+        out = asm.transform(shifted)
+        # +100 input shift survives (stats frozen, not refit)
+        scaler = asm.steps[0][1]
+        assert out.col("a").to_numpy().mean() == pytest.approx(
+            100.0 / scaler.sds["a"], rel=1e-3)
+
+    def test_transform_before_fit_raises(self, fr):
+        with pytest.raises(RuntimeError, match="not fitted"):
+            H2OAssembly(steps=[("s", H2OScaler())]).transform(fr)
+
+    def test_artifact_roundtrip(self, fr, tmp_path):
+        asm = H2OAssembly(steps=[
+            ("select", H2OColSelect(["a"])),
+            ("sqrt", H2OColOp("sqrt", col="a")),
+        ])
+        expect = asm.fit(fr).col("a").to_numpy()
+        p = str(tmp_path / "asm.bin")
+        asm.save(p)
+        re = H2OAssembly.load(p)
+        np.testing.assert_allclose(re.transform(fr).col("a").to_numpy(),
+                                   expect, atol=1e-6)
+        bad = tmp_path / "bad.bin"
+        bad.write_bytes(b"nope")
+        with pytest.raises(ValueError, match="not an assembly"):
+            H2OAssembly.load(str(bad))
+
+    def test_colop_new_column(self, fr):
+        asm = H2OAssembly(steps=[
+            ("cos", H2OColOp("cos", col="a", inplace=False,
+                             new_col_name="cos_a")),
+        ])
+        out = asm.fit(fr)
+        assert "cos_a" in out.names and "a" in out.names
+        np.testing.assert_allclose(out.col("cos_a").to_numpy(),
+                                   np.cos(fr.col("a").to_numpy()), atol=1e-5)
+
+    def test_top_level_import(self, fr):
+        import h2o3_tpu as h2o
+
+        asm = h2o.H2OAssembly(steps=[("sel", H2OColSelect(["b"]))])
+        assert asm.fit(fr).names == ["b"]
+
+
+def test_callable_op_pickles_and_names_stably(cl, tmp_path):
+    """jnp.cos (a non-picklable ufunc object) normalizes to its name at
+    construction, so artifacts save and derived names are stable."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(1)
+    fr2 = Frame()
+    fr2.add("a", Column.from_numpy(rng.uniform(0, 3, 100)))
+    asm = H2OAssembly(steps=[
+        ("cos", H2OColOp(jnp.cos, col="a", inplace=False)),
+    ])
+    out = asm.fit(fr2)
+    assert "cos_a" in out.names           # name from __name__, not repr
+    p = str(tmp_path / "c.bin")
+    asm.save(p)                           # must not raise PicklingError
+    re = H2OAssembly.load(p)
+    np.testing.assert_allclose(re.transform(fr2).col("cos_a").to_numpy(),
+                               np.cos(fr2.col("a").to_numpy()), atol=1e-5)
+    with pytest.raises(ValueError, match="unknown op"):
+        H2OColOp(lambda x: x, col="a")
