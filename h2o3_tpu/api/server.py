@@ -573,6 +573,26 @@ def _extract_train_params(cls, body: Dict[str, Any]):
     return params, ignored
 
 
+def _pop_train_args(params: Dict[str, Any]):
+    """Shared extraction of the frame/response/ignored args from a coerced
+    param dict (used by the ModelBuilders and Grid build handlers — one
+    place for the 404/412 shapes)."""
+    train_key = str(params.pop("training_frame", "") or "").strip('"')
+    valid_key = str(params.pop("validation_frame", "") or "").strip('"')
+    y = str(params.pop("response_column", "") or "").strip('"') or None
+    x_ignored = params.pop("ignored_columns", None)
+    if not train_key:
+        raise ApiError("training_frame required", 412, "H2OModelBuilderErrorV3")
+    train = DKV.get(train_key)
+    if not isinstance(train, Frame):
+        raise ApiError(f"Object '{train_key}' not found for argument: "
+                       "training_frame", 404, "H2OModelBuilderErrorV3")
+    valid = DKV.get(valid_key) if valid_key else None
+    if x_ignored:
+        x_ignored = [str(c).strip('"') for c in x_ignored]
+    return train, valid, y, x_ignored
+
+
 def h_modelbuilder_train(ctx: Ctx):
     algo = ctx.params["algo"].lower()
     cls = _builders().get(algo)
@@ -580,23 +600,13 @@ def h_modelbuilder_train(ctx: Ctx):
         raise ApiError(f"unknown algo {algo!r}", 404)
     body = dict(ctx.body)
     params, _ignored = _extract_train_params(cls, body)
-    train_key = str(params.pop("training_frame", "") or "").strip('"')
-    valid_key = str(params.pop("validation_frame", "") or "").strip('"')
-    y = str(params.pop("response_column", "") or "").strip('"') or None
     model_id = str(params.pop("model_id", "") or "").strip('"') or None
-    x_ignored = params.pop("ignored_columns", None)
-    if not train_key:
-        raise ApiError("training_frame required", 412, "H2OModelBuilderErrorV3")
-    train = DKV.get(train_key)
-    if not isinstance(train, Frame):
-        raise ApiError(f"Object '{train_key}' not found for argument: training_frame",
-                       404, "H2OModelBuilderErrorV3")
-    valid = DKV.get(valid_key) if valid_key else None
+    train, valid, y, x_ignored = _pop_train_args(params)
 
     try:
         builder = cls(**params)
         if x_ignored:
-            builder.params["ignored_columns"] = [str(c).strip('"') for c in x_ignored]
+            builder.params["ignored_columns"] = x_ignored
         if model_id:
             builder.params["model_id"] = model_id
     except ValueError as e:
@@ -616,7 +626,7 @@ def h_modelbuilder_train(ctx: Ctx):
             DKV.remove(old)
             model._key = Key(dest)
         DKV.put(dest, model)
-        model._parms.setdefault("training_frame", train_key)
+        model._parms.setdefault("training_frame", str(train.key))
         return model
 
     job.start(run, background=True)
@@ -715,6 +725,76 @@ def h_predict_v4(ctx: Ctx):
 
     job.start(run, background=True)
     return {"__meta": S.meta("JobV4"), "job": S.job_v3(job)}
+
+
+def h_grid_build(ctx: Ctx):
+    """POST /99/Grid/{algo} — hyperparameter search job (water/api
+    GridSearchHandler; genuine h2o-py H2OGridSearch.train rides this)."""
+    algo = ctx.params["algo"].lower()
+    cls = _builders().get(algo)
+    if cls is None:
+        raise ApiError(f"unknown algo {algo!r}", 404)
+    body = dict(ctx.body)
+    hp_raw = body.pop("hyper_parameters", None)
+    if not hp_raw:
+        raise ApiError("hyper_parameters required", 412)
+    hyper = hp_raw if isinstance(hp_raw, dict) else json.loads(str(hp_raw))
+    defaults = cls.default_params()
+    hyper = {("lambda_" if k == "lambda" else cls.translate_param(k)):
+             list(v) for k, v in hyper.items()}
+    unknown = [k for k in hyper if k not in defaults]
+    if unknown:
+        raise ApiError(f"unknown hyper parameters {unknown}", 412)
+    sc_raw = body.pop("search_criteria", None)
+    criteria = (sc_raw if isinstance(sc_raw, dict)
+                else json.loads(str(sc_raw)) if sc_raw else None)
+    grid_id = str(body.pop("grid_id", "") or "").strip('"') or \
+        f"Grid_{algo.upper()}_{uuid.uuid4().hex[:10]}"
+    params, _ignored = _extract_train_params(cls, body)
+    train, valid, y, x_ignored = _pop_train_args(params)
+    if x_ignored:
+        params["ignored_columns"] = x_ignored
+
+    from h2o3_tpu.grid import H2OGridSearch
+
+    job = Job(description=f"{algo} Grid Build", dest=grid_id)
+    job.dest_type = "Key<Grid>"
+
+    def run(j: Job):
+        base = cls(**params)
+        grid = H2OGridSearch(base, hyper, grid_id=grid_id,
+                             search_criteria=criteria)
+        grid.train(y=y, training_frame=train, validation_frame=valid)
+        return grid
+
+    job.start(run, background=True)
+    return {"__meta": S.meta("GridSearchV99"), "job": S.job_v3(job)}
+
+
+def h_grid_get(ctx: Ctx):
+    """GET /99/Grids/{grid_id} — the GridSchemaV99 fields h2o-py reads:
+    model_ids (rank-ordered when sort_by given), hyper_names, failure
+    lists, summary_table."""
+    grid = DKV.get(ctx.params["grid_id"])
+    from h2o3_tpu.grid import H2OGridSearch
+
+    if not isinstance(grid, H2OGridSearch):
+        raise ApiError(f"grid {ctx.params['grid_id']!r} not found", 404)
+    sort_by = str(ctx.arg("sort_by", "") or "").strip('"') or None
+    dec_raw = ctx.arg("decreasing")
+    decreasing = None if dec_raw is None else \
+        str(dec_raw).lower() in ("1", "true")
+    g = grid.get_grid(sort_by=sort_by, decreasing=decreasing) \
+        if grid.models else grid
+    return {"__meta": S.meta("GridSchemaV99"),
+            "grid_id": S.key_ref(str(grid.key), "Key<Grid>"),
+            "model_ids": [{"name": str(m.key)} for m in g.models],
+            "hyper_names": list(grid.hyper_params),
+            "failure_details": [f["error"] for f in grid.failed],
+            "failed_params": [f["params"] for f in grid.failed],
+            "failure_stack_traces": [f["error"] for f in grid.failed],
+            "export_checkpoints_dir": None,
+            "summary_table": None}
 
 
 def h_import_sql(ctx: Ctx):
@@ -1007,6 +1087,9 @@ ROUTES: List[Tuple[str, str, Callable, str]] = [
      "Score a frame (async job)"),
     ("POST", "/3/ModelMetrics/models/{model_id}/frames/{frame_id}", h_model_metrics,
      "Compute model metrics on a frame"),
+    ("POST", "/99/Grid/{algo}", h_grid_build, "Hyperparameter grid search"),
+    ("GET", "/99/Models/{model_id}", h_model_get, "Model details (v99 alias)"),
+    ("GET", "/99/Grids/{grid_id}", h_grid_get, "Grid results"),
     ("POST", "/99/ImportSQLTable", h_import_sql, "Import a SQL table/query"),
     ("GET", "/3/NetworkTest", h_network_test, "Mesh compute/BW/latency probes"),
     ("POST", "/3/CreateFrame", h_create_frame, "Generate a synthetic frame"),
@@ -1171,6 +1254,11 @@ class _Handler(BaseHTTPRequestHandler):
         status = 200
         u = urlparse(self.path)
         try:
+            # the body must ALWAYS be drained FIRST — before auth/route
+            # early returns: h2o-py sends form bodies on GET too (e.g. GET
+            # /99/Grids with sort_by), and any unread body bytes desync the
+            # keep-alive stream so the NEXT request on the connection hangs
+            body = self._read_body()
             if not self._authorized():
                 status = 401
                 return self._send(401, b'{"error":"unauthorized"}',
@@ -1181,7 +1269,6 @@ class _Handler(BaseHTTPRequestHandler):
                 status = 404
                 return self._reply_error(f"unknown route {self.command} {u.path}", 404)
             query = {k: v[0] for k, v in parse_qs(u.query, keep_blank_values=True).items()}
-            body = self._read_body() if self.command in ("POST", "PUT", "DELETE") else {}
             ctx = Ctx(params, query, body, self.server_ref)
             out = handler(ctx)
             if isinstance(out, RawReply):

@@ -148,3 +148,20 @@ def test_predict_contributions_via_h2opy(h2o, air):
     assert "BiasTerm" in contribs.names
     df = contribs.as_data_frame()
     assert np.isfinite(df.to_numpy(dtype=float)).all()
+
+
+def test_grid_search_via_h2opy(h2o, air):
+    """Genuine h2o-py H2OGridSearch: POST /99/Grid/{algo} -> job poll ->
+    GET /99/Grids/{id} -> ranked models (grid/grid_search.py:383-420)."""
+    from h2o.estimators import H2OGradientBoostingEstimator
+    from h2o.grid.grid_search import H2OGridSearch
+
+    gs = H2OGridSearch(
+        H2OGradientBoostingEstimator(seed=7),
+        hyper_params={"max_depth": [2, 4], "ntrees": [3, 5]})
+    gs.train(y="IsDepDelayed", training_frame=air)
+    assert len(gs.model_ids) == 4
+    best = gs.get_grid(sort_by="auc", decreasing=True)
+    aucs = [m.auc() for m in best.models]
+    assert aucs == sorted(aucs, reverse=True)
+    assert aucs[0] > 0.55
