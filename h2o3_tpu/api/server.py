@@ -727,6 +727,117 @@ def h_predict_v4(ctx: Ctx):
     return {"__meta": S.meta("JobV4"), "job": S.job_v3(job)}
 
 
+def _automl_tables(aml):
+    """Leaderboard + event-log TwoDimTables in the shapes the genuine
+    h2o-py AutoML client parses (autoh2o.py _fetch_state/_fetch_table:
+    a leading index column the client strips with lb[1:], and an event log
+    carrying name/value columns for _training_info)."""
+    from h2o3_tpu.automl.automl import _leaderboard_metric
+    from h2o3_tpu.utils.twodim import TwoDimTable
+
+    metric = aml._metric_name
+    lb = TwoDimTable("Leaderboard", ["", "model_id", metric],
+                     ["string", "string", "double"])
+    cache = getattr(aml, "_lb_cache", {})
+    lbf = getattr(aml, "_leaderboard_frame", None)
+    ranked = aml._ranked()
+    for i, m in enumerate(ranked):
+        # model_id must be the fetchable DKV key (h2o.get_model uses it)
+        lb.add_row(str(i), str(m.key),
+                   float(_leaderboard_metric(m, metric, lbf, cache)))
+    el = TwoDimTable("Event Log",
+                     ["", "timestamp", "level", "stage", "message",
+                      "name", "value"],
+                     ["string", "string", "string", "string", "string",
+                      "string", "string"])
+    for i, ev in enumerate(aml.event_log):
+        # "Info" capitalization matters: the client filters levels against
+        # ['Debug','Info','Warn'] (EventLogEntry.Level spellings)
+        el.add_row(str(i), str(ev.get("timestamp", "")), "Info", "run",
+                   str(ev.get("message", "")), "", "")
+    el.add_row(str(len(aml.event_log)), "", "Info", "run", "",
+               "project_name", aml.project_name)
+    return lb, el, ranked
+
+
+def h_automl_build(ctx: Ctx):
+    """POST /99/AutoMLBuilder (ai.h2o.automl AutoMLBuildSpec; genuine
+    h2o-py H2OAutoML.train posts build_control/build_models/input_spec)."""
+    spec = ctx.body or {}
+    input_spec = spec.get("input_spec") or {}
+    build_control = spec.get("build_control") or {}
+    build_models = spec.get("build_models") or {}
+    sc = build_control.get("stopping_criteria") or {}
+    train = _frame_or_404(str(input_spec.get("training_frame", "")))
+    y = str(input_spec.get("response_column", "") or "")
+    if not y:
+        raise ApiError("response_column required", 412)
+    valid_key = input_spec.get("validation_frame")
+    lb_key = input_spec.get("leaderboard_frame")
+    project = str(build_control.get("project_name", "") or "") or \
+        f"AutoML_{uuid.uuid4().hex[:8]}"
+
+    from h2o3_tpu.automl.automl import H2OAutoML
+
+    nf = build_control.get("nfolds")
+    mm = sc.get("max_models")
+    aml = H2OAutoML(
+        # explicit 0 is meaningful for both (no CV / no model cap) — only
+        # ABSENT values take the defaults
+        max_models=int(mm) if mm is not None else 10,
+        max_runtime_secs=float(sc.get("max_runtime_secs") or 0.0),
+        seed=int(sc.get("seed", -1) if sc.get("seed") is not None else -1),
+        nfolds=int(nf) if nf is not None else 5,
+        sort_metric=str(input_spec.get("sort_metric") or "AUTO"),
+        include_algos=build_models.get("include_algos"),
+        exclude_algos=build_models.get("exclude_algos"),
+        project_name=project)
+    ignored = set(input_spec.get("ignored_columns") or [])
+    x = [c for c in train.names if c != y and c not in ignored] or None
+    job = Job(description="AutoML", dest=project)
+    job.dest_type = "Key<AutoML>"
+    job.dest_key = project
+
+    def run(j: Job):
+        # Job.start installs the result under job.dest (= project) itself
+        aml.train(x=x, y=y, training_frame=train,
+                  validation_frame=DKV.get(str(valid_key)) if valid_key else None,
+                  leaderboard_frame=DKV.get(str(lb_key)) if lb_key else None)
+        return aml
+
+    job.start(run, background=True)
+    return {"__meta": S.meta("AutoMLBuilderV99"), "job": S.job_v3(job),
+            "build_control": {"project_name": project}}
+
+
+def h_automl_get(ctx: Ctx):
+    """GET /99/AutoML/{aml_id} — the AutoMLV99 state json h2o-py reads."""
+    from h2o3_tpu.automl.automl import H2OAutoML
+
+    aml = DKV.get(ctx.params["aml_id"])
+    if not isinstance(aml, H2OAutoML):
+        raise ApiError(f"AutoML {ctx.params['aml_id']!r} not found", 404)
+    lb, el, ranked = _automl_tables(aml)
+    return {"__meta": S.meta("AutoMLV99"),
+            "project_name": aml.project_name,
+            "leaderboard": {"models": [{"name": str(m.key)} for m in ranked]},
+            "leaderboard_table": lb.to_v3(),
+            "event_log_table": el.to_v3()}
+
+
+def h_leaderboard_get(ctx: Ctx):
+    """GET /99/Leaderboards/{aml_id} (h2o.automl.get_leaderboard)."""
+    from h2o3_tpu.automl.automl import H2OAutoML
+
+    aml = DKV.get(ctx.params["aml_id"])
+    if not isinstance(aml, H2OAutoML):
+        raise ApiError(f"AutoML {ctx.params['aml_id']!r} not found", 404)
+    lb, _el, _ranked = _automl_tables(aml)
+    return {"__meta": S.meta("LeaderboardV99"),
+            "project_name": aml.project_name,
+            "table": lb.to_v3()}
+
+
 def h_grid_build(ctx: Ctx):
     """POST /99/Grid/{algo} — hyperparameter search job (water/api
     GridSearchHandler; genuine h2o-py H2OGridSearch.train rides this)."""
@@ -1087,6 +1198,9 @@ ROUTES: List[Tuple[str, str, Callable, str]] = [
      "Score a frame (async job)"),
     ("POST", "/3/ModelMetrics/models/{model_id}/frames/{frame_id}", h_model_metrics,
      "Compute model metrics on a frame"),
+    ("POST", "/99/AutoMLBuilder", h_automl_build, "Run AutoML"),
+    ("GET", "/99/AutoML/{aml_id}", h_automl_get, "AutoML state"),
+    ("GET", "/99/Leaderboards/{aml_id}", h_leaderboard_get, "AutoML leaderboard"),
     ("POST", "/99/Grid/{algo}", h_grid_build, "Hyperparameter grid search"),
     ("GET", "/99/Models/{model_id}", h_model_get, "Model details (v99 alias)"),
     ("GET", "/99/Grids/{grid_id}", h_grid_get, "Grid results"),

@@ -67,7 +67,11 @@ class H2OAutoML:
         # model draws identical CV fold assignments — the StackedEnsemble
         # level-one frame requires it (ensemble.py fold-digest check)
         self.seed = int(seed) if int(seed) >= 0 else random_seed()
-        self.nfolds = max(int(nfolds), 2)
+        # nfolds=0 disables cross-validation (reference allows it when a
+        # leaderboard/blending frame provides the ranking metric); negative
+        # = AUTO = 5
+        nf = int(nfolds)
+        self.nfolds = 0 if nf == 0 else (nf if nf >= 2 else 5)
         self.sort_metric = sort_metric
         self.include_algos = [a.lower() for a in include_algos] if include_algos else None
         self.exclude_algos = [a.lower() for a in (exclude_algos or [])]
@@ -228,9 +232,10 @@ class H2OAutoML:
             cls = BUILDERS.get(algo)
             if cls is None:
                 continue
-            params.update(nfolds=self.nfolds,
-                          keep_cross_validation_predictions=True,
-                          seed=self.seed)
+            params.update(seed=self.seed)
+            if self.nfolds:
+                params.update(nfolds=self.nfolds,
+                              keep_cross_validation_predictions=True)
             if getattr(self, "_te_fold_col", None):
                 params.update(fold_column=self._te_fold_col)
             try:

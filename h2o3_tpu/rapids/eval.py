@@ -679,8 +679,45 @@ def _strlen(env, fr):
 
 # -- arithmetic / comparison / logic ----------------------------------------
 
+def _str_cmp(col: Column, s: str, op: str) -> Column:
+    """(col == 'label') / (col != 'label') for enum/string columns — NA
+    compares as NA (AstBinOp string semantics: NA rows drop out of row
+    filters), enum compares by code against the interned domain."""
+    if col.is_categorical:
+        dom = col.domain or []
+        idx = dom.index(s) if s in dom else -2       # -2: matches nothing
+        codes = col.to_numpy()
+        eq = (codes == idx).astype(np.float64)
+        if op == "!=":
+            eq = 1.0 - eq
+        eq[codes < 0] = np.nan
+        return Column.from_numpy(eq)
+    if col.is_string:
+        vals = np.array([np.nan if v is None
+                         else float((v == s) if op == "==" else (v != s))
+                         for v in col.host_data], np.float64)
+        return Column.from_numpy(vals)
+    # numeric column vs string: numeric compare when the string parses,
+    # else nothing matches (== -> 0 / != -> 1, NA stays NA)
+    vals = col.to_numpy()
+    try:
+        f = float(s)
+        eq = (vals == f).astype(np.float64)
+    except ValueError:
+        eq = np.zeros(len(vals), np.float64)
+    if op == "!=":
+        eq = 1.0 - eq
+    eq[~np.isfinite(vals)] = np.nan
+    return Column.from_numpy(eq)
+
+
 def _binprim(op):
     def impl(env, l, r):
+        sl = l.s if isinstance(l, StrLit) else (l if isinstance(l, str) else None)
+        sr = r.s if isinstance(r, StrLit) else (r if isinstance(r, str) else None)
+        if op in ("==", "!=") and (sl is not None) != (sr is not None):
+            col = _one_col(r if sl is not None else l)
+            return _colfr(_str_cmp(col, sl if sl is not None else sr, op), op)
         lv = _one_col(l) if _is_fr(l) else l
         rv = _one_col(r) if _is_fr(r) else r
         if isinstance(lv, Column) or isinstance(rv, Column):

@@ -43,6 +43,21 @@ class TwoDimTable:
                      for i in range(len(self.col_names))],
         }
 
+    def to_v3(self) -> dict:
+        """The exact water/api/schemas3/TwoDimTableV3 wire shape genuine
+        h2o-py parses (H2OTwoDimTable.make reads name/description/columns
+        [name,type,format]/data) — to_dict extended with __meta/rowcount/
+        per-column format."""
+        fmt = {"int": "%d", "long": "%d", "double": "%f", "float": "%f"}
+        d = self.to_dict()
+        d["__meta"] = {"schema_version": 3, "schema_name": "TwoDimTableV3",
+                       "schema_type": "TwoDimTable"}
+        d["rowcount"] = len(self.rows)
+        for c in d["columns"]:
+            c["format"] = fmt.get(c["type"], "%s")
+            c["description"] = c["name"]
+        return d
+
     def as_data_frame(self):
         import pandas as pd
 

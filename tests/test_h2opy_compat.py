@@ -165,3 +165,19 @@ def test_grid_search_via_h2opy(h2o, air):
     aucs = [m.auc() for m in best.models]
     assert aucs == sorted(aucs, reverse=True)
     assert aucs[0] > 0.55
+
+
+def test_automl_via_h2opy(h2o, air):
+    """Genuine h2o-py H2OAutoML: POST /99/AutoMLBuilder -> job poll ->
+    GET /99/AutoML/{id} state (leaderboard/event-log TwoDimTables) ->
+    leader predict (autoh2o.py:471-525)."""
+    from h2o.automl import H2OAutoML
+
+    aml = H2OAutoML(max_models=2, seed=5, nfolds=2,
+                    include_algos=["GBM"], verbosity=None)
+    aml.train(y="IsDepDelayed", training_frame=air)
+    assert aml.leader is not None
+    lb = aml.leaderboard
+    assert lb.nrows >= 2 and "model_id" in lb.names
+    preds = aml.predict(air)
+    assert preds.nrows == air.nrows
