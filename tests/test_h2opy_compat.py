@@ -181,3 +181,24 @@ def test_automl_via_h2opy(h2o, air):
     assert lb.nrows >= 2 and "model_id" in lb.names
     preds = aml.predict(air)
     assert preds.nrows == air.nrows
+
+
+def test_varimp_and_mojo_download_via_h2opy(h2o, air, tmp_path):
+    """Genuine h2o-py varimp table parse + MOJO artifact download
+    (model_base.py:525 varimp, :969 download_mojo save_to)."""
+    import os
+    import zipfile
+
+    from h2o.estimators import H2OGradientBoostingEstimator
+
+    m = H2OGradientBoostingEstimator(ntrees=4, max_depth=3, seed=2)
+    m.train(y="IsDepDelayed", training_frame=air)
+    vi = m.varimp()
+    assert vi and len(vi[0]) == 4            # (variable, rel, scaled, pct)
+    names = [row[0] for row in vi]
+    assert set(names) <= {"DayOfWeek", "Carrier", "Distance", "DepTime"}
+    assert abs(sum(row[3] for row in vi) - 1.0) < 1e-6   # percentages
+    path = m.download_mojo(path=str(tmp_path))
+    assert os.path.exists(path)
+    with zipfile.ZipFile(path) as z:
+        assert "model.ini" in z.namelist()
