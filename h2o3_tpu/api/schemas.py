@@ -329,11 +329,14 @@ def metrics_v3(mm, model_key: Optional[str] = None,
         return None
     if isinstance(mm, M.ModelMetricsBinomial):
         out = _metrics_common(mm, "ModelMetricsBinomial", model_key, frame_key)
+        gl = getattr(mm, "gains_lift_table", None)
         out.update({"r2": None, "logloss": mm.logloss, "AUC": mm.auc,
                     "pr_auc": mm.pr_auc, "Gini": mm.gini,
                     "mean_per_class_error": mm.mean_per_class_error,
                     "domain": (mm.cm.domain if mm.cm else None),
-                    "gains_lift_table": None})
+                    # genuine h2o-py metrics_base.gains_lift reads this as a
+                    # TwoDimTableV3
+                    "gains_lift_table": gl.to_v3() if gl is not None else None})
         if mm.auc_data is not None:
             tt, mt = _binomial_threshold_tables(mm.auc_data)
             out["thresholds_and_metric_scores"] = tt

@@ -202,3 +202,19 @@ def test_varimp_and_mojo_download_via_h2opy(h2o, air, tmp_path):
     assert os.path.exists(path)
     with zipfile.ZipFile(path) as z:
         assert "model.ini" in z.namelist()
+
+
+def test_gains_lift_via_h2opy(h2o, air):
+    """Genuine h2o-py gains/lift table (metrics_base.py:1724)."""
+    from h2o.estimators import H2OGradientBoostingEstimator
+
+    m = H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=3)
+    m.train(y="IsDepDelayed", training_frame=air)
+    gl = m.model_performance().gains_lift()
+    assert gl is not None
+    rows = gl.cell_values
+    assert rows
+    hdr = gl.col_header
+    assert "lift" in hdr and "cumulative_capture_rate" in hdr
+    ccr = [r[hdr.index("cumulative_capture_rate")] for r in rows]
+    assert abs(float(ccr[-1]) - 1.0) < 1e-6
