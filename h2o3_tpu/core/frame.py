@@ -81,14 +81,15 @@ class Column:
     None — TPUs never touch strings (SURVEY.md §7).
     """
 
-    __slots__ = ("_data", "_evicted", "_touch", "ctype", "domain",
+    __slots__ = ("_data", "_evicted", "_loader", "_touch", "ctype", "domain",
                  "host_data", "nrows", "_rollups", "_chunks")
 
     def __init__(self, data, ctype: str, nrows: int,
                  domain: Optional[List[str]] = None,
                  host_data: Optional[np.ndarray] = None):
         self._data = data
-        self._evicted = None       # host copy while swapped out of HBM
+        self._evicted = None       # host copy (or loader) while out of HBM
+        self._loader = None        # file-backed source (FileVec analog)
         self._touch = 0            # LRU clock (core/cleaner.py)
         self.ctype = ctype
         self.domain = domain
@@ -104,11 +105,17 @@ class Column:
 
         d = self._data
         if d is None and self._evicted is not None:
-            # fault-in under the swap lock (a background Cleaner sweep may
-            # race); concurrent readers fault in once
+            # `_evicted` is either a host buffer (Cleaner swap-out) or a
+            # CALLABLE loader (file-backed Vec, water/fvec/FileVec.java
+            # analog). The possibly-slow load/decode runs OUTSIDE the swap
+            # lock so concurrent fault-ins of other columns don't serialize
+            # behind a disk read; the lock guards only the install, and a
+            # racing loser simply discards its buffer.
+            src = self._evicted
+            buf = src() if callable(src) else src
             with cleaner.SWAP_LOCK:
                 if self._data is None and self._evicted is not None:
-                    self._data = _cluster().put_rows(self._evicted)
+                    self._data = _cluster().put_rows(buf)
                     self._evicted = None
                 d = self._data
         self._touch = cleaner.tick()
@@ -116,6 +123,16 @@ class Column:
         # landing between the check and the return: the caller's reference
         # pins the device buffer it already obtained
         return d
+
+    @staticmethod
+    def file_backed(loader, ctype: str, nrows: int,
+                    domain: Optional[List[str]] = None) -> "Column":
+        """A column whose device buffer materializes lazily from `loader()`
+        (must return the PADDED host buffer) on first data access."""
+        c = Column(None, ctype, nrows, domain=domain)
+        c._evicted = loader
+        c._loader = loader      # evictions revert to the source
+        return c
 
     @data.setter
     def data(self, v):
@@ -133,7 +150,10 @@ class Column:
                     not getattr(self._data, "is_fully_addressable", True):
                 return 0
             freed = int(self._data.nbytes)
-            self._evicted = np.asarray(self._data)
+            # file-backed columns revert to their DISK source — eviction
+            # must free host RAM too, not pin a padded copy of the file
+            self._evicted = (self._loader if self._loader is not None
+                             else np.asarray(self._data))
             self._data = None
             return freed
 

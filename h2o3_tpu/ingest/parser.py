@@ -222,3 +222,53 @@ def import_file(path: str, destination_frame: Optional[str] = None,
 
 
 upload_file = import_file  # same machinery in-process
+
+
+def lazy_import_parquet(path: str,
+                        destination_frame: Optional[str] = None) -> H2OFrame:
+    """File-backed Frame over a Parquet file (water/fvec/FileVec.java
+    analog): numeric/time columns stay ON DISK until first touched — open a
+    frame wider than HBM, column-prune, and only the touched columns
+    materialize (through the normal padded-shard path). Categorical/string
+    columns load eagerly (their domains are frame metadata)."""
+    from h2o3_tpu import persist
+    from h2o3_tpu.core.runtime import cluster
+    from h2o3_tpu.ingest import formats
+
+    local = persist.resolve(path)
+    import pyarrow.parquet as pq
+
+    pf = pq.ParquetFile(local)
+    n = pf.metadata.num_rows
+    names = [f.name for f in pf.schema_arrow]
+    types = [formats._arrow_field_type(f.type) for f in pf.schema_arrow]
+    padded = cluster().pad_rows(n)
+    fr = H2OFrame(destination_frame=destination_frame)
+    # categorical/string columns load eagerly in ONE column-pruned read
+    eager = [nm for nm, t in zip(names, types) if t in (T_CAT, T_STR)]
+    eager_cols = {}
+    if eager:
+        tbl = pq.read_table(local, columns=eager)
+        eager_cols, _types = formats.arrow_to_host_cols(tbl)
+    for name, t in zip(names, types):
+        if t in (T_CAT, T_STR):
+            fr.add(name, Column.from_numpy(
+                eager_cols[name], ctype=t if t == T_CAT else None))
+            continue
+
+        def loader(col=name, ct=t):
+            tbl = pq.read_table(local, columns=[col])
+            arr, _types = formats.arrow_to_host_cols(tbl)
+            # same padded-buffer dtype rules as Column.from_numpy: T_NUM
+            # honors the cluster's bf16 opt-in, T_TIME stays f32
+            from h2o3_tpu.core.frame import _numeric_dtype
+
+            dt = _numeric_dtype() if ct == T_NUM else np.dtype(np.float32)
+            buf = np.full(padded, np.nan, dt)
+            buf[:n] = np.asarray(arr[col], np.float64).astype(dt)
+            return buf
+
+        fr.add(name, Column.file_backed(loader, t, n))
+    log.info(f"lazy-opened parquet {n}x{len(names)} [{fr.frame_id}] "
+             f"(numeric columns load on first touch)")
+    return fr
