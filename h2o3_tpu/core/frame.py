@@ -52,6 +52,16 @@ def _code_dtype(n_levels: int):
     return np.int32
 
 
+def pad_numeric_host(arr, n: int, padded: int, ctype: str) -> np.ndarray:
+    """The one place deciding numeric padded-buffer dtype rules (shared by
+    Column.from_numpy and file-backed loaders): T_NUM honors the cluster's
+    bf16 opt-in; T_TIME/T_INT stay f32; pad tail is NaN."""
+    dt = _numeric_dtype() if ctype == T_NUM else np.dtype(np.float32)
+    buf = np.full(padded, np.nan, dt)
+    buf[:n] = np.asarray(arr, np.float64).astype(dt)
+    return buf
+
+
 def _numeric_dtype():
     """Device storage dtype for numeric columns: float32 default, bfloat16
     when the cluster opts in (halves HBM per column; compute still runs in
@@ -104,17 +114,21 @@ class Column:
         from h2o3_tpu.core import cleaner
 
         d = self._data
-        if d is None and self._evicted is not None:
+        while d is None:
             # `_evicted` is either a host buffer (Cleaner swap-out) or a
             # CALLABLE loader (file-backed Vec, water/fvec/FileVec.java
             # analog). The possibly-slow load/decode runs OUTSIDE the swap
             # lock so concurrent fault-ins of other columns don't serialize
-            # behind a disk read; the lock guards only the install, and a
-            # racing loser simply discards its buffer.
+            # behind a disk read; the install happens under the lock only
+            # if _evicted is still the SAME source we materialized (a
+            # racing evict/fault-in cycle retries with the fresh state).
             src = self._evicted
+            if src is None:
+                d = self._data      # plain data-less column, or raced-in
+                break
             buf = src() if callable(src) else src
             with cleaner.SWAP_LOCK:
-                if self._data is None and self._evicted is not None:
+                if self._data is None and self._evicted is src:
                     self._data = _cluster().put_rows(buf)
                     self._evicted = None
                 d = self._data
@@ -200,17 +214,10 @@ class Column:
                 else int(max(codes.max(initial=0) + 1, 1))
             buf = np.full(padded, NA_CAT, _code_dtype(card))
             buf[:n] = codes
-        elif ctype in (T_TIME, T_INT):
-            # times and integer columns stay f32: epoch-millis already strain
-            # f32, and bf16's 8 mantissa bits would conflate distinct int
-            # keys (IDs/counts) above 256
-            buf = np.full(padded, np.nan, np.float32)
-            buf[:n] = np.asarray(arr, np.float64).astype(np.float32)
-        elif ctype == T_NUM:
-            dt = _numeric_dtype()
-            buf = np.full(padded, np.nan, dt)
-            a = np.asarray(arr, np.float64)
-            buf[:n] = a.astype(dt)
+        elif ctype in (T_TIME, T_INT, T_NUM):
+            # dtype rules live in pad_numeric_host: T_NUM may opt into bf16;
+            # times (epoch-millis precision) and integer keys stay f32
+            buf = pad_numeric_host(arr, n, padded, ctype)
         else:
             raise TypeError(f"cannot device-store ctype {ctype}")
 

@@ -238,10 +238,11 @@ def lazy_import_parquet(path: str,
     local = persist.resolve(path)
     import pyarrow.parquet as pq
 
-    pf = pq.ParquetFile(local)
-    n = pf.metadata.num_rows
-    names = [f.name for f in pf.schema_arrow]
-    types = [formats._arrow_field_type(f.type) for f in pf.schema_arrow]
+    # metadata-only reads: no file handle kept open past this point
+    n = pq.read_metadata(local).num_rows
+    schema = pq.read_schema(local)
+    names = [f.name for f in schema]
+    types = [formats._arrow_field_type(f.type) for f in schema]
     padded = cluster().pad_rows(n)
     fr = H2OFrame(destination_frame=destination_frame)
     # categorical/string columns load eagerly in ONE column-pruned read
@@ -257,16 +258,11 @@ def lazy_import_parquet(path: str,
             continue
 
         def loader(col=name, ct=t):
+            from h2o3_tpu.core.frame import pad_numeric_host
+
             tbl = pq.read_table(local, columns=[col])
             arr, _types = formats.arrow_to_host_cols(tbl)
-            # same padded-buffer dtype rules as Column.from_numpy: T_NUM
-            # honors the cluster's bf16 opt-in, T_TIME stays f32
-            from h2o3_tpu.core.frame import _numeric_dtype
-
-            dt = _numeric_dtype() if ct == T_NUM else np.dtype(np.float32)
-            buf = np.full(padded, np.nan, dt)
-            buf[:n] = np.asarray(arr[col], np.float64).astype(dt)
-            return buf
+            return pad_numeric_host(arr[col], n, padded, ct)
 
         fr.add(name, Column.file_backed(loader, t, n))
     log.info(f"lazy-opened parquet {n}x{len(names)} [{fr.frame_id}] "

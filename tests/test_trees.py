@@ -189,3 +189,97 @@ def test_drf_binomial_double_trees(cl):
     pred = m.predict(fr)
     p = np.column_stack([pred.col(c).to_numpy() for c in pred.names[1:]])
     assert np.allclose(p.sum(1), 1.0, atol=1e-5)
+
+
+class TestXGBoostBoosters:
+    """booster='dart' (DartBooster, normalize_type=tree) and
+    booster='gblinear' (linear boosting == elastic-net GLM limit)."""
+
+    @staticmethod
+    def _frame(n=1200, seed=4):
+        import numpy as np
+
+        from h2o3_tpu.core.frame import Column, Frame
+
+        rng = np.random.default_rng(seed)
+        x1, x2 = rng.standard_normal((2, n))
+        y = np.where(rng.random(n) < 1 / (1 + np.exp(-(2 * x1 - x2))),
+                     "Y", "N")
+        fr = Frame()
+        fr.add("x1", Column.from_numpy(x1))
+        fr.add("x2", Column.from_numpy(x2))
+        fr.add("y", Column.from_numpy(y, ctype="enum"))
+        return fr
+
+    def test_dart_trains_and_drops(self, cl):
+        import numpy as np
+
+        from h2o3_tpu.models.xgboost import XGBoost
+
+        fr = self._frame()
+        m = XGBoost(booster="dart", ntrees=12, max_depth=3, rate_drop=0.3,
+                    seed=1, score_each_iteration=True).train(
+            y="y", training_frame=fr)
+        assert m.forest.n_trees == 12
+        hist = m._output.scoring_history
+        assert any(h["dropped"] > 0 for h in hist)    # dropout actually fired
+        assert float(m._output.training_metrics.auc) > 0.8
+        p = m.predict(fr).col("Y").to_numpy()
+        assert np.all(np.isfinite(p))
+        # deviance still decreases overall despite dropout
+        assert hist[-1]["training_deviance"] < hist[0]["training_deviance"]
+
+    def test_dart_zero_drop_matches_gbtree(self, cl):
+        import numpy as np
+
+        from h2o3_tpu.models.xgboost import XGBoost
+
+        fr = self._frame()
+        kw = dict(ntrees=6, max_depth=3, seed=2)
+        a = XGBoost(booster="dart", rate_drop=0.0, **kw).train(
+            y="y", training_frame=fr)
+        b = XGBoost(booster="gbtree", **kw).train(y="y", training_frame=fr)
+        pa = a.predict(fr).col("Y").to_numpy()
+        pb = b.predict(fr).col("Y").to_numpy()
+        np.testing.assert_allclose(pa, pb, atol=1e-5)
+
+    def test_gblinear_delegates_to_elastic_net(self, cl):
+        import numpy as np
+
+        from h2o3_tpu.models.xgboost import XGBoost
+
+        fr = self._frame()
+        m = XGBoost(booster="gblinear", reg_lambda=1.0, reg_alpha=0.0,
+                    seed=3).train(y="y", training_frame=fr)
+        assert m._parms["booster"] == "gblinear"
+        assert float(m._output.training_metrics.auc) > 0.8
+        coefs = m.coef()
+        assert abs(coefs["x1"]) > abs(coefs["x2"]) > 0   # linear recovery
+
+    def test_dart_validation_stopping_and_guards(self, cl):
+        import numpy as np
+        import pytest
+
+        from h2o3_tpu.models.xgboost import XGBoost
+
+        fr = self._frame()
+        va = self._frame(seed=9)
+        m = XGBoost(booster="dart", ntrees=20, max_depth=3, rate_drop=0.2,
+                    seed=1, stopping_rounds=2, score_each_iteration=True,
+                    ).train(y="y", training_frame=fr, validation_frame=va)
+        hist = m._output.scoring_history
+        assert all("validation_deviance" in h for h in hist)
+        with pytest.raises(ValueError, match="unknown booster"):
+            XGBoost(booster="gblineer", ntrees=2).train(
+                y="y", training_frame=fr)
+        # multinomial dart rejected, not silently gbtree
+        from h2o3_tpu.core.frame import Column, Frame
+
+        rng = np.random.default_rng(0)
+        f3 = Frame()
+        f3.add("x", Column.from_numpy(rng.standard_normal(200)))
+        f3.add("y", Column.from_numpy(
+            np.array(list("abc"))[rng.integers(0, 3, 200)], ctype="enum"))
+        with pytest.raises(ValueError, match="binomial/regression"):
+            XGBoost(booster="dart", ntrees=2, rate_drop=0.5).train(
+                y="y", training_frame=f3)
