@@ -5,14 +5,27 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 The reference publishes no in-tree numbers (BASELINE.md) — vs_baseline is
 relative to the first recorded run of this implementation (RECORDED below);
 1.0 until a baseline exists.
+
+Watchdog design (round-4 fix): the driver runs `python bench.py` under its
+own ~1500 s timeout. Every stage that touches jax runs in a SUBPROCESS with
+its own hard timeout, and the stage budgets sum to ~1100 s so the parent
+always gets to print its JSON line before the driver's outer timeout:
+  1. flagship GBM bench (default env, real chip if tunnel is up) .. 700 s
+  2. GLM IRLS fallback (default env) ............................. 200 s
+  3. GLM IRLS on CPU, bypassing the axon tunnel entirely ......... 180 s
+The parent NEVER imports jax: a wedged accelerator tunnel hangs jax import
+in any process that touches it, so all jax work is quarantined in children.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+REPO = os.path.dirname(os.path.abspath(__file__))
 
 # first recorded values on real TPU hardware (v5 lite, 2026-07-29) — the
 # baseline later rounds are measured against
@@ -20,12 +33,12 @@ RECORDED = {
     "gbm_rows_per_sec": 465943.8,
     "glm_irls_rows_per_sec": 371850175.7,
 }
-METRIC = "glm_irls_rows_per_sec"
 
 
 def bench_glm(n_rows: int = 1_000_000, p: int = 32, iters: int = 20) -> float:
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     rng = np.random.default_rng(0)
     X = jnp.asarray(rng.standard_normal((n_rows, p)), jnp.float32)
@@ -58,39 +71,60 @@ def bench_glm(n_rows: int = 1_000_000, p: int = 32, iters: int = 20) -> float:
     return n_rows * iters / dt
 
 
-def _flagship_watchdog(timeout_s: int = 1500):
-    """Run the flagship bench in a SUBPROCESS with a hard timeout: a wedged
-    accelerator tunnel or a pathological compile must degrade to the GLM
-    fallback metric, not hang the driver's bench step."""
-    import subprocess
-    import sys
-
-    proc = subprocess.run(
-        [sys.executable, "-m", "h2o3_tpu.bench"],
-        capture_output=True, timeout=timeout_s, text=True,
-        cwd=__import__("os").path.dirname(__import__("os").path.abspath(__file__)))
-    for ln in proc.stdout.splitlines():
+def _parse_result(stdout: str):
+    for ln in stdout.splitlines():
         if ln.startswith("H2O3_BENCH "):
-            _, metric, value = ln.split()
-            return float(value), metric
-    raise RuntimeError(f"flagship bench produced no result "
-                       f"(rc={proc.returncode}): {proc.stderr[-2000:]}")
+            try:
+                _, metric, value = ln.split()
+                return float(value), metric
+            except ValueError:
+                print(f"malformed bench line: {ln!r}", file=sys.stderr)
+    return None
+
+
+def _stage(cmd, timeout_s, env_extra=None):
+    """Run one bench stage in a subprocess with a hard timeout. Returns
+    (value, metric) or None on timeout / crash / missing result line."""
+    env = dict(os.environ)
+    if env_extra:
+        env.update(env_extra)
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=timeout_s,
+                              text=True, cwd=REPO, env=env)
+    except subprocess.TimeoutExpired:
+        print(f"bench stage timed out after {timeout_s}s: {cmd}",
+              file=sys.stderr)
+        return None
+    got = _parse_result(proc.stdout)
+    if got is None:
+        print(f"bench stage rc={proc.returncode} produced no result: "
+              f"{proc.stderr[-2000:]}", file=sys.stderr)
+    return got
+
+
+_GLM_SNIPPET = ("import bench; "
+                "print('H2O3_BENCH glm_irls_rows_per_sec', bench.bench_glm())")
 
 
 def main():
-    try:
-        value, metric = _flagship_watchdog()
-    except Exception:
-        # keep the one-JSON-line contract, but surface the flagship failure
-        import sys
-        import traceback
-
-        traceback.print_exc(file=sys.stderr)
-        value, metric = bench_glm(), METRIC
+    got = _stage([sys.executable, "-m", "h2o3_tpu.bench"], 700)
+    if got is None:  # flagship failed/hung: GLM fallback, still default env
+        got = _stage([sys.executable, "-c", _GLM_SNIPPET], 200)
+    unit = "rows/sec/chip"
+    if got is None:  # tunnel wedged: CPU bypass so a number ALWAYS lands
+        got = _stage([sys.executable, "-c", _GLM_SNIPPET], 180,
+                     env_extra={"PALLAS_AXON_POOL_IPS": "",
+                                "JAX_PLATFORMS": "cpu"})
+        unit = "rows/sec/cpu-fallback"
+    if got is None:
+        print(json.dumps({"metric": "bench_failed", "value": 0.0,
+                          "unit": "none", "vs_baseline": 0.0}))
+        return
+    value, metric = got
     rec = RECORDED.get(metric)
-    vs = value / rec if rec else 1.0
+    vs = value / rec if (rec and unit == "rows/sec/chip") else 0.0
     print(json.dumps({"metric": metric, "value": round(value, 1),
-                      "unit": "rows/sec/chip", "vs_baseline": round(vs, 3)}))
+                      "unit": unit, "vs_baseline": round(vs, 3)}))
 
 
 if __name__ == "__main__":

@@ -706,6 +706,8 @@ def h_predict_v4(ctx: Ctx):
     m = _model_or_404(ctx.params["model_id"])
     fr = _frame_or_404(ctx.params["frame_id"])
     contribs = str(ctx.arg("predict_contributions", "")).lower() in ("1", "true")
+    if contribs:
+        _check_contributions_size(fr)  # same 400 as the sync v3 route
     job = Job(description=f"{m.algo_name} "
                           f"{'contributions' if contribs else 'prediction'}")
     job.dest_type = "Key<Frame>"
@@ -1359,8 +1361,11 @@ class _Handler(BaseHTTPRequestHandler):
             user, _, pw = base64.b64decode(hdr[6:]).decode().partition(":")
         except Exception:   # noqa: BLE001 — malformed header
             return False
+        import hmac
+
         want = auth.get(user)
-        return bool(want) and hashlib.sha256(pw.encode()).hexdigest() == want
+        return bool(want) and hmac.compare_digest(
+            hashlib.sha256(pw.encode()).hexdigest(), want)
 
     # -- dispatch ---------------------------------------------------------
     def _handle(self):

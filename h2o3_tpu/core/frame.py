@@ -150,8 +150,15 @@ class Column:
 
     @data.setter
     def data(self, v):
-        self._data = v
-        self._evicted = None
+        from h2o3_tpu.core import cleaner
+
+        # under SWAP_LOCK so a concurrent evict() can't capture the old
+        # loader mid-rebind; clearing _loader makes the rebound buffer
+        # authoritative (evict falls back to a host copy, not stale disk)
+        with cleaner.SWAP_LOCK:
+            self._data = v
+            self._evicted = None
+            self._loader = None
 
     def evict(self) -> int:
         """Swap the device buffer to host RAM; returns bytes freed. No-op

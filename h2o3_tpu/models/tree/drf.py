@@ -455,6 +455,7 @@ class DRF(SharedTree):
 
         max_depth = int(self.params["max_depth"])
         trees, tree_class, varimp = [], [], self._ckpt_varimp0()
+        leaf_means = []
         t_start = self._ckpt_start(ntrees, per_iter=K)
         oob_sum = jnp.zeros((N, K), jnp.float32)
         oob_cnt = jnp.zeros(N, jnp.float32)
@@ -469,7 +470,11 @@ class DRF(SharedTree):
                 ln, ld = leaf_stats(row_leaf, w_t * onehot[:, k], w_t,
                                     tree.n_leaves)
                 mean = np.where(ld > 1e-12, ln / np.maximum(ld, 1e-12), 0.0)
-                tree.set_leaf_values(mean / ntrees)
+                # raw class-indicator mean; rescaled to 1/total after the
+                # loop so a max_runtime_secs break divides by trees built,
+                # not trees requested (mirrors the binomial path)
+                tree.set_leaf_values(mean)
+                leaf_means.append(mean)
                 trees.append(tree)
                 tree_class.append(k)
                 self._accumulate_varimp(tree, varimp, model)
@@ -486,14 +491,16 @@ class DRF(SharedTree):
             if self.job:
                 self.job.update(progress=(t + 1) / ntrees, msg=f"iter {t + 1}")
         self._finalize_varimp(model, varimp)
+        total = t_start + len(trees) // K
+        for tree, mean in zip(trees, leaf_means):
+            tree.set_leaf_values(mean / total)
         forest = CompressedForest.from_host_trees(
             trees, spec, tree_class=tree_class, max_depth=max_depth,
             nclasses=K)
         if t_start:
-            # leaves above are /ntrees (loop always completes here) and prev's
-            # are /t_start — rescale prev onto the same /ntrees denominator
+            # prev leaves are /t_start — rescale onto the /total denominator
             forest = CompressedForest.concat(self._ckpt.forest, forest,
-                                             scale_a=t_start / ntrees)
+                                             scale_a=t_start / total)
         self._oob_raw = None
         if float(jnp.max(oob_cnt)) > 0:
             p = jnp.clip(oob_sum / jnp.maximum(oob_cnt, 1.0)[:, None], 0.0, 1.0)

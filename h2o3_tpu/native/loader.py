@@ -110,6 +110,11 @@ def native_treeshap(binned: np.ndarray, forest, nthreads: int = 0
     lib = get_lib()
     if lib is None or not hasattr(lib, "h2o_treeshap"):
         return None
+    # treeshap.cpp's unique-path buffer is PE m[72]; extend() writes one
+    # entry per root-to-leaf level, so forests deeper than ~70 would
+    # overflow it — route those to the pure-Python fallback instead
+    if getattr(forest, "max_depth", 0) + 2 > 70:
+        return None
     n, F = binned.shape
     T, M = forest.feat.shape
     b = np.ascontiguousarray(binned, np.int32)

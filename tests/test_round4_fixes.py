@@ -1,0 +1,88 @@
+"""Regression tests for round-4 advisor findings (ADVICE.md)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame
+
+
+def _multi(n=600, seed=3):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    y = np.array(["a", "b", "c"])[
+        np.argmax(np.column_stack([x1, x2, -x1 - x2]) +
+                  rng.normal(0, .3, (n, 3)), axis=1)]
+    fr = Frame()
+    fr.add("x1", Column.from_numpy(x1))
+    fr.add("x2", Column.from_numpy(x2))
+    fr.add("y", Column.from_numpy(y, ctype="enum"))
+    return fr
+
+
+def test_drf_multinomial_deep_truncation_scale(cl, monkeypatch):
+    """max_runtime_secs break in the deep multinomial path must divide
+    leaves by trees BUILT, not trees requested (ADVICE round-4 #1)."""
+    from h2o3_tpu.models.tree import drf as drf_mod
+
+    calls = {"n": 0}
+
+    def fake_oot(self):
+        calls["n"] += 1
+        return calls["n"] >= 2   # stop after 2 of 6 iterations
+
+    monkeypatch.setattr(drf_mod.DRF, "_out_of_time", fake_oot)
+    fr = _multi()
+    m = drf_mod.DRF(ntrees=6, max_depth=12, seed=1).train(
+        y="y", training_frame=fr)
+    # class-indicator means sum to ~1 per iteration; with the correct
+    # 1/total denominator the raw margin rows sum to ~1, with the buggy
+    # 1/ntrees denominator they'd sum to ~built/ntrees = 1/3
+    f = np.asarray(m._margin(fr))
+    assert f.shape[1] == 3
+    assert abs(float(np.mean(f.sum(axis=1))) - 1.0) < 0.15
+
+
+def test_native_treeshap_depth_gate():
+    """Forests deeper than the C++ unique-path buffer must fall back to
+    Python TreeSHAP, not overflow the stack (ADVICE round-4 #2)."""
+    from h2o3_tpu.native import loader
+
+    class DeepForest:
+        max_depth = 80
+
+    out = loader.native_treeshap(np.zeros((1, 2), np.int32), DeepForest())
+    assert out is None
+
+
+def test_v4_contributions_size_cap(cl):
+    """/4/Predictions with predict_contributions must enforce the same
+    row cap as the sync v3 route (ADVICE round-4 #3)."""
+    from h2o3_tpu.api import server as srv
+
+    fake = type("F", (), {"nrows": 10_000_001, "nrow": 10_000_001,
+                          "ncol": 3, "ncols": 3})()
+    with pytest.raises(srv.ApiError):
+        srv._check_contributions_size(fake)
+    ok = type("F", (), {"nrows": 10, "nrow": 10, "ncol": 3, "ncols": 3})()
+    srv._check_contributions_size(ok)   # under the cap: no raise
+
+
+def test_file_backed_column_setter_clears_loader(tmp_path, cl):
+    """Rebinding .data on a file-backed column must drop the disk loader so
+    evict/fault-in keeps the new values (ADVICE round-4 #4)."""
+    col = Column.from_numpy(np.arange(8, dtype=np.float64))
+    col._loader = lambda: np.zeros(8)   # simulate file-backed source
+    col.data = np.full(8, 7.0)
+    col.evict()
+    got = col.to_numpy()
+    assert np.allclose(got, 7.0), "evict restored stale disk values"
+
+
+def test_basic_auth_uses_constant_time_compare():
+    import inspect
+
+    from h2o3_tpu.api import server as srv
+
+    src = inspect.getsource(srv)
+    assert "compare_digest" in src
