@@ -1,0 +1,27 @@
+"""h2o3_genmodel — standalone MOJO scoring runtime.
+
+The dependency-free counterpart of the reference's h2o-genmodel jar
+(h2o-genmodel/src/main/java/hex/genmodel/easy/EasyPredictModelWrapper.java:1,
+MojoModel.java:1): loads a MOJO zip exported by h2o3_tpu and scores rows
+using ONLY numpy + the standard library — no h2o3_tpu, no jax, no server.
+
+Usage:
+    import h2o3_genmodel as gm
+    model = gm.load_mojo("model.zip")
+    res = model.predict({"x1": 0.3, "g": "b"})       # one row, EasyPredict
+    tbl = model.score(cols)                          # batch: dict of arrays
+
+CLI (hex/genmodel/tools/PredictCsv.java analog):
+    python -m h2o3_genmodel.predict_csv --mojo model.zip \
+        --input in.csv --output out.csv
+"""
+
+from h2o3_genmodel.easy import (AnomalyPrediction, BinomialPrediction,
+                                ClusteringPrediction, EasyPredictor,
+                                MultinomialPrediction, RegressionPrediction,
+                                load_mojo)
+
+__version__ = "1.0.0"
+__all__ = ["load_mojo", "EasyPredictor", "BinomialPrediction",
+           "MultinomialPrediction", "RegressionPrediction",
+           "ClusteringPrediction", "AnomalyPrediction", "__version__"]

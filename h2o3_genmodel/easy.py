@@ -1,0 +1,118 @@
+"""EasyPredictModelWrapper analog — typed row predictions over a MOJO.
+
+Reference: hex/genmodel/easy/EasyPredictModelWrapper.java:1 and the
+prediction POJOs under hex/genmodel/easy/prediction/."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from h2o3_genmodel.reader import read_mojo_bundle
+from h2o3_genmodel.scorers import ColumnBlock, build_scorer
+
+
+@dataclass
+class BinomialPrediction:
+    label: str
+    class_probabilities: List[float]
+
+
+@dataclass
+class MultinomialPrediction:
+    label: str
+    class_probabilities: List[float]
+
+
+@dataclass
+class RegressionPrediction:
+    value: float
+
+
+@dataclass
+class ClusteringPrediction:
+    cluster: int
+    distances: List[float] = field(default_factory=list)
+
+
+@dataclass
+class AnomalyPrediction:
+    score: float
+    normalized_score: float
+
+
+class EasyPredictor:
+    """Loads a MOJO once; predicts single rows (dicts) or batches (dict of
+    columns). Mirrors EasyPredictModelWrapper's categorical handling: unseen
+    levels and missing columns score as NA."""
+
+    def __init__(self, bundle):
+        self.bundle = bundle
+        s = bundle.scorer
+        self.algo: str = s["algo"]
+        self.category: str = s["model_category"]
+        self.names: List[str] = list(s["names"])
+        self.response_domain: List[str] = list(s.get("response_domain") or [])
+        self.default_threshold = float(s.get("default_threshold", 0.5))
+        self._scorer = build_scorer(bundle)
+
+    # -- batch ------------------------------------------------------------
+    def score(self, cols: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """Batch scoring: dict of raw columns → output columns, the same
+        table shape as the server's predict frame (predict + per-class
+        probability columns named by response level)."""
+        block = ColumnBlock.from_dict(cols)
+        raw = self._scorer.raw_predict(block)
+        out: Dict[str, np.ndarray] = {}
+        if "probs" in raw:
+            probs = np.asarray(raw["probs"])
+            dom = self.response_domain or [str(i) for i in
+                                           range(probs.shape[1])]
+            if self.category == "Binomial":
+                label = (probs[:, 1] >= self.default_threshold).astype(int)
+            else:
+                label = probs.argmax(axis=-1)
+            out["predict"] = np.asarray([dom[i] for i in label], object)
+            for k, lvl in enumerate(dom):
+                out[str(lvl)] = probs[:, k]
+        elif "cluster" in raw:
+            out["predict"] = np.asarray(raw["cluster"], np.int64)
+        elif "score" in raw and self.category == "AnomalyDetection":
+            out["predict"] = np.asarray(raw["score"])
+            if "mean_length" in raw:
+                out["mean_length"] = np.asarray(raw["mean_length"])
+        else:
+            out["predict"] = np.asarray(raw["value"])
+        return out
+
+    # -- single row (EasyPredictModelWrapper.predict*) --------------------
+    def predict(self, row: Dict[str, Any]):
+        cols = {k: [v] for k, v in row.items()}
+        block = ColumnBlock.from_dict(cols)
+        raw = self._scorer.raw_predict(block)
+        if self.category == "Binomial":
+            p = np.asarray(raw["probs"])[0]
+            label = self.response_domain[int(p[1] >= self.default_threshold)]
+            return BinomialPrediction(label, [float(x) for x in p])
+        if self.category == "Multinomial":
+            p = np.asarray(raw["probs"])[0]
+            dom = self.response_domain or [str(i) for i in range(len(p))]
+            return MultinomialPrediction(dom[int(p.argmax())],
+                                         [float(x) for x in p])
+        if self.category == "Clustering":
+            return ClusteringPrediction(int(raw["cluster"][0]))
+        if self.category == "AnomalyDetection":
+            # reference AnomalyDetectionPrediction: score = mean path
+            # length, normalizedScore = the [0,1] 2^(-len/c) value
+            ml = raw.get("mean_length")
+            norm = float(raw["score"][0])
+            return AnomalyPrediction(
+                float(ml[0]) if ml is not None else norm, norm)
+        return RegressionPrediction(float(np.asarray(raw["value"])[0]))
+
+
+def load_mojo(source) -> EasyPredictor:
+    """Load a MOJO zip (path / bytes / file-like) into a predictor."""
+    return EasyPredictor(read_mojo_bundle(source))

@@ -1,0 +1,69 @@
+"""PredictCsv — batch-score a CSV with a MOJO, no framework install.
+
+Reference: hex/genmodel/tools/PredictCsv.java:1 (the `java -cp h2o-genmodel
+.jar hex.genmodel.tools.PredictCsv` entry point). Same contract: reads a
+headered CSV, writes a CSV with `predict` (+ per-class probability columns
+for classifiers).
+
+    python -m h2o3_genmodel.predict_csv --mojo model.zip \
+        --input in.csv --output out.csv [--separator ,]
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+from h2o3_genmodel.easy import load_mojo
+
+
+def read_csv_columns(path: str, sep: str = ",") -> Dict[str, List[str]]:
+    with open(path, newline="") as f:
+        rd = csv.reader(f, delimiter=sep)
+        try:
+            header = next(rd)
+        except StopIteration:
+            raise ValueError(f"{path}: empty file")
+        cols: Dict[str, List[str]] = {h.strip().strip('"'): [] for h in header}
+        keys = list(cols)
+        for row in rd:
+            for i, k in enumerate(keys):
+                cols[k].append(row[i] if i < len(row) else "")
+    return cols
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="h2o3_genmodel.predict_csv",
+        description="Score a CSV file with an h2o3_tpu MOJO (numpy-only).")
+    ap.add_argument("--mojo", required=True, help="path to the MOJO zip")
+    ap.add_argument("--input", required=True, help="input CSV (headered)")
+    ap.add_argument("--output", help="output CSV (default: stdout)")
+    ap.add_argument("--separator", default=",", help="field separator")
+    args = ap.parse_args(argv)
+
+    model = load_mojo(args.mojo)
+    cols = read_csv_columns(args.input, args.separator)
+    out = model.score(cols)
+
+    names = list(out)
+    n = len(np.asarray(out[names[0]]).reshape(-1))
+    sink = open(args.output, "w", newline="") if args.output else sys.stdout
+    try:
+        w = csv.writer(sink)
+        w.writerow(names)
+        mats = [np.asarray(out[nm]).reshape(-1) for nm in names]
+        for i in range(n):
+            w.writerow([mats[j][i] for j in range(len(names))])
+    finally:
+        if args.output:
+            sink.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

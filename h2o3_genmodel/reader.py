@@ -1,0 +1,58 @@
+"""MOJO zip container reader (hex/genmodel/MojoReaderBackend analog).
+
+Parses the h2o3_tpu MOJO layout: `model.ini` ([info]/[columns]/[domains]),
+`domains/d*.txt`, `scorer.json` and `data/*.npy` numpy payloads. Pure
+stdlib + numpy."""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Any, Dict
+
+import numpy as np
+
+
+class MojoBundle:
+    """Raw parsed artifact: .info (model.ini [info] keys), .scorer
+    (scorer.json), .arrays (data/*.npy)."""
+
+    def __init__(self, info: Dict[str, str], scorer: Dict[str, Any],
+                 arrays: Dict[str, np.ndarray]):
+        self.info = info
+        self.scorer = scorer
+        self.arrays = arrays
+
+    @property
+    def algo(self) -> str:
+        return self.scorer["algo"]
+
+
+def read_mojo_bundle(source) -> MojoBundle:
+    """source: path / bytes / file-like of a MOJO zip."""
+    if isinstance(source, (bytes, bytearray)):
+        source = io.BytesIO(source)
+    with zipfile.ZipFile(source) as z:
+        names = set(z.namelist())
+        if "scorer.json" not in names:
+            raise ValueError(
+                "not an h2o3_tpu MOJO: scorer.json missing (reference-Java "
+                "MOJO payloads are not supported by this runtime)")
+        scorer = json.loads(z.read("scorer.json").decode())
+        info: Dict[str, str] = {}
+        if "model.ini" in names:
+            section = ""
+            for ln in z.read("model.ini").decode().splitlines():
+                ln = ln.strip()
+                if ln.startswith("["):
+                    section = ln
+                elif section == "[info]" and " = " in ln:
+                    k, _, v = ln.partition(" = ")
+                    info[k.strip()] = v.strip()
+        arrays = {}
+        for n in names:
+            if n.startswith("data/") and n.endswith(".npy"):
+                arrays[n[len("data/"):-len(".npy")]] = np.load(
+                    io.BytesIO(z.read(n)), allow_pickle=False)
+    return MojoBundle(info, scorer, arrays)

@@ -1,0 +1,411 @@
+"""Pure-numpy scoring engines for each MOJO payload family.
+
+Numerics mirror the in-framework device scorers exactly:
+- trees: h2o3_tpu/models/tree/compressed.py _traverse_fn (lockstep node
+  walk, categorical split tables, per-feature NA bins) and binning.py
+  bin_columns (searchsorted on training quantile edges);
+- GLM:   h2o3_tpu/models/glm.py _glm_predict / _ordinal_class_probs;
+- KMeans/DeepLearning: DataInfo.expand + their _predict_raw.
+Reference counterparts: hex/genmodel/algos/tree/SharedTreeMojoModel.java:1,
+glm/GlmMojoModel.java:1, kmeans/KMeansMojoModel.java:1,
+deeplearning/DeeplearningMojoModel.java:1."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+NA_STRINGS = {"", "na", "nan", "null", "none", "n/a", "-"}
+
+
+def _softmax(x: np.ndarray) -> np.ndarray:
+    m = x - x.max(axis=-1, keepdims=True)
+    e = np.exp(m)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def _sigmoid(x: np.ndarray) -> np.ndarray:
+    return 1.0 / (1.0 + np.exp(-np.clip(x, -60, 60)))
+
+
+def to_float(values) -> np.ndarray:
+    """Raw column (strings / numbers / None) → float64 with NaN for NA."""
+    a = np.asarray(values)
+    if a.dtype.kind in "fiub":
+        return a.astype(np.float64)
+    out = np.full(a.shape, np.nan)
+    flat = a.reshape(-1).astype(object)
+    for i, v in enumerate(flat):
+        if v is None:
+            continue
+        if isinstance(v, (int, float)):
+            out.reshape(-1)[i] = float(v)
+            continue
+        s = str(v).strip()
+        if s.lower() in NA_STRINGS:
+            continue
+        try:
+            out.reshape(-1)[i] = float(s)
+        except ValueError:
+            pass
+    return out
+
+
+def to_codes(values, domain: Sequence[str]) -> np.ndarray:
+    """Raw column → int32 domain codes; NA/unseen → -1 (the in-framework
+    adapt_test contract: unseen test levels score as NA)."""
+    lut = {str(d): i for i, d in enumerate(domain)}
+    a = np.asarray(values).reshape(-1)
+    out = np.full(a.shape, -1, np.int32)
+    for i, v in enumerate(a):
+        if v is None:
+            continue
+        s = str(v).strip()
+        if s.lower() in NA_STRINGS:
+            continue
+        code = lut.get(s)
+        if code is None:
+            # numeric-looking categorical ("3.0" vs "3") — integral only;
+            # "3.7" or "Infinity" must stay NA, not snap to a level
+            try:
+                fv = float(s)
+                if fv == int(fv):
+                    code = lut.get(str(int(fv)))
+            except (ValueError, OverflowError):
+                code = None
+        out[i] = -1 if code is None else code
+    return out
+
+
+class ColumnBlock:
+    """Named raw input columns; missing names resolve to all-NA."""
+
+    def __init__(self, cols: Dict[str, Any], n: int):
+        self.cols = cols
+        self.n = n
+
+    @staticmethod
+    def from_dict(cols: Dict[str, Any]) -> "ColumnBlock":
+        arrs = {k: np.asarray(v).reshape(-1) for k, v in cols.items()}
+        lens = {len(v) for v in arrs.values()}
+        if len(lens) > 1:
+            detail = ", ".join(f"{k}={len(v)}" for k, v in arrs.items())
+            raise ValueError(f"input columns have mismatched lengths: {detail}")
+        return ColumnBlock(arrs, lens.pop() if lens else 0)
+
+    def raw(self, name: str):
+        return self.cols.get(name)
+
+
+# ---------------------------------------------------------------------------
+# tree family
+# ---------------------------------------------------------------------------
+
+class TreeScorer:
+    """CompressedForest traversal + training-edge binning in numpy."""
+
+    def __init__(self, bundle):
+        s = bundle.scorer
+        a = bundle.arrays
+        meta = s["meta"]
+        self.algo = s["algo"]
+        self.category = str(s["model_category"])
+        self.names: List[str] = list(meta["spec_names"])
+        self.is_cat = a["spec_is_cat"].astype(bool)
+        self.nbins = a["spec_nbins"].astype(np.int64)
+        self.domains = {k: list(v) for k, v in (s.get("domains") or {}).items()}
+        lens, flat = a["spec_edges_len"], a["spec_edges_flat"]
+        self.edges, pos = [], 0
+        for ln in lens:
+            self.edges.append(np.asarray(flat[pos:pos + int(ln)], np.float64))
+            pos += int(ln)
+        self.feat = a["feat"].astype(np.int32)            # (T, M)
+        self.thresh = a["thresh_bin"].astype(np.int32)
+        self.na_left = a["na_left"].astype(bool)
+        self.left = a["left"].astype(np.int32)
+        self.right = a["right"].astype(np.int32)
+        self.leaf_val = a["leaf_val"].astype(np.float64)
+        self.leaf_val32 = a["leaf_val"].astype(np.float32)
+        self.cat_split = a["cat_split"].astype(np.int32)
+        self.cat_table = a["cat_table"].astype(bool)
+        self.tree_class = a["tree_class"].astype(np.int32)
+        self.na_bins = a["na_bins"].astype(np.int32)      # (F,)
+        self.max_depth = int(meta["max_depth"])
+        self.init_f = float(meta["init_f"])
+        self.nclasses = int(meta["nclasses"])
+        self.init_class = (np.asarray(a["init_class"], np.float64)
+                           if "init_class" in a else None)
+        self.init_class32 = (np.asarray(a["init_class"], np.float32)
+                             if "init_class" in a else None)
+        self.distribution = meta.get("distribution")
+        self.cnorm = float(meta.get("cnorm", 1.0) or 1.0)
+
+    @property
+    def n_trees(self) -> int:
+        return self.feat.shape[0]
+
+    def bin(self, block: ColumnBlock) -> np.ndarray:
+        """(N, F) int32 bin matrix, matching BinSpec.bin_columns."""
+        n = block.n
+        parts = []
+        for i, name in enumerate(self.names):
+            na_bin = int(self.nbins[i]) - 1
+            raw = block.raw(name)
+            if raw is None:
+                parts.append(np.full(n, na_bin, np.int32))
+                continue
+            if self.is_cat[i]:
+                codes = to_codes(raw, self.domains.get(name, []))
+                b = np.where((codes < 0) | (codes >= na_bin), na_bin, codes)
+            else:
+                # float32 on both sides: the device binner compares f32
+                # values to f32 edges, and values landing exactly on an
+                # edge must fall in the same bin here
+                x = to_float(raw).astype(np.float32)
+                b = np.searchsorted(self.edges[i].astype(np.float32), x,
+                                    side="left").astype(np.int32)
+                b = np.where(np.isnan(x), na_bin, b)
+            parts.append(b.astype(np.int32))
+        return np.stack(parts, axis=-1)
+
+    def margin(self, binned: np.ndarray) -> np.ndarray:
+        """Σ leaf values over trees (+init) — (N,) or (N, K)."""
+        N = binned.shape[0]
+        T, _M = self.feat.shape
+        tidx = np.arange(T)[None, :]                      # (1, T)
+        node = np.zeros((N, T), np.int32)
+        W = self.cat_table.shape[1] if self.cat_table.size else 1
+        for _ in range(self.max_depth + 1):
+            f = self.feat[tidx, node]                     # (N, T)
+            leaf = f < 0
+            fi = np.maximum(f, 0)
+            b = np.take_along_axis(binned, fi, axis=1)    # (N, T)
+            is_na = b == self.na_bins[fi]
+            csid = self.cat_split[tidx, node]
+            if self.cat_table.size:
+                cat_left = self.cat_table[np.maximum(csid, 0),
+                                          np.minimum(b, W - 1)]
+            else:
+                cat_left = np.zeros_like(leaf)
+            go_left = np.where(csid >= 0, cat_left, b <= self.thresh[tidx, node])
+            go_left = np.where(is_na, self.na_left[tidx, node], go_left)
+            nxt = np.where(go_left, self.left[tidx, node],
+                           self.right[tidx, node])
+            node = np.where(leaf, node, nxt)
+        # float32 SEQUENTIAL accumulation in tree order — bitwise-identical
+        # to the device scan (compressed.py walk_one_tree), so margin-space
+        # ties (e.g. the max-F1 labeling threshold, which IS a predicted
+        # value) resolve the same way here as in the framework
+        contrib = self.leaf_val32[tidx, node]             # (N, T) f32
+        K = self.nclasses if self.nclasses > 2 else 1
+        if K > 1:
+            acc = np.zeros((N, K), np.float32)
+            for t in range(T):
+                acc[:, self.tree_class[t]] += contrib[:, t]
+        else:
+            acc = np.zeros(N, np.float32)
+            for t in range(T):
+                acc += contrib[:, t]
+        if self.init_class is not None:
+            return acc + self.init_class32[None, :]
+        return acc + np.float32(self.init_f)
+
+    def _linkinv(self, f: np.ndarray) -> np.ndarray:
+        # f32 in, f32 ops: matches the device Bernoulli.linkinv bit layout
+        d = (self.distribution or "gaussian").lower()
+        f = np.asarray(f, np.float32)
+        if d in ("bernoulli", "quasibinomial"):
+            one = np.float32(1.0)
+            return one / (one + np.exp(-f))
+        if d in ("poisson", "gamma", "tweedie", "multinomial"):
+            return np.exp(np.clip(f, -60, 60))
+        return f                      # gaussian/laplace/quantile/huber
+
+    def raw_predict(self, block: ColumnBlock, chunk: int = 8192) -> Dict[str, np.ndarray]:
+        outs = []
+        binned = self.bin(block)
+        for s in range(0, binned.shape[0], chunk):
+            outs.append(self.margin(binned[s:s + chunk]))
+        f = np.concatenate(outs, axis=0) if outs else self.margin(binned)
+        if self.algo == "isolationforest":
+            mean_len = f / self.n_trees
+            score = np.exp2(-mean_len / max(self.cnorm, 1e-9))
+            return {"score": score, "mean_length": mean_len}
+        if self.algo == "drf":
+            # vote means, not margins (DRFModel._predict_raw); the category
+            # drives the branch — binomial forests carry nclasses=1
+            if self.category == "Multinomial" or f.ndim == 2:
+                p = np.clip(f, 0.0, 1.0)
+                p = p / np.maximum(p.sum(axis=-1, keepdims=True), 1e-12)
+                return {"probs": p}
+            if self.category == "Binomial":
+                p = np.clip(f, 0.0, 1.0)
+                return {"probs": np.stack([1 - p, p], axis=-1)}
+            return {"value": f}
+        if self.category == "Multinomial":
+            return {"probs": _softmax(f)}
+        if self.category == "Binomial":
+            p = self._linkinv(f)
+            return {"probs": np.stack([1 - p, p], axis=-1)}
+        return {"value": self._linkinv(f)}
+
+
+# ---------------------------------------------------------------------------
+# DataInfo expansion (shared by GLM / KMeans / DeepLearning)
+# ---------------------------------------------------------------------------
+
+class DataInfoExpander:
+    """numpy twin of h2o3_tpu/models/data_info.py DataInfo.expand."""
+
+    def __init__(self, state: dict):
+        self.cat_names = list(state["cat_names"])
+        self.num_names = list(state["num_names"])
+        self.domains = {k: list(v) for k, v in state["domains"].items()}
+        self.cards = [int(c) for c in state["cards"]]
+        self.standardize = bool(state["standardize"])
+        self.use_all_factor_levels = bool(state["use_all_factor_levels"])
+        self.num_means = np.asarray(state["num_means"], np.float64)
+        self.num_sigmas = np.asarray(state["num_sigmas"], np.float64)
+        self.cat_modes = np.asarray(state["cat_modes"], np.int32)
+        self.impute_values = np.asarray(state["impute_values"], np.float64)
+
+    def expand(self, block: ColumnBlock) -> np.ndarray:
+        n = block.n
+        base = 0 if self.use_all_factor_levels else 1
+        parts = []
+        for i, name in enumerate(self.cat_names):
+            raw = block.raw(name)
+            codes = (to_codes(raw, self.domains.get(name, []))
+                     if raw is not None else np.full(n, -1, np.int32))
+            card = max(self.cards[i], base + 1)
+            mode = int(self.cat_modes[i]) if self.cat_modes.size > i else 0
+            codes = np.where((codes < 0) | (codes >= card), mode, codes)
+            oh = np.eye(card)[codes]
+            parts.append(oh[:, base:] if base else oh)
+        if self.num_names:
+            nums = np.stack(
+                [to_float(block.raw(nm)) if block.raw(nm) is not None
+                 else np.full(n, np.nan) for nm in self.num_names], axis=-1)
+            nums = np.where(np.isnan(nums), self.impute_values[None, :], nums)
+            if self.standardize:
+                nums = (nums - self.num_means[None, :]) / self.num_sigmas[None, :]
+            parts.append(nums)
+        if not parts:
+            raise ValueError("no predictors")
+        return np.concatenate(parts, axis=-1) if len(parts) > 1 else parts[0]
+
+
+class GlmScorer:
+    def __init__(self, bundle):
+        s = bundle.scorer
+        meta = s["meta"]
+        self.beta = np.asarray(bundle.arrays["beta"], np.float64)
+        self.linkname = meta["linkname"]
+        self.link_power = float(meta["link_power"])
+        self.di = DataInfoExpander(meta["dinfo"])
+        dom = s.get("response_domain") or []
+        self.nclasses = len(dom) if dom else 1
+
+    def _linkinv(self, eta: np.ndarray) -> np.ndarray:
+        nm, lp = self.linkname, self.link_power
+        if nm == "identity":
+            return eta
+        if nm == "log":
+            return np.exp(np.clip(eta, -30, 30))
+        if nm == "logit":
+            return _sigmoid(eta)
+        if nm == "inverse":
+            return 1.0 / np.where(np.abs(eta) < 1e-10, 1e-10, eta)
+        if nm == "tweedie":
+            if lp == 0.0:
+                return np.exp(np.clip(eta, -30, 30))
+            return np.maximum(eta, 1e-10) ** (1.0 / lp)
+        raise ValueError(f"unknown link {nm!r}")
+
+    def raw_predict(self, block: ColumnBlock) -> Dict[str, np.ndarray]:
+        X = self.di.expand(block)
+        if self.linkname == "ordinal":
+            p = X.shape[1]
+            beta, traw = self.beta[:p], self.beta[p:]
+            th = traw[0] + np.concatenate(
+                [np.zeros(1), np.cumsum(np.logaddexp(0.0, traw[1:]))])
+            eta = X @ beta
+            cum = _sigmoid(th[None, :] - eta[:, None])
+            n = X.shape[0]
+            cf = np.concatenate([np.zeros((n, 1)), cum, np.ones((n, 1))], 1)
+            return {"probs": np.maximum(cf[:, 1:] - cf[:, :-1], 0.0)}
+        Xi = np.concatenate([X, np.ones((X.shape[0], 1))], axis=1)
+        if self.nclasses > 2:
+            return {"probs": _softmax(Xi @ self.beta)}
+        mu = self._linkinv(Xi @ self.beta)
+        if self.nclasses == 2:
+            return {"probs": np.stack([1 - mu, mu], axis=-1)}
+        return {"value": mu}
+
+
+class KMeansScorer:
+    def __init__(self, bundle):
+        self.centers = np.asarray(bundle.arrays["centers"], np.float64)
+        self.di = DataInfoExpander(bundle.scorer["meta"]["dinfo"])
+
+    def raw_predict(self, block: ColumnBlock) -> Dict[str, np.ndarray]:
+        X = self.di.expand(block)
+        d2 = ((X * X).sum(axis=1, keepdims=True)
+              - 2.0 * X @ self.centers.T
+              + (self.centers * self.centers).sum(axis=1)[None, :])
+        return {"cluster": np.argmin(d2, axis=1).astype(np.int32),
+                "dist2": d2.min(axis=1)}
+
+
+class DeepLearningScorer:
+    def __init__(self, bundle):
+        meta = bundle.scorer["meta"]
+        a = bundle.arrays
+        self.layers = [(np.asarray(a[f"W{i}"], np.float64),
+                        np.asarray(a[f"b{i}"], np.float64))
+                       for i in range(int(meta["n_layers"]))]
+        self.activation = meta["activation"]
+        self.nclasses = int(meta["nclasses"])
+        self.autoencoder = bool(meta["autoencoder"])
+        self.di = DataInfoExpander(meta["dinfo"])
+
+    def _act(self, x: np.ndarray) -> np.ndarray:
+        base = self.activation.replace("withdropout", "")
+        if base == "tanh":
+            return np.tanh(x)
+        if base == "rectifier":
+            return np.maximum(x, 0.0)
+        if base == "maxout":
+            return np.maximum(x, 0.5 * x)
+        raise ValueError(f"unknown activation {self.activation!r}")
+
+    def raw_predict(self, block: ColumnBlock) -> Dict[str, np.ndarray]:
+        X = self.di.expand(block)
+        h = X
+        for W, b in self.layers[:-1]:
+            h = self._act(h @ W + b)
+        W, b = self.layers[-1]
+        out = h @ W + b
+        if self.autoencoder:
+            err = np.mean((out - X) ** 2, axis=-1)
+            return {"reconstruction": out, "score": err, "value": err}
+        if self.nclasses > 1:
+            return {"probs": _softmax(out)}
+        return {"value": out[:, 0]}
+
+
+_TREE_ALGOS = {"gbm", "drf", "isolationforest", "xgboost"}
+
+
+def build_scorer(bundle):
+    algo = bundle.algo
+    if algo in _TREE_ALGOS:
+        return TreeScorer(bundle)
+    if algo == "glm":
+        return GlmScorer(bundle)
+    if algo == "kmeans":
+        return KMeansScorer(bundle)
+    if algo == "deeplearning":
+        return DeepLearningScorer(bundle)
+    raise ValueError(f"h2o3_genmodel cannot score algo {algo!r}")

@@ -1,0 +1,257 @@
+"""Standalone genmodel runtime: in-framework predictions must match the
+numpy-only h2o3_genmodel scorer on the SAME mojo, including in a subprocess
+that cannot import h2o3_tpu at all.
+
+Reference contract: hex/genmodel/easy/EasyPredictModelWrapper.java:1 (row
+scoring), hex/genmodel/tools/PredictCsv.java:1 (CLI), MojoModel.java:1
+(artifact loading) — the dependency-free scoring product (VERDICT r3 #2).
+"""
+
+import csv
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def data(cl):
+    rng = np.random.default_rng(5)
+    n = 900
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    g = np.array(["a", "b", "c", "d"])[rng.integers(0, 4, n)]
+    logit = 1.2 * x1 - x2 + (g == "a") * 1.0 - (g == "d") * 0.7
+    ybin = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "Y", "N")
+    ymul = np.array(["p", "q", "r"])[
+        np.argmax(np.column_stack([x1, x2, -x1 - x2])
+                  + rng.normal(0, .4, (n, 3)), axis=1)]
+    yreg = logit + 0.2 * rng.normal(size=n)
+    fr = Frame()
+    fr.add("x1", Column.from_numpy(x1))
+    fr.add("x2", Column.from_numpy(x2))
+    fr.add("g", Column.from_numpy(g, ctype="enum"))
+    fr.add("ybin", Column.from_numpy(ybin, ctype="enum"))
+    fr.add("ymul", Column.from_numpy(ymul, ctype="enum"))
+    fr.add("yreg", Column.from_numpy(yreg))
+    raw = {"x1": x1, "x2": x2, "g": g}
+    return fr, raw
+
+
+def _compare(model, fr, raw, atol=1e-5):
+    import h2o3_genmodel as gm
+
+    from h2o3_tpu.models import mojo
+
+    pred = gm.load_mojo(mojo.export_mojo_bytes(model))
+    got = pred.score(raw)
+    want = model.predict(fr)
+    for name in want.names:
+        if name not in got:
+            continue
+        col = want.col(name)
+        a = np.asarray(col.to_numpy())
+        if col.domain:                 # cat columns yield codes: decode
+            a = np.asarray(col.domain, object)[a.astype(int)]
+        b = np.asarray(got[name])
+        if a.dtype.kind in "fc" and b.dtype.kind in "fc":
+            np.testing.assert_allclose(a.astype(float), b.astype(float),
+                                       atol=atol, rtol=1e-5)
+        else:
+            assert (a.astype(str) == b.astype(str)).all(), name
+    return pred
+
+
+def test_gbm_binomial_matches(data, cl):
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    fr, raw = data
+    m = GBM(ntrees=10, max_depth=4, seed=1).train(
+        x=["x1", "x2", "g"], y="ybin", training_frame=fr)
+    pred = _compare(m, fr, raw)
+    one = pred.predict({"x1": 0.5, "x2": -1.0, "g": "a"})
+    assert one.label in ("Y", "N")
+    assert abs(sum(one.class_probabilities) - 1.0) < 1e-6
+
+
+def test_gbm_multinomial_matches(data, cl):
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    fr, raw = data
+    m = GBM(ntrees=8, max_depth=3, seed=2).train(
+        x=["x1", "x2", "g"], y="ymul", training_frame=fr)
+    _compare(m, fr, raw)
+
+
+def test_gbm_poisson_matches(data, cl):
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    fr, raw = data
+    rng = np.random.default_rng(0)
+    fr2 = Frame()
+    for nm in ("x1", "x2", "g"):
+        fr2.add(nm, fr.col(nm))
+    fr2.add("cnt", Column.from_numpy(
+        rng.poisson(np.exp(0.3 * fr.col("x1").to_numpy())).astype(float)))
+    m = GBM(ntrees=6, max_depth=3, seed=3, distribution="poisson").train(
+        x=["x1", "x2", "g"], y="cnt", training_frame=fr2)
+    _compare(m, fr2, raw)
+
+
+def test_drf_binomial_and_regression_match(data, cl):
+    from h2o3_tpu.models.tree.drf import DRF
+
+    fr, raw = data
+    m = DRF(ntrees=10, max_depth=6, seed=1).train(
+        x=["x1", "x2", "g"], y="ybin", training_frame=fr)
+    _compare(m, fr, raw)
+    r = DRF(ntrees=8, max_depth=6, seed=2).train(
+        x=["x1", "x2", "g"], y="yreg", training_frame=fr)
+    _compare(r, fr, raw)
+
+
+def test_drf_multinomial_matches(data, cl):
+    from h2o3_tpu.models.tree.drf import DRF
+
+    fr, raw = data
+    m = DRF(ntrees=6, max_depth=5, seed=4).train(
+        x=["x1", "x2", "g"], y="ymul", training_frame=fr)
+    _compare(m, fr, raw)
+
+
+def test_isolation_forest_matches(data, cl):
+    from h2o3_tpu.models.tree.isofor import IsolationForest
+
+    fr, raw = data
+    m = IsolationForest(ntrees=20, seed=1).train(training_frame=fr,
+                                                 x=["x1", "x2", "g"])
+    _compare(m, fr, raw)
+
+
+def test_xgboost_matches(data, cl):
+    from h2o3_tpu.models.xgboost import XGBoost
+
+    fr, raw = data
+    m = XGBoost(ntrees=8, max_depth=4, seed=1).train(
+        x=["x1", "x2", "g"], y="ybin", training_frame=fr)
+    _compare(m, fr, raw)
+
+
+def test_glm_binomial_and_regression_match(data, cl):
+    from h2o3_tpu.models.glm import GLM
+
+    fr, raw = data
+    m = GLM(family="binomial").train(x=["x1", "x2", "g"], y="ybin",
+                                     training_frame=fr)
+    _compare(m, fr, raw)
+    r = GLM(family="gaussian").train(x=["x1", "x2", "g"], y="yreg",
+                                     training_frame=fr)
+    _compare(r, fr, raw)
+
+
+def test_glm_multinomial_matches(data, cl):
+    from h2o3_tpu.models.glm import GLM
+
+    fr, raw = data
+    m = GLM(family="multinomial").train(x=["x1", "x2", "g"], y="ymul",
+                                        training_frame=fr)
+    _compare(m, fr, raw)
+
+
+def test_kmeans_matches(data, cl):
+    from h2o3_tpu.models.kmeans import KMeans
+
+    fr, raw = data
+    m = KMeans(k=3, seed=1).train(training_frame=fr, x=["x1", "x2"])
+    _compare(m, fr, {"x1": raw["x1"], "x2": raw["x2"]})
+
+
+def test_deeplearning_matches(data, cl):
+    from h2o3_tpu.models.deeplearning import DeepLearning
+
+    fr, raw = data
+    m = DeepLearning(hidden=[8, 8], epochs=3, seed=1).train(
+        x=["x1", "x2", "g"], y="ybin", training_frame=fr)
+    _compare(m, fr, raw, atol=1e-4)
+
+
+def test_unseen_level_and_missing_column_score_as_na(data, cl):
+    """EasyPredictModelWrapper contract: unknown categorical levels and
+    absent columns do not crash — they score through the NA path."""
+    import h2o3_genmodel as gm
+
+    from h2o3_tpu.models import mojo
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    fr, raw = data
+    m = GBM(ntrees=5, max_depth=3, seed=1).train(
+        x=["x1", "x2", "g"], y="ybin", training_frame=fr)
+    pred = gm.load_mojo(mojo.export_mojo_bytes(m))
+    one = pred.predict({"x1": 0.1, "x2": 0.2, "g": "NEVER_SEEN"})
+    assert one.label in ("Y", "N")
+    two = pred.predict({"x1": 0.1})        # x2 and g missing entirely
+    assert two.label in ("Y", "N")
+
+
+def test_predictcsv_subprocess_no_framework(data, tmp_path, cl):
+    """The PredictCsv CLI must run where h2o3_tpu does NOT exist: copy
+    h2o3_genmodel alone into a tmp dir, clear PYTHONPATH down to it, verify
+    `import h2o3_tpu` fails there, and check predictions byte-match the
+    server-side scorer (VERDICT r3 'Done =' criterion)."""
+    from h2o3_tpu.models import mojo
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    fr, raw = data
+    m = GBM(ntrees=8, max_depth=4, seed=1).train(
+        x=["x1", "x2", "g"], y="ybin", training_frame=fr)
+    mz = tmp_path / "model.zip"
+    mz.write_bytes(mojo.export_mojo_bytes(m))
+
+    iso = tmp_path / "iso"
+    iso.mkdir()
+    shutil.copytree(os.path.join(REPO, "h2o3_genmodel"),
+                    iso / "h2o3_genmodel")
+    csv_in = tmp_path / "in.csv"
+    with open(csv_in, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["x1", "x2", "g"])
+        for i in range(len(raw["x1"])):
+            w.writerow([raw["x1"][i], raw["x2"][i], raw["g"][i]])
+    csv_out = tmp_path / "out.csv"
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PYTHONPATH",)}
+    env["PYTHONPATH"] = str(iso)
+    env["PYTHONSAFEPATH"] = "1"          # no cwd fallback onto the repo
+    env.setdefault("PALLAS_AXON_POOL_IPS", "")
+    code = (
+        "import sys, importlib.util as u\n"
+        "assert u.find_spec('h2o3_tpu') is None, 'framework leaked in'\n"
+        "from h2o3_genmodel.predict_csv import main\n"
+        f"rc = main(['--mojo', {str(mz)!r}, '--input', {str(csv_in)!r}, "
+        f"'--output', {str(csv_out)!r}])\n"
+        "assert 'jax' not in sys.modules and 'h2o3_tpu' not in sys.modules\n"
+        "sys.exit(rc)\n")
+    proc = subprocess.run([sys.executable, "-c", code], cwd=str(iso),
+                          env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr
+
+    with open(csv_out) as f:
+        rows = list(csv.DictReader(f))
+    want = m.predict(fr)
+    pc = want.col("predict")
+    wl = np.asarray(pc.domain, object)[
+        np.asarray(pc.to_numpy()).astype(int)].astype(str)
+    wp = np.asarray(want.col("Y").to_numpy()).astype(float)
+    assert len(rows) == len(wl)
+    got_l = np.asarray([r["predict"] for r in rows])
+    got_p = np.asarray([float(r["Y"]) for r in rows])
+    assert (got_l == wl).all()
+    np.testing.assert_allclose(got_p, wp, atol=1e-5, rtol=1e-5)
