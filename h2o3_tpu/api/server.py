@@ -503,6 +503,9 @@ def h_frame_summary(ctx: Ctx):
 
 def h_frame_delete(ctx: Ctx):
     DKV.remove(ctx.params["frame_id"])
+    from h2o3_tpu.api import routes_ext
+
+    routes_ext.purge_metrics(frame_key=ctx.params["frame_id"])
     return {"__meta": S.meta("FramesV3")}
 
 
@@ -668,6 +671,9 @@ def h_model_get(ctx: Ctx):
 
 def h_model_delete(ctx: Ctx):
     DKV.remove(ctx.params["model_id"])
+    from h2o3_tpu.api import routes_ext
+
+    routes_ext.purge_metrics(model_key=ctx.params["model_id"])
     return {"__meta": S.meta("ModelsV3")}
 
 
@@ -1059,6 +1065,9 @@ def h_model_metrics(ctx: Ctx):
     mm = m.model_performance(fr)
     out = []
     if mm is not None:
+        from h2o3_tpu.api import routes_ext
+
+        routes_ext.record_metrics(str(m.key), str(fr.key), mm)
         out.append(S.metrics_v3(mm, str(m.key), str(fr.key)))
     return {"__meta": S.meta("ModelMetricsListSchemaV3"), "model_metrics": out}
 
@@ -1287,6 +1296,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._parse_multipart(raw, ctype)
         if "json" in ctype:
             return json.loads(raw.decode())
+        if "octet-stream" in ctype or "zip" in ctype:
+            return {"__raw__": raw}
         out: Dict[str, Any] = {}
         for k, vs in parse_qs(raw.decode(), keep_blank_values=True).items():
             out[k] = vs[0]
@@ -1467,3 +1478,21 @@ class ApiServer:
 def start_server(port: int = 54321, auth_file: Optional[str] = None,
                  host: Optional[str] = None) -> ApiServer:
     return ApiServer(port, auth_file=auth_file, host=host).start()
+
+
+# ---------------------------------------------------------------------------
+# extended surface (routes_ext.py) — appended after every server name exists
+# so dispatch and /3/Metadata/endpoints see the full table. If routes_ext
+# was imported FIRST (it is mid-import here and `register` not yet defined),
+# its own module bottom self-registers + recompiles instead.
+# ---------------------------------------------------------------------------
+from h2o3_tpu.api import routes_ext as _ext  # noqa: E402
+
+if hasattr(_ext, "register"):
+    _ext.register(ROUTES, {"h_model_mojo": h_model_mojo,
+                           "h_importfiles": h_importfiles,
+                           "h_pdp_post": h_pdp_post,
+                           "h_pdp_get": h_pdp_get,
+                           "h_modelbuilder_train": h_modelbuilder_train,
+                           "h_session_end_legacy": h_session_end})
+    _COMPILED = _compile_routes()

@@ -202,3 +202,106 @@ class H2OAssembly:
             if ver > H2OAssembly._SAVE_VERSION:
                 raise ValueError(f"assembly artifact version {ver} too new")
             return pickle.load(f)
+
+    # -- REST wire format (h2o-py transform_base.to_rest) ----------------
+    @staticmethod
+    def from_steps(step_strings: Sequence[str]) -> "H2OAssembly":
+        """Decode the POST /99/Assembly `steps` payload: each entry is
+        `name__ClassName__(rapids ast over 'dummy')__inplace__newcols`
+        (h2o-py transforms/transform_base.py to_rest; server counterpart
+        water/rapids/transforms/H2OColOp.java:28)."""
+        steps: List[Tuple[str, Any]] = []
+        for raw in step_strings:
+            s = str(raw).strip().strip('"').strip("'")
+            parts = s.split("__")
+            if len(parts) < 5:
+                raise ValueError(f"bad assembly step {s!r}")
+            name, klass, ast, inplace, newcols = (
+                parts[0], parts[1], "__".join(parts[2:-2]),
+                parts[-2], parts[-1])
+            new_names = [c for c in newcols.split("|") if c]
+            steps.append((name, RestStep(
+                klass, ast, inplace.strip().lower() == "true", new_names)))
+        return H2OAssembly(steps)
+
+    def describe(self) -> List[str]:
+        return [f"{n}: {getattr(s, 'describe', lambda: type(s).__name__)()}"
+                for n, s in self.steps]
+
+    def to_source(self, name: str = "MungePipeline") -> str:
+        """Self-contained replay source (the reference emits a Java munging
+        POJO via GET /99/Assembly.java; we emit the equivalent pipeline as
+        commented Rapids so any client of this server can replay it)."""
+        lines = [f"// {name} — munging pipeline export (h2o3_tpu)",
+                 "// Replay: POST each Rapids expression below with the",
+                 "// target frame id substituted for 'dummy'."]
+        for n, s in self.steps:
+            lines.append(f"// step {n}")
+            lines.append(getattr(s, "ast", f"(noop {type(s).__name__})"))
+        return "\n".join(lines) + "\n"
+
+
+class RestStep:
+    """One wire-decoded munging step, with the reference's column-splice
+    semantics (water/rapids/transforms/H2OColOp.java transformImpl:
+    substitute the frame, exec the ast, then replace/append columns)."""
+
+    def __init__(self, klass: str, ast: str, inplace: bool,
+                 new_names: List[str]):
+        self.klass = klass
+        self.ast = ast
+        self.inplace = inplace
+        self.new_names = new_names
+
+    def describe(self) -> str:
+        return f"{self.klass}(inplace={self.inplace}) {self.ast}"
+
+    def _old_col(self) -> Optional[str]:
+        import re
+
+        m = re.search(r"\(cols(?:_py)?\s+dummy\s+'([^']+)'\)", self.ast) or \
+            re.search(r'\(cols(?:_py)?\s+dummy\s+"([^"]+)"\)', self.ast)
+        return m.group(1) if m else None
+
+    def _exec(self, fr: Frame):
+        import re
+
+        from h2o3_tpu.rapids import exec_rapids
+
+        expr = re.sub(r"\bdummy\b", str(fr.key), self.ast)
+        return exec_rapids(expr)
+
+    def fit_transform(self, fr: Frame) -> Frame:
+        return self.transform(fr)
+
+    def transform(self, fr: Frame) -> Frame:
+        fr.install()
+        res = self._exec(fr)
+        if self.klass == "H2OColSelect":
+            return res if isinstance(res, Frame) else fr
+        old = self._old_col()
+        out = Frame()
+        res_cols = (list(res.names) if isinstance(res, Frame) else [None])
+        if isinstance(res, Frame) and len(res_cols) == 1:
+            new_col = res.col(res_cols[0])
+            if self.inplace and old is not None:
+                for nm in fr.names:
+                    out.add(nm, new_col if nm == old else fr.col(nm))
+            else:
+                for nm in fr.names:
+                    out.add(nm, fr.col(nm))
+                nm = self.new_names[0] if self.new_names else \
+                    f"{old or 'col'}0"
+                out.add(nm, new_col)
+            return out
+        if isinstance(res, Frame):       # multi-column result
+            for nm in fr.names:
+                if self.inplace and nm == old:
+                    continue
+                out.add(nm, fr.col(nm))
+            for i, rn in enumerate(res_cols):
+                nm = (self.new_names[i] if i < len(self.new_names)
+                      else f"{old or 'col'}{i}")
+                out.add(nm, res.col(rn))
+            return out
+        return fr

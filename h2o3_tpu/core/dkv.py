@@ -150,8 +150,20 @@ class _DKV:
         with self._lock:
             return self._rw.setdefault(str(key), threading.RLock())
 
+    def unlock_all(self) -> int:
+        """Drop every per-key lock object (water/api/UnlockTask: force-
+        unlock all Lockables after a failed job). Returns count dropped."""
+        with self._lock:
+            n = len(self._rw)
+            self._rw.clear()
+            return n
+
 
 DKV = _DKV()
+
+
+def unlock_all() -> int:
+    return DKV.unlock_all()
 
 
 class Scope:

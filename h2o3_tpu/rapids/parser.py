@@ -43,7 +43,11 @@ class _Reader:
         self.i = 0
 
     def peek(self):
-        while self.i < len(self.s) and self.s[self.i].isspace():
+        # ',' counts as whitespace, matching the reference tokenizer
+        # (water/rapids/Rapids.java skipWS) — h2o-py emits %r-style lists
+        # like ['a','b'] in Assembly step ASTs
+        while self.i < len(self.s) and (self.s[self.i].isspace()
+                                        or self.s[self.i] == ","):
             self.i += 1
         return self.s[self.i] if self.i < len(self.s) else ""
 
@@ -55,7 +59,7 @@ class _Reader:
     def token(self) -> str:
         self.peek()
         j = self.i
-        while j < len(self.s) and not self.s[j].isspace() and self.s[j] not in "()[]{}'\"":
+        while j < len(self.s) and not self.s[j].isspace() and self.s[j] not in "()[]{},'\"":
             j += 1
         tok = self.s[self.i:j]
         self.i = j
