@@ -10,7 +10,8 @@ Watchdog design (round-4 fix): the driver runs `python bench.py` under its
 own ~1500 s timeout. Every stage that touches jax runs in a SUBPROCESS with
 its own hard timeout, and the stage budgets sum to ~1100 s so the parent
 always gets to print its JSON line before the driver's outer timeout:
-  1. flagship GBM bench (default env, real chip if tunnel is up) .. 700 s
+  1. flagship GBM bench (default env, real chip if tunnel is up) .. 650 s
+  1b. depth-20 DRF secondary metric (own stage, only after 1 OK) .. 180 s
   2. GLM IRLS fallback (default env) ............................. 200 s
   3. GLM IRLS on CPU, bypassing the axon tunnel entirely ......... 180 s
 The parent NEVER imports jax: a wedged accelerator tunnel hangs jax import
@@ -107,7 +108,16 @@ _GLM_SNIPPET = ("import bench; "
 
 
 def main():
-    got = _stage([sys.executable, "-m", "h2o3_tpu.bench"], 700)
+    got = _stage([sys.executable, "-m", "h2o3_tpu.bench"], 650)
+    if got is not None:
+        # secondary metric in its OWN stage so a slow/hung DRF bench can
+        # never take the flagship result down with it
+        extra = _stage([sys.executable, "-m", "h2o3_tpu.bench"], 180,
+                       env_extra={"H2O3_BENCH_ONLY": "drf"})
+        if extra is not None:
+            print(json.dumps({"metric": extra[1], "value": round(extra[0], 1),
+                              "unit": "rows/sec/chip", "secondary": True}),
+                  file=sys.stderr)
     if got is None:  # flagship failed/hung: GLM fallback, still default env
         got = _stage([sys.executable, "-c", _GLM_SNIPPET], 200)
     unit = "rows/sec/chip"

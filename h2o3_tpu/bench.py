@@ -45,11 +45,42 @@ def run_flagship(n_rows: int = 1_000_000, n_num: int = 8, n_cat: int = 2,
     return n_rows * ntrees / dt, "gbm_rows_per_sec"
 
 
+def run_drf_deep(n_rows: int = 200_000, ntrees: int = 5,
+                 max_depth: int = 20):
+    """Secondary metric: depth-20 DRF (the dense-frontier deep grower) —
+    rows × trees / wallclock, recorded alongside the flagship."""
+    import h2o3_tpu
+    from h2o3_tpu.core.frame import Column, Frame
+    from h2o3_tpu.models.tree.drf import DRF
+
+    h2o3_tpu.init()
+    rng = np.random.default_rng(1)
+    fr = Frame()
+    logit = np.zeros(n_rows)
+    for i in range(6):
+        x = rng.standard_normal(n_rows)
+        logit += x * rng.uniform(-1, 1)
+        fr.add(f"n{i}", Column.from_numpy(x))
+    y = np.where(rng.random(n_rows) < 1 / (1 + np.exp(-logit)), "Y", "N")
+    fr.add("y", Column.from_numpy(y, ctype="enum"))
+    DRF(ntrees=1, max_depth=max_depth, seed=1).train(
+        y="y", training_frame=fr)            # warm compile
+    t0 = time.perf_counter()
+    DRF(ntrees=ntrees, max_depth=max_depth, seed=1).train(
+        y="y", training_frame=fr)
+    dt = time.perf_counter() - t0
+    return n_rows * ntrees / dt, "drf_deep_rows_per_sec"
+
+
 if __name__ == "__main__":
-    # subprocess entry for the watchdog in the repo-root bench.py
+    # subprocess entry for the watchdog in the repo-root bench.py; the DRF
+    # secondary metric runs as its OWN watchdog stage (H2O3_BENCH_ONLY=drf)
     import os
 
-    value, metric = run_flagship(
-        n_rows=int(os.environ.get("H2O3_BENCH_ROWS", 1_000_000)),
-        ntrees=int(os.environ.get("H2O3_BENCH_TREES", 20)))
+    if os.environ.get("H2O3_BENCH_ONLY") == "drf":
+        value, metric = run_drf_deep()
+    else:
+        value, metric = run_flagship(
+            n_rows=int(os.environ.get("H2O3_BENCH_ROWS", 1_000_000)),
+            ntrees=int(os.environ.get("H2O3_BENCH_TREES", 20)))
     print(f"H2O3_BENCH {metric} {value}", flush=True)

@@ -1,5 +1,5 @@
 """Fully device-side tree growth — histogram, split search, routing and leaf
-statistics in ONE compiled program per tree.
+statistics in ONE compiled program per tree, at ANY depth.
 
 Reference: hex/tree/ScoreBuildHistogram2.java:60 (per-row histogram build,
 CAS adds into DHistogram._vals, DHistogram.java:62-90) + DTree.decideBestSplit
@@ -11,7 +11,7 @@ time (scatter serializes on TPU), and on this environment every device→host
 fetch pays ~60 ms of tunnel latency, so per-level (and even per-tree) syncs
 dominate everything else.
 
-TPU-native redesign (this module):
+TPU-native design (round 3 + the round-4 deep-tree unification):
 - Histograms are MXU matmuls, not scatters:  hist = Oᵀ·V  with
   O (rows, F·maxB) the per-feature bin one-hot and V (rows, 3·S) the
   (w, w·y, w·y²) triples crossed with the node one-hot. Operands are cast
@@ -22,26 +22,38 @@ TPU-native redesign (this module):
   categorical bins are ordered by per-node mean response (argsort) — the
   same sorted-subset optimum the host search computed — numeric bins keep
   natural order via an iota sort key. NA direction is tried both ways.
-- Nodes live at HEAP positions (level-relative slot s → children 2s, 2s+1):
-  no host renumbering between levels; terminal rows record a heap-global
-  leaf id (2^d - 1 + s).
+- DENSE-FRONTIER slots, not heap positions (round 4): level d holds
+  S_d = min(2^d, frontier_cap) slots; nodes that split are renumbered by a
+  device prefix-sum and record explicit child-slot links in their packed
+  row. Memory is O(depth · frontier_cap) instead of O(2^depth), so DRF's
+  default depth 20 runs in the SAME one-dispatch program — no host
+  fallback. When a level wants more than S_{d+1}/2 splits, the lowest-gain
+  candidates terminalize (greedy-best under a width budget; cap via
+  H2O_TPU_FRONTIER_CAP, default 4096).
+- Levels wider than the MXU sweet spot (S > 1024) switch the histogram to
+  a blocked scatter-add: O(N·F) work per level — the matmul's O(N·F·B·S)
+  FLOPs stop being free once the node one-hot is thousands wide. Shallow
+  levels (where the flagship bench lives) keep the matmul path untouched.
 - The GammaPass inputs (num, den) are computed BEFORE the tree from
   (w, y, z, f) and segment-summed per leaf inside the same program, so leaf
   Newton steps need no extra dispatch.
-- All per-level tables pack into ONE (depth+1, S_max, 4+maxB+3) f32 array;
-  training keeps it on device and fetches every tree's tables in a single
-  end-of-training transfer (one ~60 ms tunnel round-trip total, not one per
-  level per tree).
+- All per-level tables pack into ONE (depth+1, S_max, 4+maxB+3+2) f32
+  array; training keeps it on device and fetches every tree's tables in a
+  single end-of-training transfer (one ~60 ms tunnel round-trip total, not
+  one per level per tree).
 """
 
 from __future__ import annotations
 
 import functools
+import os
 from typing import List, Optional, Tuple
 
 import numpy as np
 
 EPS_W = 1e-12
+MATMUL_S_LIMIT = 1024       # widest node one-hot the MXU path should carry
+DEFAULT_FRONTIER_CAP = 4096
 
 
 def _mesh():
@@ -50,8 +62,59 @@ def _mesh():
     return cluster().mesh
 
 
-def heap_size(max_depth: int) -> int:
-    return 2 ** (max_depth + 1) - 1
+def frontier_cap(F: Optional[int] = None, maxB: Optional[int] = None) -> int:
+    """Frontier width budget. With feature geometry given, the cap shrinks
+    so the scatter histogram buffer (S·F·maxB·3 f32) stays under ~512 MB —
+    a >=1024-level enum would otherwise blow HBM at the env default."""
+    cap = int(os.environ.get("H2O_TPU_FRONTIER_CAP", DEFAULT_FRONTIER_CAP))
+    if F and maxB:
+        budget_slots = (512 * 1024 * 1024) // (F * maxB * 12)
+        mem_cap = 1 << max(int(budget_slots).bit_length() - 1, 8)
+        cap = min(cap, mem_cap)
+    return cap
+
+
+def stash_packed(packed, max_depth: int):
+    """Fit loops hold every tree's packed table until the end-of-training
+    fetch. Shallow tables are tiny; deep ones (cap-wide levels) are fetched
+    to HOST immediately so a 50-tree depth-20 forest cannot OOM the chip —
+    one small transfer per deep tree instead of ~17 GB resident."""
+    if max_depth > 10:
+        return np.asarray(packed)
+    return packed
+
+
+def build_feat_masks(max_depth: int, feat_mask_fn, F: Optional[int] = None,
+                     maxB: Optional[int] = None):
+    """Per-level (S_d, F) column-sampling masks for grow_tree_device."""
+    if feat_mask_fn is None:
+        return None
+    widths = level_widths(max_depth, frontier_cap(F, maxB))
+    return [np.asarray(feat_mask_fn(wd), bool) for wd in widths[:max_depth]]
+
+
+def level_widths(max_depth: int, cap: Optional[int] = None) -> Tuple[int, ...]:
+    """Per-level slot counts S_d = min(2^d, cap)."""
+    cap = cap or frontier_cap()
+    return tuple(min(2 ** d, cap) for d in range(max_depth + 1))
+
+
+def level_offsets(widths: Tuple[int, ...]) -> Tuple[int, ...]:
+    out, acc = [], 0
+    for s in widths:
+        out.append(acc)
+        acc += s
+    return tuple(out)
+
+
+def total_slots(max_depth: int, cap: Optional[int] = None) -> int:
+    return sum(level_widths(max_depth, cap))
+
+
+def pack_width(maxB: int) -> int:
+    """Per-slot f32 lanes: split_feat, thresh, na_left, gain, left_table
+    (maxB), tot (3), left_slot, right_slot."""
+    return 4 + maxB + 3 + 2
 
 
 # ---------------------------------------------------------------------------
@@ -143,16 +206,10 @@ def _search_level(hist, *, nbins, is_cat, maxB, min_rows, min_split_improvement,
 # the per-tree program
 # ---------------------------------------------------------------------------
 
-def pack_width(maxB: int) -> int:
-    """Per-slot f32 lanes: split_feat, thresh, na_left, gain, left_table
-    (maxB), tot (3)."""
-    return 4 + maxB + 3
-
-
 @functools.lru_cache(maxsize=32)
 def _grow_fn(max_depth: int, F: int, maxB: int, nbins: tuple, is_cat: tuple,
              min_rows: float, min_split_improvement: float,
-             has_masks: bool, mesh, n_shard: int, blk: int,
+             has_masks: bool, mesh, n_shard: int, blk: int, cap: int,
              use_pallas: bool = False):
     import jax
     import jax.numpy as jnp
@@ -160,12 +217,13 @@ def _grow_fn(max_depth: int, F: int, maxB: int, nbins: tuple, is_cat: tuple,
 
     nblk = -(-n_shard // blk)
     pad_to = nblk * blk
-    L = heap_size(max_depth)                   # heap leaf-id space
-    Lp = max(1 << (L - 1).bit_length(), 1)
-    Smax = 2 ** max_depth
+    widths = level_widths(max_depth, cap)
+    offs = level_offsets(widths)
+    tot_slots = sum(widths)
+    Smax = max(widths)
     K = pack_width(maxB)
 
-    def hist_level(binned, row_node, live, w, y, S):
+    def hist_matmul(binned, row_node, live, w, y, S):
         """(S, F, maxB, 3) via blocked bf16 one-hot matmul + psum. With
         H2O_TPU_PALLAS_HIST set, the block loop runs as the fused Pallas
         kernel (pallas_hist.py) that never materializes the one-hots in
@@ -205,23 +263,31 @@ def _grow_fn(max_depth: int, F: int, maxB: int, nbins: tuple, is_cat: tuple,
         acc = jax.lax.psum(acc, "rows")
         return acc.reshape(F, maxB, S, 3).transpose(2, 0, 1, 3)
 
+    def hist_scatter(binned, row_node, live, w, y, S):
+        """(S, F, maxB, 3) via scatter-add — O(N·F) per level, the right
+        asymptotics once the frontier is thousands wide (deep DRF levels);
+        the matmul path's O(N·F·B·S) FLOPs stop being free there."""
+        node = jnp.where(live, row_node, S)               # dead rows → pad slot
+        base = (node[:, None] * F + jnp.arange(F)[None, :]) * maxB + binned
+        w_live = jnp.where(live, w, 0.0)
+        vals = jnp.stack([w_live, w_live * y, w_live * y * y], -1)  # (n, 3)
+        acc0 = jax.lax.pcast(jnp.zeros(((S + 1) * F * maxB, 3), jnp.float32),
+                             ("rows",), to="varying")
+        acc = acc0.at[base.reshape(-1)].add(
+            jnp.broadcast_to(vals[:, None, :],
+                             (vals.shape[0], F, 3)).reshape(-1, 3))
+        acc = jax.lax.psum(acc, "rows")
+        return acc[: S * F * maxB].reshape(S, F, maxB, 3)
+
     def leaf_sums(row_leaf, cols):
-        """(Lp, C) per-heap-leaf sums of the given row columns (n, C);
-        f32 one-hot matmul (exact accumulation for the Newton steps)."""
-        C = cols.shape[1]
-
-        def body(i, acc):
-            sl = lambda a: jax.lax.dynamic_slice_in_dim(a, i * blk, blk, 0)
-            lb = sl(row_leaf)
-            vb = sl(cols)
-            oh = jax.nn.one_hot(jnp.maximum(lb, 0), Lp, dtype=jnp.float32)
-            oh = oh * (lb >= 0)[:, None]
-            return acc + jnp.dot(oh.T, vb, preferred_element_type=jnp.float32)
-
-        acc0 = jax.lax.pcast(jnp.zeros((Lp, C), jnp.float32), ("rows",),
-                             to="varying")
-        acc = jax.lax.fori_loop(0, nblk, body, acc0)
-        return jax.lax.psum(acc, "rows")
+        """(tot_slots, C) per-leaf sums (scatter; O(N) at any tree size)."""
+        idx = jnp.where(row_leaf >= 0, row_leaf, tot_slots)
+        idx = jnp.minimum(idx, tot_slots)
+        acc0 = jax.lax.pcast(
+            jnp.zeros((tot_slots + 1, cols.shape[1]), jnp.float32),
+            ("rows",), to="varying")
+        acc = acc0.at[idx].add(cols)
+        return jax.lax.psum(acc, "rows")[:tot_slots]
 
     def tree_program(binned, w, y, num, den, masks):
         n = binned.shape[0]
@@ -244,14 +310,15 @@ def _grow_fn(max_depth: int, F: int, maxB: int, nbins: tuple, is_cat: tuple,
         row_node = jnp.zeros(pad_to, jnp.int32)
         row_leaf = jnp.full(pad_to, -1, jnp.int32)
         if pad_to != n:        # pad rows are immediately dead
-            row_leaf = row_leaf.at[n:].set(L)     # off-range sentinel
+            row_leaf = row_leaf.at[n:].set(tot_slots)   # off-range sentinel
 
         packed = jnp.zeros((max_depth + 1, Smax, K), jnp.float32)
         for d in range(max_depth + 1):
-            S = 2 ** d
+            S = widths[d]
             live = row_leaf < 0
             if d < max_depth:
-                hist = hist_level(binned, row_node, live, w, yc, S)
+                hist_fn = hist_matmul if S <= MATMUL_S_LIMIT else hist_scatter
+                hist = hist_fn(binned, row_node, live, w, yc, S)
                 fm = masks[d] if has_masks else None
                 (split_feat, t_star, na_left, gain,
                  left_table, tot) = _search_level(
@@ -267,6 +334,28 @@ def _grow_fn(max_depth: int, F: int, maxB: int, nbins: tuple, is_cat: tuple,
                 left_table = jnp.zeros((S, maxB), bool)
                 tot = jnp.zeros((S, 3), jnp.float32)
 
+            # frontier budget: keep at most S_{d+1}//2 splits, best-gain
+            # first; the rest terminalize (greedy-best under the cap)
+            if d < max_depth:
+                S_next = widths[d + 1]
+                want = split_feat >= 0
+                if 2 * S > S_next:          # cap can bind at this level
+                    max_splits = S_next // 2
+                    order = jnp.argsort(-jnp.where(want, gain, -jnp.inf))
+                    rank = jnp.argsort(order)
+                    keep = want & (rank < max_splits)
+                else:
+                    keep = want
+                split_feat = jnp.where(keep, split_feat, -1)
+                gain = jnp.where(keep, gain, 0.0)
+                ki = keep.astype(jnp.int32)
+                excl = jnp.cumsum(ki) - ki
+                left_slot = jnp.where(keep, 2 * excl, -1)
+                right_slot = jnp.where(keep, 2 * excl + 1, -1)
+            else:
+                left_slot = jnp.full(S, -1, jnp.int32)
+                right_slot = jnp.full(S, -1, jnp.int32)
+
             # de-center the recorded node totals back to true y space
             # (wy = wy_c + w·ȳ; wyy = wyy_c + 2ȳ·wy_c + ȳ²·w)
             tot_true = jnp.stack(
@@ -280,24 +369,26 @@ def _grow_fn(max_depth: int, F: int, maxB: int, nbins: tuple, is_cat: tuple,
                  na_left.astype(jnp.float32)[:, None],
                  gain[:, None],
                  left_table.astype(jnp.float32),
-                 tot_true], axis=1)                  # (S, K)
+                 tot_true,
+                 left_slot.astype(jnp.float32)[:, None],
+                 right_slot.astype(jnp.float32)[:, None]], axis=1)  # (S, K)
             packed = packed.at[d, :S, :].set(row)
 
             node = row_node
             terminal = split_feat[node] < 0
-            heap_id = (S - 1) + node
-            row_leaf = jnp.where(live & terminal, heap_id, row_leaf)
+            gid = offs[d] + node
+            row_leaf = jnp.where(live & terminal, gid, row_leaf)
             f_sel = jnp.maximum(split_feat[node], 0)
             b = jnp.take_along_axis(binned, f_sel[:, None], axis=1)[:, 0]
             gl = left_table[node, jnp.minimum(b, maxB - 1)]
-            row_node = jnp.where(live & ~terminal,
-                                 2 * node + (1 - gl.astype(jnp.int32)),
-                                 0)
+            row_node = jnp.where(
+                live & ~terminal,
+                jnp.where(gl, left_slot[node], right_slot[node]), 0)
 
         cols = jnp.stack([w, w * y, num, den], axis=-1)
         leaf4 = leaf_sums(row_leaf, cols)
-        row_leaf = jnp.where(row_leaf >= L, -1, row_leaf)   # clear pad sentinel
-        return packed, leaf4[:L], row_leaf[:n]
+        row_leaf = jnp.where(row_leaf >= tot_slots, -1, row_leaf)  # clear pad
+        return packed, leaf4, row_leaf[:n]
 
     in_specs = (P("rows", None), P("rows"), P("rows"), P("rows"), P("rows"),
                 tuple(P() for _ in range(max_depth)) if has_masks else P())
@@ -330,14 +421,15 @@ def grow_tree_device(binned, w, y, spec, *, max_depth: int, min_rows: float,
 
     binned (N, F) int32 row-sharded; w, y, num, den (N,) device (num/den are
     the GammaPass numerator/denominator rows; default num=w·y, den=w).
-    feat_masks: optional per-level (2^d, F) bool arrays, levels
-    0..max_depth-1 (mtries / column sampling).
+    feat_masks: optional per-level (S_d, F) bool arrays, levels
+    0..max_depth-1 (mtries / column sampling) — widths per level_widths().
 
     Returns device arrays (packed, leaf4, row_leaf):
-      packed   — (max_depth+1, 2^max_depth, 4+maxB+3) f32 per-level split
-                 tables (see pack_width)
-      leaf4    — (heap_size, 4) per-heap-leaf sums of (w, w·y, num, den)
-      row_leaf — (N,) int32 heap-global leaf id per row
+      packed   — (max_depth+1, S_max, pack_width(maxB)) f32 per-level split
+                 tables with explicit child-slot links
+      leaf4    — (total_slots, 4) per-leaf sums of (w, w·y, num, den),
+                 indexed by GLOBAL slot id (level offset + slot)
+      row_leaf — (N,) int32 global leaf slot id per row
     """
     import jax.numpy as jnp
 
@@ -352,7 +444,7 @@ def grow_tree_device(binned, w, y, spec, *, max_depth: int, min_rows: float,
     fn = _grow_fn(int(max_depth), F, maxB, tuple(int(b) for b in spec.nbins),
                   tuple(bool(c) for c in spec.is_cat), float(min_rows),
                   float(min_split_improvement), has_masks, mesh, n_shard, blk,
-                  use_pallas=pallas_hist.enabled())
+                  frontier_cap(F, maxB), use_pallas=pallas_hist.enabled())
     w = w.astype(jnp.float32)
     y = y.astype(jnp.float32)
     if num is None:
@@ -372,10 +464,14 @@ def grow_tree_device(binned, w, y, spec, *, max_depth: int, min_rows: float,
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=32)
-def _apply_fn(max_depth: int, maxB: int, mesh):
+def _apply_fn(max_depth: int, maxB: int, mesh, cap: int):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
+
+    widths = level_widths(max_depth, cap)
+    offs = level_offsets(widths)
+    K = pack_width(maxB)
 
     def apply(binned, packed, values):
         """Route rows through the packed tree; -> (n,) leaf values."""
@@ -383,18 +479,20 @@ def _apply_fn(max_depth: int, maxB: int, mesh):
         row_node = jnp.zeros(n, jnp.int32)
         row_leaf = jnp.full(n, -1, jnp.int32)
         for d in range(max_depth + 1):
-            S = 2 ** d
+            S = widths[d]
             split_feat = packed[d, :S, 0].astype(jnp.int32)
             left_table = packed[d, :S, 4:4 + maxB] > 0.5
+            ls = packed[d, :S, K - 2].astype(jnp.int32)
+            rs = packed[d, :S, K - 1].astype(jnp.int32)
             live = row_leaf < 0
             node = row_node
             terminal = split_feat[node] < 0
-            row_leaf = jnp.where(live & terminal, (S - 1) + node, row_leaf)
+            row_leaf = jnp.where(live & terminal, offs[d] + node, row_leaf)
             f_sel = jnp.maximum(split_feat[node], 0)
             b = jnp.take_along_axis(binned, f_sel[:, None], axis=1)[:, 0]
             gl = left_table[node, jnp.minimum(b, maxB - 1)]
             row_node = jnp.where(live & ~terminal,
-                                 2 * node + (1 - gl.astype(jnp.int32)), 0)
+                                 jnp.where(gl, ls[node], rs[node]), 0)
         return values[jnp.maximum(row_leaf, 0)]
 
     fn = jax.shard_map(apply, mesh=mesh,
@@ -405,10 +503,11 @@ def _apply_fn(max_depth: int, maxB: int, mesh):
 
 def apply_packed(binned, packed, values, max_depth: int, maxB: int):
     """Device traversal: (N, F) binned rows -> (N,) leaf values, using a
-    packed tree table and a (heap_size,) leaf-value array."""
+    packed tree table and a (total_slots,) leaf-value array."""
     import jax.numpy as jnp
 
-    fn = _apply_fn(int(max_depth), int(maxB), _mesh())
+    F = binned.shape[1]
+    fn = _apply_fn(int(max_depth), int(maxB), _mesh(), frontier_cap(F, maxB))
     return fn(binned, packed, values.astype(jnp.float32))
 
 
@@ -437,18 +536,21 @@ def host_tree_from_packed(packed_np: np.ndarray, leaf_wy: np.ndarray,
                           leaf_values: Optional[np.ndarray] = None):
     """Assemble a HostTree from one tree's packed table (numpy).
 
-    packed_np (max_depth+1, Smax, K); leaf_wy (heap, 2) = per-heap-leaf
-    (w, w·y); leaf_values optional (heap,) final leaf predictions.
-    Leaf ids are HEAP-GLOBAL — n_leaves is the heap size, so leaf-value
-    arrays index directly by heap id."""
+    packed_np (max_depth+1, S_max, K); leaf_wy (total_slots, 2) = per-leaf
+    (w, w·y); leaf_values optional (total_slots,) final leaf predictions.
+    Leaf ids are GLOBAL slot ids — n_leaves is total_slots, so leaf-value
+    arrays index directly by global slot id."""
     from h2o3_tpu.models.tree.dtree import HostTree, Split
 
     maxB = int(spec.nbins.max())
-    L = heap_size(max_depth)
+    K = pack_width(maxB)
+    cap = frontier_cap(spec.F, maxB)
+    widths = level_widths(max_depth, cap)
+    offs = level_offsets(widths)
     tree = HostTree()
-    tree.n_leaves = L
+    tree.n_leaves = sum(widths)
     slot_nid = {(0, 0): 0}
-    root_tot = packed_np[0, 0, 4 + maxB:]
+    root_tot = packed_np[0, 0, 4 + maxB:4 + maxB + 3]
     tree.nodes[0].weight = float(root_tot[0])
     tree.nodes[0].pred = float(root_tot[1]) / max(float(root_tot[0]), EPS_W)
 
@@ -459,13 +561,13 @@ def host_tree_from_packed(packed_np: np.ndarray, leaf_wy: np.ndarray,
             node = tree.nodes[nid]
             f = int(lv[s, 0])
             if f < 0:
-                heap = (2 ** d - 1) + s
-                node.leaf_id = heap
-                lw, lwy = leaf_wy[heap]
+                gid = offs[d] + s
+                node.leaf_id = gid
+                lw, lwy = leaf_wy[gid]
                 node.weight = float(lw)
                 node.pred = float(lwy) / max(float(lw), EPS_W)
                 if leaf_values is not None:
-                    node.leaf_value = float(leaf_values[heap])
+                    node.leaf_value = float(leaf_values[gid])
                 continue
             Bf = int(spec.nbins[f])
             lt_row = lv[s, 4:4 + maxB] > 0.5
@@ -480,7 +582,7 @@ def host_tree_from_packed(packed_np: np.ndarray, leaf_wy: np.ndarray,
             node.split = sp
             node.left = tree.new_node(d + 1)
             node.right = tree.new_node(d + 1)
-            ls, rs = 2 * s, 2 * s + 1
+            ls, rs = int(lv[s, K - 2]), int(lv[s, K - 1])
             slot_nid[(d + 1, ls)] = node.left
             slot_nid[(d + 1, rs)] = node.right
             if next_lv is not None:
@@ -494,5 +596,3 @@ def host_tree_from_packed(packed_np: np.ndarray, leaf_wy: np.ndarray,
                 sp.right_stats = (float(next_lv[rs, 4 + maxB]),
                                   float(next_lv[rs, 4 + maxB + 1]))
     return tree
-
-

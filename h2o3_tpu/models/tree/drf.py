@@ -133,18 +133,14 @@ class DRF(SharedTree):
         import jax.numpy as jnp
 
         from h2o3_tpu.models.tree.device_tree import (apply_packed,
-                                                      grow_tree_device)
+                                                      build_feat_masks,
+                                                      grow_tree_device,
+                                                      stash_packed)
 
         classification = model._output.model_category == ModelCategory.Binomial
         if classification and self.params.get("binomial_double_trees"):
             return self._fit_multinomial(model, binned, y, w, offset, spec,
                                          2, rng, ntrees)
-        from h2o3_tpu.models.tree.shared_tree import DEVICE_DEPTH_LIMIT
-
-        if int(self.params["max_depth"]) > DEVICE_DEPTH_LIMIT:
-            return self._fit_single_deep(model, binned, y, w, offset, spec,
-                                         dist, rng, ntrees)
-
         N = binned.shape[0]
         mtries = self._mtries(spec.F, classification)
         feat_mask_fn = _node_feat_mask_fn(rng, spec.F, mtries)
@@ -183,8 +179,7 @@ class DRF(SharedTree):
         for t in range(t_start, ntrees):
             mask, w_t = pre(w, root_key, np.int32(t), sample_rate) \
                 if sampling else (None, w)
-            masks = [np.asarray(feat_mask_fn(2 ** d), bool)
-                     for d in range(max_depth)]
+            masks = build_feat_masks(max_depth, feat_mask_fn, spec.F, maxB)
             packed, leaf4, row_leaf = grow_tree_device(
                 binned, w_t, y, spec, max_depth=max_depth, min_rows=min_rows,
                 min_split_improvement=msi, feat_masks=masks)
@@ -194,7 +189,7 @@ class DRF(SharedTree):
             else:
                 ln, ld = leaf4[:, 2], leaf4[:, 3]  # defaults: (w·y, w) sums
                 mean = jnp.where(ld > 1e-12, ln / jnp.maximum(ld, 1e-12), 0.0)
-            packs.append(packed)
+            packs.append(stash_packed(packed, max_depth))
             leaf_means.append(mean)
             leaf_wys.append(leaf4[:, :2])
             if v_sum is not None:
@@ -258,123 +253,14 @@ class DRF(SharedTree):
                 self._oob_raw = ({"value": f}, oob_mask)
         return forest, f
 
-    def _fit_single_deep(self, model, binned, y, w, offset, spec, dist, rng,
-                         ntrees):
-        """Deep-tree fallback: host-orchestrated level loop (host_grow.py),
-        memory O(active nodes) — required at the DRF default max_depth=20."""
-        import jax.numpy as jnp
-
-        from h2o3_tpu.models.tree.histogram import leaf_stats
-        from h2o3_tpu.models.tree.host_grow import grow_tree_host
-
-        classification = model._output.model_category == ModelCategory.Binomial
-        N = binned.shape[0]
-        mtries = self._mtries(spec.F, classification)
-        feat_mask_fn = _node_feat_mask_fn(rng, spec.F, mtries)
-
-        max_depth = int(self.params["max_depth"])
-        trees, varimp, history = [], self._ckpt_varimp0(), []
-        leaf_means: list = []
-        stop_metric = []
-        vs = self._vstate
-        t_start = self._ckpt_start(ntrees)
-        binned_v = np.asarray(vs["binned"]) if vs is not None else None
-        if vs is None:
-            v_sum = None
-        elif t_start:
-            v_sum = np.asarray(self._ckpt.forest.predict_binned(vs["binned"]),
-                               np.float64) * t_start
-        else:
-            v_sum = np.zeros(binned_v.shape[0], np.float64)
-        oob_sum = jnp.zeros(N, jnp.float32)
-        oob_cnt = jnp.zeros(N, jnp.float32)
-        for t in range(t_start, ntrees):
-            mask, w_t = self._sample_rows(rng, N, w)
-            tree, row_leaf = grow_tree_host(
-                binned, w_t, y, spec, max_depth=max_depth,
-                min_rows=float(self.params["min_rows"]),
-                min_split_improvement=float(self.params["min_split_improvement"]),
-                feat_mask_fn=feat_mask_fn)
-            ln, ld = leaf_stats(row_leaf, w_t * y, w_t, tree.n_leaves)
-            mean = np.where(ld > 1e-12, ln / np.maximum(ld, 1e-12), 0.0)
-            leaf_means.append(mean)
-            trees.append(tree)
-            self._accumulate_varimp(tree, varimp, model)
-            if mask is not None:
-                leaf_arr = jnp.asarray(mean.astype(np.float32))
-                pred_t = jnp.where(row_leaf >= 0,
-                                   leaf_arr[jnp.maximum(row_leaf, 0)], 0.0)
-                oob = (~mask) & (w > 0)
-                oob_sum = oob_sum + jnp.where(oob, pred_t, 0.0)
-                oob_cnt = oob_cnt + oob.astype(jnp.float32)
-            if v_sum is not None:
-                # unscaled per-tree means; final leaf values are rescaled by
-                # the actual tree count after the loop
-                tree.set_leaf_values(mean)
-                v_sum += tree.apply_binned(binned_v, spec)
-            if (mask is not None or v_sum is not None) \
-                    and self._should_score(t, ntrees):
-                entry = {"tree": t + 1}
-                mse = None
-                if mask is not None:
-                    fcur = jnp.where(oob_cnt > 0,
-                                     oob_sum / jnp.maximum(oob_cnt, 1.0), 0.0)
-                    wm = w * (oob_cnt > 0)
-                    mse = float(jnp.sum(wm * (y - fcur) ** 2) /
-                                jnp.maximum(jnp.sum(wm), 1e-12))
-                    entry["training_rmse"] = float(np.sqrt(mse))
-                if v_sum is not None:
-                    fv = v_sum / (t + 1)
-                    if classification:
-                        fv = np.clip(fv, 0.0, 1.0)
-                    wv = np.asarray(vs["w"])
-                    yv = np.asarray(vs["y"])
-                    vmse = float(np.sum(wv * (yv - fv) ** 2) /
-                                 max(float(wv.sum()), 1e-12))
-                    entry["validation_rmse"] = float(np.sqrt(vmse))
-                    stop_metric.append(vmse)
-                else:
-                    stop_metric.append(mse)
-                history.append(entry)
-                if self._early_stop(stop_metric):
-                    break
-            if self._out_of_time():
-                break
-            if self.job:
-                self.job.update(progress=(t + 1) / ntrees, msg=f"tree {t + 1}")
-        model._output.scoring_history = history
-        self._finalize_varimp(model, varimp)
-        # scale leaves by the ACTUAL tree count (early stopping may truncate)
-        total = t_start + len(trees)
-        for tree, mean in zip(trees, leaf_means):
-            tree.set_leaf_values(mean / total)
-        forest = CompressedForest.from_host_trees(
-            trees, spec, max_depth=max_depth, init_f=0.0, nclasses=1)
-        if t_start:
-            forest = CompressedForest.concat(self._ckpt.forest, forest,
-                                             scale_a=t_start / total)
-        f = jnp.where(oob_cnt > 0, oob_sum / jnp.maximum(oob_cnt, 1.0), 0.0)
-        self._oob_raw = None
-        if float(jnp.max(oob_cnt)) > 0:
-            oob_mask = (oob_cnt > 0).astype(jnp.float32)
-            if classification:
-                p = jnp.clip(f, 0.0, 1.0)
-                self._oob_raw = ({"probs": jnp.stack([1 - p, p], axis=-1)}, oob_mask)
-            else:
-                self._oob_raw = ({"value": f}, oob_mask)
-        return forest, f
-
     def _fit_multinomial(self, model, binned, y, w, offset, spec, K, rng, ntrees):
         """One tree per class per iteration voting class indicator means."""
         import jax
         import jax.numpy as jnp
 
-        from h2o3_tpu.models.tree.device_tree import grow_tree_device
-        from h2o3_tpu.models.tree.shared_tree import DEVICE_DEPTH_LIMIT
-
-        if int(self.params["max_depth"]) > DEVICE_DEPTH_LIMIT:
-            return self._fit_multinomial_deep(model, binned, y, w, offset,
-                                              spec, K, rng, ntrees)
+        from h2o3_tpu.models.tree.device_tree import (build_feat_masks,
+                                                      grow_tree_device,
+                                                      stash_packed)
 
         N = binned.shape[0]
         yi = y.astype(jnp.int32)
@@ -383,6 +269,7 @@ class DRF(SharedTree):
         feat_mask_fn = _node_feat_mask_fn(rng, spec.F, mtries)
 
         max_depth = int(self.params["max_depth"])
+        maxB = int(spec.nbins.max())
         min_rows = float(self.params["min_rows"])
         msi = float(self.params["min_split_improvement"])
         tree_class = []
@@ -393,8 +280,8 @@ class DRF(SharedTree):
         for t in range(t_start, ntrees):
             mask, w_t = self._sample_rows(rng, N, w)
             for k in range(K):
-                masks = [np.asarray(feat_mask_fn(2 ** d), bool)
-                         for d in range(max_depth)]
+                masks = build_feat_masks(max_depth, feat_mask_fn,
+                                         spec.F, maxB)
                 packed, leaf4, row_leaf = grow_tree_device(
                     binned, w_t, onehot[:, k], spec, max_depth=max_depth,
                     min_rows=min_rows, min_split_improvement=msi,
@@ -402,7 +289,7 @@ class DRF(SharedTree):
                 mean = jnp.where(leaf4[:, 3] > 1e-12,
                                  leaf4[:, 2] / jnp.maximum(leaf4[:, 3], 1e-12),
                                  0.0)
-                packs.append(packed)
+                packs.append(stash_packed(packed, max_depth))
                 leaf_means.append(mean.astype(jnp.float32))
                 leaf_wys.append(leaf4[:, :2])
                 tree_class.append(k)
@@ -439,71 +326,3 @@ class DRF(SharedTree):
             self._oob_raw = ({"probs": p}, (oob_cnt > 0).astype(jnp.float32))
         return forest, None
 
-    def _fit_multinomial_deep(self, model, binned, y, w, offset, spec, K,
-                              rng, ntrees):
-        import jax
-        import jax.numpy as jnp
-
-        from h2o3_tpu.models.tree.histogram import leaf_stats
-        from h2o3_tpu.models.tree.host_grow import grow_tree_host
-
-        N = binned.shape[0]
-        yi = y.astype(jnp.int32)
-        onehot = jax.nn.one_hot(yi, K, dtype=jnp.float32)
-        mtries = self._mtries(spec.F, True)
-        feat_mask_fn = _node_feat_mask_fn(rng, spec.F, mtries)
-
-        max_depth = int(self.params["max_depth"])
-        trees, tree_class, varimp = [], [], self._ckpt_varimp0()
-        leaf_means = []
-        t_start = self._ckpt_start(ntrees, per_iter=K)
-        oob_sum = jnp.zeros((N, K), jnp.float32)
-        oob_cnt = jnp.zeros(N, jnp.float32)
-        for t in range(t_start, ntrees):
-            mask, w_t = self._sample_rows(rng, N, w)
-            for k in range(K):
-                tree, row_leaf = grow_tree_host(
-                    binned, w_t, onehot[:, k], spec, max_depth=max_depth,
-                    min_rows=float(self.params["min_rows"]),
-                    min_split_improvement=float(self.params["min_split_improvement"]),
-                    feat_mask_fn=feat_mask_fn)
-                ln, ld = leaf_stats(row_leaf, w_t * onehot[:, k], w_t,
-                                    tree.n_leaves)
-                mean = np.where(ld > 1e-12, ln / np.maximum(ld, 1e-12), 0.0)
-                # raw class-indicator mean; rescaled to 1/total after the
-                # loop so a max_runtime_secs break divides by trees built,
-                # not trees requested (mirrors the binomial path)
-                tree.set_leaf_values(mean)
-                leaf_means.append(mean)
-                trees.append(tree)
-                tree_class.append(k)
-                self._accumulate_varimp(tree, varimp, model)
-                if mask is not None:
-                    leaf_arr = jnp.asarray(mean.astype(np.float32))
-                    pred_t = jnp.where(row_leaf >= 0,
-                                       leaf_arr[jnp.maximum(row_leaf, 0)], 0.0)
-                    oob = (~mask) & (w > 0)
-                    oob_sum = oob_sum.at[:, k].add(jnp.where(oob, pred_t, 0.0))
-            if mask is not None:
-                oob_cnt = oob_cnt + ((~mask) & (w > 0)).astype(jnp.float32)
-            if self._out_of_time():
-                break
-            if self.job:
-                self.job.update(progress=(t + 1) / ntrees, msg=f"iter {t + 1}")
-        self._finalize_varimp(model, varimp)
-        total = t_start + len(trees) // K
-        for tree, mean in zip(trees, leaf_means):
-            tree.set_leaf_values(mean / total)
-        forest = CompressedForest.from_host_trees(
-            trees, spec, tree_class=tree_class, max_depth=max_depth,
-            nclasses=K)
-        if t_start:
-            # prev leaves are /t_start — rescale onto the /total denominator
-            forest = CompressedForest.concat(self._ckpt.forest, forest,
-                                             scale_a=t_start / total)
-        self._oob_raw = None
-        if float(jnp.max(oob_cnt)) > 0:
-            p = jnp.clip(oob_sum / jnp.maximum(oob_cnt, 1.0)[:, None], 0.0, 1.0)
-            p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-12)
-            self._oob_raw = ({"probs": p}, (oob_cnt > 0).astype(jnp.float32))
-        return forest, None

@@ -8,10 +8,9 @@ a host numpy split search over only the ACTIVE nodes (dtree.py), and one
 device routing pass — memory O(active nodes), like the reference's
 level-wise SharedTree (hex/tree/SharedTree.java:439 scoreAndBuildTrees).
 
-Slower per tree on remote-tunnel TPU setups (two dispatches + a small
-fetch per level), but depth-20 DRF forests are wide, shallow-compute
-objects where correctness beats dispatch latency; SharedTree picks the
-strategy per max_depth (shared_tree.DEVICE_DEPTH_LIMIT).
+Since round 4 the fit loops use device_tree.py's dense-frontier grower at
+EVERY depth; this module remains only behind the public grow_tree() entry
+(old single-tree contract with dense leaf ids).
 """
 
 from __future__ import annotations

@@ -30,11 +30,6 @@ from h2o3_tpu.models.model_builder import ModelBuilder, register
 from h2o3_tpu.models.tree.binning import BinSpec
 from h2o3_tpu.models.tree.compressed import CompressedForest
 
-# beyond this depth the single-dispatch heap grower's O(2^depth) tables stop
-# paying for themselves; the host-orchestrated level-wise grower takes over
-DEVICE_DEPTH_LIMIT = 10
-
-
 # jitted per-tree glue, cached across train() calls — every eager jnp op in
 # the boosting loop is a separate device dispatch, and on this environment a
 # dispatch through the TPU tunnel costs ~10 ms; fusing the gradient/sampling
@@ -345,18 +340,15 @@ class SharedTree(ModelBuilder):
         the per-tree split tables fetched in a single end-of-loop transfer —
         no per-tree host syncs (each costs ~60 ms through the TPU tunnel).
 
-        Trees deeper than DEVICE_DEPTH_LIMIT fall back to the host-
-        orchestrated level-wise grower (host_grow.py): the heap layout is
-        O(2^depth) memory, which is the right trade to depth ~10 and the
-        wrong one at DRF's default 20."""
+        Any depth runs in this one-dispatch program: the dense-frontier
+        grower (device_tree.py, round 4) renumbers live nodes per level, so
+        depth-20 DRF no longer falls back to a per-level host loop."""
         import jax.numpy as jnp
 
-        if int(self.params["max_depth"]) > DEVICE_DEPTH_LIMIT:
-            return self._fit_single_deep(model, binned, y, w, offset, spec,
-                                         dist, rng, ntrees)
-
         from h2o3_tpu.models.tree.device_tree import (apply_packed,
-                                                      grow_tree_device)
+                                                      build_feat_masks,
+                                                      grow_tree_device,
+                                                      stash_packed)
 
         N = binned.shape[0]
         t_start = self._ckpt_start(ntrees)
@@ -405,14 +397,13 @@ class SharedTree(ModelBuilder):
             z, w_t, num_r, den_r, _mask = pre(y, f, w, root_key,
                                               np.int32(t), sample_rate)
             feat_mask_fn = self._feat_mask_fn(rng, spec)
-            masks = ([np.asarray(feat_mask_fn(2 ** d), bool)
-                      for d in range(max_depth)] if feat_mask_fn else None)
+            masks = build_feat_masks(max_depth, feat_mask_fn, spec.F, maxB)
             packed, leaf4, row_leaf = grow_tree_device(
                 binned, w_t, z, spec, max_depth=max_depth, min_rows=min_rows,
                 min_split_improvement=msi, num=num_r, den=den_r,
                 feat_masks=masks)
             gamma, f = post(leaf4, row_leaf, f, self._tree_lr(t))
-            packs.append(packed)
+            packs.append(stash_packed(packed, max_depth))
             leaf_vals.append(gamma)
             leaf_wys.append(leaf4[:, :2])
             if f_valid is not None:
@@ -459,11 +450,9 @@ class SharedTree(ModelBuilder):
         import jax.numpy as jnp
 
         from h2o3_tpu.models.tree.device_tree import (apply_packed,
-                                                      grow_tree_device)
-
-        if int(self.params["max_depth"]) > DEVICE_DEPTH_LIMIT:
-            return self._fit_multinomial_deep(model, binned, y, w, offset,
-                                              spec, K, rng, ntrees)
+                                                      build_feat_masks,
+                                                      grow_tree_device,
+                                                      stash_packed)
 
         N = binned.shape[0]
         yi = y.astype(jnp.int32)
@@ -528,8 +517,7 @@ class SharedTree(ModelBuilder):
         packs, leaf_vals, leaf_wys = [], [], []
         for t in range(t_start, ntrees):
             feat_mask_fn = self._feat_mask_fn(rng, spec)
-            masks = ([np.asarray(feat_mask_fn(2 ** d), bool)
-                      for d in range(max_depth)] if feat_mask_fn else None)
+            masks = build_feat_masks(max_depth, feat_mask_fn, spec.F, maxB)
             for k in range(K):
                 # multinomial leaf gamma (GBM.java fitBestConstants, K-class):
                 # (K-1)/K * Σz / Σ|z|(1-|z|)
@@ -542,7 +530,7 @@ class SharedTree(ModelBuilder):
                     num=num_r, den=den_r, feat_masks=masks)
                 gamma, f = kpost(leaf4, row_leaf, f,
                                  np.float32(self._tree_lr(t)), np.int32(k))
-                packs.append(packed)
+                packs.append(stash_packed(packed, max_depth))
                 leaf_vals.append(gamma)
                 leaf_wys.append(leaf4[:, :2])
                 tree_class.append(k)
@@ -589,185 +577,6 @@ class SharedTree(ModelBuilder):
             forest = CompressedForest.concat(self._ckpt.forest, forest)
         return forest, f
 
-    # deep-tree fallback (host-orchestrated level loop, host_grow.py) ------
-    def _fit_single_deep(self, model, binned, y, w, offset, spec, dist, rng,
-                         ntrees):
-        import jax.numpy as jnp
-
-        from h2o3_tpu.models.tree.histogram import leaf_stats
-        from h2o3_tpu.models.tree.host_grow import grow_tree_host
-
-        N = binned.shape[0]
-        t_start = self._ckpt_start(ntrees)
-        vs = self._vstate
-        binned_v = np.asarray(vs["binned"]) if vs is not None else None
-        if t_start:
-            pf = self._ckpt.forest
-            init_f = pf.init_f
-            f = pf.predict_binned(binned) + offset
-            f_valid = (np.asarray(pf.predict_binned(binned_v), np.float64)
-                       + np.asarray(vs["offset"], np.float64)
-                       if vs is not None else None)
-        else:
-            num = float(jnp.sum(dist.init_f_num(w, y, offset)))
-            den = float(jnp.sum(dist.init_f_denom(w, y, offset)))
-            init_f = float(dist.link(jnp.float32(num / max(den, 1e-12))))
-            if dist.name in ("bernoulli", "quasibinomial"):
-                init_f = float(np.clip(init_f, -19, 19))
-            f = jnp.full(N, init_f, jnp.float32) + offset
-            f_valid = (init_f + np.asarray(vs["offset"], np.float64)
-                       if vs is not None else None)
-
-        leaf_clip = self._leaf_clip()
-        trees, varimp = [], self._ckpt_varimp0()
-        history = []
-        max_depth = int(self.params["max_depth"])
-        stop_metric: List[float] = []
-        for t in range(t_start, ntrees):
-            z = dist.neg_half_gradient(y, f)
-            row_active, w_t = self._sample_rows(rng, N, w)
-            feat_mask_fn = self._feat_mask_fn(rng, spec)
-            tree, row_leaf = grow_tree_host(
-                binned, w_t, z, spec, max_depth=max_depth,
-                min_rows=float(self.params["min_rows"]),
-                min_split_improvement=float(self.params["min_split_improvement"]),
-                row_active=None,     # keep all rows routed; sampling via w_t
-                feat_mask_fn=feat_mask_fn)
-            num_r, den_r = self._leaf_num_den(w_t, y, z, f, dist)
-            ln, ld = leaf_stats(row_leaf, num_r, den_r, tree.n_leaves)
-            gamma = np.asarray(self._leaf_gamma(jnp.asarray(ln), jnp.asarray(ld)))
-            gamma = np.clip(gamma, -leaf_clip, leaf_clip)
-            lr = self._tree_lr(t)
-            tree.set_leaf_values(gamma * lr)
-            leaf_arr = jnp.asarray((gamma * lr).astype(np.float32))
-            f = f + jnp.where(row_leaf >= 0,
-                              leaf_arr[jnp.maximum(row_leaf, 0)], 0.0)
-            trees.append(tree)
-            self._accumulate_varimp(tree, varimp, model)
-            if f_valid is not None:
-                f_valid += tree.apply_binned(binned_v, spec)
-            if self._should_score(t, ntrees):
-                dev = float(jnp.sum(dist.deviance(w, y, f)) /
-                            jnp.maximum(jnp.sum(w), 1e-12))
-                entry = {"tree": t + 1, "training_deviance": dev}
-                if f_valid is not None:
-                    vdev = float(np.sum(np.asarray(dist.deviance(
-                        vs["w"], vs["y"],
-                        jnp.asarray(f_valid, jnp.float32)))) /
-                        max(float(jnp.sum(vs["w"])), 1e-12))
-                    entry["validation_deviance"] = vdev
-                    stop_metric.append(vdev)
-                else:
-                    stop_metric.append(dev)
-                history.append(entry)
-                if self._early_stop(stop_metric):
-                    break
-            if self._out_of_time():
-                break
-            if self.job:
-                self.job.update(progress=(t + 1) / ntrees, msg=f"tree {t + 1}")
-        model._output.scoring_history = history
-        self._finalize_varimp(model, varimp)
-        forest = CompressedForest.from_host_trees(
-            trees, spec, max_depth=max_depth, init_f=init_f, nclasses=1)
-        if t_start:
-            forest = CompressedForest.concat(self._ckpt.forest, forest)
-        return forest, f
-
-    def _fit_multinomial_deep(self, model, binned, y, w, offset, spec, K,
-                              rng, ntrees):
-        import jax
-        import jax.numpy as jnp
-
-        from h2o3_tpu.models.tree.histogram import leaf_stats
-        from h2o3_tpu.models.tree.host_grow import grow_tree_host
-
-        N = binned.shape[0]
-        yi = y.astype(jnp.int32)
-        t_start = self._ckpt_start(ntrees, per_iter=K)
-        vs = self._vstate
-        binned_v = np.asarray(vs["binned"]) if vs is not None else None
-        if t_start:
-            pf = self._ckpt.forest
-            init = np.asarray(pf.init_class, np.float32)
-            f = pf.predict_binned(binned).astype(jnp.float32)
-            f_valid = (np.asarray(pf.predict_binned(binned_v), np.float64)
-                       if vs is not None else None)
-        else:
-            pri = np.asarray(jax.jit(
-                lambda: jnp.zeros(K).at[yi].add(w, mode="drop"))())
-            pri = np.maximum(pri / max(pri.sum(), 1e-12), 1e-9)
-            init = np.log(pri).astype(np.float32)
-            f = jnp.broadcast_to(jnp.asarray(init), (N, K)).astype(jnp.float32)
-            f_valid = (np.broadcast_to(init, (binned_v.shape[0], K)).copy()
-                       .astype(np.float64) if vs is not None else None)
-
-        leaf_clip = self._leaf_clip()
-        trees, tree_class, varimp, history = [], [], self._ckpt_varimp0(), []
-        max_depth = int(self.params["max_depth"])
-        stop_metric: List[float] = []
-        onehot = jax.nn.one_hot(yi, K, dtype=jnp.float32)
-        for t in range(t_start, ntrees):
-            P = jax.nn.softmax(f, axis=-1)
-            row_active, w_t = self._sample_rows(rng, N, w)
-            feat_mask_fn = self._feat_mask_fn(rng, spec)
-            for k in range(K):
-                z = onehot[:, k] - P[:, k]
-                tree, row_leaf = grow_tree_host(
-                    binned, w_t, z, spec, max_depth=max_depth,
-                    min_rows=float(self.params["min_rows"]),
-                    min_split_improvement=float(self.params["min_split_improvement"]),
-                    feat_mask_fn=feat_mask_fn)
-                az = jnp.abs(z)
-                ln, ld = leaf_stats(row_leaf, w_t * z, w_t * az * (1 - az),
-                                    tree.n_leaves)
-                gamma = np.where(ld > 1e-12,
-                                 (K - 1) / K * ln / np.maximum(ld, 1e-12), 0.0)
-                gamma = np.clip(gamma, -leaf_clip, leaf_clip)
-                lr = self._tree_lr(t)
-                tree.set_leaf_values(gamma * lr)
-                leaf_arr = jnp.asarray((gamma * lr).astype(np.float32))
-                upd = jnp.where(row_leaf >= 0,
-                                leaf_arr[jnp.maximum(row_leaf, 0)], 0.0)
-                f = f.at[:, k].add(upd)
-                trees.append(tree)
-                tree_class.append(k)
-                self._accumulate_varimp(tree, varimp, model)
-                if f_valid is not None:
-                    f_valid[:, k] += tree.apply_binned(binned_v, spec)
-            if self._should_score(t, ntrees):
-                ll = float(jnp.sum(-w * jnp.log(jnp.maximum(
-                    jax.nn.softmax(f, axis=-1)[jnp.arange(N), yi], 1e-15))) /
-                    jnp.maximum(jnp.sum(w), 1e-12))
-                entry = {"tree": t + 1, "training_logloss": ll}
-                if f_valid is not None:
-                    ex = np.exp(f_valid - f_valid.max(axis=1, keepdims=True))
-                    pv = ex / np.maximum(ex.sum(axis=1, keepdims=True), 1e-30)
-                    yv = np.maximum(np.asarray(vs["y"]).astype(np.int64), 0)
-                    wv = np.asarray(vs["w"])
-                    vll = float(np.sum(-wv * np.log(np.maximum(
-                        pv[np.arange(len(yv)), yv], 1e-15))) /
-                        max(float(wv.sum()), 1e-12))
-                    entry["validation_logloss"] = vll
-                    stop_metric.append(vll)
-                else:
-                    stop_metric.append(ll)
-                history.append(entry)
-                if self._early_stop(stop_metric):
-                    break
-            if self._out_of_time():
-                break
-            if self.job:
-                self.job.update(progress=(t + 1) / ntrees, msg=f"iter {t + 1}")
-        model._output.scoring_history = history
-        self._finalize_varimp(model, varimp)
-        forest = CompressedForest.from_host_trees(
-            trees, spec, tree_class=tree_class, max_depth=max_depth,
-            init_f=0.0, nclasses=K)
-        forest.init_class = init          # added per-class at scoring
-        if t_start:
-            forest = CompressedForest.concat(self._ckpt.forest, forest)
-        return forest, f
 
     # sampling ------------------------------------------------------------
     def _sample_rows(self, rng, N, w):

@@ -152,13 +152,11 @@ class XGBoost(GBM):
 
         from h2o3_tpu.models.tree.compressed import CompressedForest
         from h2o3_tpu.models.tree.device_tree import (assemble_trees,
-                                                      grow_tree_device)
-        from h2o3_tpu.models.tree.shared_tree import (DEVICE_DEPTH_LIMIT,
-                                                      _pre_fn)
+                                                      build_feat_masks,
+                                                      grow_tree_device,
+                                                      stash_packed)
+        from h2o3_tpu.models.tree.shared_tree import _pre_fn
 
-        if int(self.params["max_depth"]) > DEVICE_DEPTH_LIMIT:
-            raise ValueError("booster='dart' supports max_depth <= "
-                             f"{DEVICE_DEPTH_LIMIT}")
         if self._ckpt_start(ntrees):
             raise ValueError("booster='dart' does not support checkpoints")
 
@@ -211,8 +209,8 @@ class XGBoost(GBM):
             z, w_t, num_r, den_r, _m = pre(y, f_used, w, root_key,
                                            np.int32(t), sample_rate)
             feat_mask_fn = self._feat_mask_fn(rng, spec)
-            masks = ([np.asarray(feat_mask_fn(2 ** d_), bool)
-                      for d_ in range(max_depth)] if feat_mask_fn else None)
+            masks = build_feat_masks(max_depth, feat_mask_fn,
+                                     spec.F, int(spec.nbins.max()))
             packed, leaf4, row_leaf = grow_tree_device(
                 binned, w_t, z, spec, max_depth=max_depth, min_rows=min_rows,
                 min_split_improvement=msi, num=num_r, den=den_r,
