@@ -519,7 +519,12 @@ def assemble_trees(packs, leaf_vals, leaf_wys, spec, max_depth: int,
     count so the summed traversal averages)."""
     import jax.numpy as jnp
 
-    packs_np = np.asarray(jnp.stack(packs))
+    if packs and isinstance(packs[0], np.ndarray):
+        # deep trees were host-stashed per tree (stash_packed) — stack on
+        # HOST; re-uploading would recreate the full-forest HBM footprint
+        packs_np = np.stack(packs)
+    else:
+        packs_np = np.asarray(jnp.stack(packs))
     vals_np = np.asarray(jnp.stack(leaf_vals), np.float64) * scale
     wys_np = np.asarray(jnp.stack(leaf_wys), np.float64)
     return [host_tree_from_packed(packs_np[i], wys_np[i], spec, max_depth,

@@ -245,7 +245,7 @@ class XGBoost(GBM):
                 f = f + contrib_new
                 if vs is not None:
                     f_valid = f_valid + vcontrib_new
-            packs.append(packed)
+            packs.append(stash_packed(packed, max_depth))
             leaf_vals.append(gamma)
             leaf_wys.append(leaf4[:, :2])
             contribs.append(contrib_new)

@@ -1,0 +1,147 @@
+"""Round-4: Rapids prims that used to drop to host numpy now run on
+device — cor / distance / mmult / table / cumsum complete with ZERO
+full-column Column.to_numpy() fetches (VERDICT r3 #6 acceptance), results
+unchanged vs the host reference computation.
+
+Reference: water/rapids/ast/prims/advmath/AstCorrelation.java:1,
+AstDistance.java, matrix/AstMMult.java, mungers/AstTable.java."""
+
+import contextlib
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame
+from h2o3_tpu.rapids import exec_rapids
+
+N = 100_000
+
+
+@contextlib.contextmanager
+def no_host_fetch():
+    """Poison Column.to_numpy — any device→host column fetch fails."""
+    orig = Column.to_numpy
+
+    def boom(self, *a, **k):
+        raise AssertionError("Column.to_numpy() called on the device path")
+
+    Column.to_numpy = boom
+    try:
+        yield
+    finally:
+        Column.to_numpy = orig
+
+
+@pytest.fixture(scope="module")
+def big(cl):
+    rng = np.random.default_rng(11)
+    f = Frame(key="dev_fr")
+    x = rng.normal(size=N)
+    y = 0.6 * x + 0.8 * rng.normal(size=N)
+    z = rng.normal(size=N)
+    f.add("x", Column.from_numpy(x))
+    f.add("y", Column.from_numpy(y))
+    f.add("z", Column.from_numpy(z))
+    f.add("g", Column.from_numpy(
+        np.asarray(["a", "b", "c"], object)[rng.integers(0, 3, N)]
+        .astype(str), ctype="enum"))
+    f.install()
+    return f, x, y, z
+
+
+def test_cor_pearson_on_device(big):
+    f, x, y, z = big
+    sub = Frame(key="dev_xy")
+    sub.add("x", f.col("x"))
+    sub.add("y", f.col("y"))
+    sub.install()
+    with no_host_fetch():
+        got = exec_rapids('(cor dev_xy dev_xy "everything" "pearson")')
+        C = np.asarray([np.asarray(got.col(n).data)[:2] for n in got.names])
+    want = np.corrcoef(x, y)
+    np.testing.assert_allclose(np.asarray(C, float), want, atol=1e-5)
+
+
+def test_cor_spearman_matches_scipy(big):
+    f, x, y, z = big
+    sub = Frame(key="dev_xy2")
+    sub.add("x", f.col("x"))
+    sub.add("y", f.col("y"))
+    sub.install()
+    with no_host_fetch():
+        got = exec_rapids('(cor dev_xy2 dev_xy2 "complete.obs" "spearman")')
+        C01 = float(np.asarray(got.col("y").data)[0])
+    from scipy import stats as st
+
+    want = st.spearmanr(x, y).statistic
+    assert abs(C01 - want) < 1e-5
+
+
+def test_cor_complete_obs_with_nas(cl):
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=5000)
+    y = 0.5 * x + rng.normal(size=5000)
+    x[::17] = np.nan
+    f = Frame(key="dev_na")
+    f.add("x", Column.from_numpy(x))
+    f.add("y", Column.from_numpy(y))
+    f.install()
+    with no_host_fetch():
+        got = exec_rapids('(cor dev_na dev_na "complete.obs" "pearson")')
+        c = float(np.asarray(got.col("y").data)[0])
+    keep = ~np.isnan(x)
+    want = np.corrcoef(x[keep], y[keep])[0, 1]
+    assert abs(c - want) < 1e-5
+
+
+def test_cumsum_on_device(big):
+    f, x, *_ = big
+    sub = Frame(key="dev_x")
+    sub.add("x", f.col("x"))
+    sub.install()
+    with no_host_fetch():
+        got = exec_rapids("(cumsum dev_x 0)")
+        head = np.asarray(got.col(got.names[0]).data)[:1000]
+    np.testing.assert_allclose(head, np.cumsum(x)[:1000], rtol=1e-4,
+                               atol=1e-3)
+
+
+def test_table_on_device(big):
+    f, *_ = big
+    sub = Frame(key="dev_g")
+    sub.add("g", f.col("g"))
+    sub.install()
+    with no_host_fetch():
+        got = exec_rapids("(table dev_g)")
+    counts = np.asarray(got.col("nrow").to_numpy(), float)
+    assert counts.sum() == N
+
+
+def test_mmult_and_distance_on_device(cl):
+    rng = np.random.default_rng(4)
+    A = rng.normal(size=(2000, 3))
+    B = rng.normal(size=(3, 2))
+    fa = Frame(key="dev_A")
+    for j in range(3):
+        fa.add(f"a{j}", Column.from_numpy(A[:, j]))
+    fa.install()
+    fb = Frame(key="dev_B")
+    for j in range(2):
+        fb.add(f"b{j}", Column.from_numpy(B[:, j]))
+    fb.install()
+    with no_host_fetch():
+        got = exec_rapids("(x dev_A dev_B)")
+        M = np.column_stack([np.asarray(got.col(n).data)[:2000]
+                             for n in got.names])
+    np.testing.assert_allclose(M, A @ B, rtol=1e-4, atol=1e-4)
+
+    fc = Frame(key="dev_C")
+    for j in range(3):
+        fc.add(f"c{j}", Column.from_numpy(A[:5, j]))
+    fc.install()
+    with no_host_fetch():
+        got = exec_rapids('(distance dev_A dev_C "l2")')
+        D = np.column_stack([np.asarray(got.col(n).data)[:2000]
+                             for n in got.names])
+    want = np.sqrt(((A[:, None, :] - A[None, :5, :]) ** 2).sum(-1))
+    np.testing.assert_allclose(D, want, rtol=1e-3, atol=1e-3)
