@@ -732,7 +732,12 @@ def h_predict_v4(ctx: Ctx):
         return pred
 
     job.start(run, background=True)
-    return {"__meta": S.meta("JobV4"), "job": S.job_v3(job)}
+    # h2o-r predict.H2OModel reads key/dest at the TOP level of the v4
+    # response (models.R:679 res$key$name, res$dest$name); h2o-py reads
+    # the nested job — serve both shapes
+    jv = S.job_v3(job)
+    return {"__meta": S.meta("JobV4"), "job": jv,
+            "key": jv.get("key"), "dest": jv.get("dest")}
 
 
 def _automl_tables(aml):

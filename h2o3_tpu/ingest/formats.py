@@ -29,25 +29,28 @@ STRUCTURED_EXT = {".arff": "ARFF", ".svm": "SVMLight",
                   ".svmlight": "SVMLight"}
 
 
-GATED_EXT = {".xls": "XLS", ".xlsx": "XLSX", ".avro": "Avro"}
+# legacy BIFF .xls only: a 1997 binary format whose decoder (POI/xlrd)
+# this image lacks; .xlsx and .avro parse natively (round 4)
+GATED_EXT = {".xls": "XLS"}
+NATIVE_BINARY_EXT = {".xlsx": "XLSX", ".avro": "AVRO"}
 
 
 def detect_parse_type(path: str) -> Optional[str]:
     """Extension -> parse type; None = fall back to CSV text sniffing.
-    Raises NotImplementedError for known-binary formats whose decoders are
-    not present (surfaced as HTTP 501 by the REST layer)."""
+    Raises for known-binary formats whose decoders are not present
+    (surfaced as HTTP 501 by the REST layer)."""
     from h2o3_tpu.errors import CapabilityGate
 
     ext = os.path.splitext(path)[1].lower()
     if ext in GATED_EXT:
         # fail fast with the reason — sniffing these binaries as CSV would
-        # produce garbage columns (reference ships h2o-parsers/h2o-avro-
-        # parser and XlsParser; their decoders need libs this image lacks)
+        # produce garbage columns (reference: legacy XlsParser rides POI)
         raise CapabilityGate(
-            f"{GATED_EXT[ext]} parsing needs a decoder library not present "
-            "in this environment (openpyxl/fastavro). Convert to CSV or "
-            "Parquet and import that instead.")
-    return COLUMNAR_EXT.get(ext) or STRUCTURED_EXT.get(ext)
+            f"{GATED_EXT[ext]} (legacy BIFF) parsing needs a decoder "
+            "library not present in this environment (xlrd). Save as "
+            ".xlsx or CSV and import that instead.")
+    return (COLUMNAR_EXT.get(ext) or STRUCTURED_EXT.get(ext)
+            or NATIVE_BINARY_EXT.get(ext))
 
 
 # ---------------------------------------------------------------------------
@@ -308,3 +311,109 @@ def parse_svmlight_host(path: str) -> Tuple[Dict[str, np.ndarray], List[str], Li
     for i in range(max_idx):
         cols[names[i + 1]] = dense[:, i]
     return cols, names, [T_NUM] * len(names)
+
+
+# ---------------------------------------------------------------------------
+# XLSX (stdlib zip + XML — reference: h2o XlsxParser via POI-like decode)
+# ---------------------------------------------------------------------------
+
+def _xlsx_col_index(ref: str) -> int:
+    """Cell ref 'BC12' -> zero-based column index."""
+    idx = 0
+    for ch in ref:
+        if not ch.isalpha():
+            break
+        idx = idx * 26 + (ord(ch.upper()) - ord("A") + 1)
+    return idx - 1
+
+
+def xlsx_header(path: str, sample_rows: int = 100
+                ) -> Tuple[List[str], List[str]]:
+    """Names + sampled types without keeping the data (ParseSetup tier)."""
+    cols, names, types = parse_xlsx_host(path, max_rows=sample_rows)
+    return names, types
+
+
+def parse_xlsx_host(path: str, max_rows: Optional[int] = None
+                    ) -> Tuple[Dict[str, np.ndarray], List[str],
+                               List[str]]:
+    """First worksheet of an .xlsx workbook -> (cols, names, types); row 1
+    is the header (the reference's XlsParser contract)."""
+    import xml.etree.ElementTree as ET
+    import zipfile
+
+    NS = "{http://schemas.openxmlformats.org/spreadsheetml/2006/main}"
+    with zipfile.ZipFile(path) as z:
+        shared: List[str] = []
+        if "xl/sharedStrings.xml" in z.namelist():
+            root = ET.fromstring(z.read("xl/sharedStrings.xml"))
+            for si in root:
+                shared.append("".join(t.text or ""
+                                      for t in si.iter(NS + "t")))
+        sheets = sorted(n for n in z.namelist()
+                        if n.startswith("xl/worksheets/sheet"))
+        if not sheets:
+            raise ValueError(f"{path!r}: no worksheets")
+        root = ET.fromstring(z.read(sheets[0]))
+        # honor r attributes: Excel omits empty rows/cells from the XML,
+        # so both row index and column index come from the refs, with
+        # sequential fallbacks when a writer drops them
+        rowmap: Dict[int, Dict[int, Optional[str]]] = {}
+        ncols = 0
+        next_row = 1
+        for row in root.iter(NS + "row"):
+            ri = int(row.get("r", next_row))
+            next_row = ri + 1
+            cells: Dict[int, Optional[str]] = {}
+            next_ci = 0
+            for c in row.iter(NS + "c"):
+                ref = c.get("r")
+                ci = _xlsx_col_index(ref) if ref else next_ci
+                next_ci = ci + 1
+                t = c.get("t")
+                vel = c.find(NS + "v")
+                if vel is not None:
+                    val = vel.text
+                    if t == "s" and val is not None:
+                        val = shared[int(val)]
+                elif c.find(NS + "is") is not None:
+                    val = "".join(tt.text or ""
+                                  for tt in c.find(NS + "is").iter(NS + "t"))
+                else:
+                    val = None
+                cells[ci] = val
+                ncols = max(ncols, ci + 1)
+            rowmap[ri] = cells
+        if not rowmap:
+            grid: List[Dict[int, Optional[str]]] = []
+        else:
+            first, last = min(rowmap), max(rowmap)
+            grid = [rowmap.get(i, {}) for i in range(first, last + 1)]
+    if not grid:
+        raise ValueError(f"{path!r}: empty worksheet")
+    header = [str(grid[0].get(j) or f"C{j + 1}") for j in range(ncols)]
+    body = grid[1:]
+    if max_rows is not None:
+        body = body[:max_rows]
+    cols: Dict[str, np.ndarray] = {}
+    types: List[str] = []
+    for j, name in enumerate(header):
+        raw = [r.get(j) for r in body]
+        numeric = True
+        vals = np.full(len(raw), np.nan)
+        for i, v in enumerate(raw):
+            if v is None or v == "":
+                continue
+            try:
+                vals[i] = float(v)
+            except ValueError:
+                numeric = False
+                break
+        if numeric:
+            cols[name] = vals
+            types.append("real")
+        else:
+            cols[name] = np.asarray(["" if v is None else str(v)
+                                     for v in raw], object)
+            types.append("enum")
+    return cols, header, types

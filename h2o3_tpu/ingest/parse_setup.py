@@ -104,6 +104,13 @@ def guess_setup(path: str, sample_rows: int = 1000,
                 formats.columnar_schema(path, ptype)
         elif ptype == "ARFF":
             setup.column_names, setup.column_types = formats.arff_header(path)
+        elif ptype == "AVRO":
+            from h2o3_tpu.ingest.avro import avro_schema
+
+            setup.column_names, setup.column_types = avro_schema(path)
+        elif ptype == "XLSX":
+            setup.column_names, setup.column_types = \
+                formats.xlsx_header(path)
         # SVMLight: width only known after a full scan; filled at parse time
         if column_types and setup.column_types:
             _apply_type_overrides(setup.column_types, setup.column_names,

@@ -95,6 +95,12 @@ def _parse_one(path: str, setup: ParseSetup):
         cols, names, types = formats.parse_arff_host(path)
     elif pt == "SVMLight":
         cols, names, types = formats.parse_svmlight_host(path)
+    elif pt == "AVRO":
+        from h2o3_tpu.ingest.avro import parse_avro_host
+
+        cols, names, types = parse_avro_host(path)
+    elif pt == "XLSX":
+        cols, names, types = formats.parse_xlsx_host(path)
     else:
         raise ValueError(f"unknown parse_type {pt!r}")
     # honor user col_types overrides carried on the setup (the CSV path

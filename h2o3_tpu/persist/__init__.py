@@ -83,7 +83,9 @@ def _gated(scheme: str, pkg: str, ref: str):
 register_scheme("http", _fetch_http)
 register_scheme("https", _fetch_http)
 register_scheme("file", _fetch_file)
-register_scheme("s3", _gated("s3", "boto3", "h2o-persist-s3/PersistS3.java"))
+from h2o3_tpu.persist.s3 import fetch_s3  # noqa: E402
+
+register_scheme("s3", fetch_s3)
 register_scheme("gs", _gated("gs", "google-cloud-storage",
                              "h2o-persist-gcs/PersistGcs.java"))
 register_scheme("hdfs", _gated("hdfs", "pyarrow HadoopFileSystem",

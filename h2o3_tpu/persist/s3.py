@@ -1,0 +1,109 @@
+"""PersistS3 — native S3 object fetch, no boto3.
+
+Reference: h2o-persist-s3/src/main/java/water/persist/PersistS3.java:1.
+S3's GET-object API is plain HTTPS + (optionally) an AWS Signature V4
+Authorization header, both of which the stdlib covers (urllib + hmac/
+hashlib) — the SDK buys retries/multipart we don't need for ingest.
+
+Credentials: AWS_ACCESS_KEY_ID / AWS_SECRET_ACCESS_KEY (+ AWS_SESSION_TOKEN,
+AWS_REGION) env vars, the same chain the reference's default provider reads
+first. Without credentials the request goes out unsigned (public buckets).
+H2O_TPU_S3_ENDPOINT overrides the endpoint with path-style addressing —
+minio/localstack and the mocked-persist test tier ride this."""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import os
+import shutil
+import urllib.parse
+import urllib.request
+from typing import Dict, Optional, Tuple
+
+
+def _split(uri: str) -> Tuple[str, str]:
+    p = urllib.parse.urlparse(uri)
+    bucket = p.netloc
+    key = p.path.lstrip("/")
+    if not bucket or not key:
+        raise ValueError(f"malformed s3 uri {uri!r} (want s3://bucket/key)")
+    return bucket, key
+
+
+def _sign_v4(method: str, url: str, region: str, access_key: str,
+             secret_key: str, session_token: Optional[str]) -> Dict[str, str]:
+    """AWS Signature Version 4 for an empty-body request."""
+    p = urllib.parse.urlparse(url)
+    host = p.netloc
+    now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    payload_hash = hashlib.sha256(b"").hexdigest()
+
+    headers = {"host": host, "x-amz-content-sha256": payload_hash,
+               "x-amz-date": amz_date}
+    if session_token:
+        headers["x-amz-security-token"] = session_token
+    signed = ";".join(sorted(headers))
+    canonical = "\n".join([
+        # the path is ALREADY percent-encoded by object_url — re-quoting
+        # would double-encode and break the signature for keys with
+        # spaces/unicode; AWS canonicalizes the path exactly as sent
+        method, p.path or "/",
+        p.query,
+        "".join(f"{k}:{headers[k]}\n" for k in sorted(headers)),
+        signed, payload_hash])
+    scope = f"{datestamp}/{region}/s3/aws4_request"
+    to_sign = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                         hashlib.sha256(canonical.encode()).hexdigest()])
+
+    def _h(key: bytes, msg: str) -> bytes:
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = _h(("AWS4" + secret_key).encode(), datestamp)
+    k = _h(k, region)
+    k = _h(k, "s3")
+    k = _h(k, "aws4_request")
+    sig = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+    out = {k_: v for k_, v in headers.items() if k_ != "host"}
+    out["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed}, Signature={sig}")
+    return out
+
+
+def object_url(uri: str) -> str:
+    bucket, key = _split(uri)
+    endpoint = os.environ.get("H2O_TPU_S3_ENDPOINT")
+    if endpoint:
+        # path-style for custom endpoints (minio/localstack/mock)
+        return f"{endpoint.rstrip('/')}/{bucket}/{urllib.parse.quote(key)}"
+    region = os.environ.get("AWS_REGION", "us-east-1")
+    host = (f"{bucket}.s3.amazonaws.com" if region == "us-east-1"
+            else f"{bucket}.s3.{region}.amazonaws.com")
+    return f"https://{host}/{urllib.parse.quote(key)}"
+
+
+def fetch_s3(uri: str) -> str:
+    """s3://bucket/key → local cache path (PersistS3.importFiles analog)."""
+    from h2o3_tpu.persist import _local_name
+
+    dest = _local_name(uri)
+    if os.path.exists(dest):
+        return dest
+    url = object_url(uri)
+    headers: Dict[str, str] = {}
+    ak = os.environ.get("AWS_ACCESS_KEY_ID")
+    sk = os.environ.get("AWS_SECRET_ACCESS_KEY")
+    if ak and sk:
+        headers = _sign_v4("GET", url, os.environ.get("AWS_REGION",
+                                                      "us-east-1"),
+                           ak, sk, os.environ.get("AWS_SESSION_TOKEN"))
+    req = urllib.request.Request(url, headers=headers)
+    tmp = dest + ".part"
+    with urllib.request.urlopen(req, timeout=120) as r, open(tmp, "wb") as f:
+        shutil.copyfileobj(r, f)
+    os.replace(tmp, dest)
+    return dest

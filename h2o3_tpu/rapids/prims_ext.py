@@ -15,6 +15,7 @@ keeps working over POST /99/Rapids.
 
 from __future__ import annotations
 
+import functools as _functools
 import math as _math
 from typing import List, Optional
 
@@ -52,6 +53,51 @@ def _dev_matrix(fr: Frame):
                       for n in fr.names], axis=1)
 
 
+@_functools.lru_cache(maxsize=16)
+def _corr_fn(usemode: str, method: str):
+    """Jitted correlation kernel, cached per (use, method) — a fresh
+    closure per call would re-trace + recompile every time."""
+    import jax
+    import jax.numpy as jnp
+
+    def corr(X, Y, n_valid_rows):
+        rows = jnp.arange(X.shape[0])
+        in_frame = rows < n_valid_rows
+        if usemode == "complete.obs":
+            w = in_frame & ~(jnp.isnan(X).any(axis=1)
+                             | jnp.isnan(Y).any(axis=1))
+        else:       # everything / all.obs: NaNs propagate, pads excluded
+            w = in_frame
+        wf = w.astype(jnp.float32)
+        n_used = wf.sum()
+        nn = jnp.maximum(n_used, 1.0)
+
+        def ranks(M):
+            def col_rank(c):
+                cv = jnp.where(w, c, jnp.inf)
+                s = jnp.sort(cv)
+                l = jnp.searchsorted(s, cv, side="left")
+                r = jnp.searchsorted(s, cv, side="right")
+                return (l + r + 1).astype(jnp.float32) / 2.0
+            return jax.vmap(col_rank, in_axes=1, out_axes=1)(M)
+
+        if method == "spearman":
+            X_, Y_ = ranks(X), ranks(Y)
+        else:
+            X_, Y_ = X, Y
+        mx = jnp.einsum("n,nf->f", wf, jnp.where(w[:, None], X_, 0.0)) / nn
+        my = jnp.einsum("n,nf->f", wf, jnp.where(w[:, None], Y_, 0.0)) / nn
+        Xc = jnp.where(w[:, None], X_ - mx[None, :], 0.0)
+        Yc = jnp.where(w[:, None], Y_ - my[None, :], 0.0)
+        denom = jnp.sqrt(jnp.outer((Xc ** 2).sum(axis=0),
+                                   (Yc ** 2).sum(axis=0)))
+        C = (Xc.T @ Yc) / jnp.maximum(denom, 1e-30)
+        # no usable rows -> undefined correlation (host path returned NaN)
+        return jnp.where(n_used > 0, C, jnp.nan)
+
+    return jax.jit(corr)
+
+
 @prim("cor")
 def _cor(env, fr, other, use, method="pearson"):
     """Correlation matrix / vector (AstCorrelation). use: everything |
@@ -70,42 +116,7 @@ def _cor(env, fr, other, use, method="pearson"):
     X = _dev_matrix(fr)
     same = not (_is_fr(other) and other is not fr)
     Y = X if same else _dev_matrix(other)
-    n_valid_rows = fr.nrows
-
-    @jax.jit
-    def corr(X, Y):
-        rows = jnp.arange(X.shape[0])
-        in_frame = rows < n_valid_rows
-        if usemode == "complete.obs":
-            w = in_frame & ~(jnp.isnan(X).any(axis=1)
-                             | jnp.isnan(Y).any(axis=1))
-        else:       # everything / all.obs: NaNs propagate, pads excluded
-            w = in_frame
-        wf = w.astype(jnp.float32)
-        nn = jnp.maximum(wf.sum(), 1.0)
-
-        def ranks(M):
-            def col_rank(c):
-                cv = jnp.where(w, c, jnp.inf)
-                s = jnp.sort(cv)
-                l = jnp.searchsorted(s, cv, side="left")
-                r = jnp.searchsorted(s, cv, side="right")
-                return (l + r + 1).astype(jnp.float32) / 2.0
-            return jax.vmap(col_rank, in_axes=1, out_axes=1)(M)
-
-        if method == "spearman":
-            X_, Y_ = ranks(X), (ranks(Y) if not same else ranks(X))
-        else:
-            X_, Y_ = X, Y
-        mx = jnp.einsum("n,nf->f", wf, jnp.where(w[:, None], X_, 0.0)) / nn
-        my = jnp.einsum("n,nf->f", wf, jnp.where(w[:, None], Y_, 0.0)) / nn
-        Xc = jnp.where(w[:, None], X_ - mx[None, :], 0.0)
-        Yc = jnp.where(w[:, None], Y_ - my[None, :], 0.0)
-        denom = jnp.sqrt(jnp.outer((Xc ** 2).sum(axis=0),
-                                   (Yc ** 2).sum(axis=0)))
-        return (Xc.T @ Yc) / jnp.maximum(denom, 1e-30)
-
-    C = corr(X, Y)
+    C = _corr_fn(usemode, method)(X, Y, np.int32(fr.nrows))
     if C.shape == (1, 1):
         return float(C[0, 0])
     C = np.asarray(C, np.float64)         # (F, F') tiny: fetch is the result
@@ -299,6 +310,14 @@ def _transpose(env, fr):
     return out
 
 
+@_functools.lru_cache(maxsize=32)
+def _mm_fn(k: int):
+    import jax
+
+    # pad rows sit beyond k and are sliced away
+    return jax.jit(lambda A, B: A @ B[:k, :])
+
+
 @prim("x")
 def _mmult(env, a, b):
     """AstMMult — A (n×k) @ B (k×m) fully on device; the result columns
@@ -311,13 +330,7 @@ def _mmult(env, a, b):
                          f"{b.nrows} rows)")
     A = _dev_matrix(a)
     B = _dev_matrix(b)
-    k = b.nrows
-
-    @jax.jit
-    def mm(A, B):
-        return A @ B[:k, :]     # pad rows sit beyond k and are sliced away
-
-    M = mm(A, B)
+    M = _mm_fn(b.nrows)(A, B)
     out = Frame()
     for j in range(M.shape[1]):
         out.add(f"C{j + 1}", Column.from_device(M[:, j], T_NUM, a.nrows))
@@ -1490,16 +1503,32 @@ def _grouped_permute(env, fr, perm_col, groupby, permute_by, keep_col):
     return out
 
 
+def _median_combine(x: np.ndarray, cm: str) -> float:
+    """QuantileModel.CombineMethod semantics for the even-length median."""
+    xs = np.sort(x)
+    n = len(xs)
+    if n % 2 == 1:
+        return float(xs[n // 2])
+    lo, hi = float(xs[n // 2 - 1]), float(xs[n // 2])
+    if cm == "low":
+        return lo
+    if cm == "high":
+        return hi
+    return (lo + hi) / 2.0          # interpolate / average coincide here
+
+
 @prim("h2o.mad")
 def _mad(env, fr, combine_method="interpolate", constant=1.4826):
     """AstMad — median absolute deviation × constant; NaN when the column
-    carries NAs (reference semantics)."""
+    carries NAs (reference semantics); combine_method resolves even-length
+    medians (QuantileModel.CombineMethod)."""
     col = _one_col(fr)
     x = np.asarray(col.to_numpy(), np.float64)
-    if np.isnan(x).any():
+    if np.isnan(x).any() or not len(x):
         return float("nan")
-    med = float(np.median(x))
-    return float(_scalar(constant)) * float(np.median(np.abs(x - med)))
+    cm = _s(combine_method).strip('"').lower()
+    med = _median_combine(x, cm)
+    return float(_scalar(constant)) * _median_combine(np.abs(x - med), cm)
 
 
 def _na_rollup(op):

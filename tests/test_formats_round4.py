@@ -1,0 +1,172 @@
+"""Round-4 ingest closure: native Avro + XLSX parsers and a working
+s3:// persist path (mock-endpoint test proving the registry + SigV4
+client end-to-end).
+
+Reference: h2o-parsers/h2o-avro-parser/ (AvroParser.java),
+h2o XlsxParser, h2o-persist-s3/PersistS3.java."""
+
+import http.server
+import io
+import threading
+import zipfile
+
+import numpy as np
+import pytest
+
+import h2o3_tpu as h2o
+
+
+def test_avro_import_roundtrip(tmp_path, cl):
+    from h2o3_tpu.ingest.avro import write_avro
+
+    path = str(tmp_path / "data.avro")
+    n = 500
+    rng = np.random.default_rng(1)
+    xs = rng.normal(size=n)
+    gs = ["red", "green", "blue"]
+    cols = {"x": [float(v) for v in xs],
+            "g": [gs[i % 3] for i in range(n)],
+            "k": [int(i) for i in range(n)],
+            "maybe": [None if i % 7 == 0 else float(i) for i in range(n)]}
+    write_avro(path, cols, [
+        {"name": "x", "type": "double"},
+        {"name": "g", "type": "string"},
+        {"name": "k", "type": "long"},
+        {"name": "maybe", "type": ["null", "double"]}], codec="deflate")
+    fr = h2o.import_file(path)
+    assert fr.nrows == n
+    assert fr.names == ["x", "g", "k", "maybe"]
+    np.testing.assert_allclose(np.asarray(fr.col("x").to_numpy())[:10],
+                               xs[:10], rtol=1e-6)
+    m = np.asarray(fr.col("maybe").to_numpy())
+    assert np.isnan(m[0]) and np.isnan(m[7])
+    assert abs(float(np.nanmean(m)) - np.nanmean(
+        [np.nan if i % 7 == 0 else i for i in range(n)])) < 1e-2
+
+
+def _make_xlsx(path, header, rows):
+    """Hand-built minimal xlsx (zip of sheet XML + shared strings)."""
+    NS = 'xmlns="http://schemas.openxmlformats.org/spreadsheetml/2006/main"'
+    shared, sidx = [], {}
+
+    def sref(s):
+        if s not in sidx:
+            sidx[s] = len(shared)
+            shared.append(s)
+        return sidx[s]
+
+    def cell(r, cidx, v):
+        col = ""
+        ci = cidx + 1
+        while ci:
+            ci, rem = divmod(ci - 1, 26)
+            col = chr(65 + rem) + col
+        ref = f"{col}{r}"
+        if isinstance(v, str):
+            return f'<c r="{ref}" t="s"><v>{sref(v)}</v></c>'
+        return f'<c r="{ref}"><v>{v}</v></c>'
+
+    body = []
+    body.append("<row r=\"1\">" + "".join(
+        cell(1, j, h) for j, h in enumerate(header)) + "</row>")
+    for i, row in enumerate(rows):
+        body.append(f'<row r="{i + 2}">' + "".join(
+            cell(i + 2, j, v) for j, v in enumerate(row) if v is not None)
+            + "</row>")
+    sheet = (f'<?xml version="1.0"?><worksheet {NS}><sheetData>'
+             + "".join(body) + "</sheetData></worksheet>")
+    sst = (f'<?xml version="1.0"?><sst {NS} count="{len(shared)}" '
+           f'uniqueCount="{len(shared)}">'
+           + "".join(f"<si><t>{s}</t></si>" for s in shared) + "</sst>")
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("xl/worksheets/sheet1.xml", sheet)
+        z.writestr("xl/sharedStrings.xml", sst)
+        z.writestr("[Content_Types].xml", "<Types/>")
+    return path
+
+
+def test_xlsx_import(tmp_path, cl):
+    path = str(tmp_path / "book.xlsx")
+    _make_xlsx(path, ["name", "value", "n"],
+               [["alpha", 1.5, 10], ["beta", 2.5, 20],
+                ["gamma", None, 30], ["alpha", 4.0, 40]])
+    fr = h2o.import_file(path)
+    assert fr.names == ["name", "value", "n"]
+    assert fr.nrows == 4
+    v = np.asarray(fr.col("value").to_numpy())
+    assert np.isnan(v[2]) and v[3] == 4.0
+    assert fr.col("name").domain is not None     # strings -> enum
+
+
+def test_xls_legacy_still_gated(tmp_path, cl):
+    from h2o3_tpu.errors import CapabilityGate
+    from h2o3_tpu.ingest.formats import detect_parse_type
+
+    with pytest.raises(CapabilityGate):
+        detect_parse_type("old.xls")
+
+
+class _S3Mock(http.server.BaseHTTPRequestHandler):
+    """Path-style S3 endpoint: GET /bucket/key serves canned bytes and
+    records the request headers for the signing assertion."""
+
+    store = {}
+    seen = []
+
+    def do_GET(self):
+        type(self).seen.append(dict(self.headers))
+        body = self.store.get(self.path)
+        if body is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_s3_import_via_mock_endpoint(tmp_path, cl, monkeypatch):
+    csv = b"a,b\n1,x\n2,y\n3,x\n"
+    _S3Mock.store = {"/mybucket/data/test.csv": csv}
+    _S3Mock.seen = []
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _S3Mock)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        monkeypatch.setenv("H2O_TPU_S3_ENDPOINT",
+                           f"http://127.0.0.1:{srv.server_port}")
+        monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AKIATEST")
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "secret")
+        fr = h2o.import_file("s3://mybucket/data/test.csv")
+        assert fr.nrows == 3
+        assert fr.names == ["a", "b"]
+        # the request carried a complete SigV4 authorization header
+        auth = _S3Mock.seen[0].get("Authorization", "")
+        assert auth.startswith("AWS4-HMAC-SHA256 Credential=AKIATEST/")
+        assert "Signature=" in auth
+        hdrs = {k.lower(): v for k, v in _S3Mock.seen[0].items()}
+        assert hdrs.get("x-amz-content-sha256")
+    finally:
+        srv.shutdown()
+
+
+def test_s3_anonymous_when_no_creds(tmp_path, cl, monkeypatch):
+    csv = b"q\n1\n2\n"
+    _S3Mock.store = {"/pub/open.csv": csv}
+    _S3Mock.seen = []
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _S3Mock)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        monkeypatch.setenv("H2O_TPU_S3_ENDPOINT",
+                           f"http://127.0.0.1:{srv.server_port}")
+        monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+        monkeypatch.delenv("AWS_SECRET_ACCESS_KEY", raising=False)
+        fr = h2o.import_file("s3://pub/open.csv")
+        assert fr.nrows == 2
+        assert "Authorization" not in _S3Mock.seen[0]
+    finally:
+        srv.shutdown()

@@ -1,0 +1,155 @@
+"""h2o-r wire-format replay: the exact HTTP transcript communication.R
+produces for h2o.init → h2o.importFile → h2o.gbm → predict, byte-encoded
+the way RCurl's curlPerform sends it (urlencoded POST bodies, R-style
+TRUE/FALSE literals, .collapse.char ["a","b"] lists).
+
+No Rscript exists in this image, so this is the recorded-transcript tier
+(VERDICT r3 #9): every request/response field below is one the R client
+actually reads, cited to the R source.
+
+Reference: h2o-r/h2o-package/R/communication.R:49 (.h2o.doRawREST),
+parse.R:62 (h2o.parseRaw), models.R:123 (.h2o.startModelJob),
+models.R:679 (predict — v4 key/dest at top level), connection.R:465
+(InitID session)."""
+
+import json
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.api.server import start_server
+
+
+@pytest.fixture(scope="module")
+def base(cl):
+    srv = start_server(port=0)
+    yield f"http://127.0.0.1:{srv.port}"
+    srv.stop()
+
+
+def _get(base, path, params=None):
+    url = base + path
+    if params:
+        # communication.R builds name=curlEscape(value) query strings
+        url += "?" + "&".join(f"{k}={urllib.parse.quote(str(v), safe='')}"
+                              for k, v in params.items())
+    with urllib.request.urlopen(url, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def _post(base, path, params=None):
+    # curlPerform(postfields=queryString) — urlencoded body, no JSON
+    body = "&".join(f"{k}={urllib.parse.quote(str(v), safe='')}"
+                    for k, v in (params or {}).items()).encode()
+    req = urllib.request.Request(
+        base + path, data=body,
+        headers={"Content-Type": "application/x-www-form-urlencoded"},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return json.loads(r.read())
+
+
+def _wait_job(base, job_key):
+    """.h2o.__waitOnJob (communication.R:926): poll /3/Jobs/{key} reading
+    jobs[[1]]$status until DONE."""
+    for _ in range(600):
+        res = _get(base, f"/3/Jobs/{urllib.parse.quote(job_key, safe='')}")
+        status = res["jobs"][0]["status"]
+        if status in ("DONE", "FAILED", "CANCELLED"):
+            assert status == "DONE", res["jobs"][0]
+            return res["jobs"][0]
+        time.sleep(0.1)
+    raise AssertionError("job did not finish")
+
+
+@pytest.fixture(scope="module")
+def csv_path(tmp_path_factory):
+    rng = np.random.default_rng(7)
+    p = tmp_path_factory.mktemp("rwire") / "r_data.csv"
+    with open(p, "w") as f:
+        f.write("x1,x2,g,y\n")
+        for i in range(800):
+            x1, x2 = rng.normal(), rng.normal()
+            g = "abc"[i % 3]
+            pr = 1 / (1 + np.exp(-(1.2 * x1 - x2 + (g == "a"))))
+            f.write(f"{x1:.5f},{x2:.5f},{g},{'YN'[int(rng.random() < pr)]}\n")
+    return str(p)
+
+
+def test_h2or_full_transcript(base, csv_path):
+    # -- h2o.init: clusterInfo + session (connection.R) -------------------
+    cloud = _get(base, "/3/Cloud")
+    assert cloud["cloud_healthy"] is True
+    assert cloud["cloud_size"] >= 1
+    assert "version" in cloud and isinstance(cloud["nodes"], list)
+    sid = _get(base, "/3/InitID")["session_key"]
+    assert sid
+
+    # -- h2o.importFile (import.R -> parse.R) -----------------------------
+    imp = _get(base, "/3/ImportFiles", {"path": csv_path})
+    assert imp["destination_frames"], imp
+    src = imp["destination_frames"][0]
+
+    setup = _post(base, "/3/ParseSetup",
+                  {"source_frames": f'["{src}"]'})
+    assert setup["number_columns"] == 4
+    col_names = "[" + ",".join(f'"{c}"' for c in setup["column_names"]) + "]"
+    col_types = "[" + ",".join(f'"{t}"' for t in setup["column_types"]) + "]"
+    parse = _post(base, "/3/Parse", {
+        "source_frames": f'["{src}"]',
+        "destination_frame": "r_data.hex",
+        "parse_type": setup["parse_type"],
+        "separator": setup["separator"],
+        "number_columns": setup["number_columns"],
+        "single_quotes": "FALSE",
+        "column_names": col_names,
+        "column_types": col_types,
+        "check_header": setup["check_header"],
+        "delete_on_done": "TRUE",
+        "chunk_size": setup.get("chunk_size", 4194304),
+        "blocking": "FALSE",
+    })
+    _wait_job(base, parse["job"]["key"]["name"])
+
+    fr = _get(base, "/3/Frames/r_data.hex")
+    f0 = fr["frames"][0]
+    assert f0["rows"] == 800
+    assert [c["label"] for c in f0["columns"]] == ["x1", "x2", "g", "y"]
+
+    # -- h2o.gbm (.h2o.makeModelParams reads the builder schema first) ----
+    builders = _get(base, "/3/ModelBuilders/gbm")
+    params = builders["model_builders"]["gbm"]["parameters"]
+    assert any(p["name"] == "ntrees" for p in params)
+    assert all("type" in p for p in params)
+
+    res = _post(base, "/3/ModelBuilders/gbm", {
+        "training_frame": "r_data.hex",
+        "response_column": "y",
+        "ntrees": 5, "max_depth": 3, "seed": 1,
+    })
+    job_key = res["job"]["key"]["name"]        # models.R:131 res$job$key$name
+    dest_key = res["job"]["dest"]["name"]      # models.R:132 res$job$dest$name
+    _wait_job(base, job_key)
+
+    model = _get(base, f"/3/Models/{dest_key}")
+    m0 = model["models"][0]
+    assert m0["model_id"]["name"] == dest_key
+    assert m0["algo"] == "gbm"
+    assert "output" in m0                      # R reads res$models[[1]]$output
+
+    # -- predict (models.R:679: v4, key/dest at TOP level) ----------------
+    pred = _post(base, f"/4/Predictions/models/{dest_key}/frames/r_data.hex")
+    assert pred["key"]["name"]
+    pdest = pred["dest"]["name"]
+    _wait_job(base, pred["key"]["name"])
+    pfr = _get(base, f"/3/Frames/{pdest}")
+    labels = [c["label"] for c in pfr["frames"][0]["columns"]]
+    assert labels[0] == "predict"
+
+    # -- session teardown (connection.R:558 DELETE InitID) ----------------
+    req = urllib.request.Request(base + "/3/InitID", method="DELETE")
+    with urllib.request.urlopen(req, timeout=60) as r:
+        assert r.status == 200
