@@ -372,14 +372,30 @@ def h_parse(ctx: Ctx):
     col_names = [str(c).strip('"') for c in (_parse_list(ctx.arg("column_names")) or [])] or None
     col_types = [str(c).strip('"') for c in (_parse_list(ctx.arg("column_types")) or [])] or None
     check_header = ctx.arg("check_header")
+    from h2o3_tpu.parallel import oplog
+
+    if oplog.active() and len(real) > 1:
+        # before Job() so a rejected request leaves no phantom CREATED job
+        raise ApiError("multi-file parse over REST is not yet "
+                       "supported on a multi-process cloud", 501)
     job = Job(description="Parse")
     job.dest_type = "Key<Frame>"
     job.dest_key = dest
 
+    # followers must run the SAME parse so the sharded frame materializes
+    # on every process of the cloud
+    op_seq = oplog.broadcast("import_file", {
+        "path": real[0], "destination_frame": dest,
+        "col_names": col_names, "col_types": col_types,
+        "header": int(check_header) if check_header is not None else None})
+
     def run(j: Job):
+        from h2o3_tpu.parallel import oplog as _ol
+
         kw = dict(col_names=col_names, col_types=col_types,
                   header=int(check_header) if check_header is not None else 0)
-        fr = import_file(real[0], destination_frame=dest, **kw)
+        with _ol.turn(op_seq):
+            fr = import_file(real[0], destination_frame=dest, **kw)
         if len(real) > 1:
             # multi-file import: parse each file and stack (reference
             # MultiFileParseTask parses all byte-chunks into ONE frame,
@@ -431,7 +447,12 @@ def h_rapids(ctx: Ctx):
     ast = ctx.arg("ast", "")
     sid = str(ctx.arg("session_id", "default"))
     sess = _SESSIONS.setdefault(sid, Session(sid))
-    val = exec_rapids(ast, sess)
+    from h2o3_tpu.parallel import oplog
+
+    # munging runs device programs too: replay the same AST cloud-wide
+    op_seq = oplog.broadcast("rapids", {"ast": str(ast), "session_id": sid})
+    with oplog.turn(op_seq):
+        val = exec_rapids(ast, sess)
     out: Dict[str, Any] = {"__meta": S.meta("RapidsFrameV3", "RapidsFrameV3")}
     if isinstance(val, Frame):
         if DKV.get(str(val.key)) is None:
@@ -620,8 +641,28 @@ def h_modelbuilder_train(ctx: Ctx):
     job.dest_type = "Key<Model>"
     job.dest_key = dest
 
+    from h2o3_tpu.parallel import oplog
+
+    op_seq = None
+    if oplog.active():
+        # every process must draw the SAME host-side sampling masks, so a
+        # wildcard seed gets pinned before the op ships
+        if builder.params.get("seed") in (None, -1):
+            builder.params["seed"] = int(uuid.uuid4().int % (2 ** 31))
+        wire_params = {k: v for k, v in builder.params.items()
+                       if isinstance(v, (int, float, str, bool, type(None),
+                                         list))}
+        wire_params.pop("model_id", None)
+        op_seq = oplog.broadcast("train", {
+            "algo": algo, "params": wire_params,
+            "training_frame": str(train.key),
+            "validation_frame": str(valid.key) if valid is not None else None,
+            "y": y, "model_id": dest})
+
     def run(j: Job):
-        model = builder.train(y=y, training_frame=train, validation_frame=valid)
+        with oplog.turn(op_seq):
+            model = builder.train(y=y, training_frame=train,
+                                  validation_frame=valid)
         # the client captured dest at submit time (h2o-py H2OJob.__init__
         # reads dest.name once) — re-home the model under the advertised key
         old = str(model.key)
@@ -691,7 +732,16 @@ def _check_contributions_size(fr: Frame) -> None:
 def h_predict_v3(ctx: Ctx):
     m = _model_or_404(ctx.params["model_id"])
     fr = _frame_or_404(ctx.params["frame_id"])
+    from h2o3_tpu.parallel import oplog
+
     dest = str(ctx.arg("predictions_frame", "") or "").strip('"') or None
+    if oplog.active() and not _wants_contributions(ctx):
+        # followers must mirror EVERY device program this handler runs —
+        # predict AND the model_performance metrics pass below
+        oplog.broadcast("predict", {"model": str(m.key),
+                                    "frame": str(fr.key),
+                                    "destination_frame": None,
+                                    "with_metrics": True})
     if _wants_contributions(ctx):
         # genmodel TreeSHAP surfaced over REST (h2o-py predict_contributions)
         _check_contributions_size(fr)
@@ -721,13 +771,21 @@ def h_predict_v4(ctx: Ctx):
                 else f"prediction_{m.key}_on_{fr.key}")
     job.dest_key = pred_key
 
+    from h2o3_tpu.parallel import oplog
+
+    op_seq = oplog.broadcast("predict", {
+        "model": str(m.key), "frame": str(fr.key),
+        "destination_frame": pred_key, "contributions": contribs,
+        "with_metrics": False})
+
     def run(j: Job):
-        if contribs:
-            # genuine h2o-py predict_contributions rides this async route
-            # (model_base.py:199: POST /4/Predictions + flag)
-            pred = m.predict_contributions(fr, key=pred_key)
-        else:
-            pred = m.predict(fr, key=pred_key)
+        with oplog.turn(op_seq):
+            if contribs:
+                # genuine h2o-py predict_contributions rides this async
+                # route (model_base.py:199: POST /4/Predictions + flag)
+                pred = m.predict_contributions(fr, key=pred_key)
+            else:
+                pred = m.predict(fr, key=pred_key)
         pred.install()
         return pred
 

@@ -106,44 +106,11 @@ def _schema_types(fields) -> List[str]:
     return out
 
 
-def avro_schema(path: str) -> Tuple[List[str], List[str]]:
-    """Names + types from the file-metadata block only — the ParseSetup
-    tier never decodes data blocks (cheap-schema pattern, like the
-    parquet footer probe)."""
-    with open(path, "rb") as f:
-        head = f.read(1 << 20)          # metadata fits well under 1 MB
-    if not head.startswith(MAGIC):
-        raise ValueError(f"{path!r} is not an avro container file")
-    r = _Reader(head)
-    r.read(4)
-    meta: Dict[str, bytes] = {}
-    while True:
-        n = r.long()
-        if n == 0:
-            break
-        if n < 0:
-            r.long()
-            n = -n
-        for _ in range(n):
-            k = r.read(r.long()).decode()
-            meta[k] = r.read(r.long())
-    schema = json.loads(meta["avro.schema"].decode())
-    if schema.get("type") != "record":
-        raise ValueError("avro top-level schema must be a record")
-    fields = schema["fields"]
-    return [f["name"] for f in fields], _schema_types(fields)
-
-
-def parse_avro_host(path: str) -> Tuple[Dict[str, np.ndarray], List[str],
-                                        List[str]]:
-    """-> (cols, names, types) with types in the framework vocabulary
-    (real / enum / string)."""
-    with open(path, "rb") as f:
-        data = f.read()
-    if not data.startswith(MAGIC):
-        raise ValueError(f"{path!r} is not an avro container file")
-    r = _Reader(data)
-    r.read(4)
+def _read_header(r: "_Reader") -> Tuple[dict, bytes]:
+    """Shared container-header decode: -> (schema json, sync marker).
+    Consumes MAGIC + the file-metadata map."""
+    if r.read(4) != MAGIC:
+        raise ValueError("not an avro container file")
     meta: Dict[str, bytes] = {}
     while True:
         n = r.long()
@@ -157,9 +124,32 @@ def parse_avro_host(path: str) -> Tuple[Dict[str, np.ndarray], List[str],
             meta[k] = r.read(r.long())
     sync = r.read(16)
     schema = json.loads(meta["avro.schema"].decode())
-    codec = meta.get("avro.codec", b"null").decode()
     if schema.get("type") != "record":
         raise ValueError("avro top-level schema must be a record")
+    schema["_codec"] = meta.get("avro.codec", b"null").decode()
+    return schema, sync
+
+
+def avro_schema(path: str) -> Tuple[List[str], List[str]]:
+    """Names + types from the file-metadata block only — the ParseSetup
+    tier never decodes data blocks (cheap-schema pattern, like the
+    parquet footer probe)."""
+    with open(path, "rb") as f:
+        head = f.read(1 << 20)          # metadata fits well under 1 MB
+    schema, _sync = _read_header(_Reader(head))
+    fields = schema["fields"]
+    return [f["name"] for f in fields], _schema_types(fields)
+
+
+def parse_avro_host(path: str) -> Tuple[Dict[str, np.ndarray], List[str],
+                                        List[str]]:
+    """-> (cols, names, types) with types in the framework vocabulary
+    (real / enum / string)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    r = _Reader(data)
+    schema, sync = _read_header(r)
+    codec = schema["_codec"]
     fields = schema["fields"]
     names = [f["name"] for f in fields]
     rows: List[list] = []

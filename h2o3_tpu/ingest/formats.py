@@ -362,6 +362,8 @@ def parse_xlsx_host(path: str, max_rows: Optional[int] = None
         ncols = 0
         next_row = 1
         for row in root.iter(NS + "row"):
+            if max_rows is not None and len(rowmap) > max_rows:
+                break            # ParseSetup tier: sample only
             ri = int(row.get("r", next_row))
             next_row = ri + 1
             cells: Dict[int, Optional[str]] = {}

@@ -1,0 +1,178 @@
+"""Operation log — the cross-process control plane for REST-driven work.
+
+Reference: in the JVM cloud any node can accept a REST request and fan the
+work out over the RPC layer (water/RPC.java + MRTask dispatch). Under SPMD
+multi-controller JAX there is no RPC: every process must enter the SAME
+jitted collective program. This module gives the coordinator a way to make
+that happen for REST-initiated operations: the coordinator appends ops to
+a sequence in the jax.distributed coordination-service KV, follower
+processes replay them in order (`follower_loop`), and both sides execute
+the identical framework call — so the shard_map programs line up and the
+collectives complete.
+
+Ops carry ONLY metadata (paths, keys, params) — data stays sharded on
+device; files are read from the shared filesystem by every process, the
+same contract the parse tier already uses.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+from h2o3_tpu.parallel import distributed as D
+
+_SEQ = 0
+_PREFIX = "oplog"
+_RAPIDS_SESSIONS: Dict[str, Any] = {}     # follower-side session mirror
+
+# coordinator-side execution turnstile: broadcast order == device-program
+# order. REST jobs run in background threads, so without this two
+# concurrent requests could enter their shard_map programs in the opposite
+# order from the follower's strictly sequential replay — a mesh deadlock.
+_EXEC_COND = threading.Condition()
+_NEXT_EXEC = 0
+
+
+def active() -> bool:
+    """Coordinator with followers attached: REST handlers must broadcast."""
+    return D.process_count() > 1 and D.is_coordinator()
+
+
+def publish(kind: str, payload: Dict[str, Any]) -> int:
+    """Append one op (coordinator only); followers replay in sequence.
+    Returns the op's sequence number (the coordinator's execution ticket)."""
+    global _SEQ
+    D.kv_put(f"{_PREFIX}/{_SEQ}",
+             json.dumps({"kind": kind, "payload": payload}))
+    seq = _SEQ
+    _SEQ += 1
+    return seq
+
+
+def broadcast(kind: str, payload: Dict[str, Any]) -> Optional[int]:
+    """Publish when this process is the coordinator of a live multi-process
+    cloud; no-op single-process (the common local path pays nothing).
+    Returns the execution ticket (None single-process)."""
+    if active():
+        return publish(kind, payload)
+    return None
+
+
+@contextlib.contextmanager
+def turn(seq: Optional[int]):
+    """Hold the coordinator's device-execution turnstile for op `seq`:
+    ops run their device programs in exactly broadcast order, matching the
+    follower's sequential replay. No-op when seq is None."""
+    global _NEXT_EXEC
+    if seq is None:
+        yield
+        return
+    with _EXEC_COND:
+        while _NEXT_EXEC != seq:
+            _EXEC_COND.wait(timeout=1.0)
+    try:
+        yield
+    finally:
+        with _EXEC_COND:
+            _NEXT_EXEC = seq + 1
+            _EXEC_COND.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# follower side
+# ---------------------------------------------------------------------------
+
+def _apply(kind: str, p: Dict[str, Any]) -> None:
+    if kind == "import_file":
+        from h2o3_tpu.ingest.parser import import_file
+
+        kw = {}
+        if p.get("col_names"):
+            kw["col_names"] = p["col_names"]
+        if p.get("col_types"):
+            kw["col_types"] = p["col_types"]
+        if p.get("header") is not None:
+            kw["header"] = int(p["header"])
+        import_file(p["path"], destination_frame=p.get("destination_frame"),
+                    **kw)
+        return
+    if kind == "train":
+        from h2o3_tpu.core.dkv import DKV
+        from h2o3_tpu.models.model_builder import BUILDERS
+
+        cls = BUILDERS[p["algo"]]
+        params = dict(p.get("params") or {})
+        train = DKV.get(p["training_frame"])
+        valid = DKV.get(p["validation_frame"]) if p.get("validation_frame") \
+            else None
+        y = p.get("y")
+        model = cls(**params).train(y=y, training_frame=train,
+                                    validation_frame=valid)
+        if p.get("model_id"):
+            from h2o3_tpu.core.dkv import Key
+
+            model._key = Key(p["model_id"])
+        model.install()
+        return
+    if kind == "predict":
+        from h2o3_tpu.core.dkv import DKV
+
+        m = DKV.get(p["model"])
+        fr = DKV.get(p["frame"])
+        if p.get("contributions"):
+            pred = m.predict_contributions(fr, key=p.get("destination_frame"))
+        else:
+            pred = m.predict(fr, key=p.get("destination_frame"))
+        pred.install()
+        if p.get("with_metrics"):
+            # the v3 handler also computes metrics: same program sequence
+            m.model_performance(fr)
+        return
+    if kind == "rapids":
+        from h2o3_tpu.rapids import Session, exec_rapids
+
+        sid = p.get("session_id", "oplog")
+        sess = _RAPIDS_SESSIONS.get(sid)
+        if sess is None:
+            sess = _RAPIDS_SESSIONS[sid] = Session(sid)
+        exec_rapids(p["ast"], sess)
+        return
+    raise ValueError(f"unknown oplog op {kind!r}")
+
+
+def follower_loop(idle_timeout_s: float = 120.0,
+                  on_op: Optional[Callable[[str, dict], None]] = None) -> int:
+    """Replay coordinator ops until a 'shutdown' op (or idle timeout).
+    Returns the number of ops applied. Runs on every non-coordinator
+    process of a multi-process cloud whose coordinator serves REST."""
+    i, applied = 0, 0
+    deadline = time.time() + idle_timeout_s
+    while time.time() < deadline:
+        raw = D.kv_try_get(f"{_PREFIX}/{i}")
+        if raw is None:
+            time.sleep(0.05)
+            continue
+        op = json.loads(raw)
+        if op["kind"] == "shutdown":
+            return applied
+        try:
+            _apply(op["kind"], op["payload"])
+        except Exception:
+            # surface the replay failure to the cloud BEFORE dying: the
+            # coordinator (and operators reading /3/Cloud health) see the
+            # error instead of a bare collective hang
+            D.kv_put(f"{_PREFIX}/error/{i}",
+                     json.dumps({"kind": op["kind"],
+                                 "trace": traceback.format_exc()[-4000:]}))
+            raise
+        if on_op is not None:
+            on_op(op["kind"], op["payload"])
+        applied += 1
+        i += 1
+        deadline = time.time() + idle_timeout_s
+    raise TimeoutError(f"oplog follower idle for {idle_timeout_s}s at op {i}")

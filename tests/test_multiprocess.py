@@ -34,7 +34,7 @@ def test_two_process_cloud_trains_glm():
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=480)
             outs.append(out.decode())
     except subprocess.TimeoutExpired:
         for p in procs:
