@@ -39,15 +39,18 @@ class _ArtifactUnpickler(pickle.Unpickler):
     must not be able to smuggle arbitrary callables (pickle RCE). Applied
     to every load path, including the network-facing upload route."""
 
-    _PREFIXES = ("h2o3_tpu.", "numpy", "jax.", "jaxlib.", "collections",
-                 "functools.partial")
+    _PREFIXES = ("h2o3_tpu.", "numpy.", "jax.", "jaxlib.", "collections.")
+    _MODULES = {"numpy", "jax", "jaxlib", "collections"}
+    _EXACT = {("functools", "partial")}
     _BUILTINS = {"set", "frozenset", "slice", "complex", "range",
                  "bytearray", "object"}
 
     def find_class(self, module, name):
         if module == "builtins" and name in self._BUILTINS:
             return super().find_class(module, name)
-        if module in ("numpy", "jax", "jaxlib") or \
+        if (module, name) in self._EXACT:
+            return super().find_class(module, name)
+        if module in self._MODULES or \
                 any(module.startswith(pfx) for pfx in self._PREFIXES):
             return super().find_class(module, name)
         raise pickle.UnpicklingError(

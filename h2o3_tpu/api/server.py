@@ -228,9 +228,20 @@ def h_profiler(ctx: Ctx):
 
 
 def h_flow(ctx: Ctx):
-    """Minimal Flow landing page (reference ships the full Flow notebook,
-    h2o-web/; here a live cluster/model/frame dashboard over the same REST
-    endpoints so / isn't a 404 for browsers)."""
+    """Serve the Flow single-page app (api/flow.html): import → parse →
+    train → leaderboard → predict over the existing REST routes. Falls back
+    to the plain status dashboard if the packaged asset is missing.
+    Reference: h2o-web/ Flow notebook packaging."""
+    fpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "flow.html")
+    if os.path.exists(fpath):
+        with open(fpath, "rb") as f:
+            return RawReply(f.read(), "text/html")
+    return h_flow_status(ctx)
+
+
+def h_flow_status(ctx: Ctx):
+    """Plain status dashboard (pre-round-5 Flow landing)."""
     from h2o3_tpu.core.runtime import cluster_info
 
     import html as _html
@@ -735,24 +746,35 @@ def h_predict_v3(ctx: Ctx):
     from h2o3_tpu.parallel import oplog
 
     dest = str(ctx.arg("predictions_frame", "") or "").strip('"') or None
-    if oplog.active() and not _wants_contributions(ctx):
-        # followers must mirror EVERY device program this handler runs —
-        # predict AND the model_performance metrics pass below
-        oplog.broadcast("predict", {"model": str(m.key),
-                                    "frame": str(fr.key),
-                                    "destination_frame": None,
-                                    "with_metrics": True})
     if _wants_contributions(ctx):
         # genmodel TreeSHAP surfaced over REST (h2o-py predict_contributions)
         _check_contributions_size(fr)
-        pred = m.predict_contributions(fr, key=dest)
-        pred.install()
+        dest = dest or f"contributions_{m.key}_on_{fr.key}"
+        op_seq = oplog.broadcast("predict", {
+            "model": str(m.key), "frame": str(fr.key),
+            "destination_frame": dest, "contributions": True,
+            "with_metrics": False})
+        with oplog.turn(op_seq):
+            pred = m.predict_contributions(fr, key=dest)
+            pred.install()
         return {"__meta": S.meta("ModelMetricsListSchemaV3"),
                 "predictions_frame": {"name": str(pred.key)},
                 "model_metrics": []}
-    pred = m.predict(fr, key=dest)
-    pred.install()
-    mm = m.model_performance(fr)
+    # followers must mirror EVERY device program this handler runs —
+    # predict AND the model_performance metrics pass below — and the
+    # coordinator must run them inside its turnstile slot so they cannot
+    # interleave out of broadcast order vs the follower's sequential replay.
+    # The destination key ships explicitly (default included) so every
+    # process installs the prediction frame under the SAME DKV name.
+    dest = dest or f"prediction_{m.key}_on_{fr.key}"
+    op_seq = oplog.broadcast("predict", {"model": str(m.key),
+                                         "frame": str(fr.key),
+                                         "destination_frame": dest,
+                                         "with_metrics": True})
+    with oplog.turn(op_seq):
+        pred = m.predict(fr, key=dest)
+        pred.install()
+        mm = m.model_performance(fr)
     return {"__meta": S.meta("ModelMetricsListSchemaV3"),
             "predictions_frame": {"name": str(pred.key)},
             "model_metrics": [S.metrics_v3(mm, str(m.key), str(fr.key))] if mm else []}
@@ -869,11 +891,41 @@ def h_automl_build(ctx: Ctx):
     job.dest_type = "Key<AutoML>"
     job.dest_key = project
 
+    from h2o3_tpu.parallel import oplog
+
+    op_seq = None
+    if oplog.active():
+        # multi-process cloud: every process must walk the IDENTICAL model
+        # sequence, so the seed is already pinned (H2OAutoML.__init__) and
+        # the wall-clock budget — which would diverge across processes —
+        # is cleared in favor of the max_models cap
+        if aml.max_runtime_secs > 0:
+            import logging
+
+            logging.getLogger("h2o3_tpu").warning(
+                "AutoML max_runtime_secs ignored on a multi-process cloud "
+                "(nondeterministic across processes); bounded by "
+                "max_models=%d instead", aml.max_models)
+            aml.max_runtime_secs = 0.0
+        op_seq = oplog.broadcast("automl", {
+            "spec": {"max_models": aml.max_models, "max_runtime_secs": 0.0,
+                     "seed": aml.seed, "nfolds": aml.nfolds,
+                     "sort_metric": aml.sort_metric,
+                     "include_algos": aml.include_algos,
+                     "exclude_algos": aml.exclude_algos,
+                     "project_name": aml.project_name,
+                     "preprocessing": aml.preprocessing},
+            "training_frame": str(train.key),
+            "validation_frame": str(valid_key) if valid_key else None,
+            "leaderboard_frame": str(lb_key) if lb_key else None,
+            "x": x, "y": y})
+
     def run(j: Job):
         # Job.start installs the result under job.dest (= project) itself
-        aml.train(x=x, y=y, training_frame=train,
-                  validation_frame=DKV.get(str(valid_key)) if valid_key else None,
-                  leaderboard_frame=DKV.get(str(lb_key)) if lb_key else None)
+        with oplog.turn(op_seq):
+            aml.train(x=x, y=y, training_frame=train,
+                      validation_frame=DKV.get(str(valid_key)) if valid_key else None,
+                      leaderboard_frame=DKV.get(str(lb_key)) if lb_key else None)
         return aml
 
     job.start(run, background=True)
@@ -1236,8 +1288,8 @@ ROUTES: List[Tuple[str, str, Callable, str]] = [
     ("GET", "/3/Logs", h_logs, "Server log tail"),
     ("GET", "/3/Timeline", h_timeline, "Recent request timeline"),
     ("GET", "/3/Profiler", h_profiler, "Per-device memory gauges"),
-    ("GET", "/", h_flow, "Status dashboard (Flow landing)"),
-    ("GET", "/flow/index.html", h_flow, "Status dashboard (Flow landing)"),
+    ("GET", "/", h_flow, "Flow SPA (import-parse-train-predict)"),
+    ("GET", "/flow/index.html", h_flow, "Flow SPA (import-parse-train-predict)"),
     ("GET", "/3/ImportFiles", h_importfiles, "List importable files"),
     ("POST", "/3/ImportFilesMulti", h_importfiles_multi, "List files for many paths"),
     ("POST", "/3/PostFile", h_postfile, "Upload a raw file"),
@@ -1536,10 +1588,16 @@ class ApiServer:
         if self.httpd:
             self.httpd.shutdown()
             self.httpd = None
+        from h2o3_tpu.parallel import oplog
+
+        oplog.REST_SERVING = False
 
 
 def start_server(port: int = 54321, auth_file: Optional[str] = None,
                  host: Optional[str] = None) -> ApiServer:
+    from h2o3_tpu.parallel import oplog
+
+    oplog.REST_SERVING = True     # handler-thread collectives need op turns
     return ApiServer(port, auth_file=auth_file, host=host).start()
 
 

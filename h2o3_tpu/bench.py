@@ -72,13 +72,70 @@ def run_drf_deep(n_rows: int = 200_000, ntrees: int = 5,
     return n_rows * ntrees / dt, "drf_deep_rows_per_sec"
 
 
+def run_compile_probe(n_rows: int = 20_000):
+    """Compile-only stage: the flagship program on tiny rows. Wallclock here
+    is compile-dominated — the watchdog uses it to tell 'slow compile' from
+    'slow execute' and from 'tunnel dead' (which fails the earlier probe)."""
+    t0 = time.perf_counter()
+    run_flagship(n_rows=n_rows, ntrees=2)
+    return time.perf_counter() - t0, "gbm_compile_secs"
+
+
+def run_glm(n_rows: int = 1_000_000, p: int = 32, iters: int = 20):
+    """GLM IRLS secondary metric (matches the repo-root bench_glm shape)."""
+    import jax
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.standard_normal((n_rows, p)), jnp.float32)
+    true_b = jnp.asarray(rng.standard_normal(p), jnp.float32)
+    y = (jax.nn.sigmoid(X @ true_b) > 0.5).astype(jnp.float32)
+
+    @jax.jit
+    def irls_step(beta, _):
+        eta = X @ beta[:-1] + beta[-1]
+        mu = jax.nn.sigmoid(eta)
+        w = jnp.maximum(mu * (1 - mu), 1e-6)
+        z = eta + (y - mu) / w
+        Xa = jnp.concatenate([X, jnp.ones((X.shape[0], 1), X.dtype)], axis=1)
+        gram = (Xa * w[:, None]).T @ Xa + 1e-6 * jnp.eye(p + 1, dtype=X.dtype)
+        rhs = Xa.T @ (w * z)
+        return jnp.linalg.solve(gram, rhs), 0.0
+
+    @jax.jit
+    def run(beta):
+        beta, _ = lax.scan(irls_step, beta, None, length=iters)
+        return beta
+
+    beta0 = jnp.zeros(p + 1, jnp.float32)
+    run(beta0).block_until_ready()
+    t0 = time.perf_counter()
+    run(beta0).block_until_ready()
+    dt = time.perf_counter() - t0
+    return n_rows * iters / dt, "glm_irls_rows_per_sec"
+
+
 if __name__ == "__main__":
-    # subprocess entry for the watchdog in the repo-root bench.py; the DRF
-    # secondary metric runs as its OWN watchdog stage (H2O3_BENCH_ONLY=drf)
+    # subprocess entry for the watchdog in the repo-root bench.py; each
+    # secondary metric runs as its OWN watchdog stage (H2O3_BENCH_ONLY=…)
     import os
 
-    if os.environ.get("H2O3_BENCH_ONLY") == "drf":
+    mode = os.environ.get("H2O3_BENCH_ONLY", "")
+    if mode == "drf":
         value, metric = run_drf_deep()
+    elif mode == "compile":
+        value, metric = run_compile_probe()
+    elif mode == "glm":
+        value, metric = run_glm()
+    elif mode == "pallas":
+        # Pallas-vs-XLA on silicon: same flagship config, Pallas histogram
+        # path forced on (smaller tree count to fit the stage budget)
+        os.environ["H2O_TPU_PALLAS_HIST"] = "1"
+        value, metric = run_flagship(
+            n_rows=int(os.environ.get("H2O3_BENCH_ROWS", 1_000_000)),
+            ntrees=10)
+        metric = "gbm_pallas_rows_per_sec"
     else:
         value, metric = run_flagship(
             n_rows=int(os.environ.get("H2O3_BENCH_ROWS", 1_000_000)),

@@ -266,12 +266,29 @@ class Column:
         return int(self.data.shape[0]) if self.data is not None else len(self.host_data)
 
     def to_numpy(self) -> np.ndarray:
-        """Gather the logical (unpadded) rows back to host."""
+        """Gather the logical (unpadded) rows back to host. On a
+        multi-process cloud the column spans non-addressable devices —
+        allgather the shards so any process sees the full column (the
+        reference's as_data_frame works from any node: water/Frame fetch
+        over RPC; here it rides the jax.distributed transport)."""
         if self.data is None:
             return self.host_data[: self.nrows]
-        arr = np.asarray(self.data)[: self.nrows]
-        if self.ctype == T_CAT:
-            return arr
+        data = self.data
+        if not getattr(data, "is_fully_addressable", True):
+            from jax.experimental import multihost_utils
+
+            from h2o3_tpu.parallel import oplog
+
+            if oplog.unmirrored_collective_risk():
+                # a REST handler outside its op turn must not enter a
+                # collective the follower will never join — fail fast with
+                # the actionable error instead of deadlocking the mesh
+                raise RuntimeError(
+                    "host fetch of a multi-process frame from a REST "
+                    "handler requires an oplog-mirrored op (followers "
+                    "replay broadcast ops only)")
+            data = multihost_utils.process_allgather(data, tiled=True)
+        arr = np.asarray(data)[: self.nrows]
         return arr
 
     def values(self) -> np.ndarray:

@@ -349,12 +349,15 @@ class DeepLearning(ModelBuilder):
         grad_fn = jax.grad(loss_fn)
 
         @jax.jit
-        def run_epoch(params, opt_state, key):
+        def _epoch_impl(params, opt_state, key, Xa, ya, wa):
+            # data arrives as ARGUMENTS, not closed-over globals: on a
+            # multi-process cloud closing over an array that spans
+            # non-addressable devices is an error (jax multi-controller)
             def step(carry, _):
                 params, opt_state, key = carry
                 key, kidx, kdrop = jax.random.split(key, 3)
                 idx = jax.random.randint(kidx, (batch,), 0, padded)
-                xb, yb, wb = X[idx], y[idx], row_w[idx]
+                xb, yb, wb = Xa[idx], ya[idx], wa[idx]
                 grads = grad_fn(params, xb, yb, wb, kdrop)
                 updates, opt_state = opt.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
@@ -363,6 +366,9 @@ class DeepLearning(ModelBuilder):
             (params, opt_state, key), _ = jax.lax.scan(
                 step, (params, opt_state, key), None, length=steps_per_epoch)
             return params, opt_state, key
+
+        def run_epoch(params, opt_state, key):
+            return _epoch_impl(params, opt_state, key, X, y, row_w)
 
         # per-device model averaging (DeepLearningTask.java:19,180 — local
         # replicas train independently, reduce = weighted average): each

@@ -28,6 +28,10 @@ class GenericModel(Model):
         self._inner = inner
         # mirror the inner model's world so REST/metrics introspection works
         self._output = inner._output
+        # ... and its scoring add-ons (Platt/isotonic calibration columns)
+        self._calibrator = getattr(inner, "_calibrator", None)
+        if self._calibrator is not None:
+            self._calibrated_p1 = inner._calibrated_p1
 
     def _predict_raw(self, frame: Frame):
         return self._inner._predict_raw(frame)

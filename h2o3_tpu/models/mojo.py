@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import uuid as _uuid
 import zipfile
 from typing import Any, Dict, List, Optional, Tuple
@@ -382,14 +383,27 @@ def _threshold_metrics(thr: float):
 
 
 def read_mojo(source) -> Model:
-    """Load a MOJO (path / bytes / file-like) back into a scoring model."""
+    """Load a MOJO (path / bytes / file-like) back into a scoring model.
+    Reference-format (Java) MOJOs — model.ini + trees/*.bin — route to the
+    mojo_java importer, so `Generic(path=...)` accepts REAL h2o-3 artifacts
+    (hex/generic/Generic.java parity)."""
+    from h2o3_tpu.models import mojo_java
+
+    if not isinstance(source, (bytes, bytearray)) and \
+            isinstance(source, (str, os.PathLike)) and os.path.isdir(source):
+        return mojo_java.read_java_mojo(source)     # exploded reference MOJO
     if isinstance(source, (bytes, bytearray)):
         source = io.BytesIO(source)
     with zipfile.ZipFile(source) as z:
         names = set(z.namelist())
         if "scorer.json" not in names:
-            raise ValueError("not an h2o3_tpu MOJO: scorer.json missing "
-                             "(reference-Java MOJO payloads are not supported)")
+            if "model.ini" in names:
+                if hasattr(source, "seek"):
+                    source.seek(0)
+                return mojo_java.read_java_mojo(
+                    source.read() if hasattr(source, "read") else source)
+            raise ValueError("not a MOJO: neither scorer.json (h2o3_tpu) "
+                             "nor model.ini (reference format) present")
         scorer = json.loads(z.read("scorer.json").decode())
         arrays = {}
         for n in names:

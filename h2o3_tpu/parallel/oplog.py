@@ -36,21 +36,53 @@ _RAPIDS_SESSIONS: Dict[str, Any] = {}     # follower-side session mirror
 # order from the follower's strictly sequential replay — a mesh deadlock.
 _EXEC_COND = threading.Condition()
 _NEXT_EXEC = 0
+# publish() runs on concurrent REST handler threads: sequence allocation
+# and the kv_put must be atomic or two ops can claim the same slot (one
+# overwrites the other in the KV and the follower stalls at the gap)
+_PUB_LOCK = threading.Lock()
+
+
+# reentrancy guard: while the coordinator executes an op inside turn() (or
+# a follower replays one in _apply), nested framework calls — AutoML's base
+# models, CV submodels, grid entries — must NOT broadcast their own ops:
+# the follower replays the TOP-level op and re-runs the nested programs
+# itself, so a nested broadcast would double-execute them on the follower.
+_TLS = threading.local()
+
+# set by api.server.start_server: this process is the coordinator of a
+# REST-driven cloud, so device/collective work on handler threads is only
+# legal inside a broadcast op's turn (the follower replays ops, nothing
+# else). Framework internals consult this to fail fast instead of entering
+# a collective the follower will never join.
+REST_SERVING = False
+
+
+def _in_op() -> bool:
+    return bool(getattr(_TLS, "in_op", False))
+
+
+def unmirrored_collective_risk() -> bool:
+    """True when the calling thread is about to run a collective the other
+    processes will NOT mirror: coordinator of a REST-serving multi-process
+    cloud, outside any op turn."""
+    return (REST_SERVING and D.process_count() > 1 and D.is_coordinator()
+            and not _in_op())
 
 
 def active() -> bool:
     """Coordinator with followers attached: REST handlers must broadcast."""
-    return D.process_count() > 1 and D.is_coordinator()
+    return D.process_count() > 1 and D.is_coordinator() and not _in_op()
 
 
 def publish(kind: str, payload: Dict[str, Any]) -> int:
     """Append one op (coordinator only); followers replay in sequence.
     Returns the op's sequence number (the coordinator's execution ticket)."""
     global _SEQ
-    D.kv_put(f"{_PREFIX}/{_SEQ}",
-             json.dumps({"kind": kind, "payload": payload}))
-    seq = _SEQ
-    _SEQ += 1
+    with _PUB_LOCK:
+        seq = _SEQ
+        _SEQ += 1
+        D.kv_put(f"{_PREFIX}/{seq}",
+                 json.dumps({"kind": kind, "payload": payload}))
     return seq
 
 
@@ -75,9 +107,11 @@ def turn(seq: Optional[int]):
     with _EXEC_COND:
         while _NEXT_EXEC != seq:
             _EXEC_COND.wait(timeout=1.0)
+    _TLS.in_op = True
     try:
         yield
     finally:
+        _TLS.in_op = False
         with _EXEC_COND:
             _NEXT_EXEC = seq + 1
             _EXEC_COND.notify_all()
@@ -141,6 +175,26 @@ def _apply(kind: str, p: Dict[str, Any]) -> None:
         if sess is None:
             sess = _RAPIDS_SESSIONS[sid] = Session(sid)
         exec_rapids(p["ast"], sess)
+        return
+    if kind == "automl":
+        # one op = the WHOLE deterministic build: seed is pinned and
+        # max_runtime_secs cleared by the coordinator before broadcast, so
+        # every process walks the identical model sequence and the nested
+        # device programs line up without per-model ops
+        from h2o3_tpu.automl.automl import H2OAutoML
+        from h2o3_tpu.core.dkv import DKV
+
+        aml = H2OAutoML(**p["spec"])
+        train = DKV.get(p["training_frame"])
+        valid = DKV.get(p["validation_frame"]) if p.get("validation_frame") \
+            else None
+        lb = DKV.get(p["leaderboard_frame"]) if p.get("leaderboard_frame") \
+            else None
+        aml.train(x=p.get("x"), y=p["y"], training_frame=train,
+                  validation_frame=valid, leaderboard_frame=lb)
+        # mirror the coordinator's Job.start(dest=project) install so the
+        # project key resolves on every process
+        DKV.put(p["spec"]["project_name"], aml)
         return
     raise ValueError(f"unknown oplog op {kind!r}")
 

@@ -1,0 +1,131 @@
+"""Cross-scoring parity vs REAL reference MOJO artifacts (VERDICT r4 #5).
+
+Ground truth = the hard-coded expectations of the reference's own genmodel
+tests (GbmMojoModelTest.java, GlmMojoModelTest.java), scored here against
+the UNMODIFIED artifacts shipped in the reference test resources — no JVM
+involved; the importer (models/mojo_java.py) decodes the compressed-tree
+byte format and scores through device arrays.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.core.frame import Column, Frame
+
+REF = "/root/reference/h2o-genmodel/src/test/resources/hex/genmodel/algos"
+GBM_FIXTURE = os.path.join(REF, "gbm", "calibrated")
+GLM_FIXTURE = os.path.join(REF, "glm", "prostate")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(GBM_FIXTURE),
+    reason="reference genmodel fixtures not present")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    h2o3_tpu.init()
+    yield
+
+
+def _read_domain(fixture, fname):
+    with open(os.path.join(fixture, "domains", fname)) as f:
+        return [ln.rstrip("\n") for ln in f if ln != "\n"]
+
+
+def test_gbm_reference_mojo_parity():
+    """GbmMojoModelTest.testScore0/testPredict: row → [0.5416688,
+    0.4583312], label '1', calibrated [0.3920402, 0.6079598]."""
+    from h2o3_tpu.models.generic import Generic
+
+    model = Generic(path=GBM_FIXTURE).train()
+    num_cols = ["SegSumT", "SegTSeas", "SegLowFlow", "DSDist", "DSMaxSlope",
+                "USAvgT", "USRainDays", "USSlope", "USNative", "DSDam"]
+    vals = [18.7, 1.51, 1.003, 132.53, 1.15, 0.2, 1.153, 8.3, 0.34, 0.0]
+    fr = Frame()
+    for c, v in zip(num_cols, vals):
+        fr.add(c, Column.from_numpy(np.asarray([v], np.float64)))
+    fr.add("Method", Column.from_numpy(np.asarray(["electric"]),
+                                       ctype="enum"))
+    pred = model.predict(fr)
+    p0 = float(pred.col("0").to_numpy()[0])
+    p1 = float(pred.col("1").to_numpy()[0])
+    assert p0 == pytest.approx(0.5416688, abs=1e-5)
+    assert p1 == pytest.approx(0.4583312, abs=1e-5)
+    lbl = pred.col("predict").values()[0]
+    assert str(lbl) == "1"          # p1 >= default_threshold 0.29007…
+    cal1 = float(pred.col("cal_1").to_numpy()[0])
+    cal0 = float(pred.col("cal_0").to_numpy()[0])
+    assert cal1 == pytest.approx(0.6079598, abs=1e-5)
+    assert cal0 == pytest.approx(0.3920402, abs=1e-5)
+
+
+def test_glm_reference_mojo_parity():
+    """GlmMojoModelTest: 12 prostate rows (incl. one NaN needing mean
+    imputation) → exact probabilities to 1e-7."""
+    from h2o3_tpu.models import mojo
+
+    model = mojo.read_mojo(GLM_FIXTURE)
+    race_dom = _read_domain(GLM_FIXTURE, "d000.txt")
+    data = np.asarray([
+        [2, 73, 2, 1, 7.9, 18, 6],
+        [1, 51, 3, 1, 8.9, 0, 6],
+        [2, 57, 3, 1, 3.4, 30.8, 6],
+        [1, 65, 4, 1, 6.3, 0, 6],
+        [1, 61, 3, 1, 1.5, 0, 5],
+        [1, 56, 2, 2, 58, 0, 6],
+        [1, 72, 2, 1, 1.4, 24.2, 6],
+        [1, 54, 2, 1, 18, 43, 9],
+        [1, 62, 2, 1, 7.3, 0, 7],
+        [2, 63, 3, 1, 14.3, 16, 7],
+        [1, 68, 1, 1, 5.4, 34, 5],
+        [1, np.nan, 1, 1, 5.4, 34, 5],
+    ])
+    exp = np.asarray([
+        [0.0, 0.883740206424754, 0.11625979357524593],
+        [1.0, 0.5591006829867439, 0.44089931701325613],
+        [0.0, 0.8200793110208472, 0.1799206889791528],
+        [1.0, 0.4855023555733662, 0.5144976444266338],
+        [0.0, 0.8260781970262484, 0.17392180297375157],
+        [1.0, 0.2685796973779421, 0.7314203026220579],
+        [0.0, 0.8265057623033865, 0.1734942376966135],
+        [1.0, 0.1332488800455477, 0.8667511199544523],
+        [1.0, 0.5038183003787983, 0.49618169962120173],
+        [1.0, 0.5384202639029669, 0.46157973609703307],
+        [0.0, 0.9543248143434919, 0.04567518565650803],
+        [0.0, 0.9531416700165544, 0.046858329983445586],
+    ])
+    fr = Frame()
+    fr.add("RACE", Column.from_numpy(
+        np.asarray([race_dom[int(c)] for c in data[:, 0]]), ctype="enum"))
+    for j, name in enumerate(["AGE", "DPROS", "DCAPS", "PSA", "VOL",
+                              "GLEASON"], start=1):
+        fr.add(name, Column.from_numpy(data[:, j]))
+    pred = model.predict(fr)
+    got0 = np.asarray(pred.col("0").to_numpy(), np.float64)
+    got1 = np.asarray(pred.col("1").to_numpy(), np.float64)
+    np.testing.assert_allclose(got0, exp[:, 1], atol=1e-6)
+    np.testing.assert_allclose(got1, exp[:, 2], atol=1e-6)
+    lbl = pred.col("predict").values()
+    assert [str(x) for x in lbl] == [str(int(e)) for e in exp[:, 0]]
+
+
+def test_rest_import_reference_mojo(tmp_path):
+    """The /3/ModelBuilders/generic REST path accepts a zipped reference
+    MOJO (hex/generic/Generic.java parity at the API surface)."""
+    import shutil
+    import zipfile
+
+    from h2o3_tpu.models import mojo
+
+    zpath = tmp_path / "ref_gbm.zip"
+    with zipfile.ZipFile(zpath, "w") as z:
+        for root, _, files in os.walk(GBM_FIXTURE):
+            for f in files:
+                full = os.path.join(root, f)
+                z.write(full, os.path.relpath(full, GBM_FIXTURE))
+    model = mojo.read_mojo(str(zpath))
+    assert model.algo_name == "gbm"
+    assert model._output.response_domain == ["0", "1"]

@@ -19,9 +19,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_cloud_trains_glm():
+def _run_workers(script: str, timeout: int = 480):
     port = _free_port()
-    worker = os.path.join(os.path.dirname(__file__), "mp_worker.py")
+    worker = os.path.join(os.path.dirname(__file__), script)
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS",)}          # workers pick their own count
     env["JAX_PLATFORMS"] = "cpu"
@@ -34,7 +34,7 @@ def test_two_process_cloud_trains_glm():
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=480)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out.decode())
     except subprocess.TimeoutExpired:
         for p in procs:
@@ -43,3 +43,14 @@ def test_two_process_cloud_trains_glm():
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
         assert f"proc {i}: OK" in out
+
+
+def test_two_process_cloud_trains_glm():
+    _run_workers("mp_worker.py")
+
+
+def test_two_process_sort_join_dl_rapids_automl():
+    """Round-5 widening (VERDICT r4 item 4): sort/join all_to_all,
+    DeepLearning, Rapids replay, and a broadcast AutoML build — all across
+    a real jax.distributed process boundary."""
+    _run_workers("mp_worker2.py", timeout=600)
