@@ -1,0 +1,105 @@
+# Connection + wire layer. Mirrors the reference client's REST contract
+# (h2o-r/h2o-package/R/connection.R + communication.R: urlencoded POST
+# bodies, /3/Cloud boot probe, /3/InitID session) over the system curl
+# binary, so the package needs no compiled dependencies.
+
+.h2o.env <- new.env(parent = emptyenv())
+
+.h2o.base <- function() {
+  b <- get0("base_url", envir = .h2o.env)
+  if (is.null(b)) stop("no active connection; call h2o.init() first")
+  b
+}
+
+.h2o.esc <- function(x) {
+  # communication.R curlEscape on every value
+  vapply(as.character(x), utils::URLencode, "", reserved = TRUE,
+         USE.NAMES = FALSE)
+}
+
+.h2o.curl <- function(args) {
+  out <- suppressWarnings(system2("curl", c("-s", "-S", args),
+                                  stdout = TRUE, stderr = TRUE))
+  status <- attr(out, "status")
+  if (!is.null(status) && status != 0)
+    stop("curl failed (", status, "): ", paste(out, collapse = "\n"))
+  paste(out, collapse = "\n")
+}
+
+.h2o.fromJSON <- function(txt) {
+  res <- jsonlite::fromJSON(txt, simplifyVector = FALSE)
+  # H2O error schema: surface exception_msg/msg like .h2o.doSafeREST
+  if (!is.null(res$exception_msg)) stop(res$exception_msg)
+  if (!is.null(res$error_url) && !is.null(res$msg)) stop(res$msg)
+  res
+}
+
+.h2o.GET <- function(path, params = list()) {
+  url <- paste0(.h2o.base(), path)
+  if (length(params)) {
+    q <- paste(names(params), .h2o.esc(unlist(params)),
+               sep = "=", collapse = "&")
+    url <- paste0(url, "?", q)
+  }
+  .h2o.fromJSON(.h2o.curl(url))
+}
+
+.h2o.POST <- function(path, params = list()) {
+  # curlPerform(postfields = name=value&...) — NEVER json (communication.R)
+  body <- if (length(params)) {
+    paste(names(params), .h2o.esc(unlist(params)), sep = "=", collapse = "&")
+  } else ""
+  .h2o.fromJSON(.h2o.curl(c("-X", "POST",
+                            "-H", "Content-Type: application/x-www-form-urlencoded",
+                            "--data", body, paste0(.h2o.base(), path))))
+}
+
+.h2o.DELETE <- function(path) {
+  .h2o.fromJSON(.h2o.curl(c("-X", "DELETE", paste0(.h2o.base(), path))))
+}
+
+# connection.R h2o.init: probe /3/Cloud until healthy, open an /3/InitID
+# session key for Rapids scoping
+h2o.init <- function(ip = "localhost", port = 54321, https = FALSE,
+                     max_retries = 20) {
+  scheme <- if (https) "https" else "http"
+  assign("base_url", sprintf("%s://%s:%d", scheme, ip, port),
+         envir = .h2o.env)
+  for (i in seq_len(max_retries)) {
+    cloud <- tryCatch(.h2o.GET("/3/Cloud"), error = function(e) NULL)
+    if (!is.null(cloud) && isTRUE(cloud$cloud_healthy)) {
+      sess <- .h2o.POST("/3/InitID")
+      assign("session_id", sess$session_key, envir = .h2o.env)
+      message(sprintf("Connected to h2o3-tpu cloud '%s' (%d device(s))",
+                      cloud$cloud_name, cloud$cloud_size))
+      return(invisible(cloud))
+    }
+    Sys.sleep(0.5)
+  }
+  stop("could not connect to ", .h2o.base())
+}
+
+h2o.clusterInfo <- function() .h2o.GET("/3/Cloud")
+
+h2o.shutdown <- function(prompt = FALSE) {
+  if (prompt) {
+    ans <- readline("Are you sure you want to shutdown the cloud? (Y/N) ")
+    if (!identical(toupper(ans), "Y")) return(invisible(FALSE))
+  }
+  invisible(tryCatch(.h2o.POST("/3/Shutdown"), error = function(e) NULL))
+}
+
+# models.R .h2o.getFutureModel-style job poll
+.h2o.waitJob <- function(job_key, poll_s = 0.2, timeout_s = 3600) {
+  deadline <- Sys.time() + timeout_s
+  path <- paste0("/3/Jobs/", .h2o.esc(job_key))
+  while (Sys.time() < deadline) {
+    j <- .h2o.GET(path)$jobs[[1]]
+    if (j$status %in% c("DONE")) return(invisible(j))
+    if (j$status %in% c("FAILED", "CANCELLED"))
+      stop("job ", job_key, " ", j$status, ": ",
+           if (!is.null(j$exception)) j$exception else "")
+    Sys.sleep(poll_s)
+  }
+  stop("job ", job_key, " timed out")
+}

@@ -1,0 +1,68 @@
+# Frames. Mirrors h2o-r/h2o-package/R/frame.R + parse.R surface: an
+# H2OFrame is a lightweight handle (frame_id + cached dims); data pulls
+# ride /3/DownloadDataset as CSV.
+
+.h2o.newFrame <- function(frame_id) {
+  fg <- .h2o.GET(paste0("/3/Frames/", .h2o.esc(frame_id)),
+                 list(row_count = 1))$frames[[1]]
+  structure(list(frame_id = frame_id,
+                 nrows = fg$rows,
+                 ncols = length(fg$columns),
+                 col_names = vapply(fg$columns, function(c) c$label, "")),
+            class = "H2OFrame")
+}
+
+# parse.R h2o.importFile -> h2o.parseRaw: POST /3/Parse with the R-style
+# ["path"] source_frames list (.collapse.char), then poll the parse job
+h2o.importFile <- function(path, destination_frame = NULL, header = NA,
+                           col.names = NULL) {
+  if (is.null(destination_frame) || !nzchar(destination_frame)) {
+    base <- sub("\\.[^.]*$", "", basename(path))
+    destination_frame <- paste0(base, ".hex")
+  }
+  params <- list(
+    source_frames = paste0("[\"", path, "\"]"),
+    destination_frame = destination_frame)
+  if (!is.na(header)) params$header <- if (isTRUE(header)) 1 else 0
+  if (!is.null(col.names))
+    params$column_names <- paste0("[", paste0("\"", col.names, "\"",
+                                              collapse = ","), "]")
+  res <- .h2o.POST("/3/Parse", params)
+  .h2o.waitJob(res$job$key$name)
+  .h2o.newFrame(destination_frame)
+}
+
+h2o.getFrame <- function(id) .h2o.newFrame(id)
+
+h2o.ls <- function() {
+  fr <- .h2o.GET("/3/Frames")$frames
+  ml <- .h2o.GET("/3/Models")$models
+  data.frame(key = c(vapply(fr, function(f) f$frame_id$name, ""),
+                     vapply(ml, function(m) m$model_id$name, "")),
+             type = c(rep("frame", length(fr)), rep("model", length(ml))),
+             stringsAsFactors = FALSE)
+}
+
+h2o.rm <- function(id) {
+  id <- if (inherits(id, "H2OFrame")) id$frame_id else as.character(id)
+  invisible(.h2o.DELETE(paste0("/3/Frames/", .h2o.esc(id))))
+}
+
+dim.H2OFrame <- function(x) c(x$nrows, x$ncols)
+
+print.H2OFrame <- function(x, ...) {
+  cat(sprintf("H2OFrame '%s': %d rows x %d cols\n",
+              x$frame_id, x$nrows, x$ncols))
+  invisible(x)
+}
+
+# frame.R as.data.frame.H2OFrame: stream the frame back as CSV
+# (/3/DownloadDataset, the same route the reference client uses)
+as.data.frame.H2OFrame <- function(x, ...) {
+  url <- paste0(.h2o.base(), "/3/DownloadDataset?frame_id=",
+                .h2o.esc(x$frame_id))
+  tmp <- tempfile(fileext = ".csv")
+  on.exit(unlink(tmp))
+  .h2o.curl(c("-o", tmp, url))
+  utils::read.csv(tmp, stringsAsFactors = FALSE)
+}

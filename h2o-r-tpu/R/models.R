@@ -1,0 +1,119 @@
+# Model training + scoring. Mirrors h2o-r/h2o-package/R/models.R:
+# .h2o.startModelJob posts urlencoded params to /3/ModelBuilders/{algo},
+# predict posts to /4/Predictions and reads key/dest at the TOP level of
+# the v4 response (models.R:679 res$key$name, res$dest$name).
+
+.h2o.frameId <- function(fr) {
+  if (inherits(fr, "H2OFrame")) fr$frame_id else as.character(fr)
+}
+
+.h2o.trainModel <- function(algo, x, y, training_frame,
+                            validation_frame = NULL, model_id = NULL, ...) {
+  params <- list(training_frame = .h2o.frameId(training_frame))
+  if (!is.null(y)) params$response_column <- y
+  if (!is.null(validation_frame))
+    params$validation_frame <- .h2o.frameId(validation_frame)
+  if (!is.null(model_id)) params$model_id <- model_id
+  extra <- list(...)
+  for (k in names(extra)) {
+    v <- extra[[k]]
+    if (is.null(v)) next
+    # models.R: R logicals go as TRUE/FALSE words, vectors as [a,b,c]
+    params[[k]] <- if (is.logical(v)) {
+      if (v) "TRUE" else "FALSE"
+    } else if (length(v) > 1) {
+      paste0("[", paste(v, collapse = ","), "]")
+    } else v
+  }
+  if (!is.null(x)) {
+    keep <- unique(c(x, y))
+    fg <- .h2o.GET(paste0("/3/Frames/",
+                          .h2o.esc(params$training_frame)),
+                   list(row_count = 1))$frames[[1]]
+    all_cols <- vapply(fg$columns, function(c) c$label, "")
+    ign <- setdiff(all_cols, keep)
+    if (length(ign))
+      params$ignored_columns <- paste0("[", paste0("\"", ign, "\"",
+                                                   collapse = ","), "]")
+  }
+  res <- .h2o.POST(paste0("/3/ModelBuilders/", algo), params)
+  job <- .h2o.waitJob(res$job$key$name)
+  h2o.getModel(job$dest$name)
+}
+
+h2o.getModel <- function(model_id) {
+  m <- .h2o.GET(paste0("/3/Models/", .h2o.esc(model_id)))$models[[1]]
+  structure(list(model_id = model_id, algo = m$algo, model = m),
+            class = "H2OModel")
+}
+
+print.H2OModel <- function(x, ...) {
+  cat(sprintf("H2OModel '%s' (%s)\n", x$model_id, x$algo))
+  invisible(x)
+}
+
+h2o.gbm <- function(x = NULL, y, training_frame, validation_frame = NULL,
+                    model_id = NULL, ...)
+  .h2o.trainModel("gbm", x, y, training_frame, validation_frame,
+                  model_id, ...)
+
+h2o.glm <- function(x = NULL, y, training_frame, validation_frame = NULL,
+                    model_id = NULL, ...)
+  .h2o.trainModel("glm", x, y, training_frame, validation_frame,
+                  model_id, ...)
+
+h2o.randomForest <- function(x = NULL, y, training_frame,
+                             validation_frame = NULL, model_id = NULL, ...)
+  .h2o.trainModel("drf", x, y, training_frame, validation_frame,
+                  model_id, ...)
+
+h2o.deeplearning <- function(x = NULL, y, training_frame,
+                             validation_frame = NULL, model_id = NULL, ...)
+  .h2o.trainModel("deeplearning", x, y, training_frame, validation_frame,
+                  model_id, ...)
+
+# automl.R h2o.automl: JSON body on /99/AutoMLBuilder (the one jsonized
+# request in the reference client too)
+h2o.automl <- function(x = NULL, y, training_frame, max_models = 10,
+                       project_name = NULL, nfolds = -1, seed = NULL, ...) {
+  spec <- list(
+    input_spec = list(training_frame = .h2o.frameId(training_frame),
+                      response_column = y),
+    build_control = list(
+      stopping_criteria = list(max_models = max_models)))
+  if (!is.null(project_name)) spec$build_control$project_name <- project_name
+  if (nfolds >= 0) spec$build_control$nfolds <- nfolds
+  if (!is.null(seed)) spec$build_control$stopping_criteria$seed <- seed
+  body <- jsonlite::toJSON(spec, auto_unbox = TRUE)
+  tmp <- tempfile(); on.exit(unlink(tmp))
+  writeLines(body, tmp)
+  res <- .h2o.fromJSON(.h2o.curl(c(
+    "-X", "POST", "-H", "Content-Type: application/json",
+    "--data", paste0("@", tmp),
+    paste0(.h2o.base(), "/99/AutoMLBuilder"))))
+  .h2o.waitJob(res$job$key$name)
+  project <- res$build_control$project_name
+  lb <- .h2o.GET(paste0("/99/Leaderboards/", .h2o.esc(project)))
+  list(project_name = project, leaderboard = lb)
+}
+
+# models.R predict.H2OModel/h2o.predict: async v4 route, dest at top level
+h2o.predict <- function(object, newdata, ...) {
+  res <- .h2o.POST(paste0("/4/Predictions/models/",
+                          .h2o.esc(object$model_id), "/frames/",
+                          .h2o.esc(.h2o.frameId(newdata))))
+  dest <- if (!is.null(res$dest)) res$dest$name else res$key$name
+  if (!is.null(res$job)) .h2o.waitJob(res$job$key$name)
+  .h2o.newFrame(dest)
+}
+
+predict.H2OModel <- function(object, newdata, ...)
+  h2o.predict(object, newdata, ...)
+
+# models.R h2o.performance: the synchronous v3 metrics route
+h2o.performance <- function(model, newdata) {
+  res <- .h2o.POST(paste0("/3/Predictions/models/",
+                          .h2o.esc(model$model_id), "/frames/",
+                          .h2o.esc(.h2o.frameId(newdata))))
+  if (length(res$model_metrics)) res$model_metrics[[1]] else NULL
+}

@@ -1,0 +1,30 @@
+# End-to-end smoke: init -> importFile -> gbm -> predict -> as.data.frame.
+# Run with: Rscript smoke.R <port> <csv_path>
+# (tests/test_h2or_client.py launches this against a live server when an
+# R runtime exists; the same wire sequence is replayed in python otherwise)
+
+args <- commandArgs(trailingOnly = TRUE)
+port <- as.integer(args[[1]])
+csv <- args[[2]]
+
+pkg_dir <- file.path(dirname(sub("--file=", "",
+  grep("--file=", commandArgs(), value = TRUE))), "..", "R")
+for (f in list.files(pkg_dir, full.names = TRUE)) source(f)
+
+h2o.init(port = port)
+fr <- h2o.importFile(csv, destination_frame = "r_smoke.hex")
+stopifnot(dim(fr)[1] > 0)
+cat("IMPORT_OK", dim(fr)[1], dim(fr)[2], "\n")
+
+m <- h2o.gbm(y = "y", training_frame = fr, ntrees = 3, max_depth = 3,
+             model_id = "r_smoke_gbm")
+cat("TRAIN_OK", m$model_id, "\n")
+
+p <- h2o.predict(m, fr)
+df <- as.data.frame(p)
+stopifnot(nrow(df) == dim(fr)[1], "predict" %in% names(df))
+cat("PREDICT_OK", nrow(df), "\n")
+
+perf <- h2o.performance(m, fr)
+cat("PERF_OK", if (!is.null(perf$AUC)) perf$AUC else "NA", "\n")
+cat("R_SMOKE_DONE\n")

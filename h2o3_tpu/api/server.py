@@ -594,6 +594,20 @@ def h_modelbuilder_get(ctx: Ctx):
             "model_builders": {algo: _builder_schema(algo, cls)}}
 
 
+def _pin_seed_and_wire(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Prepare builder params for an oplog broadcast: every process must
+    draw the SAME host-side sampling masks, so a wildcard seed is pinned
+    IN PLACE before the op ships; the returned copy keeps only
+    JSON-serializable values (and drops model_id — the op carries the
+    destination separately)."""
+    if params.get("seed") in (None, -1):
+        params["seed"] = int(uuid.uuid4().int % (2 ** 31))
+    wire = {k: v for k, v in params.items()
+            if isinstance(v, (int, float, str, bool, type(None), list))}
+    wire.pop("model_id", None)
+    return wire
+
+
 def _extract_train_params(cls, body: Dict[str, Any]):
     defaults = cls.default_params()
     params: Dict[str, Any] = {}
@@ -656,14 +670,7 @@ def h_modelbuilder_train(ctx: Ctx):
 
     op_seq = None
     if oplog.active():
-        # every process must draw the SAME host-side sampling masks, so a
-        # wildcard seed gets pinned before the op ships
-        if builder.params.get("seed") in (None, -1):
-            builder.params["seed"] = int(uuid.uuid4().int % (2 ** 31))
-        wire_params = {k: v for k, v in builder.params.items()
-                       if isinstance(v, (int, float, str, bool, type(None),
-                                         list))}
-        wire_params.pop("model_id", None)
+        wire_params = _pin_seed_and_wire(builder.params)
         op_seq = oplog.broadcast("train", {
             "algo": algo, "params": wire_params,
             "training_frame": str(train.key),
@@ -991,14 +998,38 @@ def h_grid_build(ctx: Ctx):
 
     from h2o3_tpu.grid import H2OGridSearch
 
+    parallelism = int(body.pop("parallelism", 1) or 1)
+    recovery_dir = str(body.pop("recovery_dir", "") or "").strip('"') or None
     job = Job(description=f"{algo} Grid Build", dest=grid_id)
     job.dest_type = "Key<Grid>"
+
+    from h2o3_tpu.parallel import oplog
+
+    op_seq = None
+    if oplog.active():
+        # one deterministic op: every process walks the identical combo
+        # sequence. Parallel building would interleave device programs
+        # nondeterministically across processes — force sequential there.
+        sc_seed = (criteria or {}).get("seed")
+        if str((criteria or {}).get("strategy", "")).lower() == "randomdiscrete" \
+                and (not isinstance(sc_seed, (int, float)) or int(sc_seed) < 0):
+            criteria = dict(criteria or {})
+            criteria["seed"] = int(uuid.uuid4().int % (2 ** 31))
+        parallelism = 1
+        wire_params = _pin_seed_and_wire(params)
+        op_seq = oplog.broadcast("grid", {
+            "algo": algo, "params": wire_params, "hyper": hyper,
+            "criteria": criteria, "grid_id": grid_id, "y": y,
+            "training_frame": str(train.key),
+            "validation_frame": str(valid.key) if valid is not None else None})
 
     def run(j: Job):
         base = cls(**params)
         grid = H2OGridSearch(base, hyper, grid_id=grid_id,
                              search_criteria=criteria)
-        grid.train(y=y, training_frame=train, validation_frame=valid)
+        with oplog.turn(op_seq):
+            grid.train(y=y, training_frame=train, validation_frame=valid,
+                       parallelism=parallelism, recovery_dir=recovery_dir)
         return grid
 
     job.start(run, background=True)
@@ -1395,6 +1426,11 @@ class RawReply:
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # per-connection socket timeout: a silent client (or a TLS client that
+    # never completes the deferred handshake) releases its handler thread
+    # instead of pinning it forever. Generous enough that a keep-alive
+    # client polling a long job never sees a surprise close mid-exchange.
+    timeout = 300
     server_ref: "ApiServer" = None    # set by ApiServer
 
     def log_message(self, fmt, *args):    # quiet; reference logs to file
@@ -1552,13 +1588,23 @@ class ApiServer:
 
     def __init__(self, port: int = 54321,
                  auth_file: Optional[str] = None,
-                 host: Optional[str] = None):
+                 host: Optional[str] = None,
+                 ssl_certfile: Optional[str] = None,
+                 ssl_keyfile: Optional[str] = None):
         # bind address: loopback by default; containers/pods set
         # H2O_TPU_BIND=0.0.0.0 (deploy/ manifests do)
         self.host = host or os.environ.get("H2O_TPU_BIND", "127.0.0.1")
         self.port = port
         self.httpd: Optional[ThreadingHTTPServer] = None
         self.thread: Optional[threading.Thread] = None
+        # TLS on the REST bind (reference: water/network/SSLProperties +
+        # jetty h2o_ssl_jks options; here a PEM cert/key pair, the
+        # standard python-stack equivalent)
+        self.ssl_certfile = ssl_certfile or os.environ.get("H2O_TPU_SSL_CERT")
+        self.ssl_keyfile = ssl_keyfile or os.environ.get("H2O_TPU_SSL_KEY")
+        if bool(self.ssl_certfile) != bool(self.ssl_keyfile):
+            raise ValueError("TLS needs BOTH H2O_TPU_SSL_CERT and "
+                             "H2O_TPU_SSL_KEY (PEM paths)")
         # {user: sha256(password) hex} from "user:hash" lines
         self.auth: Optional[Dict[str, str]] = None
         path = auth_file or os.environ.get("H2O_TPU_AUTH_FILE")
@@ -1579,10 +1625,27 @@ class ApiServer:
     def start(self) -> "ApiServer":
         handler = type("_BoundHandler", (_Handler,), {"server_ref": self})
         self.httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        if self.ssl_certfile:
+            import ssl
+
+            sctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            sctx.minimum_version = ssl.TLSVersion.TLSv1_2
+            sctx.load_cert_chain(self.ssl_certfile, self.ssl_keyfile)
+            # handshake must happen in the per-connection handler thread,
+            # NOT the accept loop: with on-connect handshakes one idle TCP
+            # connection (port scan, health probe) wedges serve_forever and
+            # the whole API with it
+            self.httpd.socket = sctx.wrap_socket(
+                self.httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
         self.port = self.httpd.server_address[1]
         self.thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         self.thread.start()
         return self
+
+    @property
+    def scheme(self) -> str:
+        return "https" if self.ssl_certfile else "http"
 
     def stop(self):
         if self.httpd:
@@ -1594,11 +1657,15 @@ class ApiServer:
 
 
 def start_server(port: int = 54321, auth_file: Optional[str] = None,
-                 host: Optional[str] = None) -> ApiServer:
+                 host: Optional[str] = None,
+                 ssl_certfile: Optional[str] = None,
+                 ssl_keyfile: Optional[str] = None) -> ApiServer:
     from h2o3_tpu.parallel import oplog
 
     oplog.REST_SERVING = True     # handler-thread collectives need op turns
-    return ApiServer(port, auth_file=auth_file, host=host).start()
+    return ApiServer(port, auth_file=auth_file, host=host,
+                     ssl_certfile=ssl_certfile,
+                     ssl_keyfile=ssl_keyfile).start()
 
 
 # ---------------------------------------------------------------------------

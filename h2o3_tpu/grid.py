@@ -1,18 +1,27 @@
 """Grid search — hyperparameter sweeps with cartesian / random walkers.
 
 Reference: hex/grid/GridSearch.java + HyperSpaceWalker.java (cartesian and
-RandomDiscrete with max_models/max_runtime budget, seed), resumable Grid kept
-in DKV, models ranked by a sort metric.
+RandomDiscrete with max_models/max_runtime budget, seed), parallel model
+building (GridSearch.java `parallelism`), resumable Grid kept in DKV with
+filesystem auto-recovery (Grid.exportBinary + GridSearchHandler resume).
 
 TPU-native: each candidate trains through the normal builder path (one or a
 few compiled programs); models with identical frame shapes share XLA compile
 caches, so a grid over e.g. learn_rate costs one compile + N executions.
+`parallelism > 1` overlaps the HOST side of k builds (binning, setup,
+metric assembly) while XLA serializes device programs itself — the same
+division of labor as the reference's ParallelModelBuilder over H2O.SELF.
 """
 
 from __future__ import annotations
 
 import itertools
+import json
+import os
+import pickle
+import threading
 import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Any, Dict, List, Optional, Sequence, Type
 
 import numpy as np
@@ -64,7 +73,23 @@ class H2OGridSearch(Keyed):
         self.search_criteria = dict(search_criteria or {"strategy": "Cartesian"})
         self.models: List[Model] = []
         self.failed: List[Dict[str, Any]] = []
+        self._done: set = set()            # combo keys already trained
+        self._lock = threading.Lock()
+        self.recovery_dir: Optional[str] = None
         self.install()
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d.pop("_lock", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _combo_key(params: Dict[str, Any]) -> str:
+        return json.dumps(sorted((k, str(v)) for k, v in params.items()))
 
     # -- walkers (HyperSpaceWalker.java) ----------------------------------
     def _candidates(self):
@@ -78,29 +103,136 @@ class H2OGridSearch(Keyed):
             rng.shuffle(combos)
         return keys, combos
 
+    # -- persistence (Grid.exportBinary / auto-recovery) -------------------
+    def _persist_model(self, model: Model) -> None:
+        mdir = os.path.join(self.recovery_dir, "models")
+        os.makedirs(mdir, exist_ok=True)
+        with open(os.path.join(mdir, f"{model.key}.bin"), "wb") as f:
+            pickle.dump(model, f)
+
+    def _persist_meta(self) -> None:
+        meta = {"grid_id": str(self.key),
+                "algo": self.builder_cls.algo_name,
+                "base_params": self.base_params,
+                "hyper_params": self.hyper_params,
+                "search_criteria": self.search_criteria,
+                "done": [{"combo_key": k} for k in sorted(self._done)],
+                "models": [str(m.key) for m in self.models],
+                "grid_params": {str(m.key): getattr(m, "_grid_params", {})
+                                for m in self.models},
+                "failed": self.failed}
+        tmp = os.path.join(self.recovery_dir, "grid.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=1)
+        os.replace(tmp, os.path.join(self.recovery_dir, "grid.json"))
+
+    @classmethod
+    def load(cls, recovery_dir: str) -> "H2OGridSearch":
+        """h2o.load_grid analog: restore a persisted grid (models included)
+        so train() continues with the remaining hyperparameter combos —
+        kill-and-resume parity with hex/grid/Grid resume."""
+        with open(os.path.join(recovery_dir, "grid.json")) as f:
+            meta = json.load(f)
+        g = cls(meta["algo"], meta["hyper_params"],
+                grid_id=meta["grid_id"],
+                search_criteria=meta["search_criteria"])
+        g.base_params = dict(meta["base_params"])
+        g.failed = list(meta["failed"])
+        g._done = {d["combo_key"] for d in meta["done"]}
+        g.recovery_dir = recovery_dir
+        from h2o3_tpu.api.routes_ext import _artifact_load_file
+
+        for mk in meta["models"]:
+            path = os.path.join(recovery_dir, "models", f"{mk}.bin")
+            m = _artifact_load_file(path)       # restricted unpickler
+            m._grid_params = meta["grid_params"].get(mk, {})
+            m.install()
+            g.models.append(m)
+        g.install()
+        return g
+
+    def _record(self, combo_params: Dict[str, Any], model: Model) -> None:
+        with self._lock:
+            model._grid_params = dict(combo_params)
+            self.models.append(model)
+            self._done.add(self._combo_key(combo_params))
+            if self.recovery_dir:
+                self._persist_model(model)
+                self._persist_meta()
+
     def train(self, x=None, y=None, training_frame: Optional[Frame] = None,
-              validation_frame: Optional[Frame] = None, **kw):
+              validation_frame: Optional[Frame] = None,
+              parallelism: int = 1, recovery_dir: Optional[str] = None,
+              **kw):
+        """Walk the hyper space. `parallelism` builds k models concurrently
+        (GridSearch.java parallelism); `recovery_dir` persists every
+        finished model + grid state so H2OGridSearch.load(dir) resumes
+        after a crash. Already-trained combos (after load) are skipped."""
         keys, combos = self._candidates()
+        if recovery_dir:
+            self.recovery_dir = recovery_dir
+            os.makedirs(recovery_dir, exist_ok=True)
         max_models = int(self.search_criteria.get("max_models", 0) or 0)
         max_secs = float(self.search_criteria.get("max_runtime_secs", 0) or 0)
         t0 = time.time()
-        for combo in combos:
+
+        def budget_left() -> bool:
             if max_models and len(self.models) >= max_models:
-                break
+                return False
             if max_secs and time.time() - t0 > max_secs:
-                break
+                return False
+            return True
+
+        def build(combo) -> None:
+            combo_params = dict(zip(keys, combo))
             params = dict(self.base_params)
             params.update(kw)
-            params.update(dict(zip(keys, combo)))
+            params.update(combo_params)
             try:
                 b = self.builder_cls(**params)
                 m = b.train(x=x, y=y, training_frame=training_frame,
                             validation_frame=validation_frame)
-                m._grid_params = dict(zip(keys, combo))
-                self.models.append(m)
+                self._record(combo_params, m)
             except Exception as e:       # noqa: BLE001 — grid keeps going
-                self.failed.append({"params": dict(zip(keys, combo)),
-                                    "error": f"{type(e).__name__}: {e}"})
+                with self._lock:
+                    self.failed.append({"params": combo_params,
+                                        "error": f"{type(e).__name__}: {e}"})
+
+        pending = [c for c in combos
+                   if self._combo_key(dict(zip(keys, c))) not in self._done]
+        if parallelism <= 1:
+            for combo in pending:
+                if not budget_left():
+                    break
+                build(combo)
+        else:
+            with ThreadPoolExecutor(max_workers=int(parallelism)) as pool:
+                futures = set()
+                it = iter(pending)
+                while True:
+                    # the models cap counts in-flight builds too, so the
+                    # budget is honored EXACTLY like the sequential walk
+                    # (not overshot by up to parallelism-1 models)
+                    def can_submit():
+                        if max_models and \
+                                len(self.models) + len(futures) >= max_models:
+                            return False
+                        return budget_left()
+
+                    while len(futures) < int(parallelism) and can_submit():
+                        combo = next(it, None)
+                        if combo is None:
+                            break
+                        futures.add(pool.submit(build, combo))
+                    if not futures:
+                        break
+                    finished, futures = wait(futures,
+                                             return_when=FIRST_COMPLETED)
+                    for f in finished:
+                        f.result()      # surface unexpected errors
+                    if not budget_left():
+                        wait(futures)   # stop feeding; let inflight finish
+                        break
         if not self.models:
             raise RuntimeError(f"grid produced no models; failures: {self.failed[:3]}")
         return self

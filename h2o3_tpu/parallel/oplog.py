@@ -176,6 +176,21 @@ def _apply(kind: str, p: Dict[str, Any]) -> None:
             sess = _RAPIDS_SESSIONS[sid] = Session(sid)
         exec_rapids(p["ast"], sess)
         return
+    if kind == "grid":
+        from h2o3_tpu.core.dkv import DKV
+        from h2o3_tpu.grid import H2OGridSearch
+        from h2o3_tpu.models.model_builder import BUILDERS
+
+        cls = BUILDERS[p["algo"]]
+        base = cls(**(p.get("params") or {}))
+        grid = H2OGridSearch(base, p["hyper"], grid_id=p["grid_id"],
+                             search_criteria=p.get("criteria"))
+        train = DKV.get(p["training_frame"])
+        valid = DKV.get(p["validation_frame"]) if p.get("validation_frame") \
+            else None
+        grid.train(y=p.get("y"), training_frame=train,
+                   validation_frame=valid)
+        return
     if kind == "automl":
         # one op = the WHOLE deterministic build: seed is pinned and
         # max_runtime_secs cleared by the coordinator before broadcast, so

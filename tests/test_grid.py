@@ -51,3 +51,70 @@ def test_grid_survives_failures(cl):
     g.train(y="y", training_frame=fr)
     assert len(g) == 1
     assert len(g.failed) == 1
+
+
+def test_parallel_grid(cl):
+    """GridSearch.java parallelism: k concurrent builds produce the same
+    model set as the sequential walk."""
+    from h2o3_tpu.models.glm import GLM
+
+    fr = _data(n=600, seed=2)
+    hp = {"alpha": [0.0, 0.5, 1.0], "lambda_": [0.0, 0.01]}
+    seq = H2OGridSearch(GLM, hp).train(y="y", training_frame=fr, seed=1)
+    par = H2OGridSearch(GLM, hp).train(y="y", training_frame=fr, seed=1,
+                                       parallelism=3)
+    assert len(par) == len(seq) == 6
+    def combos(g):
+        return sorted(str(sorted(m._grid_params.items())) for m in g.models)
+    assert combos(par) == combos(seq)
+    # same ranking metric values regardless of build order
+    sa = sorted(round(r["auc"], 6) for r in seq.sorted_metric_table("auc"))
+    pa = sorted(round(r["auc"], 6) for r in par.sorted_metric_table("auc"))
+    assert sa == pa
+
+
+def test_grid_kill_and_resume(cl, tmp_path):
+    """Grid auto-recovery (hex/grid Grid.exportBinary + resume): persist
+    per-model, 'crash' mid-walk, load from disk, finish the remaining
+    combos only."""
+    from h2o3_tpu.core.dkv import DKV
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    fr = _data(n=600, seed=3)
+    rec = str(tmp_path / "grid_rec")
+    hp = {"max_depth": [2, 3], "learn_rate": [0.1, 0.3]}
+    g = H2OGridSearch(GBM, hp, grid_id="resume_grid",
+                      search_criteria={"max_models": 2})
+    g.train(y="y", training_frame=fr, ntrees=3, seed=1, recovery_dir=rec)
+    assert len(g) == 2                      # budget stopped the walk early
+    trained_first = {str(m.key) for m in g.models}
+
+    # simulate process death: wipe the in-memory grid + its models
+    for m in g.models:
+        DKV.remove(str(m.key))
+    DKV.remove("resume_grid")
+
+    g2 = H2OGridSearch.load(rec)
+    assert len(g2) == 2                     # models restored from disk
+    assert {str(m.key) for m in g2.models} == trained_first
+    g2.search_criteria["max_models"] = 0    # lift the cap, finish the walk
+    g2.train(y="y", training_frame=fr, ntrees=3, seed=1, recovery_dir=rec)
+    assert len(g2) == 4
+    done = {str(sorted(m._grid_params.items())) for m in g2.models}
+    assert len(done) == 4                   # no combo trained twice
+    # restored models score (full model round-trip, not just metadata)
+    best = g2.best_model("auc")
+    preds = best.predict(fr)
+    assert preds.nrows == fr.nrows
+
+
+def test_parallel_grid_honors_max_models(cl):
+    """max_models counts in-flight builds: parallelism must not overshoot
+    the budget the way a submit-then-check loop would."""
+    from h2o3_tpu.models.glm import GLM
+
+    fr = _data(n=400, seed=4)
+    g = H2OGridSearch(GLM, {"alpha": [0.0, 0.25, 0.5, 1.0]},
+                      search_criteria={"max_models": 1})
+    g.train(y="y", training_frame=fr, seed=1, parallelism=4)
+    assert len(g) == 1

@@ -1,0 +1,81 @@
+"""Installable R client (h2o-r-tpu/): real-Rscript smoke when an R runtime
+exists, plus an always-on consistency tier binding the package's wire
+strings to the replayed transcript in test_h2or_wire.py (VERDICT r4 #7).
+
+Reference: h2o-r/h2o-package/R/connection.R, frame.R, models.R."""
+
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RPKG = os.path.join(REPO, "h2o-r-tpu")
+
+
+def test_r_package_layout():
+    """An installable R source package: DESCRIPTION + NAMESPACE + R/."""
+    desc = open(os.path.join(RPKG, "DESCRIPTION")).read()
+    assert "Package: h2o3tpu" in desc
+    ns = open(os.path.join(RPKG, "NAMESPACE")).read()
+    for fn in ("h2o.init", "h2o.importFile", "h2o.gbm", "h2o.predict",
+               "h2o.performance", "h2o.automl"):
+        assert f"export({fn})" in ns, fn
+    for f in ("connection.R", "frame.R", "models.R"):
+        assert os.path.exists(os.path.join(RPKG, "R", f)), f
+
+
+def _r_source() -> str:
+    out = []
+    rdir = os.path.join(RPKG, "R")
+    for f in sorted(os.listdir(rdir)):
+        out.append(open(os.path.join(rdir, f)).read())
+    return "\n".join(out)
+
+
+def test_r_package_routes_match_wire_replay():
+    """Every route the recorded-transcript test replays appears verbatim in
+    the package source — the replay stays an honest proxy for the package."""
+    src = _r_source()
+    for route in ("/3/Cloud", "/3/InitID", "/3/Parse", "/3/Jobs/",
+                  "/3/ModelBuilders/", "/3/Models/", "/4/Predictions/models/",
+                  "/3/Predictions/models/", "/3/Frames/", "/3/DownloadDataset",
+                  "/99/AutoMLBuilder", "/99/Leaderboards/"):
+        assert route in src, f"R package no longer uses {route}"
+    # v4 predict contract: dest read at the TOP level (models.R:679)
+    assert "res$dest" in src and "res$key$name" in src
+    # urlencoded POST bodies, NOT json (communication.R curlPerform)
+    assert "application/x-www-form-urlencoded" in src
+
+
+@pytest.mark.skipif(shutil.which("Rscript") is None,
+                    reason="no R runtime in this image")
+def test_r_package_live_smoke(tmp_path):
+    """The REAL package drives a live server end-to-end via Rscript."""
+    import h2o3_tpu
+    from h2o3_tpu.api.server import start_server
+
+    h2o3_tpu.init()
+    srv = start_server(port=0)
+    try:
+        rng = np.random.default_rng(5)
+        csv = tmp_path / "r_smoke.csv"
+        with open(csv, "w") as f:
+            f.write("a,b,y\n")
+            for _ in range(300):
+                a, b = rng.normal(), rng.normal()
+                pr = 1 / (1 + np.exp(-(2 * a - b)))
+                f.write(f"{a:.4f},{b:.4f},{'YN'[int(rng.random() < pr)]}\n")
+        proc = subprocess.run(
+            ["Rscript", os.path.join(RPKG, "tests", "smoke.R"),
+             str(srv.port), str(csv)],
+            capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        for marker in ("IMPORT_OK", "TRAIN_OK", "PREDICT_OK", "R_SMOKE_DONE"):
+            assert marker in proc.stdout, proc.stdout
+    finally:
+        srv.stop()

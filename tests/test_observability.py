@@ -90,3 +90,38 @@ class TestXLATrace:
             (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
         assert os.path.isdir(d) and os.listdir(d)
         assert any(e["kind"] == "xla_trace" for e in timeline.events())
+
+
+def test_tls_rest_bind(tmp_path, cl):
+    """TLS on the REST bind (water/network/SSLProperties analog): https
+    serves, plain http against the TLS port fails."""
+    import json
+    import ssl
+    import subprocess
+    import urllib.request
+
+    from h2o3_tpu.api.server import start_server
+
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                    "-keyout", str(key), "-out", str(cert), "-days", "1",
+                    "-nodes", "-subj", "/CN=localhost"],
+                   check=True, capture_output=True)
+    srv = start_server(port=0, ssl_certfile=str(cert), ssl_keyfile=str(key))
+    try:
+        assert srv.scheme == "https"
+        sctx = ssl.create_default_context()
+        sctx.check_hostname = False
+        sctx.verify_mode = ssl.CERT_NONE        # self-signed test cert
+        with urllib.request.urlopen(f"https://127.0.0.1:{srv.port}/3/Cloud",
+                                    context=sctx, timeout=30) as r:
+            cloud = json.loads(r.read())
+        assert cloud["cloud_healthy"] is True
+        import pytest as _pytest
+
+        with _pytest.raises(Exception):
+            urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/3/Cloud",
+                                   timeout=5)
+    finally:
+        srv.stop()
