@@ -1,0 +1,13 @@
+{{- define "h2o3-tpu.name" -}}
+{{- .Chart.Name -}}
+{{- end -}}
+
+{{- define "h2o3-tpu.fullname" -}}
+{{- printf "%s-%s" .Release.Name .Chart.Name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "h2o3-tpu.labels" -}}
+app.kubernetes.io/name: {{ include "h2o3-tpu.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion }}
+{{- end -}}

@@ -83,6 +83,17 @@ class EasyPredictor:
             out["predict"] = np.asarray(raw["score"])
             if "mean_length" in raw:
                 out["mean_length"] = np.asarray(raw["mean_length"])
+        elif "scores" in raw:        # PCA: PC1..PCk (DimReduction table)
+            scores = np.asarray(raw["scores"])
+            for j in range(scores.shape[1]):
+                out[f"PC{j+1}"] = scores[:, j]
+        elif "te" in raw:            # TargetEncoder: <col>_te columns
+            for name, arr in raw["te"].items():
+                out[name] = np.asarray(arr)
+        elif "vectors" in raw:       # Word2Vec: embedding columns V1..Vd
+            vecs = np.asarray(raw["vectors"])
+            for j in range(vecs.shape[1]):
+                out[f"V{j+1}"] = vecs[:, j]
         else:
             out["predict"] = np.asarray(raw["value"])
         return out

@@ -395,6 +395,234 @@ class DeepLearningScorer:
         return {"value": out[:, 0]}
 
 
+class PcaScorer:
+    """hex/genmodel/algos/pca/PcaMojoModel: project the expanded row onto
+    the eigenvector basis → PC1..PCk columns."""
+
+    def __init__(self, bundle):
+        meta = bundle.scorer["meta"]
+        self.V = np.asarray(bundle.arrays["eigenvectors"], np.float64)
+        self.k = int(meta["k"])
+        self.di = DataInfoExpander(meta["dinfo"])
+
+    def raw_predict(self, block: ColumnBlock) -> Dict[str, np.ndarray]:
+        scores = self.di.expand(block) @ self.V
+        return {"scores": scores, "value": scores[:, 0]}
+
+
+def _np_loss_grad(name: str, period: float = 1.0):
+    """numpy twin of glrm._loss_grad: dloss/du(a, u)."""
+    if name == "quadratic":
+        return lambda a, u: 2.0 * (u - a)
+    if name == "absolute":
+        return lambda a, u: np.sign(u - a)
+    if name == "huber":
+        return lambda a, u: np.clip(u - a, -1.0, 1.0)
+    if name == "poisson":
+        return lambda a, u: np.exp(u) - a
+    if name == "logistic":
+        return lambda a, u: -(2 * a - 1) / (1.0 + np.exp((2 * a - 1) * u))
+    if name == "hinge":
+        return lambda a, u: np.where((2 * a - 1) * u < 1.0, -(2 * a - 1), 0.0)
+    if name == "periodic":
+        w = 2.0 * np.pi / max(float(period), 1e-12)
+        return lambda a, u: -w * np.sin((a - u) * w)
+    if name == "categorical":
+        return lambda a, u: (-2.0 * (2 * a - 1)
+                             * np.maximum(1.0 - (2 * a - 1) * u, 0.0))
+    raise ValueError(f"unknown GLRM loss {name!r}")
+
+
+class GlrmScorer:
+    """hex/genmodel/algos/glrm/GlrmMojoModel: iterative fixed-Y X solve
+    (proximal gradient over the EXPORTED loss grid — per-column losses and
+    the categorical multi-loss, matching the server's _composite_loss) then
+    reconstruction X @ Y."""
+
+    def __init__(self, bundle):
+        meta = bundle.scorer["meta"]
+        self.Y = np.asarray(bundle.arrays["archetypes"], np.float64)
+        self.k = int(meta["k"])
+        self.gamma_x = float(meta.get("gamma_x") or 0.0)
+        self.reg_x = str(meta.get("regularization_x") or "None").lower()
+        self.di = DataInfoExpander(meta["dinfo"])
+        # per-expanded-column loss masks (glrm._composite_loss layout:
+        # cat one-hot blocks first, then numerics)
+        default = str(meta.get("loss") or "Quadratic").lower()
+        multi = str(meta.get("multi_loss") or "Categorical").lower()
+        period = float(meta.get("period") or 1.0)
+        overrides = {}
+        by_col = [str(x).lower() for x in (meta.get("loss_by_col") or [])]
+        by_idx = [int(i) for i in (meta.get("loss_by_col_idx") or [])]
+        names = list(meta.get("names") or
+                     (self.di.cat_names + self.di.num_names))
+        for i, nm in zip(by_idx, by_col):
+            if i < len(names):
+                overrides[names[i]] = nm
+        col_loss = []
+        for i, cn in enumerate(self.di.cat_names):
+            col_loss.extend([overrides.get(cn, multi)]
+                            * int(self.di.cards[i]))
+        for nn in self.di.num_names:
+            col_loss.append(overrides.get(nn, default))
+        groups: Dict[str, list] = {}
+        for ci, nm in enumerate(col_loss):
+            groups.setdefault(nm, []).append(ci)
+        self._terms = []
+        pdim = self.Y.shape[1]
+        for nm, cols in groups.items():
+            mask = np.zeros(pdim)
+            mask[[c for c in cols if c < pdim]] = 1.0
+            self._terms.append((mask[None, :], _np_loss_grad(nm, period)))
+
+    def _dloss(self, A: np.ndarray, U: np.ndarray) -> np.ndarray:
+        return sum(m * g(A, U) for m, g in self._terms)
+
+    def _prox(self, X: np.ndarray, step: float) -> np.ndarray:
+        g = self.gamma_x * step
+        if self.reg_x == "l1":
+            return np.sign(X) * np.maximum(np.abs(X) - g, 0.0)
+        if self.reg_x in ("l2", "quadratic"):
+            return X / (1.0 + 2.0 * g)
+        if self.reg_x == "nonnegative":
+            return np.maximum(X, 0.0)
+        if self.reg_x == "onesparse":
+            keep = np.argmax(np.abs(X), axis=-1, keepdims=True)
+            mask = np.arange(X.shape[-1])[None, :] == keep
+            return np.where(mask, np.maximum(X, 0.0), 0.0)
+        if self.reg_x == "unitonesparse":
+            keep = np.argmax(np.abs(X), axis=-1, keepdims=True)
+            return (np.arange(X.shape[-1])[None, :] == keep).astype(X.dtype)
+        if self.reg_x == "simplex":
+            u = np.sort(X, axis=-1)[:, ::-1]
+            css = np.cumsum(u, axis=-1) - 1.0
+            ind = np.arange(1, X.shape[-1] + 1, dtype=X.dtype)
+            rho = np.sum(u - css / ind > 0, axis=-1, keepdims=True)
+            theta = np.take_along_axis(css, rho - 1, axis=-1) / rho
+            return np.maximum(X - theta, 0.0)
+        return X
+
+    def raw_predict(self, block: ColumnBlock,
+                    iters: int = 30) -> Dict[str, np.ndarray]:
+        A = self.di.expand(block)
+        Y = self.Y
+        X = np.zeros((A.shape[0], Y.shape[0]))
+        step = 1.0 / (np.linalg.norm(Y) ** 2 + 1e-6)
+        for _ in range(iters):
+            G = self._dloss(A, X @ Y) @ Y.T
+            X = self._prox(X - step * G, step)
+        recon = X @ Y
+        return {"reconstruction": recon, "x": X, "value": recon[:, 0]}
+
+
+class Word2VecScorer:
+    """hex/genmodel/algos/word2vec/Word2VecMojoModel: word → embedding."""
+
+    def __init__(self, bundle):
+        meta = bundle.scorer["meta"]
+        self.vectors = np.asarray(bundle.arrays["vectors"], np.float64)
+        self.vocab = {w: i for i, w in enumerate(meta["words"])}
+
+    def word_vec(self, word: str):
+        i = self.vocab.get(word)
+        return self.vectors[i] if i is not None else None
+
+    def raw_predict(self, block: ColumnBlock) -> Dict[str, np.ndarray]:
+        name = next(iter(block.cols))
+        raw = block.raw(name)
+        dim = self.vectors.shape[1]
+        out = np.full((block.n, dim), np.nan)
+        for r, w in enumerate(np.asarray(raw, object)):
+            i = self.vocab.get(str(w))
+            if i is not None:
+                out[r] = self.vectors[i]
+        return {"vectors": out, "value": out[:, 0]}
+
+
+class EnsembleScorer:
+    """hex/genmodel/algos/ensemble/StackedEnsembleMojoModel: score nested
+    base-model MOJOs, assemble the level-one block with the SAME column
+    naming the trainer used, feed the metalearner MOJO."""
+
+    def __init__(self, bundle):
+        from h2o3_genmodel.reader import read_mojo_bundle
+
+        meta = bundle.scorer["meta"]
+        self.base_names = list(meta["base_names"])
+        self.bases = []
+        for i, name in enumerate(self.base_names):
+            sub = read_mojo_bundle(bundle.arrays[f"base{i}"].tobytes())
+            self.bases.append((name, sub.scorer, build_scorer(sub)))
+        meta_bundle = read_mojo_bundle(bundle.arrays["metalearner"].tobytes())
+        self.meta_scorer = build_scorer(meta_bundle)
+        self.meta_names = list(meta_bundle.scorer["names"])
+
+    def raw_predict(self, block: ColumnBlock) -> Dict[str, np.ndarray]:
+        lone: Dict[str, np.ndarray] = {}
+        for name, scorer_json, scorer in self.bases:
+            raw = scorer.raw_predict(block)
+            if "probs" in raw:
+                probs = np.asarray(raw["probs"])
+                if probs.shape[1] == 2:
+                    lone[name] = probs[:, 1]
+                else:
+                    for j in range(probs.shape[1]):
+                        lone[f"{name}_p{j}"] = probs[:, j]
+            else:
+                lone[name] = np.asarray(raw["value"])
+        return self.meta_scorer.raw_predict(ColumnBlock.from_dict(lone))
+
+
+class TargetEncoderScorer:
+    """hex/genmodel/algos/targetencoder/TargetEncoderMojoModel: per-level
+    posterior mean with optional blending; unseen/NA → prior."""
+
+    def __init__(self, bundle):
+        meta = bundle.scorer["meta"]
+        a = bundle.arrays
+        self.prior = float(meta["prior"])
+        self.blending = bool(meta["blending"])
+        self.k = float(meta["inflection_point"])
+        self.f = float(meta["smoothing"])
+        self.cols = []
+        for i, centry in enumerate(meta["columns"]):
+            self.cols.append((centry["name"], list(centry["domain"]),
+                              np.asarray(a[f"num{i}"], np.float64),
+                              np.asarray(a[f"den{i}"], np.float64)))
+
+    def raw_predict(self, block: ColumnBlock) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {}
+        for name, domain, num, den in self.cols:
+            raw = block.raw(name)
+            codes = (to_codes(raw, domain) if raw is not None
+                     else np.full(block.n, -1, np.int32))
+            safe = np.clip(codes, 0, max(len(domain) - 1, 0))
+            n = den[safe]
+            post = np.where(n > 0, num[safe] / np.maximum(n, 1e-12),
+                            self.prior)
+            if self.blending:
+                lam = 1.0 / (1.0 + np.exp((self.k - n) / max(self.f, 1e-12)))
+                post = np.where(n > 0, lam * post + (1 - lam) * self.prior,
+                                self.prior)
+            out[f"{name}_te"] = np.where(codes >= 0, post, self.prior)
+        first = next(iter(out.values()))
+        return {"te": out, "value": first}
+
+
+class CoxPHScorer:
+    """hex/genmodel/algos/coxph/CoxPHMojoModel: centered linear predictor
+    (partial-hazard log-ratio) over the expanded row."""
+
+    def __init__(self, bundle):
+        meta = bundle.scorer["meta"]
+        self.beta = np.asarray(bundle.arrays["beta"], np.float64)
+        self.di = DataInfoExpander(meta["dinfo"])
+
+    def raw_predict(self, block: ColumnBlock) -> Dict[str, np.ndarray]:
+        lp = self.di.expand(block) @ self.beta
+        return {"value": lp}
+
+
 _TREE_ALGOS = {"gbm", "drf", "isolationforest", "xgboost"}
 
 
@@ -408,4 +636,16 @@ def build_scorer(bundle):
         return KMeansScorer(bundle)
     if algo == "deeplearning":
         return DeepLearningScorer(bundle)
+    if algo == "pca":
+        return PcaScorer(bundle)
+    if algo == "glrm":
+        return GlrmScorer(bundle)
+    if algo == "word2vec":
+        return Word2VecScorer(bundle)
+    if algo == "stackedensemble":
+        return EnsembleScorer(bundle)
+    if algo == "targetencoder":
+        return TargetEncoderScorer(bundle)
+    if algo == "coxph":
+        return CoxPHScorer(bundle)
     raise ValueError(f"h2o3_genmodel cannot score algo {algo!r}")

@@ -1224,7 +1224,18 @@ def h_model_mojo(ctx: Ctx):
     except ImportError:
         raise ApiError("MOJO export not available in this build", 501) from None
     m = _model_or_404(ctx.params["model_id"])
-    data = mojo.export_mojo_bytes(m)
+    fmt = str(ctx.arg("format", "") or "").lower()
+    if fmt in ("reference", "java"):
+        # reference byte format (SharedTreeMojoModel v1.20): scoreable by
+        # the stock dependency-free genmodel jar
+        from h2o3_tpu.models.mojo_java import export_java_mojo_bytes
+
+        try:
+            data = export_java_mojo_bytes(m)
+        except ValueError as e:
+            raise ApiError(str(e), 400) from None
+    else:
+        data = mojo.export_mojo_bytes(m)
     return RawReply(data, "application/zip",
                     headers={"Content-Disposition":
                              f'attachment; filename="{m.key}.zip"'})

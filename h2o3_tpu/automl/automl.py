@@ -126,50 +126,14 @@ class H2OAutoML:
         return self.leader.predict(frame)
 
     # -- step registry (ModelingStepsRegistry analog) ----------------------
-    # step = (name, algo, weight, params). Weights are the WorkAllocations
-    # work units (ai.h2o.automl.WorkAllocations: defaults get more budget
-    # than grid exploration entries; the SE steps are budgeted separately)
+    # steps come from per-algo providers (automl/steps.py REGISTRY) in
+    # priority-group order (defaults → grids → exploitation); weights are
+    # the WorkAllocations work units
     def _steps(self, classification: bool):
-        """Ordered candidates: defaults first, then random-grid variants —
-        the reference's default + grid phases with per-step work weights."""
-        rng = np.random.default_rng(self.seed)
-        steps = []
+        from h2o3_tpu.automl.steps import build_plan
 
-        def add(name, algo, weight, **params):
-            steps.append({"name": name, "algo": algo, "weight": weight,
-                          "params": params})
-
-        add("def_glm", "glm", 10,
-            family=("binomial" if classification else "gaussian"),
-            alpha=0.5, lambda_search=True)
-        add("def_gbm_1", "gbm", 10, ntrees=50, max_depth=6, learn_rate=0.1,
-            sample_rate=0.8, col_sample_rate_per_tree=0.8)
-        add("def_xgb_1", "xgboost", 10, ntrees=50, max_depth=8,
-            learn_rate=0.1, sample_rate=0.8)
-        add("def_drf", "drf", 10, ntrees=50)
-        add("def_dl_1", "deeplearning", 10, hidden=[64, 64], epochs=20)
-        add("def_gbm_2", "gbm", 10, ntrees=100, max_depth=4, learn_rate=0.05,
-            sample_rate=0.9)
-        add("def_xgb_2", "xgboost", 10, ntrees=100, max_depth=5,
-            learn_rate=0.05, reg_lambda=2.0)
-        add("def_drf_xrt", "drf", 10, ntrees=100, max_depth=25)
-        # random grid phase (lower per-step weight, like the reference's
-        # grid WorkAllocations)
-        for gi in range(20):
-            add(f"grid_gbm_{gi}", "gbm", 5,
-                ntrees=int(rng.choice([30, 50, 100])),
-                max_depth=int(rng.integers(3, 10)),
-                learn_rate=float(rng.choice([0.03, 0.05, 0.1, 0.2])),
-                sample_rate=float(rng.uniform(0.6, 1.0)),
-                col_sample_rate_per_tree=float(rng.uniform(0.5, 1.0)))
-        filt = []
-        for st in steps:
-            if self.include_algos and st["algo"] not in self.include_algos:
-                continue
-            if st["algo"] in self.exclude_algos:
-                continue
-            filt.append(st)
-        return filt
+        return build_plan({"classification": classification}, self.seed,
+                          self.include_algos, self.exclude_algos)
 
     @property
     def modeling_plan(self) -> List[Dict[str, Any]]:
@@ -208,47 +172,86 @@ class H2OAutoML:
         self._log(f"AutoML start: project={self.project_name}")
         plan = self._steps(classification)
         self._plan = plan
-        # WorkAllocations: the remaining time budget splits over remaining
-        # step weights, so a slow early model shrinks what later steps may
-        # spend instead of starving them outright (WorkAllocations.java)
-        total_weight = sum(st["weight"] for st in plan) or 1
-        spent_weight = 0
-        for st in plan:
-            algo, params = st["algo"], dict(st["params"])
-            if self.max_models and len(self.models) >= self.max_models:
-                break
-            elapsed = time.time() - t0
-            if self.max_runtime_secs:
-                remaining = self.max_runtime_secs - elapsed
-                if remaining <= 0:
-                    self._log("time budget exhausted")
-                    break
-                rem_weight = max(total_weight - spent_weight, 1)
-                alloc = remaining * st["weight"] / rem_weight
-                params["max_runtime_secs"] = alloc
-                self._log(f"step {st['name']}: allocated {alloc:.1f}s "
-                          f"of {remaining:.1f}s remaining")
-            spent_weight += st["weight"]
-            cls = BUILDERS.get(algo)
-            if cls is None:
-                continue
-            params.update(seed=self.seed)
-            if self.nfolds:
-                params.update(nfolds=self.nfolds,
-                              keep_cross_validation_predictions=True)
-            if getattr(self, "_te_fold_col", None):
-                params.update(fold_column=self._te_fold_col)
-            try:
-                b = cls(**params)
-                m = b.train(x=x, y=y, training_frame=training_frame,
-                            validation_frame=validation_frame)
-                self.models.append(m)
-                st["model_id"] = str(m.key)
-                self._log(f"built {st['name']} ({algo}): {self._metric_name}="
-                          f"{_metric(m, self._metric_name):.4f}")
-            except Exception as e:       # noqa: BLE001 — AutoML keeps going
-                self._log(f"FAILED {st['name']} ({algo}): "
-                          f"{type(e).__name__}: {e}")
+
+        def run_steps(steps, budget_end, model_cap):
+            # WorkAllocations: the remaining time budget splits over
+            # remaining step weights, so a slow early model shrinks what
+            # later steps may spend instead of starving them outright
+            total_weight = sum(st["weight"] for st in steps) or 1
+            spent_weight = 0
+            for st in steps:
+                algo, params = st["algo"], dict(st["params"])
+                if model_cap and len(self.models) >= model_cap:
+                    return False
+                if budget_end is not None:
+                    remaining = budget_end - time.time()
+                    if remaining <= 0:
+                        self._log("time budget exhausted")
+                        return False
+                    rem_weight = max(total_weight - spent_weight, 1)
+                    alloc = remaining * st["weight"] / rem_weight
+                    params["max_runtime_secs"] = alloc
+                    self._log(f"step {st['name']}: allocated {alloc:.1f}s "
+                              f"of {remaining:.1f}s remaining")
+                spent_weight += st["weight"]
+                cls = BUILDERS.get(algo)
+                if cls is None:
+                    continue
+                params.update(seed=self.seed)
+                if self.nfolds:
+                    params.update(nfolds=self.nfolds,
+                                  keep_cross_validation_predictions=True)
+                if getattr(self, "_te_fold_col", None):
+                    params.update(fold_column=self._te_fold_col)
+                try:
+                    b = cls(**params)
+                    m = b.train(x=x, y=y, training_frame=training_frame,
+                                validation_frame=validation_frame)
+                    self.models.append(m)
+                    st["model_id"] = str(m.key)
+                    self._log(f"built {st['name']} ({algo}): "
+                              f"{self._metric_name}="
+                              f"{_metric(m, self._metric_name):.4f}")
+                except Exception as e:   # noqa: BLE001 — AutoML keeps going
+                    self._log(f"FAILED {st['name']} ({algo}): "
+                              f"{type(e).__name__}: {e}")
+            return True
+
+        budget_end = (t0 + self.max_runtime_secs
+                      if self.max_runtime_secs else None)
+        # exploitation reserve (AutoML.java exploitation_ratio semantics):
+        # the exploration phases leave ~10% of a time budget — and, under a
+        # model-count budget, one model slot per exploitable family — so
+        # the refinement steps are actually reachable
+        explore_end = (t0 + 0.9 * self.max_runtime_secs
+                       if self.max_runtime_secs else None)
+        from h2o3_tpu.automl.steps import REGISTRY, exploitation_steps
+
+        reserve = 0
+        if self.max_models:
+            exploitable = [a for a, prov in REGISTRY.items()
+                           if prov.has_exploitation
+                           and (not self.include_algos
+                                or a in self.include_algos)
+                           and a not in self.exclude_algos]
+            reserve = min(len(exploitable), 2, max(self.max_models - 1, 0))
+        explore_cap = (self.max_models - reserve) if self.max_models else 0
+        run_steps(plan, explore_end, explore_cap)
+
+        # exploitation phase (group 60): refine each family's CURRENT best
+        # — lazy steps against the live leaderboard (modeling.*StepsProvider
+        # exploitation entries: GBM lr-annealing, XGBoost lr-search)
+        if budget_end is None or time.time() < budget_end:
+            best_by_algo = {}
+            for m in self._ranked():
+                best_by_algo.setdefault(m.algo_name, m)
+            exploit = exploitation_steps({"classification": classification},
+                                         best_by_algo, self.include_algos,
+                                         self.exclude_algos)
+            if exploit:
+                self._plan = plan + exploit
+                self._log(f"exploitation phase: {len(exploit)} step(s)")
+                run_steps(exploit, budget_end, self.max_models)
 
         # stacked ensembles (best-of-family + all), reference SE steps —
         # honoring include/exclude_algos like any other algo step

@@ -84,7 +84,7 @@ class Aggregator(ModelBuilder):
 
         p = self.params
         di = make_data_info(train, p)
-        di.use_all_factor_levels = True
+        di.set_use_all_factor_levels(True)
         n = train.nrows
         arrays = tuple(c.data for c in di.cols(train))
         X = np.asarray(jax.jit(di.expand)(*arrays))[:n]

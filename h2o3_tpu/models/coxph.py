@@ -38,6 +38,7 @@ class CoxPHModel(Model):
         self.loglik_null: float = float("nan")
         self.concordance: float = float("nan")
         self.baseline_hazard: Optional[np.ndarray] = None   # (times, hazard)
+        self.strata: Optional[dict] = None     # stratify_by columns/domains
 
     def _predict_raw(self, frame: Frame):
         import jax
@@ -78,6 +79,7 @@ class CoxPH(ModelBuilder):
             "start_column": None,
             "stop_column": None,       # event time (required)
             "ties": "efron",           # efron | breslow
+            "stratify_by": None,       # categorical cols: per-stratum risk sets
             "max_iterations": 20,
             "lre_min": 9.0,            # -log10 relative tolerance (reference)
         })
@@ -97,7 +99,11 @@ class CoxPH(ModelBuilder):
             raise ValueError(f"unknown ties {ties!r}")
 
         start_col = p.get("start_column")
-        ignore = list(p.get("ignored_columns") or ()) + [stop_col]
+        strat_cols = list(p.get("stratify_by") or [])
+        if start_col and strat_cols:
+            raise NotImplementedError(
+                "start_column with stratify_by is not supported yet")
+        ignore = list(p.get("ignored_columns") or ()) + [stop_col] + strat_cols
         if start_col:
             ignore.append(start_col)
         di = DataInfo(train, response=event_col, ignored=ignore,
@@ -108,14 +114,32 @@ class CoxPH(ModelBuilder):
         times = train.col(stop_col).to_numpy().astype(np.float64)
         ev_raw = train.col(event_col).to_numpy()
         events = (ev_raw.astype(np.float64) > 0).astype(np.float64)
-        order = np.argsort(times, kind="stable")        # ascending stop time
+
+        # stratification (CoxPH.java stratify_by): the partial likelihood
+        # factorizes over strata — each stratum has its OWN risk sets and
+        # baseline hazard. Rows sort by (stratum, time) so strata are
+        # contiguous and risk-set cumsums can reset at stratum boundaries.
+        strat_id = np.zeros(n, np.int64)
+        strat_domains = []
+        if strat_cols:
+            for cn in strat_cols:
+                c = train.col(cn)
+                if not c.is_categorical:
+                    raise ValueError(f"stratify_by column {cn!r} must be "
+                                     "categorical")
+                codes = np.maximum(c.to_numpy().astype(np.int64), 0)
+                strat_id = strat_id * max(len(c.domain or []), 1) + codes
+                strat_domains.append((cn, list(c.domain or [])))
+            _, strat_id = np.unique(strat_id, return_inverse=True)
+        order = np.lexsort((times, strat_id))   # stratum-major, time ascending
 
         # host-side group structure of the sorted data (static per dataset)
         st = times[order]
         se = events[order]
-        # groups = unique times; risk set of group g starts at its first row
-        _, group_start_idx, group_ids = np.unique(st, return_index=True,
-                                                  return_inverse=True)
+        ss = strat_id[order]
+        # groups = unique (stratum, time); risk set starts at group's first row
+        _, group_start_idx, group_ids = np.unique(
+            np.stack([ss, st]), axis=1, return_index=True, return_inverse=True)
         ev_rows = np.nonzero(se > 0)[0]                 # sorted positions of events
         ev_gid = group_ids[ev_rows]
         # rank of each event within its tied-event group (0..d-1)
@@ -131,6 +155,10 @@ class CoxPH(ModelBuilder):
         Xs = jnp.asarray(X_full[order], jnp.float32)
         n_groups = int(group_ids.max()) + 1
         gs = jnp.asarray(group_start_idx)
+        # exclusive end row of each group's stratum: risk sets never cross a
+        # stratum boundary (S0 subtracts the tail mass of later strata)
+        strat_end_row = np.searchsorted(ss, ss[group_start_idx], side="right")
+        gend = jnp.asarray(strat_end_row)
         ev_idx = jnp.asarray(ev_rows)
         ev_g = jnp.asarray(ev_gid)
         frac = jnp.asarray(ranks / np.maximum(d_per_group[ev_gid], 1), jnp.float32)
@@ -155,9 +183,11 @@ class CoxPH(ModelBuilder):
             with jax.default_matmul_precision("highest"):
                 eta = Xs @ beta
             r = ws * jnp.exp(eta)
-            # risk-set sums: reverse cumulative sum gathered at group starts
-            cum = jnp.cumsum(r[::-1])[::-1]
-            S0 = cum[gs]                                   # (G,)
+            # risk-set sums: reverse cumulative sum gathered at group starts,
+            # minus the later-strata tail so each stratum is self-contained
+            cumpad = jnp.concatenate([jnp.cumsum(r[::-1])[::-1],
+                                      jnp.zeros(1, r.dtype)])
+            S0 = cumpad[gs] - cumpad[gend]                 # (G,)
             if start_perm is not None:
                 r_by_start = r[start_perm]
                 cum_late = jnp.concatenate(
@@ -218,30 +248,47 @@ class CoxPH(ModelBuilder):
         model.loglik = -prev
         model.loglik_null = ll0
         eta_s = np.asarray(Xs @ beta, np.float64)
-        model.concordance = _concordance(st, se, eta_s)
-        # Breslow baseline cumulative hazard at event times
+        model.concordance = _concordance(st, se, eta_s,
+                                         strata=ss if strat_cols else None)
+        # Breslow baseline cumulative hazard at event times (per stratum)
         r = np.asarray(ws, np.float64) * np.exp(eta_s)
-        cum = np.cumsum(r[::-1])[::-1]
-        S0 = cum[group_start_idx]
-        dg = d_per_group[d_per_group > 0]
-        t_ev = np.unique(st[ev_rows])
-        haz = dg / np.maximum(S0[np.unique(ev_gid)], 1e-30)
-        model.baseline_hazard = np.column_stack([t_ev, np.cumsum(haz)])
+        cumpad_h = np.append(np.cumsum(r[::-1])[::-1], 0.0)
+        S0 = cumpad_h[group_start_idx] - cumpad_h[strat_end_row]
+        ev_groups = np.unique(ev_gid)
+        t_ev = st[group_start_idx[ev_groups]]
+        s_ev = ss[group_start_idx[ev_groups]]
+        haz = d_per_group[ev_groups] / np.maximum(S0[ev_groups], 1e-30)
+        # cumulative WITHIN stratum (hazard resets where the stratum changes)
+        cumhaz = np.zeros_like(haz, np.float64)
+        for s in np.unique(s_ev):
+            m = s_ev == s
+            cumhaz[m] = np.cumsum(haz[m])
+        if strat_cols:
+            model.baseline_hazard = np.column_stack([s_ev, t_ev, cumhaz])
+            model.strata = {"columns": [c for c, _ in strat_domains],
+                            "domains": {c: d for c, d in strat_domains}}
+        else:
+            model.baseline_hazard = np.column_stack([t_ev, cumhaz])
         return model
 
 
-def _concordance(times: np.ndarray, events: np.ndarray, eta: np.ndarray) -> float:
+def _concordance(times: np.ndarray, events: np.ndarray, eta: np.ndarray,
+                 strata: np.ndarray = None) -> float:
     """Harrell's C: P(eta_i > eta_j | t_i < t_j, event_i) — O(n²) pairwise on
-    a subsample (the reference's exact MRTask version is a later optimization)."""
+    a subsample (the reference's exact MRTask version is a later
+    optimization). With strata, only same-stratum pairs are comparable."""
     n = len(times)
     if n > 4000:
         idx = np.random.default_rng(0).choice(n, 4000, replace=False)
         times, events, eta = times[idx], events[idx], eta[idx]
+        strata = strata[idx] if strata is not None else None
         n = 4000
     conc = disc = ties_ = 0
     ti = times[:, None]
     ei = events[:, None].astype(bool)
     usable = ei & (ti < times[None, :])
+    if strata is not None:
+        usable &= strata[:, None] == strata[None, :]
     d = eta[:, None] - eta[None, :]
     conc = np.sum(usable & (d > 0))
     disc = np.sum(usable & (d < 0))

@@ -75,12 +75,7 @@ class DataInfo:
 
         self.domains = {n: list(frame.col(n).domain or []) for n in self.cat_names}
         self.cards = [len(self.domains[n]) for n in self.cat_names]
-        base = 0 if use_all_factor_levels else 1
-        self.cat_widths = [max(c - base, 1) for c in self.cards]
-        # _catOffsets (DataInfo.java:116): running start index per categorical
-        self.cat_offsets = np.concatenate([[0], np.cumsum(self.cat_widths)]).astype(int)
-        self.num_offset = int(self.cat_offsets[-1])
-        self.fullN = self.num_offset + len(self.num_names)
+        self._recompute_layout(use_all_factor_levels)
 
         # standardization moments from rollups (computed lazily, cached on col)
         means, sigmas, modes = [], [], []
@@ -97,6 +92,23 @@ class DataInfo:
         # NA fill on the RAW scale — stays the column mean even when a caller
         # (pca.make_data_info) rewrites num_means to change the affine transform
         self.impute_values = self.num_means.copy()
+
+    def _recompute_layout(self, use_all_factor_levels: bool) -> None:
+        """(Re)derive the expanded layout. Callers that flip
+        use_all_factor_levels after construction (GLRM, Aggregator) MUST go
+        through set_use_all_factor_levels so cat_offsets/num_offset/fullN
+        stay consistent with what expand() actually emits."""
+        self.use_all_factor_levels = use_all_factor_levels
+        base = 0 if use_all_factor_levels else 1
+        self.cat_widths = [max(c - base, 1) for c in self.cards]
+        # _catOffsets (DataInfo.java:116): running start index per categorical
+        self.cat_offsets = np.concatenate(
+            [[0], np.cumsum(self.cat_widths)]).astype(int)
+        self.num_offset = int(self.cat_offsets[-1])
+        self.fullN = self.num_offset + len(self.num_names)
+
+    def set_use_all_factor_levels(self, flag: bool) -> None:
+        self._recompute_layout(flag)
 
     # -- names of expanded coefficients (GLM coefficient table) -----------
     def coef_names(self) -> List[str]:

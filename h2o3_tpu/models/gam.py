@@ -40,6 +40,81 @@ def _thinplate_basis(knots: np.ndarray):
     return basis
 
 
+def _bspline_cols(knots: np.ndarray, order: int):
+    """Cox–de Boor B-spline basis over interior knots with clamped ends:
+    returns fn(x) -> (n, n_basis) for the given order (degree+1). Static
+    knot vector → the recursion unrolls into a handful of fused elementwise
+    ops (no data-dependent control flow under jit)."""
+    import jax.numpy as jnp
+
+    t = np.concatenate([[knots[0]] * (order - 1), knots,
+                        [knots[-1]] * (order - 1)]).astype(np.float32)
+    n_basis = len(t) - order
+    tf = jnp.asarray(t)
+
+    def basis(x):
+        # outside the knot span B-splines vanish; clamp for constant
+        # extrapolation (keeps I-spline fits monotone at the boundaries)
+        x = jnp.clip(x, tf[0], tf[-1])
+        # order-1 (piecewise constant) seed; half-open intervals with the
+        # final interval closed so x == last knot lands in a basis fn
+        B = [jnp.where((x >= tf[i]) & ((x < tf[i + 1]) |
+                       ((i + 1 == len(t) - order) & (x <= tf[i + 1]))),
+                       1.0, 0.0)
+             for i in range(len(t) - 1)]
+        for k in range(2, order + 1):
+            Bn = []
+            for i in range(len(t) - k):
+                d1 = t[i + k - 1] - t[i]
+                d2 = t[i + k] - t[i + 1]
+                term = 0.0
+                if d1 > 0:
+                    term = (x - tf[i]) / d1 * B[i]
+                if d2 > 0:
+                    term = term + (tf[i + k] - x) / d2 * B[i + 1]
+                Bn.append(term)
+            B = Bn
+        return jnp.stack(B[:n_basis], axis=-1)
+
+    return basis
+
+
+def _mspline_basis(knots: np.ndarray, order: int = 3):
+    """M-splines (hex/gam NBSplinesTypeI, bs=3): B-splines normalized to
+    integrate to 1 over their support."""
+    import jax.numpy as jnp
+
+    bs = _bspline_cols(knots, order)
+    t = np.concatenate([[knots[0]] * (order - 1), knots,
+                        [knots[-1]] * (order - 1)]).astype(np.float64)
+    norm = np.array([order / max(t[i + order] - t[i], 1e-12)
+                     for i in range(len(t) - order)], np.float32)
+
+    def basis(x):
+        return bs(x) * jnp.asarray(norm)[None, :]
+
+    return basis
+
+
+def _ispline_basis(knots: np.ndarray, order: int = 3):
+    """I-splines (hex/gam bs=2, monotone splines): running integrals of
+    M-splines, evaluated via the standard identity I_i(x) = Σ_{j≥i}
+    B_{j,order+1}(x) — each basis fn is monotone 0→1, so non-negative
+    coefficients give a monotone smooth."""
+    import jax.numpy as jnp
+
+    bs = _bspline_cols(knots, order + 1)
+
+    def basis(x):
+        B = bs(x)
+        # reverse cumulative sum over the basis index; column 0 is the
+        # constant 1 (partition of unity) — dropped, the GLM intercept
+        # covers it and keeping it would be rank-deficient
+        return jnp.cumsum(B[:, ::-1], axis=-1)[:, ::-1][:, 1:]
+
+    return basis
+
+
 def _nspline_basis(knots: np.ndarray):
     """Natural cubic spline basis functions for given knots (ESL 5.2.1):
     returns fn(x) -> (n, K-1) columns [x, N_1..N_{K-2}]."""
@@ -70,13 +145,19 @@ class GAMModel(Model):
         super().__init__(key, parms)
         self.glm_model = None
         self.knots: Dict[str, np.ndarray] = {}
-        self.bs_types: Dict[str, int] = {}     # 0=cr (default), 1=thin plate
+        # 0=cr (default), 1=thin plate, 2=I-splines (monotone), 3=M-splines
+        self.bs_types: Dict[str, int] = {}
 
     def _basis_for(self, gcol: str):
         # getattr: pre-upgrade artifacts restored via __dict__.update lack
         # bs_types (they were all cr)
-        if getattr(self, "bs_types", {}).get(gcol, 0) == 1:
+        b = getattr(self, "bs_types", {}).get(gcol, 0)
+        if b == 1:
             return _thinplate_basis(self.knots[gcol])
+        if b == 2:
+            return _ispline_basis(self.knots[gcol])
+        if b == 3:
+            return _mspline_basis(self.knots[gcol])
         return _nspline_basis(self.knots[gcol])
 
     def _expand_frame(self, frame: Frame) -> Frame:
@@ -170,8 +251,9 @@ class GAM(ModelBuilder):
         for gcol, nk, b in zip(gam_cols, num_knots, bs):
             if gcol not in train:
                 raise ValueError(f"gam column {gcol!r} not in frame")
-            if int(b) not in (0, 1):
-                raise ValueError(f"bs={b} unsupported (0=cr, 1=thin plate)")
+            if int(b) not in (0, 1, 2, 3):
+                raise ValueError(f"bs={b} unsupported (0=cr, 1=thin plate, "
+                                 "2=monotone I-splines, 3=M-splines)")
             probs = np.linspace(0.02, 0.98, int(nk))
             qs = quantile_column(train.col(gcol), probs.tolist())
             knots = np.unique(np.asarray(qs, np.float64))
@@ -192,9 +274,16 @@ class GAM(ModelBuilder):
         # an acceptable approximation until per-coefficient penalties land.
         lam = p.get("lambda_")
         ridge = float(lam) if lam is not None else float(np.mean(scales))
+        # bs=2 (I-splines): monotonicity comes from non-negative basis
+        # coefficients (hex/gam couples I-splines with a β≥0 constraint).
+        # GLM's non_negative is model-wide here — coarser than the
+        # reference's per-block constraint, so splines must dominate the
+        # design when monotone fits matter.
+        monotone = any(int(b) == 2 for b in bs)
         glm = GLM(family=p.get("family", "AUTO"),
                   alpha=float(p.get("alpha", 0.0)), lambda_=ridge,
                   standardize=bool(p.get("standardize", True)),
+                  non_negative=monotone,
                   seed=self._seed(),
                   weights_column=p.get("weights_column"))
         inner = glm.train(y=p["response_column"], training_frame=expanded)

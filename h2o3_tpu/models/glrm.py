@@ -26,12 +26,14 @@ from h2o3_tpu.models.model import Model, ModelCategory
 from h2o3_tpu.models.model_builder import ModelBuilder, register
 from h2o3_tpu.models.pca import make_data_info
 
-LOSSES = ("quadratic", "absolute", "huber", "poisson", "logistic", "hinge")
+LOSSES = ("quadratic", "absolute", "huber", "poisson", "logistic", "hinge",
+          "periodic")
+MULTI_LOSSES = ("categorical", "ordinal")
 REGULARIZERS = ("none", "quadratic", "l1", "nonnegative", "onesparse",
                 "unitonesparse", "simplex")
 
 
-def _loss_grad(name: str):
+def _loss_grad(name: str, period: float = 1.0):
     """Returns (loss(a, u), dloss/du(a, u)) elementwise fns; a = data,
     u = current approximation X@Y."""
     import jax
@@ -57,7 +59,87 @@ def _loss_grad(name: str):
     if name == "hinge":
         return (lambda a, u: jnp.maximum(1.0 - (2 * a - 1) * u, 0.0),
                 lambda a, u: jnp.where((2 * a - 1) * u < 1.0, -(2 * a - 1), 0.0))
+    if name == "periodic":
+        # GlrmLoss.Periodic: f = 1 - cos((a-u)·2π/T); T via period param
+        w = 2.0 * jnp.pi / max(float(period), 1e-12)
+        return (lambda a, u: 1.0 - jnp.cos((a - u) * w),
+                lambda a, u: -w * jnp.sin((a - u) * w))
+    if name == "categorical":
+        # GlrmLoss.Categorical over the one-hot block, elementwise form:
+        # j==a → max(1-u,0)², j≠a → max(1+u,0)² == max(1-(2a-1)u, 0)²
+        return (lambda a, u: jnp.maximum(1.0 - (2 * a - 1) * u, 0.0) ** 2,
+                lambda a, u: -2.0 * (2 * a - 1)
+                * jnp.maximum(1.0 - (2 * a - 1) * u, 0.0))
     raise ValueError(f"unknown loss {name!r}")
+
+
+def _composite_loss(di, p, pdim: int, frame_names=None):
+    """Per-column loss grid (GLRM.java lossFunc/multi_loss/loss_by_col):
+    numeric columns use `loss` (overridable per column via loss_by_col +
+    loss_by_col_idx, indices into the TRAINING FRAME column order);
+    categorical one-hot blocks use `multi_loss`. Returns (loss(A,U),
+    dloss(A,U)) closures summing masked elementwise losses."""
+    import jax.numpy as jnp
+
+    default = (p.get("loss") or "Quadratic").lower()
+    if default not in LOSSES:
+        raise ValueError(f"unknown loss {p['loss']!r}")
+    multi = (p.get("multi_loss") or "Categorical").lower()
+    if multi not in MULTI_LOSSES:
+        raise ValueError(f"unknown multi_loss {p['multi_loss']!r}")
+    if multi == "ordinal":
+        raise NotImplementedError(
+            "multi_loss='Ordinal' is not implemented; use 'Categorical' "
+            "(reference GlrmLoss.Ordinal)")
+    # per-original-column override table
+    by_col = [str(x).lower() for x in (p.get("loss_by_col") or [])]
+    by_idx = [int(i) for i in (p.get("loss_by_col_idx") or [])]
+    if by_col and not by_idx:
+        by_idx = list(range(len(by_col)))
+    if len(by_col) != len(by_idx):
+        raise ValueError("loss_by_col and loss_by_col_idx length mismatch")
+    overrides_frame = dict(zip(by_idx, by_col))
+    for nm in overrides_frame.values():
+        if nm not in LOSSES and nm not in MULTI_LOSSES:
+            raise ValueError(f"unknown loss_by_col entry {nm!r}")
+    # frame-order indices → DataInfo names (cats reorder first in expand)
+    overrides = {}
+    if overrides_frame:
+        names = list(frame_names or (di.cat_names + di.num_names))
+        for idx, nm in overrides_frame.items():
+            if idx >= len(names):
+                raise ValueError(f"loss_by_col_idx {idx} out of range")
+            overrides[names[idx]] = nm
+
+    # expanded-column → loss-name map. Expansion layout (DataInfo.expand):
+    # categorical one-hot blocks first (use_all_factor_levels=True in GLRM),
+    # then numeric columns.
+    col_loss = []
+    for i, cn in enumerate(di.cat_names):
+        col_loss.extend([overrides.get(cn, multi)] * int(di.cards[i]))
+    for nn in di.num_names:
+        col_loss.append(overrides.get(nn, default))
+    if len(col_loss) != pdim:
+        raise AssertionError((len(col_loss), pdim))
+
+    groups = {}
+    for ci, nm in enumerate(col_loss):
+        groups.setdefault(nm, []).append(ci)
+    period = float(p.get("period") or 1.0)
+    terms = []
+    for nm, cols in groups.items():
+        mask = np.zeros(pdim, np.float32)
+        mask[cols] = 1.0
+        terms.append((jnp.asarray(mask)[None, :],
+                      *_loss_grad(nm, period=period)))
+
+    def loss(A, U):
+        return sum(m * f(A, U) for m, f, _ in terms)
+
+    def dloss(A, U):
+        return sum(m * g(A, U) for m, _, g in terms)
+
+    return loss, dloss
 
 
 def _prox(name: str, gamma: float):
@@ -155,7 +237,8 @@ def _solve_x(model: GLRMModel, frame: Frame):
     arrays = tuple(c.data for c in di.cols(frame))
     Y = jnp.asarray(model.archetypes, jnp.float32)
     p = model._parms
-    loss, dloss = _loss_grad((p.get("loss") or "Quadratic").lower())
+    loss, dloss = _composite_loss(di, p, int(Y.shape[1]),
+                                  frame_names=model._output.names)
     prox_x = _prox(p.get("regularization_x", "None"),
                    float(p.get("gamma_x", 0.0)))
 
@@ -190,6 +273,9 @@ class GLRM(ModelBuilder):
             "k": 1,
             "loss": "Quadratic",
             "multi_loss": "Categorical",
+            "loss_by_col": None,
+            "loss_by_col_idx": None,
+            "period": 1,
             "regularization_x": "None",
             "regularization_y": "None",
             "gamma_x": 0.0, "gamma_y": 0.0,
@@ -208,14 +294,10 @@ class GLRM(ModelBuilder):
 
         p = self.params
         di = make_data_info(train, p)
-        di.use_all_factor_levels = True
+        di.set_use_all_factor_levels(True)
         k = int(p["k"])
         n = train.nrows
         arrays = tuple(c.data for c in di.cols(train))
-        loss_name = (p.get("loss") or "Quadratic").lower()
-        if loss_name not in LOSSES:
-            raise ValueError(f"unknown loss {p['loss']!r}")
-        loss, dloss = _loss_grad(loss_name)
         prox_x = _prox(p.get("regularization_x", "None"), float(p.get("gamma_x", 0.0)))
         prox_y = _prox(p.get("regularization_y", "None"), float(p.get("gamma_y", 0.0)))
         max_iter = int(p.get("max_iterations", 1000))
@@ -223,6 +305,7 @@ class GLRM(ModelBuilder):
 
         A = jax.jit(di.expand)(*arrays)
         padded, pdim = A.shape
+        loss, dloss = _composite_loss(di, p, pdim, frame_names=train.names)
         wrow = (jnp.arange(padded) < n).astype(jnp.float32)[:, None]
 
         # init Y from SVD of the expanded matrix (GLRM.java initialXY SVD path)

@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from h2o3_tpu.core.dkv import Key
 from h2o3_tpu.models.model import Model, ModelCategory
 
 MOJO_VERSION = 99.0
@@ -236,6 +237,24 @@ def _model_class(algo: str):
     if algo == "deeplearning":
         from h2o3_tpu.models.deeplearning import DeepLearningModel
         return DeepLearningModel
+    if algo == "pca":
+        from h2o3_tpu.models.pca import PCAModel
+        return PCAModel
+    if algo == "glrm":
+        from h2o3_tpu.models.glrm import GLRMModel
+        return GLRMModel
+    if algo == "word2vec":
+        from h2o3_tpu.models.word2vec import Word2VecModel
+        return Word2VecModel
+    if algo == "stackedensemble":
+        from h2o3_tpu.models.ensemble import StackedEnsembleModel
+        return StackedEnsembleModel
+    if algo == "targetencoder":
+        from h2o3_tpu.models.target_encoder import TargetEncoderModel
+        return TargetEncoderModel
+    if algo == "coxph":
+        from h2o3_tpu.models.coxph import CoxPHModel
+        return CoxPHModel
     raise ValueError(f"MOJO export not supported for algo {algo!r}")
 
 
@@ -252,6 +271,18 @@ def _payload(model) -> Tuple[dict, Dict[str, np.ndarray]]:
         return _kmeans_payload(model)
     if algo == "deeplearning":
         return _dl_payload(model)
+    if algo == "pca":
+        return _pca_payload(model)
+    if algo == "glrm":
+        return _glrm_payload(model)
+    if algo == "word2vec":
+        return _w2v_payload(model)
+    if algo == "stackedensemble":
+        return _ensemble_payload(model)
+    if algo == "targetencoder":
+        return _te_payload(model)
+    if algo == "coxph":
+        return _coxph_payload(model)
     raise ValueError(f"MOJO export not supported for algo {algo!r}")
 
 
@@ -264,6 +295,173 @@ def _restore_payload(model, algo, meta, arrays):
         _kmeans_restore(model, meta, arrays)
     elif algo == "deeplearning":
         _dl_restore(model, meta, arrays)
+    elif algo == "pca":
+        _pca_restore(model, meta, arrays)
+    elif algo == "glrm":
+        _glrm_restore(model, meta, arrays)
+    elif algo == "word2vec":
+        _w2v_restore(model, meta, arrays)
+    elif algo == "stackedensemble":
+        _ensemble_restore(model, meta, arrays)
+    elif algo == "targetencoder":
+        _te_restore(model, meta, arrays)
+    elif algo == "coxph":
+        _coxph_restore(model, meta, arrays)
+
+
+# -- round-5 families (VERDICT r4 #9: genmodel family completion) ----------
+
+def _pca_payload(model):
+    """hex/genmodel/algos/pca/PcaMojoModel analog: eigenvectors + the
+    DataInfo standardization state."""
+    return ({"k": model.k, "dinfo": _datainfo_state(model.data_info)},
+            {"eigenvectors": np.asarray(model.eigenvectors, np.float64),
+             "std_deviation": np.asarray(model.std_deviation, np.float64),
+             "prop_var": np.asarray(model.prop_var, np.float64),
+             "cum_var": np.asarray(model.cum_var, np.float64)})
+
+
+def _pca_restore(model, meta, arrays):
+    model.eigenvectors = np.asarray(arrays["eigenvectors"], np.float32)
+    model.std_deviation = np.asarray(arrays["std_deviation"], np.float64)
+    model.prop_var = np.asarray(arrays["prop_var"], np.float64)
+    model.cum_var = np.asarray(arrays["cum_var"], np.float64)
+    model.k = int(meta["k"])
+    model.data_info = _datainfo_restore(meta["dinfo"])
+
+
+def _glrm_payload(model):
+    """hex/genmodel/algos/glrm/GlrmMojoModel analog: archetypes Y + the
+    loss/regularizer config the fixed-Y X-solve needs at score time."""
+    p = model._parms
+    return ({"k": model.k, "dinfo": _datainfo_state(model.data_info),
+             "loss": str(p.get("loss") or "Quadratic"),
+             "period": float(p.get("period") or 1.0),
+             "multi_loss": str(p.get("multi_loss") or "Categorical"),
+             "loss_by_col": list(p.get("loss_by_col") or []),
+             "loss_by_col_idx": [int(i)
+                                 for i in (p.get("loss_by_col_idx") or [])],
+             "names": list(model._output.names or []),
+             "regularization_x": str(p.get("regularization_x") or "None"),
+             "gamma_x": float(p.get("gamma_x") or 0.0)},
+            {"archetypes": np.asarray(model.archetypes, np.float64)})
+
+
+def _glrm_restore(model, meta, arrays):
+    model.archetypes = np.asarray(arrays["archetypes"], np.float32)
+    model.k = int(meta["k"])
+    model.data_info = _datainfo_restore(meta["dinfo"])
+    model._parms.setdefault("loss", meta["loss"])
+    model._parms.setdefault("period", meta.get("period", 1.0))
+    model._parms.setdefault("multi_loss", meta.get("multi_loss",
+                                                   "Categorical"))
+    if meta.get("loss_by_col"):
+        model._parms.setdefault("loss_by_col", list(meta["loss_by_col"]))
+        model._parms.setdefault("loss_by_col_idx",
+                                list(meta["loss_by_col_idx"]))
+    model._parms.setdefault("regularization_x", meta["regularization_x"])
+    model._parms.setdefault("gamma_x", meta["gamma_x"])
+    model.x_key = None
+    model.objective = float("nan")
+
+
+def _w2v_payload(model):
+    """hex/genmodel/algos/word2vec/Word2VecMojoModel analog: vocab +
+    embedding matrix. Vocab ships as the word list in index order."""
+    words = [w for w, _ in sorted(model.vocab.items(), key=lambda kv: kv[1])]
+    return ({"words": words},
+            {"vectors": np.asarray(model.vectors, np.float32)})
+
+
+def _w2v_restore(model, meta, arrays):
+    model.vectors = np.asarray(arrays["vectors"], np.float32)
+    model.vocab = {w: i for i, w in enumerate(meta["words"])}
+
+
+def _ensemble_payload(model):
+    """hex/genmodel/algos/ensemble/StackedEnsembleMojoModel analog: the
+    base models and the metalearner ship INSIDE the artifact as nested
+    MOJO zips (uint8 arrays), so the export is self-contained."""
+    from h2o3_tpu.models.ensemble import _resolve
+
+    meta = {"base_names": [str(k) for k in model.base_keys]}
+    arrays = {}
+    for i, bk in enumerate(model.base_keys):
+        bm = _resolve(bk)
+        arrays[f"base{i}"] = np.frombuffer(export_mojo_bytes(bm), np.uint8)
+    arrays["metalearner"] = np.frombuffer(
+        export_mojo_bytes(model.metalearner), np.uint8)
+    return meta, arrays
+
+
+def _ensemble_restore(model, meta, arrays):
+    base_keys = []
+    for i, name in enumerate(meta["base_names"]):
+        bm = read_mojo(arrays[f"base{i}"].tobytes())
+        bm._key = Key(name)          # level-one column names derive from it
+        bm.install()
+        base_keys.append(name)
+    model.base_keys = base_keys
+    model.metalearner = read_mojo(arrays["metalearner"].tobytes())
+
+
+def _te_payload(model):
+    """hex/genmodel/algos/targetencoder/TargetEncoderMojoModel analog:
+    per-column (level → num/den) tables + prior + blending config."""
+    p = model._parms
+    meta = {"prior": float(model.prior), "nfolds": int(model.nfolds),
+            "columns": [], "blending": bool(p.get("blending")),
+            "inflection_point": float(p.get("inflection_point", 10.0) or 10.0),
+            "smoothing": float(p.get("smoothing", 20.0) or 20.0),
+            "keep_original_categorical_columns":
+                bool(p.get("keep_original_categorical_columns", True))}
+    arrays = {}
+    for i, (col, enc) in enumerate(sorted(model.encodings.items())):
+        meta["columns"].append({"name": col, "domain": list(enc["domain"])})
+        arrays[f"num{i}"] = np.asarray(enc["num"], np.float64)
+        arrays[f"den{i}"] = np.asarray(enc["den"], np.float64)
+    return meta, arrays
+
+
+def _te_restore(model, meta, arrays):
+    model.prior = float(meta["prior"])
+    model.nfolds = int(meta["nfolds"])
+    model.encodings = {}
+    for i, centry in enumerate(meta["columns"]):
+        model.encodings[centry["name"]] = {
+            "domain": list(centry["domain"]),
+            "num": np.asarray(arrays[f"num{i}"], np.float64),
+            "den": np.asarray(arrays[f"den{i}"], np.float64)}
+    for k in ("blending", "inflection_point", "smoothing",
+              "keep_original_categorical_columns"):
+        model._parms.setdefault(k, meta[k])
+
+
+def _coxph_payload(model):
+    """hex/genmodel/algos/coxph/CoxPHMojoModel analog: beta + strata-free
+    baseline hazard + the DataInfo centering state."""
+    bh = model.baseline_hazard
+    return ({"dinfo": _datainfo_state(model.data_info),
+             "coefficients": {k: float(v)
+                              for k, v in model.coefficients.items()},
+             "strata": model.strata,
+             "loglik": float(model.loglik),
+             "concordance": float(model.concordance)},
+            {"beta": np.asarray(model.beta, np.float64),
+             "baseline_hazard": (np.asarray(bh, np.float64)
+                                 if bh is not None else np.zeros((0, 2)))})
+
+
+def _coxph_restore(model, meta, arrays):
+    model.beta = np.asarray(arrays["beta"], np.float32)
+    bh = np.asarray(arrays["baseline_hazard"], np.float64)
+    model.baseline_hazard = bh if bh.size else None
+    model.data_info = _datainfo_restore(meta["dinfo"])
+    model.coefficients = dict(meta["coefficients"])
+    model.strata = meta.get("strata")
+    model.loglik = float(meta["loglik"])
+    model.loglik_null = float("nan")
+    model.concordance = float(meta["concordance"])
 
 
 # ---------------------------------------------------------------------------

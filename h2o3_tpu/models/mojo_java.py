@@ -620,3 +620,203 @@ def is_java_mojo(source) -> bool:
         return _Backend(source).exists("model.ini")
     except (OSError, zipfile.BadZipFile):
         return False
+
+
+# ---------------------------------------------------------------------------
+# writer — emit OUR tree models in the reference byte format, so the stock
+# dependency-free genmodel jar (hex.genmodel.MojoModel.load) scores them.
+# Exact inverse of decode_tree's grammar (mojo_version 1.20 layout).
+# ---------------------------------------------------------------------------
+
+def _encode_tree(feat, thresh, na_left, left, right, leaf_val, cat_split,
+                 cat_table, split_vals, cards_by_feat) -> bytes:
+    """Serialize one tree (dense-array node form) to compressed bytes."""
+
+    def leaf_bytes(node) -> bytes:
+        return struct.pack("<f", float(leaf_val[node]))
+
+    def encode(node) -> bytes:
+        f = int(feat[node])
+        if f < 0:        # root-only leaf: col sentinel 0xFFFF + value
+            return struct.pack("<BH", 0, 0xFFFF) + leaf_bytes(node)
+        csid = int(cat_split[node])
+        na_dir = NA_LEFT if na_left[node] else 3          # NARight
+        if csid >= 0:
+            equal = 12
+            card = int(cards_by_feat[f])
+            nbytes = ((card - 1) >> 3) + 1
+            bits = bytearray(nbytes)
+            for code in range(card):
+                # our LUT holds go-LEFT; the reference bitset holds go-RIGHT
+                if not cat_table[csid, code]:
+                    bits[code >> 3] |= 1 << (code & 7)
+            split_payload = struct.pack("<Hi", 0, card) + bytes(bits)
+        else:
+            equal = 0
+            split_payload = struct.pack("<f", float(split_vals[node]))
+
+        l, r = int(left[node]), int(right[node])
+        left_leaf = int(feat[l]) < 0
+        right_leaf = int(feat[r]) < 0
+        lbytes = leaf_bytes(l) if left_leaf else encode(l)
+        rbytes = leaf_bytes(r) if right_leaf else encode(r)
+        if left_leaf:
+            lmask = 48
+            offset_field = b""
+        else:
+            skip = len(lbytes)
+            width = next(w for w in (1, 2, 3, 4) if skip < (1 << (8 * w)))
+            lmask = width - 1
+            offset_field = skip.to_bytes(width, "little")
+        rmask = 16 if right_leaf else 0
+        node_type = equal | lmask | (rmask << 2)
+        return (struct.pack("<BH", node_type, f)
+                + bytes([na_dir]) + split_payload
+                + offset_field + lbytes + rbytes)
+
+    return encode(0)
+
+
+def _java_split_vals(forest, spec) -> np.ndarray:
+    """Binned thresholds → float split values. Our traversal goes LEFT on
+    bin(x) <= t ⇔ x <= edges[t]; the reference goes RIGHT on d >= splitVal,
+    so splitVal must be the smallest float32 ABOVE edges[t]."""
+    T, M = forest.feat.shape
+    out = np.zeros((T, M), np.float32)
+    for t in range(T):
+        for i in range(M):
+            f = int(forest.feat[t, i])
+            if f < 0 or int(forest.cat_split[t, i]) >= 0:
+                continue
+            edges = np.asarray(spec.edges[f], np.float64)
+            b = int(np.clip(forest.thresh_bin[t, i], 0, len(edges) - 1))
+            out[t, i] = np.nextafter(np.float32(edges[b]), np.float32(np.inf))
+    return out
+
+
+def export_java_mojo_bytes(model) -> bytes:
+    """Serialize a GBM/DRF model to the REFERENCE MOJO zip format
+    (model.ini + domains/*.txt + trees/t{class}_{group}.bin, v1.20)."""
+    from h2o3_tpu.models.model import ModelCategory
+
+    algo = model.algo_name
+    if algo not in ("gbm", "drf"):
+        raise ValueError(f"reference-format export supports gbm/drf, "
+                         f"not {algo!r}")
+    fo = model.forest
+    spec = model.spec
+    o = model._output
+    cat = o.model_category
+    nclasses = {ModelCategory.Binomial: 2,
+                ModelCategory.Multinomial: len(o.response_domain or []),
+                }.get(cat, 1)
+    dist = getattr(model, "_distribution", None)
+    dname = getattr(dist, "name", None) or \
+        ("bernoulli" if cat == ModelCategory.Binomial else "gaussian")
+
+    names = list(spec.names)
+    n_features = len(names)
+    domains: Dict[int, List[str]] = {}
+    for i, nm in enumerate(names):
+        if spec.is_cat[i]:
+            domains[i] = list(o.domains.get(nm) or
+                              [str(j) for j in range(int(spec.cards[i]))])
+    columns = names + [o.response_name or "response"]
+    if o.response_domain:
+        domains[n_features] = list(o.response_domain)
+
+    # per-(class, group) trees from the stacked forest arrays
+    tpc = nclasses if nclasses > 2 else 1
+    split_vals = _java_split_vals(fo, spec)
+    cards_by_feat = np.asarray(spec.cards, np.int64)
+    by_class = _group_by_class(fo, tpc)
+    ntree_groups = max((len(v) for v in by_class.values()), default=0)
+
+    leaf_val = np.asarray(fo.leaf_val, np.float64).copy()
+    if algo == "drf":
+        # our DRF pre-scales leaves by 1/ntrees at compression time
+        # (drf.py:11); the reference stores RAW per-tree values and divides
+        # by n_trees at score time — and its binomial slot accumulates
+        # P(class0), not P(class1)
+        leaf_val = leaf_val * max(ntree_groups, 1)
+        if cat == ModelCategory.Binomial:
+            leaf_val = 1.0 - leaf_val
+    if tpc > 1 and fo.init_class is not None:
+        # the reference multinomial format has no per-class init margin —
+        # fold ours into every leaf of each class's FIRST tree (exact under
+        # sum semantics)
+        init_c = np.asarray(fo.init_class, np.float64)
+        for k, tlist0 in by_class.items():
+            t0 = tlist0[0]
+            leaves = np.asarray(fo.feat[t0]) < 0
+            leaf_val[t0, leaves] += float(init_c[k])
+
+    thr = _default_threshold_of(model)
+    init_f = float(fo.init_f or 0.0)
+    lines = [
+        "[info]",
+        "h2o_version = 3.46.0-tpu",
+        "mojo_version = 1.20",
+        "license = Apache License Version 2.0",
+        f"algo = {algo}",
+        "algorithm = " + ("Gradient Boosting Machine" if algo == "gbm"
+                          else "Distributed Random Forest"),
+        "endianness = LITTLE_ENDIAN",
+        f"category = {cat}",
+        "uuid = 0",
+        "supervised = true",
+        f"n_features = {n_features}",
+        f"n_classes = {nclasses}",
+        f"n_columns = {len(columns)}",
+        f"n_domains = {len(domains)}",
+        "balance_classes = false",
+        f"default_threshold = {thr!r}",
+        "prior_class_distrib = null",
+        "model_class_distrib = null",
+        "timestamp = 2026-01-01T00:00:00.000Z",
+        f"n_trees = {ntree_groups}",
+        f"n_trees_per_class = {tpc}",
+        f"distribution = {dname if algo == 'gbm' else 'gaussian'}",
+        f"init_f = {init_f!r}",
+        "offset_column = null",
+    ]
+    if algo == "drf":
+        lines.append("binomial_double_trees = false")
+    lines.append("")
+    lines.append("[columns]")
+    lines.extend(columns)
+    lines.append("")
+    lines.append("[domains]")
+    dom_files = {}
+    for di_idx, (ci, dom) in enumerate(sorted(domains.items())):
+        fname = f"d{di_idx:03d}.txt"
+        lines.append(f"{ci}: {len(dom)} {fname}")
+        dom_files[fname] = "\n".join(dom) + "\n"
+
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("model.ini", "\n".join(lines) + "\n")
+        for fname, content in dom_files.items():
+            z.writestr(f"domains/{fname}", content)
+        for k, tlist in by_class.items():
+            for g, t in enumerate(tlist):
+                blob = _encode_tree(
+                    fo.feat[t], fo.thresh_bin[t], fo.na_left[t], fo.left[t],
+                    fo.right[t], leaf_val[t], fo.cat_split[t], fo.cat_table,
+                    split_vals[t], cards_by_feat)
+                z.writestr(f"trees/t{k:02d}_{g:03d}.bin", blob)
+    return buf.getvalue()
+
+
+def _group_by_class(fo, tpc: int) -> Dict[int, List[int]]:
+    by_class: Dict[int, List[int]] = {}
+    for t in range(fo.n_trees):
+        k = int(fo.tree_class[t]) if tpc > 1 else 0
+        by_class.setdefault(k, []).append(t)
+    return by_class
+
+
+def _default_threshold_of(model) -> float:
+    tm = model._output.training_metrics
+    aucd = getattr(tm, "auc_data", None)
+    return float(aucd.max_f1_threshold) if aucd is not None else 0.5

@@ -34,7 +34,16 @@ class PSVMModel(Model):
         self.beta: Optional[np.ndarray] = None      # (r + 1,) weights + bias
         self.gamma: float = 1.0
         self.data_info: Optional[DataInfo] = None
-        self.svs_count: int = 0
+        self.svs_count: int = 0        # support vectors (margin-active rows)
+        self.bsv_count: int = 0        # bounded SVs (margin violators)
+        self.rho: float = 0.0          # decision threshold: f(x) = w·φ(x) − rho
+        self.alpha_key: Optional[str] = None   # per-row dual coefficients
+
+    def to_dict(self):
+        d = super().to_dict()
+        d.update({"svs_count": self.svs_count, "bsv_count": self.bsv_count,
+                  "rho": self.rho, "alpha_key": self.alpha_key})
+        return d
 
     def _features(self, X):
         """H columns for new rows: k(x, pivots) mapped through L⁻ᵀ."""
@@ -147,7 +156,23 @@ class PSVM(ModelBuilder):
         model.icf_L = L
         model.beta = beta
         f = H @ beta[:-1] + beta[-1]
-        model.svs_count = int(np.sum((1.0 - yv * f) > float(p.get("sv_threshold", 1e-4))))
+        # reference PSVM output surface (PSVMModel.PSVMModelOutput:
+        # _svs_count/_bsv_count/_rho + per-row alphas): for the squared
+        # hinge primal, dual coefficients follow from stationarity
+        # α_i = 2C·w_i·max(0, 1 − y_i f_i); margin-active rows are SVs and
+        # margin VIOLATORS (y f < 1) are the bounded set
+        thr = float(p.get("sv_threshold", 1e-4))
+        slack = 1.0 - yv * f
+        model.svs_count = int(np.sum(slack > thr))
+        model.bsv_count = int(np.sum(slack > 1.0))      # y·f < 0: violators
+        model.rho = float(-beta[-1])
+        alpha = 2.0 * C * w * np.maximum(slack, 0.0) * yv
+        from h2o3_tpu.core.frame import Column
+
+        af = Frame()
+        af.add("alpha", Column.from_numpy(alpha.astype(np.float64)))
+        af.install()
+        model.alpha_key = str(af.key)
         return model
 
 

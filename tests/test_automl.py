@@ -41,3 +41,42 @@ def test_automl_regression(cl):
                     include_algos=["glm", "gbm"])
     aml.train(y="y", training_frame=fr)
     assert aml.leaderboard[0]["rmse"] < 1.0
+
+
+def test_automl_exploitation_phase(cl):
+    """Step registry + exploitation (ai.h2o.automl.modeling providers):
+    the plan executes in group order and the exploitation step refines the
+    best GBM with an annealed learning rate."""
+    import numpy as np
+
+    from h2o3_tpu.automl.automl import H2OAutoML
+    from h2o3_tpu.core.frame import Column, Frame, T_CAT
+
+    rng = np.random.default_rng(17)
+    n = 600
+    X = rng.normal(size=(n, 3))
+    logit = 1.5 * X[:, 0] - X[:, 1]
+    y = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "Y", "N")
+    fr = Frame.from_numpy(X, names=["a", "b", "c"])
+    fr.add("y", Column.from_numpy(y, ctype=T_CAT))
+    aml = H2OAutoML(max_models=4, seed=7, nfolds=0,
+                    include_algos=["GBM", "GLM"],
+                    exclude_algos=["StackedEnsemble"])
+    aml.train(y="y", training_frame=fr)
+    names = [st["name"] for st in aml.modeling_plan]
+    assert any(nm.startswith("exploit_gbm") for nm in names), names
+    built = {st["name"]: st.get("model_id") for st in aml.modeling_plan
+             if st.get("model_id")}
+    assert any(nm.startswith("exploit_gbm") for nm in built), built
+    # the exploitation model really anneals: lr half of the family best's
+    exploit_id = next(v for k, v in built.items()
+                      if k.startswith("exploit_gbm"))
+    em = next(m for m in aml.models if str(m.key) == exploit_id)
+    gbms = [m for m in aml.models
+            if m.algo_name == "gbm" and str(m.key) != exploit_id]
+    best_lr = [float(m._parms.get("learn_rate") or 0.1)
+               for m in aml._ranked(gbms)][0]
+    assert float(em._parms["learn_rate"]) == pytest.approx(best_lr / 2)
+    # groups executed in order: defaults before grids before exploitation
+    groups = [st["group"] for st in aml.modeling_plan]
+    assert groups == sorted(groups)

@@ -1,0 +1,34 @@
+"""Deployment artifacts sanity (reference analogs: h2o-helm, docker)."""
+
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHART = os.path.join(REPO, "deploy", "helm", "h2o3-tpu")
+
+
+def test_helm_chart_layout():
+    assert os.path.exists(os.path.join(CHART, "Chart.yaml"))
+    chart = open(os.path.join(CHART, "Chart.yaml")).read()
+    assert "name: h2o3-tpu" in chart and "apiVersion: v2" in chart
+    values = open(os.path.join(CHART, "values.yaml")).read()
+    for key in ("replicaCount", "auth:", "tls:", "cpuMode:"):
+        assert key in values, key
+    for tpl in ("statefulset.yaml", "service.yaml", "_helpers.tpl"):
+        assert os.path.exists(os.path.join(CHART, "templates", tpl)), tpl
+
+
+def test_helm_templates_braces_balanced():
+    """Every {{ has its }} and the security env plumbing is present."""
+    tdir = os.path.join(CHART, "templates")
+    for f in os.listdir(tdir):
+        src = open(os.path.join(tdir, f)).read()
+        assert src.count("{{") == src.count("}}"), f
+        # every if has an end
+        assert len(re.findall(r"{{-? if ", src)) == \
+            len(re.findall(r"{{-? end ?}}", src)) - \
+            len(re.findall(r"{{-? range ", src)), f
+    ss = open(os.path.join(tdir, "statefulset.yaml")).read()
+    for needle in ("H2O_TPU_COORDINATOR", "H2O_TPU_NUM_PROCESSES",
+                   "H2O_TPU_AUTH_FILE", "H2O_TPU_SSL_CERT", "/3/Ping"):
+        assert needle in ss, needle

@@ -76,3 +76,47 @@ def test_word2vec_transform_average(cl):
     assert emb.nrows == 300
     v = m.word_vec("alpha")
     assert v is not None and v.shape == (8,)
+
+
+def test_glrm_mixed_losses_and_categoricals(cl):
+    """Loss grid (GlrmLoss.java): categorical one-hot block under the
+    Categorical multi-loss, numeric columns under per-column overrides
+    (loss_by_col/loss_by_col_idx in frame order)."""
+    import numpy as np
+
+    from h2o3_tpu.core.frame import Column, Frame, T_CAT
+    from h2o3_tpu.models.glrm import GLRM
+
+    rng = np.random.default_rng(9)
+    n = 200
+    g = np.asarray(["a", "b", "c"])[rng.integers(0, 3, n)]
+    x1 = rng.normal(size=n) + (g == "a") * 2.0
+    x2 = rng.normal(size=n) - (g == "b") * 1.5
+    fr = Frame()
+    fr.add("g", Column.from_numpy(g, ctype=T_CAT))
+    fr.add("x1", Column.from_numpy(x1))
+    fr.add("x2", Column.from_numpy(x2))
+    m = GLRM(k=2, loss="Quadratic", multi_loss="Categorical",
+             loss_by_col=["Huber"], loss_by_col_idx=[2],   # x2 → Huber
+             max_iterations=200, seed=1).train(training_frame=fr)
+    rec = m.predict(fr)
+    assert set(rec.names) == {"reconstr_g", "reconstr_x1", "reconstr_x2"}
+    # the categorical reconstruction should beat chance by a wide margin
+    acc = (rec.col("reconstr_g").values() == g).mean()
+    assert acc > 0.6, acc
+    err = float(np.mean((np.asarray(rec.col("reconstr_x1").to_numpy())
+                         - x1) ** 2))
+    assert err < 1.0, err
+
+
+def test_glrm_ordinal_multiloss_rejected(cl):
+    import numpy as np
+    import pytest
+
+    from h2o3_tpu.core.frame import Frame
+    from h2o3_tpu.models.glrm import GLRM
+
+    fr = Frame.from_numpy(np.random.default_rng(0).normal(size=(50, 3)),
+                          names=["a", "b", "c"])
+    with pytest.raises(NotImplementedError):
+        GLRM(k=2, multi_loss="Ordinal").train(training_frame=fr)

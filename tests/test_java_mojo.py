@@ -129,3 +129,81 @@ def test_rest_import_reference_mojo(tmp_path):
     model = mojo.read_mojo(str(zpath))
     assert model.algo_name == "gbm"
     assert model._output.response_domain == ["0", "1"]
+
+
+def _train_data(seed=0, n=500):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 3))
+    g = np.asarray(["p", "q", "r"])[rng.integers(0, 3, n)]
+    logit = 1.5 * X[:, 0] - X[:, 1] + (g == "p") * 1.0
+    fr = Frame.from_numpy(X, names=["a", "b", "c"])
+    fr.add("g", Column.from_numpy(g, ctype="enum"))
+    ybin = np.where(np.random.default_rng(seed + 1).random(n)
+                    < 1 / (1 + np.exp(-logit)), "Y", "N")
+    yreg = logit + rng.normal(0, 0.2, n)
+    ymul = np.asarray(["u", "v", "w"])[
+        np.argmax(np.stack([logit, -logit, X[:, 2]], 1), 1)]
+    return fr, ybin, yreg, ymul
+
+
+def _export_roundtrip(model, fr, prob_cols):
+    """Export in the REFERENCE byte format, re-import through the reader
+    that is itself validated against real h2o-3 artifacts, compare."""
+    from h2o3_tpu.models.mojo_java import export_java_mojo_bytes
+
+    from h2o3_tpu.models import mojo
+
+    blob = export_java_mojo_bytes(model)
+    loaded = mojo.read_mojo(blob)           # dispatches to the java reader
+    want = model.predict(fr).to_pandas()
+    got = loaded.predict(fr).to_pandas()
+    for c in prob_cols:
+        np.testing.assert_allclose(want[c].to_numpy(float),
+                                   got[c].to_numpy(float), atol=2e-5)
+    agree = (want["predict"].astype(str).to_numpy()
+             == got["predict"].astype(str).to_numpy()).mean()
+    assert agree > 0.995, agree
+
+
+def test_export_reference_format_gbm_binomial():
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    fr, ybin, _, _ = _train_data(1)
+    tr = fr.subframe(fr.names)
+    tr.add("y", Column.from_numpy(ybin, ctype="enum"))
+    m = GBM(ntrees=8, max_depth=4, seed=1).train(y="y", training_frame=tr)
+    _export_roundtrip(m, tr, ["Y", "N"])
+
+
+def test_export_reference_format_gbm_regression():
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    fr, _, yreg, _ = _train_data(2)
+    tr = fr.subframe(fr.names)
+    tr.add("y", Column.from_numpy(yreg))
+    m = GBM(ntrees=6, max_depth=3, seed=2).train(y="y", training_frame=tr)
+    _export_roundtrip(m, tr, ["predict"])
+
+
+def test_export_reference_format_gbm_multinomial():
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    fr, _, _, ymul = _train_data(3)
+    tr = fr.subframe(fr.names)
+    tr.add("y", Column.from_numpy(ymul, ctype="enum"))
+    m = GBM(ntrees=5, max_depth=3, seed=3).train(y="y", training_frame=tr)
+    _export_roundtrip(m, tr, ["u", "v", "w"])
+
+
+def test_export_reference_format_drf():
+    from h2o3_tpu.models.tree.drf import DRF
+
+    fr, ybin, yreg, _ = _train_data(4)
+    tr = fr.subframe(fr.names)
+    tr.add("y", Column.from_numpy(ybin, ctype="enum"))
+    m = DRF(ntrees=10, max_depth=5, seed=4).train(y="y", training_frame=tr)
+    _export_roundtrip(m, tr, ["Y", "N"])
+    tr2 = fr.subframe(fr.names)
+    tr2.add("y", Column.from_numpy(yreg))
+    m2 = DRF(ntrees=8, max_depth=4, seed=5).train(y="y", training_frame=tr2)
+    _export_roundtrip(m2, tr2, ["predict"])

@@ -151,3 +151,114 @@ def test_gam_thinplate_and_knots(cl):
 
     with pytest.raises(ValueError, match="unsupported"):
         GAM(gam_columns=["x"], bs=7).train(y="y", training_frame=fr)
+
+
+def test_coxph_stratified(cl):
+    """stratify_by (CoxPH.java stratification): per-stratum risk sets and
+    baseline hazards; beta close to the data-generating coefficients even
+    when strata have very different baselines."""
+    import numpy as np
+
+    from h2o3_tpu.core.frame import Column, Frame, T_CAT
+    from h2o3_tpu.models.coxph import CoxPH
+
+    rng = np.random.default_rng(11)
+    n = 800
+    x1 = rng.normal(size=n)
+    site = np.asarray(["s1", "s2"])[rng.integers(0, 2, n)]
+    base = np.where(site == "s1", 1.0, 6.0)   # wildly different baselines
+    t = rng.exponential(1.0 / (base * np.exp(0.9 * x1)))
+    event = np.where(rng.random(n) < 0.85, "1", "0")   # some censoring
+    fr = Frame.from_numpy(np.stack([x1, t], 1), names=["x1", "time"])
+    fr.add("site", Column.from_numpy(site, ctype=T_CAT))
+    fr.add("event", Column.from_numpy(event, ctype=T_CAT))
+    m = CoxPH(stop_column="time", stratify_by=["site"]).train(
+        y="event", training_frame=fr)
+    b = m.coefficients["x1"]
+    assert abs(b - 0.9) < 0.15, b
+    # per-stratum cumulative hazard: (stratum, time, cumhaz), both strata
+    bh = m.baseline_hazard
+    assert bh.shape[1] == 3 and len(np.unique(bh[:, 0])) == 2
+    # hazard resets per stratum (strictly increasing within each)
+    for s in np.unique(bh[:, 0]):
+        ch = bh[bh[:, 0] == s, 2]
+        assert np.all(np.diff(ch) > 0)
+    assert np.isfinite(m.concordance) and m.concordance > 0.6
+    # the unstratified fit on the same data is badly biased: stratification
+    # must beat it by a wide margin
+    m0 = CoxPH(stop_column="time", ignored_columns=["site"]).train(
+        y="event", training_frame=fr)
+    assert abs(m0.coefficients["x1"] - 0.9) > abs(b - 0.9)
+
+
+def test_coxph_stratify_requires_categorical(cl):
+    import numpy as np
+    import pytest
+
+    from h2o3_tpu.core.frame import Column, Frame, T_CAT
+    from h2o3_tpu.models.coxph import CoxPH
+
+    rng = np.random.default_rng(1)
+    fr = Frame.from_numpy(rng.normal(size=(50, 2)), names=["x1", "time"])
+    fr.add("event", Column.from_numpy(np.asarray(["1"] * 50), ctype=T_CAT))
+    with pytest.raises(ValueError):
+        CoxPH(stop_column="time", stratify_by=["x1"]).train(
+            y="event", training_frame=fr)
+
+
+def test_gam_spline_families(cl):
+    """bs=2 monotone I-splines and bs=3 M-splines (hex/gam NBSplines):
+    the monotone basis must produce a nondecreasing fitted curve on
+    monotone data; M-splines fit as well as cr on smooth data."""
+    import numpy as np
+
+    from h2o3_tpu.core.frame import Column, Frame
+    from h2o3_tpu.models.gam import GAM
+
+    rng = np.random.default_rng(21)
+    n = 600
+    x = rng.uniform(-3, 3, n)
+    y = np.log1p(np.exp(2 * x)) + rng.normal(0, 0.15, n)   # monotone + noise
+    fr = Frame.from_numpy(np.stack([x, y], 1), names=["x", "y"])
+    m_iso = GAM(gam_columns=["x"], bs=[2], num_knots=[8], scale=[0.001],
+                family="gaussian").train(y="y", training_frame=fr)
+    grid = np.linspace(-2.9, 2.9, 80)
+    gfr = Frame.from_numpy(grid.reshape(-1, 1), names=["x"])
+    fit = np.asarray(m_iso.predict(gfr).col("predict").to_numpy(), float)
+    viol = np.minimum(np.diff(fit), 0.0)
+    assert np.abs(viol).max() < 1e-3, "I-spline fit must be monotone"
+    err = float(np.mean((fit - np.log1p(np.exp(2 * grid))) ** 2))
+    assert err < 0.1, err
+
+    m_ms = GAM(gam_columns=["x"], bs=[3], num_knots=[8], scale=[0.001],
+               family="gaussian").train(y="y", training_frame=fr)
+    fit_ms = np.asarray(m_ms.predict(gfr).col("predict").to_numpy(), float)
+    err_ms = float(np.mean((fit_ms - np.log1p(np.exp(2 * grid))) ** 2))
+    assert err_ms < 0.1, err_ms
+
+
+def test_psvm_sv_surface(cl):
+    """PSVMModelOutput parity (psvm/PSVM.java:139): svs/bsv counts, rho,
+    and per-row alpha coefficients with the KKT sign structure."""
+    import numpy as np
+
+    from h2o3_tpu.core.dkv import DKV
+    from h2o3_tpu.core.frame import Column, Frame, T_CAT
+    from h2o3_tpu.models.psvm import PSVM
+
+    rng = np.random.default_rng(4)
+    n = 800
+    X = rng.normal(size=(n, 2))
+    y = np.where((X ** 2).sum(axis=1) < 1.2, "in", "out")
+    fr = Frame.from_numpy(X, names=["x1", "x2"])
+    fr.add("y", Column.from_numpy(y, ctype=T_CAT))
+    m = PSVM(hyper_param=5.0, seed=1).train(y="y", training_frame=fr)
+    assert 0 < m.svs_count < n
+    assert 0 <= m.bsv_count <= m.svs_count
+    assert np.isfinite(m.rho)
+    alpha = np.asarray(DKV.get(m.alpha_key).col("alpha").to_numpy())
+    assert alpha.shape[0] == n
+    nz = alpha != 0
+    assert abs(int(nz.sum()) - m.svs_count) <= 2
+    d = m.to_dict()
+    assert {"svs_count", "bsv_count", "rho", "alpha_key"} <= d.keys()
