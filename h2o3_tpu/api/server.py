@@ -1522,7 +1522,8 @@ class _Handler(BaseHTTPRequestHandler):
     #    BasicAuth/-hash_login; enabled via H2O_TPU_AUTH_FILE) -------------
     def _authorized(self) -> bool:
         auth = getattr(self.server_ref, "auth", None)
-        if not auth:
+        login = getattr(self.server_ref, "login_module", None)
+        if not auth and login is None:
             return True
         import base64
         import hashlib
@@ -1534,6 +1535,14 @@ class _Handler(BaseHTTPRequestHandler):
             user, _, pw = base64.b64decode(hdr[6:]).decode().partition(":")
         except Exception:   # noqa: BLE001 — malformed header
             return False
+        if login is not None:
+            # pluggable authenticator (reference: JAAS login modules —
+            # h2o-security LDAP/PAM/Kerberos realms plug in the same way):
+            # any callable(user, password) -> bool
+            try:
+                return bool(login(user, pw))
+            except Exception:   # noqa: BLE001 — authenticator fault = deny
+                return False
         import hmac
 
         want = auth.get(user)
@@ -1616,6 +1625,21 @@ class ApiServer:
         if bool(self.ssl_certfile) != bool(self.ssl_keyfile):
             raise ValueError("TLS needs BOTH H2O_TPU_SSL_CERT and "
                              "H2O_TPU_SSL_KEY (PEM paths)")
+        # pluggable login module (reference: -login_conf JAAS realms —
+        # LDAP/PAM/Kerberos): H2O_TPU_LOGIN_MODULE="pkg.mod:callable",
+        # callable(user, password) -> bool. Takes precedence over the
+        # hash-file table when both are configured.
+        self.login_module = None
+        spec = os.environ.get("H2O_TPU_LOGIN_MODULE", "")
+        if spec:
+            import importlib
+
+            mod_name, _, fn_name = spec.partition(":")
+            if not fn_name:
+                raise ValueError("H2O_TPU_LOGIN_MODULE must be "
+                                 "'module:callable'")
+            self.login_module = getattr(importlib.import_module(mod_name),
+                                        fn_name)
         # {user: sha256(password) hex} from "user:hash" lines
         self.auth: Optional[Dict[str, str]] = None
         path = auth_file or os.environ.get("H2O_TPU_AUTH_FILE")

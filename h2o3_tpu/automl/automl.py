@@ -213,6 +213,7 @@ class H2OAutoML:
                               f"{self._metric_name}="
                               f"{_metric(m, self._metric_name):.4f}")
                 except Exception as e:   # noqa: BLE001 — AutoML keeps going
+                    st["failed"] = True
                     self._log(f"FAILED {st['name']} ({algo}): "
                               f"{type(e).__name__}: {e}")
             return True
@@ -252,6 +253,18 @@ class H2OAutoML:
                 self._plan = plan + exploit
                 self._log(f"exploitation phase: {len(exploit)} step(s)")
                 run_steps(exploit, budget_end, self.max_models)
+        # reserved slots that exploitation could not use (no exploitable
+        # family trained, or fewer exploit steps than the reserve) go back
+        # to the exploration plan so max_models is always filled
+        if self.max_models and len(self.models) < self.max_models and \
+                (budget_end is None or time.time() < budget_end):
+            # only steps the reserve SKIPPED — not ones that already
+            # failed (a deterministic failure would just fail again and
+            # eat the remaining time budget)
+            leftover = [st for st in plan
+                        if "model_id" not in st and not st.get("failed")]
+            if leftover:
+                run_steps(leftover, budget_end, self.max_models)
 
         # stacked ensembles (best-of-family + all), reference SE steps —
         # honoring include/exclude_algos like any other algo step

@@ -122,7 +122,17 @@ if __name__ == "__main__":
     import os
 
     mode = os.environ.get("H2O3_BENCH_ONLY", "")
-    if mode == "drf":
+    if mode == "profile":
+        # one profile artifact per round (VERDICT r4 item 3): an XLA trace
+        # of a short flagship run, viewable with tensorboard/xprof
+        import jax
+
+        pdir = os.environ.get("H2O3_PROFILE_DIR", "profile_out")
+        with jax.profiler.trace(pdir):
+            value, metric = run_flagship(n_rows=200_000, ntrees=5)
+        metric = "gbm_profiled_rows_per_sec"
+        print(f"profile written to {pdir}", flush=True)
+    elif mode == "drf":
         value, metric = run_drf_deep()
     elif mode == "compile":
         value, metric = run_compile_probe()

@@ -95,12 +95,22 @@ class BinSpec:
 
     # -- device binning ----------------------------------------------------
     def bin_columns(self, frame: Frame):
-        """-> (N, F) int32 row-sharded bin matrix (within-feature indices)."""
+        """-> (N, F) row-sharded bin matrix (within-feature indices).
+
+        Narrowest integer dtype that fits max(nbins): the bin matrix is the
+        biggest operand STREAMED from HBM on every histogram pass of every
+        level, so uint8 (nbins ≤ 256, the common case — default numeric
+        nbins=20) cuts that traffic 4× vs int32; high-cardinality
+        categorical specs (nbins_cats up to 1024+NA) fall back to int16.
+        Integer compares/gathers promote losslessly downstream."""
         import jax
         import jax.numpy as jnp
 
         from h2o3_tpu.core.runtime import cluster
 
+        max_bins = int(self.nbins.max()) if len(self.nbins) else 1
+        dtype = (jnp.uint8 if max_bins <= 256
+                 else jnp.int16 if max_bins <= 32767 else jnp.int32)
         cl = cluster()
         cols = [frame.col(n) for n in self.names]
         parts = []
@@ -114,7 +124,7 @@ class BinSpec:
                 e = jnp.asarray(self.edges[i])
                 b = jnp.searchsorted(e, x, side="left").astype(jnp.int32)
                 b = jnp.where(jnp.isnan(x), na_bin, b)
-            parts.append(b)
+            parts.append(b.astype(dtype))
         binned = jnp.stack(parts, axis=-1)          # (N, F)
         from jax.sharding import NamedSharding, PartitionSpec as P
 

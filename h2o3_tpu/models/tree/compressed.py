@@ -171,7 +171,7 @@ class CompressedForest:
             self.na_bins))
 
     def predict_binned(self, binned):
-        """binned (N, F) int32 → (N,) sums (regression/binomial margin) or
+        """binned (N, F) integer bins (any width) → (N,) sums (regression/binomial margin) or
         (N, K) per-class margins (multinomial)."""
         import jax.numpy as jnp
 

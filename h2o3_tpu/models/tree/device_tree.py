@@ -419,7 +419,8 @@ def grow_tree_device(binned, w, y, spec, *, max_depth: int, min_rows: float,
                      feat_masks: Optional[List[np.ndarray]] = None):
     """Grow one tree fully on device — NOTHING is fetched to host.
 
-    binned (N, F) int32 row-sharded; w, y, num, den (N,) device (num/den are
+    binned (N, F) integer bin matrix (uint8/int16/int32 per BinSpec.bin_columns)
+    row-sharded; w, y, num, den (N,) device (num/den are
     the GammaPass numerator/denominator rows; default num=w·y, den=w).
     feat_masks: optional per-level (S_d, F) bool arrays, levels
     0..max_depth-1 (mtries / column sampling) — widths per level_widths().

@@ -38,7 +38,8 @@ def _build_hist_fn(n_nodes: int, tot_bins: int, F: int, mesh):
     """
 
     def local_hist(binned, row_node, w, y, offsets):
-        # binned (n, F) int32; row_node (n,) int32 (-1 = finalized row)
+        # binned (n, F) integer bins (narrowest dtype that fits nbins);
+        # row_node (n,) int32 (-1 = finalized row)
         valid = row_node >= 0
         node = jnp.maximum(row_node, 0)
         idx = node[:, None] * tot_bins + offsets[None, :] + binned   # (n, F)

@@ -8,14 +8,20 @@ water.TestUtil.stall_till_cloudsize) — here the 'cloud' is a virtual
 import os
 
 # jax may already be imported by the environment's sitecustomize, so set the
-# flag env AND update jax.config (effective until backend init, which is lazy)
-os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                           + " --xla_force_host_platform_device_count=8")
-os.environ["JAX_PLATFORMS"] = "cpu"
+# flag env AND update jax.config (effective until backend init, which is lazy).
+# H2O_TPU_TEST_REAL=1 keeps the real accelerator backend instead — the
+# opt-in for the real-silicon test tiers (test_pallas_hist
+# TestRealTpuLowering), which are unreachable under the forced-CPU mesh.
+_REAL = bool(os.environ.get("H2O_TPU_TEST_REAL"))
+if not _REAL:
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _REAL:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -125,3 +125,42 @@ def test_tls_rest_bind(tmp_path, cl):
                                    timeout=5)
     finally:
         srv.stop()
+
+
+def test_pluggable_login_module(tmp_path, cl, monkeypatch):
+    """H2O_TPU_LOGIN_MODULE (JAAS login-module analog, h2o-security
+    LDAP/PAM realms): any module:callable authenticates Basic creds."""
+    import json
+    import sys
+    import types
+    import urllib.request
+
+    from h2o3_tpu.api.server import start_server
+
+    mod = types.ModuleType("_test_authmod")
+    mod.check = lambda user, pw: user == "ldapuser" and pw == "s3cret"
+    sys.modules["_test_authmod"] = mod
+    monkeypatch.setenv("H2O_TPU_LOGIN_MODULE", "_test_authmod:check")
+    srv = start_server(port=0)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        import base64
+
+        def get(creds=None):
+            req = urllib.request.Request(base + "/3/Cloud")
+            if creds:
+                req.add_header("Authorization", "Basic "
+                               + base64.b64encode(creds.encode()).decode())
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, None
+
+        assert get()[0] == 401                      # no creds
+        assert get("ldapuser:wrong")[0] == 401
+        code, cloud = get("ldapuser:s3cret")
+        assert code == 200 and cloud["cloud_healthy"] is True
+    finally:
+        srv.stop()
+        del sys.modules["_test_authmod"]
