@@ -601,8 +601,15 @@ def _read_glm_mojo(backend, info, columns, domains):
                 d = np.where(np.isnan(d), num_means[i], d)
             eta += beta[noff + cats + i] * d
         eta += beta[-1]
-        if link == "tweedie" and tweedie_lp not in (0.0, 1.0):
-            mu = np.power(np.maximum(eta, 1e-10), 1.0 / tweedie_lp)
+        if link == "tweedie":
+            # link power 0 = log link, 1 = identity, else inverse power
+            # (GLM_tweedieInv semantics)
+            if tweedie_lp == 0.0:
+                mu = _sanitized_exp(eta)
+            elif tweedie_lp == 1.0:
+                mu = eta
+            else:
+                mu = np.power(np.maximum(eta, 1e-10), 1.0 / tweedie_lp)
         else:
             mu = _link_inv("logit" if link == "logit" else link, eta)
         if family in ("binomial", "fractionalbinomial"):
@@ -700,8 +707,10 @@ def export_java_mojo_bytes(model) -> bytes:
     from h2o3_tpu.models.model import ModelCategory
 
     algo = model.algo_name
+    if algo == "glm":
+        return _export_glm_java(model)
     if algo not in ("gbm", "drf"):
-        raise ValueError(f"reference-format export supports gbm/drf, "
+        raise ValueError(f"reference-format export supports gbm/drf/glm, "
                          f"not {algo!r}")
     fo = model.forest
     spec = model.spec
@@ -805,6 +814,107 @@ def export_java_mojo_bytes(model) -> bytes:
                     fo.right[t], leaf_val[t], fo.cat_split[t], fo.cat_table,
                     split_vals[t], cards_by_feat)
                 z.writestr(f"trees/t{k:02d}_{g:03d}.bin", blob)
+    return buf.getvalue()
+
+
+def _export_glm_java(model) -> bytes:
+    """GLM → reference model.ini format (GlmMojoReader fields). The Java
+    scorer applies beta to RAW values (glmScore0 has no standardization),
+    so standardized coefficients de-standardize here: β'_j = β_j/σ_j,
+    intercept' = intercept − Σ β_j μ_j/σ_j."""
+    from h2o3_tpu.models.model import ModelCategory
+
+    o = model._output
+    di = model.dinfo
+    beta = np.asarray(model.beta, np.float64)
+    if beta.ndim != 1:
+        raise ValueError("reference-format GLM export supports binomial/"
+                         "regression (1-D beta); multinomial not yet")
+    if model._parms.get("interactions"):
+        raise ValueError("reference-format GLM export does not cover "
+                         "interaction columns")
+    if model._parms.get("offset_column"):
+        raise ValueError("reference-format GLM export does not cover "
+                         "offset_column (the MOJO format scores without "
+                         "per-row offsets)")
+    family = str(model._parms.get("family") or "gaussian").lower()
+    if family == "auto":
+        family = ("binomial" if o.model_category == ModelCategory.Binomial
+                  else "gaussian")
+    link = str(getattr(model, "linkname", "") or
+               ("logit" if family == "binomial" else "identity"))
+    if family == "ordinal" or link == "ordinal":
+        raise ValueError("reference-format GLM export does not cover "
+                         "ordinal models (beta carries threshold params)")
+    if family == "quasibinomial":
+        family = "binomial"     # identical scoring: logit inverse + threshold
+    # de-standardized beta in the Java layout (cats, nums, intercept LAST):
+    # coef() owns the de-standardization math — single source of truth
+    coefs = model.coef()
+    b = np.asarray([coefs[nm] for nm in di.coef_names() + ["Intercept"]],
+                   np.float64)
+    nums = len(di.num_names)
+    mean_imp = str(di.missing_values_handling or "").lower() \
+        .replace("_", "") == "meanimputation"
+
+    names = list(di.cat_names) + list(di.num_names)
+    columns = names + [o.response_name or "response"]
+    domains: Dict[int, List[str]] = {
+        i: list(di.domains[nm]) for i, nm in enumerate(di.cat_names)}
+    if o.response_domain:
+        domains[len(names)] = list(o.response_domain)
+    thr = _default_threshold_of(model)
+    lines = [
+        "[info]",
+        "h2o_version = 3.46.0-tpu",
+        "mojo_version = 1.0",
+        "license = Apache License Version 2.0",
+        "algo = glm",
+        "algorithm = Generalized Linear Modeling",
+        "endianness = LITTLE_ENDIAN",
+        f"category = {o.model_category}",
+        "uuid = 0",
+        "supervised = true",
+        f"n_features = {len(names)}",
+        f"n_classes = {2 if family == 'binomial' else 1}",
+        f"n_columns = {len(columns)}",
+        f"n_domains = {len(domains)}",
+        "balance_classes = false",
+        f"default_threshold = {thr!r}",
+        "prior_class_distrib = null",
+        "model_class_distrib = null",
+        "timestamp = 2026-01-01T00:00:00.000Z",
+        f"use_all_factor_levels = "
+        f"{'true' if di.use_all_factor_levels else 'false'}",
+        f"cats = {len(di.cat_names)}",
+        "cat_modes = [" + ", ".join(str(int(m))
+                                    for m in di.cat_modes) + "]",
+        "cat_offsets = [" + ", ".join(str(int(x))
+                                      for x in di.cat_offsets) + "]",
+        f"nums = {nums}",
+        "num_means = [" + ", ".join(repr(float(v))
+                                    for v in di.impute_values) + "]",
+        f"mean_imputation = {'true' if mean_imp else 'false'}",
+        "beta = [" + ", ".join(repr(float(v)) for v in b) + "]",
+        f"family = {family}",
+        f"link = {link}",
+        *( [f"tweedie_link_power = {float(model.link_power)!r}"]
+           if link == "tweedie" else [] ),
+        "",
+        "[columns]", *columns,
+        "",
+        "[domains]",
+    ]
+    dom_files = {}
+    for di_idx, (ci, dom) in enumerate(sorted(domains.items())):
+        fname = f"d{di_idx:03d}.txt"
+        lines.append(f"{ci}: {len(dom)} {fname}")
+        dom_files[fname] = "\n".join(dom) + "\n"
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("model.ini", "\n".join(lines) + "\n")
+        for fname, content in dom_files.items():
+            z.writestr(f"domains/{fname}", content)
     return buf.getvalue()
 
 
