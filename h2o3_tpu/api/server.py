@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import io
 import json
+import math
 import os
 import re
 import threading
@@ -62,6 +63,29 @@ def _json_default(o):
     if isinstance(o, np.ndarray):
         return o.tolist()
     return str(o)
+
+
+# unquoted NaN/Infinity as json.dumps emits them: preceded by a structural
+# character, never inside a quoted string (dumps escapes quotes, so a
+# [,: or space before the token means it is a bare literal)
+_BARE_NONFINITE = re.compile(rb"[\[,:\s](?:NaN|-?Infinity)[,\]\}\s]")
+
+
+def _definite(o):
+    """Recursively replace non-finite floats with None (the slow path of
+    _reply_json, taken only when the fast serialization contains NaN)."""
+    if isinstance(o, float):
+        return o if math.isfinite(o) else None
+    if isinstance(o, np.floating):
+        f = float(o)
+        return f if math.isfinite(f) else None
+    if isinstance(o, dict):
+        return {k: _definite(v) for k, v in o.items()}
+    if isinstance(o, (list, tuple)):
+        return [_definite(v) for v in o]
+    if isinstance(o, np.ndarray):
+        return _definite(o.tolist())
+    return o
 
 
 def _parse_list(v) -> Optional[list]:
@@ -622,6 +646,48 @@ def _extract_train_params(cls, body: Dict[str, Any]):
     return params, ignored
 
 
+def _h_generic_train(cls, params: Dict[str, Any], model_id):
+    """ModelBuilders path for Generic: load the MOJO named by `path` (or
+    `model_key` pointing at an uploaded blob) and install it like any
+    trained model."""
+    params.pop("training_frame", None)
+    params.pop("validation_frame", None)
+    params.pop("response_column", None)
+    dest = model_id or f"GENERIC_model_{uuid.uuid4().hex[:12]}"
+    try:
+        # validate SYNCHRONOUSLY so bad params surface as a 412 response,
+        # not a FAILED background job with a raw traceback
+        builder = cls(**{k: v for k, v in params.items() if v})
+        path = builder.params.get("path") or builder.params.get("model_key")
+        if not path:
+            raise ValueError("Generic: 'path' to a MOJO file is required")
+    except ValueError as e:
+        raise ApiError(str(e), 412, "H2OModelBuilderErrorV3") from None
+    job = Job(description="generic Model Build", dest=dest)
+    job.dest_type = "Key<Model>"
+    job.dest_key = dest
+
+    from h2o3_tpu.parallel import oplog
+
+    # followers must install the model under the SAME key (later predict
+    # ops broadcast and resolve it by name); the MOJO path rides the
+    # shared-filesystem contract like parse sources
+    op_seq = oplog.broadcast("generic", {"path": str(path),
+                                         "model_id": dest})
+
+    def run(j: Job):
+        with oplog.turn(op_seq):
+            model = builder.train()
+        model._key = Key(dest)
+        DKV.put(dest, model)
+        return model
+
+    job.start(run, background=True)
+    return {"__meta": S.meta("ModelBuilderJobV3", "ModelBuilderJob"),
+            "job": S.job_v3(job), "messages": [], "error_count": 0,
+            "parameters": [], "algo": "generic"}
+
+
 def _pop_train_args(params: Dict[str, Any]):
     """Shared extraction of the frame/response/ignored args from a coerced
     param dict (used by the ModelBuilders and Grid build handlers — one
@@ -650,6 +716,11 @@ def h_modelbuilder_train(ctx: Ctx):
     body = dict(ctx.body)
     params, _ignored = _extract_train_params(cls, body)
     model_id = str(params.pop("model_id", "") or "").strip('"') or None
+    if algo == "generic":
+        # Generic trains from a MOJO artifact, not a frame (h2o-py
+        # H2OGenericEstimator.from_file → train() with no training_frame;
+        # hex/generic/Generic.java)
+        return _h_generic_train(cls, params, model_id)
     train, valid, y, x_ignored = _pop_train_args(params)
 
     try:
@@ -1512,6 +1583,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _reply_json(self, obj: Any, code: int = 200):
         body = json.dumps(obj, default=_json_default).encode()
+        # bare (UNQUOTED) NaN/Infinity tokens are NOT valid JSON: strict
+        # parsers (simplejson>=3.19 as vendored by `requests` — i.e.
+        # genuine h2o-py — and every browser JSON.parse) reject the whole
+        # payload. The quoted "NaN" STRINGS in frame previews (ColV3
+        # convention) are fine and must not trigger the slow path.
+        if _BARE_NONFINITE.search(body):
+            body = json.dumps(_definite(obj), default=_json_default,
+                              allow_nan=False).encode()
         self._send(code, body, "application/json")
 
     def _reply_error(self, msg: str, code: int, schema: str = "H2OErrorV3",

@@ -876,7 +876,8 @@ def _export_glm_java(model) -> bytes:
         "uuid = 0",
         "supervised = true",
         f"n_features = {len(names)}",
-        f"n_classes = {2 if family == 'binomial' else 1}",
+        f"n_classes = "
+        f"{2 if o.model_category == ModelCategory.Binomial else 1}",
         f"n_columns = {len(columns)}",
         f"n_domains = {len(domains)}",
         "balance_classes = false",

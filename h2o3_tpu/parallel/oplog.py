@@ -176,6 +176,14 @@ def _apply(kind: str, p: Dict[str, Any]) -> None:
             sess = _RAPIDS_SESSIONS[sid] = Session(sid)
         exec_rapids(p["ast"], sess)
         return
+    if kind == "generic":
+        from h2o3_tpu.core.dkv import DKV, Key
+        from h2o3_tpu.models.generic import Generic
+
+        model = Generic(path=p["path"]).train()
+        model._key = Key(p["model_id"])
+        DKV.put(p["model_id"], model)
+        return
     if kind == "grid":
         from h2o3_tpu.core.dkv import DKV
         from h2o3_tpu.grid import H2OGridSearch

@@ -218,3 +218,39 @@ def test_gains_lift_via_h2opy(h2o, air):
     assert "lift" in hdr and "cumulative_capture_rate" in hdr
     ccr = [r[hdr.index("cumulative_capture_rate")] for r in rows]
     assert abs(float(ccr[-1]) - 1.0) < 1e-6
+
+
+def test_import_reference_mojo_via_h2opy(h2o, air, tmp_path):
+    """h2o.import_mojo on a REFERENCE-format artifact (the byte format the
+    stock genmodel jar reads): train → download ?format=reference →
+    re-import through genuine h2o-py → predictions match the original."""
+    from h2o.estimators import H2OGradientBoostingEstimator
+
+    m = H2OGradientBoostingEstimator(ntrees=4, max_depth=3, seed=1,
+                                     model_id="pymojo_gbm")
+    m.train(y="IsDepDelayed", training_frame=air)
+    # download the reference-format MOJO over REST, as a Java consumer would
+    import urllib.request
+
+    conn = h2o.connection()
+    url = (conn.base_url +
+           "/3/Models/pymojo_gbm/mojo?format=reference")
+    path = str(tmp_path / "ref_mojo.zip")
+    with urllib.request.urlopen(url, timeout=120) as r:
+        blob = r.read()
+    with open(path, "wb") as f:
+        f.write(blob)
+    import zipfile
+
+    with zipfile.ZipFile(path) as z:
+        assert "model.ini" in z.namelist()
+
+    generic = h2o.import_mojo(path)
+    p0 = m.predict(air).as_data_frame()
+    p1 = generic.predict(air).as_data_frame()
+    import numpy as np
+
+    np.testing.assert_allclose(p0["YES"].to_numpy(float),
+                               p1["YES"].to_numpy(float), atol=2e-5)
+    agree = (p0["predict"].astype(str) == p1["predict"].astype(str)).mean()
+    assert agree > 0.995
