@@ -198,7 +198,10 @@ class TreeScorer:
         # ties (e.g. the max-F1 labeling threshold, which IS a predicted
         # value) resolve the same way here as in the framework
         contrib = self.leaf_val32[tidx, node]             # (N, T) f32
-        K = self.nclasses if self.nclasses > 2 else 1
+        # per-class trees also occur at nclasses==2 (DRF
+        # binomial_double_trees) — mirror compressed.py per_class_trees
+        per_class = self.nclasses == 2 and T and self.tree_class.max() > 0
+        K = self.nclasses if (self.nclasses > 2 or per_class) else 1
         if K > 1:
             acc = np.zeros((N, K), np.float32)
             for t in range(T):

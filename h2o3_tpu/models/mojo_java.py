@@ -734,8 +734,12 @@ def export_java_mojo_bytes(model) -> bytes:
     if o.response_domain:
         domains[n_features] = list(o.response_domain)
 
-    # per-(class, group) trees from the stacked forest arrays
-    tpc = nclasses if nclasses > 2 else 1
+    # per-(class, group) trees from the stacked forest arrays. Binomial
+    # DRF with binomial_double_trees trains one tree PER CLASS per group
+    # (tree_class 0/1 present) — the format then needs tpc=2 and the
+    # multinomial-style accumulate/normalize, not the single-slot flip.
+    double_trees = nclasses == 2 and fo.per_class_trees
+    tpc = nclasses if fo.per_class_trees else 1
     split_vals = _java_split_vals(fo, spec)
     cards_by_feat = np.asarray(spec.cards, np.int64)
     by_class = _group_by_class(fo, tpc)
@@ -745,10 +749,12 @@ def export_java_mojo_bytes(model) -> bytes:
     if algo == "drf":
         # our DRF pre-scales leaves by 1/ntrees at compression time
         # (drf.py:11); the reference stores RAW per-tree values and divides
-        # by n_trees at score time — and its binomial slot accumulates
-        # P(class0), not P(class1)
+        # by n_trees at score time — and its SINGLE-tree binomial slot
+        # accumulates P(class0), not P(class1). Double-trees/multinomial
+        # artifacts normalize by the class-vote sum instead, so only the
+        # 1/N pre-scaling needs undoing there.
         leaf_val = leaf_val * max(ntree_groups, 1)
-        if cat == ModelCategory.Binomial:
+        if cat == ModelCategory.Binomial and not double_trees:
             leaf_val = 1.0 - leaf_val
     if tpc > 1 and fo.init_class is not None:
         # the reference multinomial format has no per-class init margin —
@@ -790,7 +796,8 @@ def export_java_mojo_bytes(model) -> bytes:
         "offset_column = null",
     ]
     if algo == "drf":
-        lines.append("binomial_double_trees = false")
+        lines.append(f"binomial_double_trees = "
+                     f"{'true' if double_trees else 'false'}")
     lines.append("")
     lines.append("[columns]")
     lines.extend(columns)

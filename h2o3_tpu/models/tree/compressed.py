@@ -170,12 +170,22 @@ class CompressedForest:
             self.leaf_val, self.cat_split, self.cat_table, self.tree_class,
             self.na_bins))
 
+    @property
+    def per_class_trees(self) -> bool:
+        """True when trees are grown one-per-class (multinomial, or DRF
+        binomial_double_trees — class-1 trees present at nclasses==2):
+        the traversal must keep K class slots, not collapse to one."""
+        return self.nclasses > 2 or (
+            self.nclasses == 2
+            and int(np.asarray(self.tree_class).max(initial=0)) > 0)
+
     def predict_binned(self, binned):
         """binned (N, F) integer bins (any width) → (N,) sums (regression/binomial margin) or
-        (N, K) per-class margins (multinomial)."""
+        (N, K) per-class margins (multinomial / double-trees binomial)."""
         import jax.numpy as jnp
 
-        fn = _traverse_fn(self.max_depth, self.nclasses)
+        fn = _traverse_fn(self.max_depth, self.nclasses,
+                          self.per_class_trees)
         out = fn(binned, *self.arrays())
         if self.init_class is not None:
             return out + jnp.asarray(self.init_class)[None, :]
@@ -188,7 +198,7 @@ class CompressedForest:
 
 
 @functools.lru_cache(maxsize=32)
-def _traverse_fn(max_depth: int, nclasses: int):
+def _traverse_fn(max_depth: int, nclasses: int, per_class: bool = False):
     import jax
     import jax.numpy as jnp
 
@@ -196,7 +206,7 @@ def _traverse_fn(max_depth: int, nclasses: int):
     def run(binned, feat, thresh, na_left, left, right, leaf_val,
             cat_split, cat_table, tree_class, na_bins):
         N = binned.shape[0]
-        K = nclasses if nclasses > 2 else 1
+        K = nclasses if (nclasses > 2 or per_class) else 1
 
         def walk_one_tree(carry, tree):
             acc = carry

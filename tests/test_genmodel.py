@@ -255,3 +255,14 @@ def test_predictcsv_subprocess_no_framework(data, tmp_path, cl):
     got_p = np.asarray([float(r["Y"]) for r in rows])
     assert (got_l == wl).all()
     np.testing.assert_allclose(got_p, wp, atol=1e-5, rtol=1e-5)
+
+
+def test_drf_double_trees_matches(data, cl):
+    """binomial_double_trees: per-class trees must keep their class slots
+    in the standalone runtime too (round-5 fix, mirrors compressed.py)."""
+    from h2o3_tpu.models.tree.drf import DRF
+
+    fr, raw = data
+    m = DRF(ntrees=10, max_depth=5, binomial_double_trees=True,
+            seed=4).train(x=["x1", "x2", "g"], y="ybin", training_frame=fr)
+    _compare(m, fr, raw)

@@ -260,3 +260,27 @@ def test_export_reference_format_glm_gates_and_tweedie():
              seed=1).train(y="y", training_frame=off)
     with pytest.raises(ValueError, match="offset"):
         export_java_mojo_bytes(m2)
+
+
+def test_export_reference_format_drf_double_trees():
+    """binomial_double_trees DRF: per-class trees export with tpc=2 and
+    the multinomial-style accumulate, matching the format's semantics."""
+    from h2o3_tpu.models.tree.drf import DRF
+
+    fr, ybin, _, _ = _train_data(9)
+    tr = fr.subframe(fr.names)
+    tr.add("y", Column.from_numpy(ybin, ctype="enum"))
+    m = DRF(ntrees=6, max_depth=4, seed=9, binomial_double_trees=True).train(
+        y="y", training_frame=tr)
+    from h2o3_tpu.models.mojo_java import export_java_mojo_bytes
+    import io as _io
+    import zipfile as _zf
+
+    blob = export_java_mojo_bytes(m)
+    with _zf.ZipFile(_io.BytesIO(blob)) as z:
+        names = z.namelist()
+        ini = z.read("model.ini").decode()
+    assert "binomial_double_trees = true" in ini
+    assert "n_trees_per_class = 2" in ini
+    assert any(n.startswith("trees/t01_") for n in names)  # class-1 trees
+    _export_roundtrip(m, tr, ["Y", "N"])

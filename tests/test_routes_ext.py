@@ -349,3 +349,28 @@ def test_find_skips_na_and_nonnumeric(server, frame, cl):
     out = _get("/3/Find", query={"key": "ext_fr", "column": "x",
                                  "row": 0, "match": "abc"})
     assert out["next"] == -1                  # non-numeric needle: no 500
+
+
+def test_drf_double_trees_pojo_per_class(cl):
+    """POJO for binomial_double_trees keeps per-class accumulators and
+    labels with the model threshold (round-5 fix, third runtime)."""
+    import numpy as np
+
+    from h2o3_tpu.core.frame import Column, Frame
+    from h2o3_tpu.models import pojo
+    from h2o3_tpu.models.mojo import _default_threshold
+    from h2o3_tpu.models.tree.drf import DRF
+
+    rng = np.random.default_rng(4)
+    n = 400
+    X = rng.normal(size=(n, 2))
+    y = np.where(rng.random(n) < 1 / (1 + np.exp(-2 * X[:, 0])), "Y", "N")
+    fr = Frame.from_numpy(X, names=["a", "b"])
+    fr.add("y", Column.from_numpy(y, ctype="enum"))
+    m = DRF(ntrees=6, max_depth=4, binomial_double_trees=True,
+            seed=4).train(y="y", training_frame=fr)
+    src = pojo.pojo_source(m)
+    assert "NCLASSES = 2" in src
+    assert "acc[TREE_CLASS[t]]" in src          # per-class accumulation
+    thr = _default_threshold(m)
+    assert f"preds[2] >= {thr!r}" in src        # threshold, not argmax

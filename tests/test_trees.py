@@ -189,6 +189,13 @@ def test_drf_binomial_double_trees(cl):
     pred = m.predict(fr)
     p = np.column_stack([pred.col(c).to_numpy() for c in pred.names[1:]])
     assert np.allclose(p.sum(1), 1.0, atol=1e-5)
+    # the PREDICT path must be discriminative too, not just the OOB
+    # metrics — round-5 regression: per-class trees were summed into one
+    # slot by the traversal (compressed.py per_class_trees)
+    yv = fr.col("y").to_numpy()
+    p1 = p[:, 1]
+    corr = np.corrcoef(p1, (yv == 1).astype(float))[0, 1]
+    assert corr > 0.5, corr
 
 
 class TestXGBoostBoosters:
