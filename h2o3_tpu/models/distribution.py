@@ -200,7 +200,11 @@ class Tweedie(Distribution):
     def gamma_denom(self, w, y, z, f):
         return w * jnp.exp(f * (2 - self.power))
 
-    init_f_num = gamma_num
+    def init_f_num(self, w, y, o):
+        # offset enters the init ratio exactly like f in the Newton step
+        # (TweedieDistribution.initFNum) — 3-arg init signature, not the
+        # 4-arg gamma_num aliasing that crashed tweedie GBM at startup
+        return w * y * jnp.exp(o * (1 - self.power))
 
     def init_f_denom(self, w, y, o):
         return w * jnp.exp(o * (2 - self.power))

@@ -290,3 +290,39 @@ class TestXGBoostBoosters:
         with pytest.raises(ValueError, match="binomial/regression"):
             XGBoost(booster="dart", ntrees=2, rate_drop=0.5).train(
                 y="y", training_frame=f3)
+
+
+def test_gbm_tweedie_trains(cl):
+    """Tweedie GBM: init_f aliasing to the 4-arg gamma_num crashed training
+    at startup (round-5 fix); distribution now trains, beats the mean-only
+    model, and round-trips through the MOJO."""
+    from h2o3_tpu.models import mojo
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    rng = np.random.default_rng(6)
+    n = 600
+    X = rng.normal(size=(n, 3))
+    y = rng.poisson(np.exp(0.5 * X[:, 0] + 0.3 * X[:, 1])).astype(float)
+    fr = Frame.from_numpy(np.column_stack([X, y]),
+                          names=["a", "b", "c", "y"])
+    m = GBM(ntrees=5, max_depth=3, distribution="tweedie", seed=1).train(
+        y="y", training_frame=fr)
+    p = np.asarray(m.predict(fr).col("predict").to_numpy(), float)
+    assert np.isfinite(p).all() and (p > 0).all()
+    assert np.mean((p - y) ** 2) < np.var(y)
+    lm = mojo.read_mojo(mojo.export_mojo_bytes(m))
+    p2 = np.asarray(lm.predict(fr).col("predict").to_numpy(), float)
+    np.testing.assert_allclose(p, p2, atol=1e-7)
+    # nonzero OFFSET exercises the init_f_num exponent itself: a constant
+    # log(2) offset must shift the whole fit down by EXACTLY that margin
+    # (rate predictions halve, per row) relative to the no-offset model —
+    # init and every tree see the same shifted margin
+    # (TweedieDistribution.initFNum parity)
+    off = np.log(np.full(n, 2.0))
+    fro = Frame.from_numpy(np.column_stack([X, off, y]),
+                           names=["a", "b", "c", "off", "y"])
+    mo = GBM(ntrees=5, max_depth=3, distribution="tweedie",
+             offset_column="off", seed=1).train(y="y", training_frame=fro)
+    po = np.asarray(mo.predict(fro).col("predict").to_numpy(), float)
+    assert np.isfinite(po).all() and (po > 0).all()
+    np.testing.assert_allclose(p / po, 2.0, rtol=1e-5)
