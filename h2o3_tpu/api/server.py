@@ -824,6 +824,25 @@ def h_predict_v3(ctx: Ctx):
     from h2o3_tpu.parallel import oplog
 
     dest = str(ctx.arg("predictions_frame", "") or "").strip('"') or None
+    if str(ctx.arg("leaf_node_assignment", "")).lower() in ("1", "true"):
+        # ModelBase.predict_leaf_node_assignment (tree models only). The
+        # bin+leaf_index pass is a DEVICE program over sharded columns, so
+        # followers must replay it like any other predict op
+        la_type = str(ctx.arg("leaf_node_assignment_type", "Path") or
+                      "Path").strip('"') or "Path"
+        if not hasattr(m, "predict_leaf_node_assignment"):
+            raise ApiError(f"{m.algo_name} has no leaf node assignments "
+                           "(tree models only)", 400)
+        dest = dest or f"leaf_assignment_{m.key}_on_{fr.key}"
+        op_seq = oplog.broadcast("leaf_assignment", {
+            "model": str(m.key), "frame": str(fr.key),
+            "type": la_type, "destination_frame": dest})
+        with oplog.turn(op_seq):
+            pred = m.predict_leaf_node_assignment(fr, type=la_type, key=dest)
+            pred.install()
+        return {"__meta": S.meta("ModelMetricsListSchemaV3"),
+                "predictions_frame": {"name": str(pred.key)},
+                "model_metrics": []}
     if _wants_contributions(ctx):
         # genmodel TreeSHAP surfaced over REST (h2o-py predict_contributions)
         _check_contributions_size(fr)

@@ -127,6 +127,68 @@ class SharedTreeModel(Model):
         binned = self.spec.bin_columns(frame)
         return self.forest.predict_binned(binned)
 
+    def predict_leaf_node_assignment(self, frame: Frame, type: str = "Path",
+                                     key=None) -> Frame:
+        """Per-tree leaf assignment (ModelBase.predict_leaf_node_assignment;
+        hex/tree SharedTreeModel.scoreLeafNodeAssignment): 'Path' = the
+        L/R root-to-leaf walk string, 'Node_ID' = the node index. One
+        column per tree (T<k>.C<cls> for per-class forests)."""
+        import numpy as np
+
+        from h2o3_tpu.core.frame import Column, T_CAT
+
+        if type not in ("Path", "Node_ID"):
+            raise ValueError(f"leaf assignment type {type!r} "
+                             "(Path or Node_ID)")
+        adapted = self.adapt_test(frame)
+        binned = self.spec.bin_columns(adapted)
+        leaf_dev = self.forest.leaf_index(binned)
+        if not getattr(leaf_dev, "is_fully_addressable", True):
+            # multi-process cloud: every process reaches this inside its
+            # mirrored op (REST turn / follower replay), so the allgather
+            # is in lockstep
+            from jax.experimental import multihost_utils
+
+            leaf_dev = multihost_utils.process_allgather(leaf_dev,
+                                                         tiled=True)
+        leaf = np.asarray(leaf_dev)[: frame.nrows]
+        fo = self.forest
+        tcls = np.asarray(fo.tree_class)
+        per_class = fo.per_class_trees
+        counters: dict = {}
+        out = Frame(key=key)
+        for t in range(fo.n_trees):
+            if per_class:
+                k = int(tcls[t])
+                g = counters.get(k, 0)
+                counters[k] = g + 1
+                name = f"T{g + 1}.C{k + 1}"
+            else:
+                name = f"T{t + 1}"
+            if type == "Node_ID":
+                # int32 (T_INT) keeps ids exact — float64 would honor a
+                # cluster bf16 opt-in and round ids above 256
+                out.add(name, Column.from_numpy(
+                    leaf[:, t].astype(np.int32)))
+                continue
+            # root-to-leaf L/R strings per node, derived once per tree
+            feat = np.asarray(fo.feat[t])
+            left = np.asarray(fo.left[t])
+            right = np.asarray(fo.right[t])
+            paths = [""] * feat.shape[0]
+
+            def walk(node, prefix):
+                paths[node] = prefix
+                if feat[node] >= 0:
+                    walk(int(left[node]), prefix + "L")
+                    walk(int(right[node]), prefix + "R")
+
+            walk(0, "")
+            vals = np.asarray([paths[i] or "(root)" for i in leaf[:, t]],
+                              object)
+            out.add(name, Column.from_numpy(vals, ctype=T_CAT))
+        return out
+
     def _predict_raw(self, frame: Frame):
         import jax.numpy as jnp
 

@@ -176,6 +176,15 @@ def _apply(kind: str, p: Dict[str, Any]) -> None:
             sess = _RAPIDS_SESSIONS[sid] = Session(sid)
         exec_rapids(p["ast"], sess)
         return
+    if kind == "leaf_assignment":
+        from h2o3_tpu.core.dkv import DKV
+
+        m = DKV.get(p["model"])
+        fr = DKV.get(p["frame"])
+        pred = m.predict_leaf_node_assignment(fr, type=p["type"],
+                                              key=p["destination_frame"])
+        pred.install()
+        return
     if kind == "generic":
         from h2o3_tpu.core.dkv import DKV, Key
         from h2o3_tpu.models.generic import Generic

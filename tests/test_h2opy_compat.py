@@ -254,3 +254,20 @@ def test_import_reference_mojo_via_h2opy(h2o, air, tmp_path):
                                p1["YES"].to_numpy(float), atol=2e-5)
     agree = (p0["predict"].astype(str) == p1["predict"].astype(str)).mean()
     assert agree > 0.995
+
+
+def test_leaf_node_assignment_via_h2opy(h2o, air):
+    """ModelBase.predict_leaf_node_assignment (Path + Node_ID) through
+    genuine h2o-py (model_base.py:148 posts leaf_node_assignment=True)."""
+    from h2o.estimators import H2OGradientBoostingEstimator
+
+    m = H2OGradientBoostingEstimator(ntrees=3, max_depth=3, seed=1)
+    m.train(y="IsDepDelayed", training_frame=air)
+    la = m.predict_leaf_node_assignment(air, type="Path")
+    df = la.as_data_frame()
+    assert df.shape == (air.nrow, 3)
+    assert list(df.columns) == ["T1", "T2", "T3"]
+    # every path is a root-to-leaf L/R walk within depth
+    assert df["T1"].astype(str).str.fullmatch(r"[LR]{1,3}|\(root\)").all()
+    ni = m.predict_leaf_node_assignment(air, type="Node_ID").as_data_frame()
+    assert (ni >= 0).all().all()
