@@ -833,12 +833,38 @@ def h_predict_v3(ctx: Ctx):
         if not hasattr(m, "predict_leaf_node_assignment"):
             raise ApiError(f"{m.algo_name} has no leaf node assignments "
                            "(tree models only)", 400)
+        if la_type not in ("Path", "Node_ID"):
+            # validate BEFORE the broadcast: a post-broadcast raise would
+            # kill every follower's replay loop
+            raise ApiError(f"leaf_node_assignment_type {la_type!r} "
+                           "(Path or Node_ID)", 400)
         dest = dest or f"leaf_assignment_{m.key}_on_{fr.key}"
         op_seq = oplog.broadcast("leaf_assignment", {
             "model": str(m.key), "frame": str(fr.key),
             "type": la_type, "destination_frame": dest})
         with oplog.turn(op_seq):
             pred = m.predict_leaf_node_assignment(fr, type=la_type, key=dest)
+            pred.install()
+        return {"__meta": S.meta("ModelMetricsListSchemaV3"),
+                "predictions_frame": {"name": str(pred.key)},
+                "model_metrics": []}
+    if str(ctx.arg("predict_staged_proba", "")).lower() in ("1", "true"):
+        # ModelBase.staged_predict_proba (GBM only) — device leaf pass, so
+        # mirrored like leaf assignment
+        if not hasattr(m, "staged_predict_proba"):
+            raise ApiError(f"{m.algo_name} has no staged probabilities "
+                           "(GBM only)", 400)
+        if m._output.model_category not in ("Binomial", "Multinomial"):
+            # validate BEFORE the broadcast (post-broadcast raises are
+            # follower-fatal); matches the model-side check
+            raise ApiError("staged_predict_proba needs a classification "
+                           "GBM", 400)
+        dest = dest or f"staged_proba_{m.key}_on_{fr.key}"
+        op_seq = oplog.broadcast("staged_proba", {
+            "model": str(m.key), "frame": str(fr.key),
+            "destination_frame": dest})
+        with oplog.turn(op_seq):
+            pred = m.staged_predict_proba(fr, key=dest)
             pred.install()
         return {"__meta": S.meta("ModelMetricsListSchemaV3"),
                 "predictions_frame": {"name": str(pred.key)},

@@ -21,6 +21,65 @@ from h2o3_tpu.models.tree.shared_tree import SharedTree, SharedTreeModel
 class GBMModel(SharedTreeModel):
     algo_name = "gbm"
 
+    def staged_predict_proba(self, frame, key=None):
+        """Per-stage class probabilities (ModelBase.staged_predict_proba;
+        hex/tree GbmModel staged scoring): column T<t>.C<c> holds class c's
+        probability using trees 1..t. Binomial trees model class 1, so
+        T<t>.C1 carries p0 (reference contract)."""
+        import numpy as np
+
+        from h2o3_tpu.core.frame import Column, Frame
+        from h2o3_tpu.models.model import ModelCategory
+
+        cat = self._output.model_category
+        if cat not in (ModelCategory.Binomial, ModelCategory.Multinomial):
+            raise ValueError("staged_predict_proba needs a classification "
+                             "GBM")
+        adapted = self.adapt_test(frame)
+        binned = self.spec.bin_columns(adapted)
+        leaf_dev = self.forest.leaf_index(binned)
+        if not getattr(leaf_dev, "is_fully_addressable", True):
+            from jax.experimental import multihost_utils
+
+            leaf_dev = multihost_utils.process_allgather(leaf_dev,
+                                                         tiled=True)
+        leaf = np.asarray(leaf_dev)[: frame.nrows]
+        fo = self.forest
+        lv = np.asarray(fo.leaf_val, np.float64)
+        contrib = np.take_along_axis(lv, leaf.T, axis=1).T   # (N, T)
+        out = Frame(key=key)
+        if cat == ModelCategory.Binomial:
+            margins = (fo.init_f
+                       + np.cumsum(contrib, axis=1)).astype(np.float32)
+            # ONE linkinv over the whole (N, T) matrix — per-stage calls
+            # would be T separate device round-trips
+            p1 = np.asarray(self._distribution.linkinv(margins), np.float64)
+            for t in range(fo.n_trees):
+                out.add(f"T{t+1}.C1", Column.from_numpy(1.0 - p1[:, t]))
+            return out
+        # multinomial: stages advance one tree GROUP (one tree per class)
+        K = fo.nclasses
+        tcls = np.asarray(fo.tree_class)
+        init = (np.asarray(fo.init_class, np.float64)
+                if fo.init_class is not None else np.zeros(K))
+        margins = np.tile(init, (frame.nrows, 1))
+        by_group: dict = {}
+        counters: dict = {}
+        for t in range(fo.n_trees):
+            k = int(tcls[t])
+            g = counters.get(k, 0)
+            counters[k] = g + 1
+            by_group.setdefault(g, []).append((k, t))
+        for g in range(len(by_group)):
+            for k, t in by_group.get(g, []):
+                margins[:, k] += contrib[:, t]
+            z = margins - margins.max(1, keepdims=True)
+            e = np.exp(z)
+            p = e / e.sum(1, keepdims=True)
+            for k in range(K):
+                out.add(f"T{g+1}.C{k+1}", Column.from_numpy(p[:, k].copy()))
+        return out
+
 
 @register
 class GBM(SharedTree):

@@ -185,6 +185,14 @@ def _apply(kind: str, p: Dict[str, Any]) -> None:
                                               key=p["destination_frame"])
         pred.install()
         return
+    if kind == "staged_proba":
+        from h2o3_tpu.core.dkv import DKV
+
+        m = DKV.get(p["model"])
+        fr = DKV.get(p["frame"])
+        pred = m.staged_predict_proba(fr, key=p["destination_frame"])
+        pred.install()
+        return
     if kind == "generic":
         from h2o3_tpu.core.dkv import DKV, Key
         from h2o3_tpu.models.generic import Generic

@@ -271,3 +271,22 @@ def test_leaf_node_assignment_via_h2opy(h2o, air):
     assert df["T1"].astype(str).str.fullmatch(r"[LR]{1,3}|\(root\)").all()
     ni = m.predict_leaf_node_assignment(air, type="Node_ID").as_data_frame()
     assert (ni >= 0).all().all()
+
+
+def test_staged_predict_proba_via_h2opy(h2o, air):
+    """ModelBase.staged_predict_proba through genuine h2o-py: per-stage
+    probabilities converge to the final prediction's p0."""
+    import numpy as np
+
+    from h2o.estimators import H2OGradientBoostingEstimator
+
+    m = H2OGradientBoostingEstimator(ntrees=4, max_depth=3, seed=1)
+    m.train(y="IsDepDelayed", training_frame=air)
+    st = m.staged_predict_proba(air).as_data_frame()
+    assert list(st.columns) == ["T1.C1", "T2.C1", "T3.C1", "T4.C1"]
+    final = m.predict(air).as_data_frame()
+    # last stage == the full model's p0 (reference contract: C1 carries p0)
+    np.testing.assert_allclose(st["T4.C1"].to_numpy(float),
+                               final["NO"].to_numpy(float), atol=1e-5)
+    # stages actually differ (the model is learning)
+    assert not np.allclose(st["T1.C1"], st["T4.C1"])
