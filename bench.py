@@ -153,7 +153,8 @@ def main():
         got = _stage("measure", [py, "-m", "h2o3_tpu.bench"],
                      min(500, max(remaining() - 130, 60)), env_extra=cache)
         if got is not None:
-            for sname, env in (("drf-deep", {"H2O3_BENCH_ONLY": "drf"}),
+            for sname, env in (("score", {"H2O3_BENCH_ONLY": "score"}),
+                               ("drf-deep", {"H2O3_BENCH_ONLY": "drf"}),
                                ("pallas", {"H2O3_BENCH_ONLY": "pallas"}),
                                ("glm", {"H2O3_BENCH_ONLY": "glm"})):
                 if remaining() < 180:
@@ -169,6 +170,19 @@ def main():
                      env_extra={"PALLAS_AXON_POOL_IPS": "",
                                 "JAX_PLATFORMS": "cpu"})
         unit = "rows/sec/cpu-fallback"
+        # round-5 gap: the fallback landed ONLY a GLM number, leaving
+        # serving perf unmeasured — always record a scoring metric too
+        # (small training set so the stage fits its CPU budget)
+        if remaining() > 150:
+            score = _stage("cpu-score", [py, "-m", "h2o3_tpu.bench"], 140,
+                           env_extra={"PALLAS_AXON_POOL_IPS": "",
+                                      "JAX_PLATFORMS": "cpu",
+                                      "H2O3_BENCH_ONLY": "score",
+                                      "H2O3_BENCH_SCORE_TRAIN_ROWS": "5000"})
+            if got is None:
+                got = score
+        else:
+            _record("cpu-score", ok=False, error="skipped: deadline")
     if got is None:
         print(json.dumps({"metric": "bench_failed", "value": 0.0,
                           "unit": "none", "vs_baseline": 0.0}))

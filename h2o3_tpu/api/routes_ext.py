@@ -372,10 +372,13 @@ def h_model_json(ctx: Ctx):
 
 
 def h_models_delete_all(ctx: Ctx):
+    from h2o3_tpu import scoring
+
     for k in list(DKV.keys()):
         if isinstance(DKV.get(k), Model):
             DKV.remove(k)
             purge_metrics(model_key=k)
+    scoring.purge()
     return {"__meta": S.meta("ModelsV3")}
 
 
@@ -699,6 +702,18 @@ def h_steam_metrics(ctx: Ctx):
     return {"__meta": S.meta("SteamMetricsV3"),
             "idle": all(not j.is_running for j in jobs),
             "idle_millis": 0, "cloud_size": info["cloud_size"]}
+
+
+def h_scoring_metrics(ctx: Ctx):
+    """GET /3/ScoringMetrics — per-model serving fast-path statistics
+    (scoring.py ScoringSession): request/batch/row counts, micro-batch
+    coalescing, latency percentiles, traversal compile counts and the
+    active row buckets. The per-dispatch events are also in /3/Timeline
+    under kind='scoring'."""
+    from h2o3_tpu import scoring
+
+    return {"__meta": S.meta("ScoringMetricsV3"),
+            "models": scoring.metrics_snapshot()}
 
 
 def h_watermeter_cpu(ctx: Ctx):
@@ -1169,6 +1184,8 @@ EXTRA_ROUTES = [
     ("POST", "/3/GarbageCollect", h_gc, "Run GC + cleaner sweep"),
     ("POST", "/3/UnlockKeys", h_unlock_keys, "Unlock all keys"),
     ("GET", "/3/SteamMetrics", h_steam_metrics, "Steam health metrics"),
+    ("GET", "/3/ScoringMetrics", h_scoring_metrics,
+     "Serving fast-path scoring metrics"),
     ("GET", "/3/WaterMeterCpuTicks/{nodeidx}", h_watermeter_cpu,
      "CPU tick counters"),
     ("GET", "/3/WaterMeterIo", h_watermeter_io, "IO counters"),

@@ -801,9 +801,11 @@ def h_model_get(ctx: Ctx):
 
 def h_model_delete(ctx: Ctx):
     DKV.remove(ctx.params["model_id"])
+    from h2o3_tpu import scoring
     from h2o3_tpu.api import routes_ext
 
     routes_ext.purge_metrics(model_key=ctx.params["model_id"])
+    scoring.purge(ctx.params["model_id"])     # drop its device-resident session
     return {"__meta": S.meta("ModelsV3")}
 
 
@@ -823,6 +825,12 @@ def h_predict_v3(ctx: Ctx):
     fr = _frame_or_404(ctx.params["frame_id"])
     from h2o3_tpu.parallel import oplog
 
+    # column-compatibility preflight BEFORE any oplog broadcast: an
+    # adapt_test raise after the broadcast would kill every follower's
+    # replay loop (the 137d938 pattern) — reject as a clean 400 instead
+    err = m.check_test_compat(fr)
+    if err:
+        raise ApiError(err, 400)
     dest = str(ctx.arg("predictions_frame", "") or "").strip('"') or None
     if str(ctx.arg("leaf_node_assignment", "")).lower() in ("1", "true"):
         # ModelBase.predict_leaf_node_assignment (tree models only). The
@@ -890,6 +898,19 @@ def h_predict_v3(ctx: Ctx):
     # The destination key ships explicitly (default included) so every
     # process installs the prediction frame under the SAME DKV name.
     dest = dest or f"prediction_{m.key}_on_{fr.key}"
+    from h2o3_tpu import scoring
+
+    if scoring.supports(m):
+        # serving fast path: compile-once bucketed traversal; concurrent
+        # requests for the same model coalesce into ONE dispatch (and ONE
+        # "score_batch" oplog op on a multi-process cloud) inside the
+        # micro-batcher's window. The scoring raw pass is reused for the
+        # metrics too, so the whole request is a single forest traversal.
+        pred, mm = scoring.score_request(m, fr, dest, with_metrics=True)
+        return {"__meta": S.meta("ModelMetricsListSchemaV3"),
+                "predictions_frame": {"name": str(pred.key)},
+                "model_metrics": [S.metrics_v3(mm, str(m.key), str(fr.key))]
+                if mm else []}
     op_seq = oplog.broadcast("predict", {"model": str(m.key),
                                          "frame": str(fr.key),
                                          "destination_frame": dest,
@@ -906,6 +927,12 @@ def h_predict_v3(ctx: Ctx):
 def h_predict_v4(ctx: Ctx):
     m = _model_or_404(ctx.params["model_id"])
     fr = _frame_or_404(ctx.params["frame_id"])
+    # same pre-broadcast preflight as the v3 route: bad column types must
+    # surface as a 400 BEFORE the op ships (post-broadcast raises are
+    # follower-fatal)
+    err = m.check_test_compat(fr)
+    if err:
+        raise ApiError(err, 400)
     contribs = str(ctx.arg("predict_contributions", "")).lower() in ("1", "true")
     if contribs:
         _check_contributions_size(fr)  # same 400 as the sync v3 route

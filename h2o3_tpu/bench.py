@@ -81,6 +81,53 @@ def run_compile_probe(n_rows: int = 20_000):
     return time.perf_counter() - t0, "gbm_compile_secs"
 
 
+def run_scoring(train_rows: int = 20_000, ntrees: int = 10,
+                max_depth: int = 5, passes: int = 3):
+    """Serving fast-path metric: bucketed batched scoring throughput
+    (rows/sec) through scoring.ScoringSession — the compile-once device
+    path behind POST /3/Predictions. Mixed request sizes exercise several
+    row buckets; the warm pass excludes per-bucket compiles, matching the
+    flagship's warm-up convention."""
+    import h2o3_tpu
+    from h2o3_tpu import scoring
+    from h2o3_tpu.core.frame import Column, Frame
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    h2o3_tpu.init()
+    rng = np.random.default_rng(2)
+
+    def make(n, with_y):
+        fr = Frame()
+        logit = np.zeros(n)
+        for i in range(6):
+            x = rng.standard_normal(n)
+            logit += x * ((-1) ** i) * 0.5
+            fr.add(f"n{i}", Column.from_numpy(x))
+        codes = rng.integers(0, 4, n)
+        fr.add("c0", Column.from_numpy(
+            np.array(["a", "b", "c", "d"])[codes], ctype="enum"))
+        if with_y:
+            yy = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "Y", "N")
+            fr.add("y", Column.from_numpy(yy, ctype="enum"))
+        return fr
+
+    model = GBM(ntrees=ntrees, max_depth=max_depth, seed=3).train(
+        y="y", training_frame=make(train_rows, True))
+    sess = scoring.session_for(model)
+    sizes = [777, 3_000, 12_000, 16_384]
+    frames = [make(s, False) for s in sizes]
+    for fr in frames:                      # warm every bucket once
+        sess.predict(fr)
+    t0 = time.perf_counter()
+    rows = 0
+    for _ in range(passes):
+        for fr in frames:
+            sess.predict(fr)
+            rows += fr.nrows
+    dt = time.perf_counter() - t0
+    return rows / dt, "score_rows_per_sec"
+
+
 def run_glm(n_rows: int = 1_000_000, p: int = 32, iters: int = 20):
     """GLM IRLS secondary metric (matches the repo-root bench_glm shape)."""
     import jax
@@ -138,6 +185,10 @@ if __name__ == "__main__":
         value, metric = run_compile_probe()
     elif mode == "glm":
         value, metric = run_glm()
+    elif mode == "score":
+        value, metric = run_scoring(
+            train_rows=int(os.environ.get("H2O3_BENCH_SCORE_TRAIN_ROWS",
+                                          20_000)))
     elif mode == "pallas":
         # Pallas-vs-XLA on silicon: same flagship config, Pallas histogram
         # path forced on (smaller tree count to fit the stage budget)

@@ -1,0 +1,42 @@
+"""JAX version compatibility shims.
+
+The framework targets the promoted `jax.shard_map` API; older jax releases
+(< 0.5) only ship it as `jax.experimental.shard_map.shard_map`. Every
+shard_map call site routes through :func:`shard_map` so the framework runs
+on both without scattering version checks."""
+
+from __future__ import annotations
+
+
+def shard_map(f=None, **kw):
+    """`jax.shard_map` where available, else the experimental spelling.
+    Translates the renamed replication-check kwarg (check_vma, jax>=0.6)
+    to the older check_rep when falling back."""
+    import inspect
+
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    params = inspect.signature(sm).parameters
+    if "check_vma" in kw and "check_vma" not in params:
+        kw["check_rep"] = kw.pop("check_vma")
+    elif "check_rep" in kw and "check_rep" not in params:
+        kw["check_vma"] = kw.pop("check_rep")
+    return sm(f, **kw) if f is not None else lambda g: sm(g, **kw)
+
+
+def pcast(x, axes, to="varying"):
+    """`jax.lax.pcast` (jax>=0.7 varying-mesh-axis annotation) with an
+    identity fallback: older shard_map has no vma system, so replicated→
+    varying casts are no-ops there."""
+    import jax
+
+    pc = getattr(jax.lax, "pcast", None)
+    if pc is not None:
+        return pc(x, axes, to=to)
+    pv = getattr(jax.lax, "pvary", None)
+    if pv is not None and to == "varying":
+        return pv(x, axes)
+    return x

@@ -23,6 +23,7 @@ collectives.
 
 from __future__ import annotations
 
+from h2o3_tpu.compat import shard_map as _compat_shard_map
 import functools
 import time
 from typing import Callable, Optional, Sequence
@@ -47,7 +48,7 @@ def _build_map_reduce(fn, n_in: int, mesh):
             partial = fn(*chunks)
             return jax.tree.map(lambda x: jax.lax.psum(x, "rows"), partial)
 
-        shard = jax.shard_map(
+        shard = _compat_shard_map(
             body, mesh=mesh,
             in_specs=tuple(P("rows") for _ in range(n_in)),
             out_specs=P(),
@@ -88,7 +89,7 @@ def map_reduce(fn: Callable, cols: Sequence[Column]):
 def _build_map_chunks(fn, n_in: int, n_out: int, mesh):
     @jax.jit
     def run(*arrays):
-        shard = jax.shard_map(
+        shard = _compat_shard_map(
             fn, mesh=mesh,
             in_specs=tuple(P("rows") for _ in range(n_in)),
             out_specs=tuple(P("rows") for _ in range(n_out)) if n_out > 1 else P("rows"),

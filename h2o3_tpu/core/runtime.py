@@ -11,6 +11,7 @@ local device list (single-host) IS the cloud.
 
 from __future__ import annotations
 
+from h2o3_tpu.compat import shard_map as _compat_shard_map
 import os
 import threading
 import time
@@ -202,7 +203,7 @@ class Cluster:
         membw = 3 * n * 4 * reps / dt / 1e9
 
         # collective round: psum of a scalar-per-shard over the rows axis
-        ps = jax.jit(jax.shard_map(lambda v: jax.lax.psum(v, "rows"),
+        ps = jax.jit(_compat_shard_map(lambda v: jax.lax.psum(v, "rows"),
                                    mesh=self.mesh, in_specs=P("rows"),
                                    out_specs=P()))
         vec = jnp.ones(self.n_devices, jnp.float32)

@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from h2o3_tpu.compat import shard_map as _compat_shard_map
 from h2o3_tpu.core.frame import Column, Frame, T_NUM
 from h2o3_tpu.models.data_info import DataInfo
 from h2o3_tpu.models.model import Model, ModelCategory
@@ -417,7 +418,7 @@ class DeepLearning(ModelBuilder):
                     length=n_rounds)
                 return params, opt_state
 
-            epoch_avg = jax.jit(jax.shard_map(
+            epoch_avg = jax.jit(_compat_shard_map(
                 epoch_avg_body, mesh=_cluster().mesh,
                 in_specs=(P(), P(), P(), P("rows", None), P("rows"), P("rows")),
                 out_specs=(P(), P())))

@@ -349,11 +349,15 @@ def _multinomial_fit(arrays, y, w, beta0, lam_l2, *, expand, nclasses, max_iter)
         updates, state = opt.update(grad, state, B, value=value, grad=grad, value_fn=loss)
         return optax.apply_updates(B, updates), state, it + 1
 
+    # optax<0.2.3 spells the l2 norm tree_l2_norm; newer optax tree_norm
+    _tree_norm = getattr(optax.tree_utils, "tree_norm",
+                         getattr(optax.tree_utils, "tree_l2_norm", None))
+
     def cond(carry):
         B, state, it = carry
         g = optax.tree_utils.tree_get(state, "grad")
         # state grad is zeros before the first step — always take step 0
-        return (it < max_iter) & ((it == 0) | (optax.tree_utils.tree_norm(g) > 1e-6))
+        return (it < max_iter) & ((it == 0) | (_tree_norm(g) > 1e-6))
 
     B, state, iters = jax.lax.while_loop(cond, step, (B0, opt.init(B0), jnp.int32(0)))
     return B, iters, loss(B) * wsum
@@ -413,11 +417,14 @@ def _ordinal_fit(arrays, y, w, lam_l2, *, expand, nclasses, max_iter):
                                     value_fn=loss)
         return optax.apply_updates(v, updates), state, it + 1
 
+    # optax<0.2.3 spells the l2 norm tree_l2_norm; newer optax tree_norm
+    _tree_norm = getattr(optax.tree_utils, "tree_norm",
+                         getattr(optax.tree_utils, "tree_l2_norm", None))
+
     def cond(carry):
         v, state, it = carry
         g = optax.tree_utils.tree_get(state, "grad")
-        return (it < max_iter) & ((it == 0) |
-                                  (optax.tree_utils.tree_norm(g) > 1e-6))
+        return (it < max_iter) & ((it == 0) | (_tree_norm(g) > 1e-6))
 
     v, state, iters = jax.lax.while_loop(cond, step,
                                          (v0, opt.init(v0), jnp.int32(0)))

@@ -101,12 +101,18 @@ class Model(Keyed):
     def adapt_test(self, test: Frame) -> Frame:
         """Align test frame to training columns: reorder, fill missing
         columns with NA, remap categorical codes onto training domains
-        (unseen level → NA)."""
+        (unseen level → NA). Type mismatches raise with the SAME message
+        check_test_compat returns — that preflight is the single home of
+        the checks, so REST handlers rejecting pre-broadcast and this
+        raise can never drift apart."""
         import jax
         import jax.numpy as jnp
 
         from h2o3_tpu.core.runtime import cluster
 
+        err = self.check_test_compat(test)
+        if err:
+            raise ValueError(err)
         cl = cluster()
         out = Frame()
         n = test.nrows
@@ -125,9 +131,7 @@ class Model(Keyed):
                 continue
             c = test.col(name)
             if train_dom is not None:
-                if not c.is_categorical:
-                    raise ValueError(
-                        f"column {name} was categorical in training, numeric in test")
+                # type mismatches were rejected by check_test_compat above
                 test_dom = c.domain or []
                 if test_dom == train_dom:
                     out.add(name, c)
@@ -136,8 +140,6 @@ class Model(Keyed):
                     out.add(name, Column(_remap_to_domain(codes, test_dom, train_dom),
                                          T_CAT, n, domain=train_dom))
             else:
-                if c.ctype == T_CAT:
-                    raise ValueError(f"column {name} was numeric in training, enum in test")
                 out.add(name, c)
         # carry through special columns the scorer may need (offset/weights)
         for pname in ("offset_column", "weights_column", "fold_column"):
@@ -162,12 +164,37 @@ class Model(Keyed):
         a test frame may intern the same labels in a different order)."""
         return self._remap_col(c, self._output.response_domain)
 
+    def check_test_compat(self, test: Frame) -> Optional[str]:
+        """Host-metadata preflight of adapt_test's type checks: returns the
+        error message a predict would raise for categorical↔numeric column
+        mismatches, or None when adaptation will succeed. Does NO device
+        work, so REST handlers can reject bad requests BEFORE an oplog
+        broadcast (a post-broadcast raise is follower-fatal)."""
+        for name in self._output.names:
+            if name not in test:
+                continue            # missing predictors are NA-filled
+            c = test.col(name)
+            train_dom = self._output.domains.get(name)
+            if train_dom is not None and not c.is_categorical:
+                return (f"column {name} was categorical in training, "
+                        "numeric in test")
+            if train_dom is None and c.ctype == T_CAT:
+                return (f"column {name} was numeric in training, "
+                        "enum in test")
+        return None
+
     # -- public scoring (hex/Model.score) ---------------------------------
     def predict(self, frame: Frame, key: Optional[str] = None) -> Frame:
         adapted = self.adapt_test(frame)
         raw = self._predict_raw(adapted)
+        return self._raw_to_frame(raw, frame.nrows, key)
+
+    def _raw_to_frame(self, raw: Dict[str, Any], n: int,
+                      key: Optional[str] = None) -> Frame:
+        """Assemble the prediction Frame from `_predict_raw` output — split
+        out of predict() so the serving fast path (scoring.py) can feed it
+        batch slices without re-running adaptation."""
         out = Frame(key=key)
-        n = frame.nrows
         cat = self._output.model_category
         if cat in (ModelCategory.Binomial, ModelCategory.Multinomial):
             probs = raw["probs"]

@@ -197,49 +197,99 @@ class CompressedForest:
         return fn(binned, *self.arrays())
 
 
+def _forest_margins(binned, feat, thresh, na_left, left, right, leaf_val,
+                    cat_split, cat_table, tree_class, na_bins,
+                    max_depth: int, K: int):
+    """Traceable core of the lockstep traversal: (N, F) integer bins →
+    (N,) / (N, K) leaf-value sums. Shared verbatim by the per-request
+    traversal (_traverse_fn) and the serving fast path's fused program
+    (_fused_score_fn) so both produce bitwise-identical margins."""
+    import jax
+    import jax.numpy as jnp
+
+    N = binned.shape[0]
+
+    def walk_one_tree(carry, tree):
+        acc = carry
+        tf, tt, tnl, tl, tr, tlv, tcs, tcls = tree
+
+        def step(_, node):
+            f = tf[node]
+            leaf = f < 0
+            fi = jnp.maximum(f, 0)
+            b = jnp.take_along_axis(binned, fi[:, None], axis=1)[:, 0]
+            is_na = b == na_bins[fi]
+            csid = tcs[node]
+            cat_left = cat_table[jnp.maximum(csid, 0),
+                                 jnp.minimum(b, cat_table.shape[1] - 1)]
+            go_left = jnp.where(csid >= 0, cat_left, b <= tt[node])
+            go_left = jnp.where(is_na, tnl[node], go_left)
+            nxt = jnp.where(go_left, tl[node], tr[node])
+            return jnp.where(leaf, node, nxt)
+
+        node = jax.lax.fori_loop(0, max_depth + 1, step,
+                                 jnp.zeros(N, jnp.int32))
+        contrib = tlv[node]
+        if K > 1:
+            acc = acc.at[:, tcls].add(contrib)
+        else:
+            acc = acc + contrib
+        return acc, None
+
+    acc0 = jnp.zeros((N, K), jnp.float32) if K > 1 else jnp.zeros(N, jnp.float32)
+    acc, _ = jax.lax.scan(
+        walk_one_tree, acc0,
+        (feat, thresh, na_left, left, right, leaf_val, cat_split, tree_class))
+    return acc
+
+
 @functools.lru_cache(maxsize=32)
 def _traverse_fn(max_depth: int, nclasses: int, per_class: bool = False):
     import jax
-    import jax.numpy as jnp
+
+    K = nclasses if (nclasses > 2 or per_class) else 1
 
     @jax.jit
     def run(binned, feat, thresh, na_left, left, right, leaf_val,
             cat_split, cat_table, tree_class, na_bins):
-        N = binned.shape[0]
-        K = nclasses if (nclasses > 2 or per_class) else 1
+        return _forest_margins(binned, feat, thresh, na_left, left, right,
+                               leaf_val, cat_split, cat_table, tree_class,
+                               na_bins, max_depth, K)
 
-        def walk_one_tree(carry, tree):
-            acc = carry
-            tf, tt, tnl, tl, tr, tlv, tcs, tcls = tree
+    return run
 
-            def step(_, node):
-                f = tf[node]
-                leaf = f < 0
-                fi = jnp.maximum(f, 0)
-                b = jnp.take_along_axis(binned, fi[:, None], axis=1)[:, 0]
-                is_na = b == na_bins[fi]
-                csid = tcs[node]
-                cat_left = cat_table[jnp.maximum(csid, 0),
-                                     jnp.minimum(b, cat_table.shape[1] - 1)]
-                go_left = jnp.where(csid >= 0, cat_left, b <= tt[node])
-                go_left = jnp.where(is_na, tnl[node], go_left)
-                nxt = jnp.where(go_left, tl[node], tr[node])
-                return jnp.where(leaf, node, nxt)
 
-            node = jax.lax.fori_loop(0, max_depth + 1, step,
-                                     jnp.zeros(N, jnp.int32))
-            contrib = tlv[node]
-            if K > 1:
-                acc = acc.at[:, tcls].add(contrib)
-            else:
-                acc = acc + contrib
-            return acc, None
+@functools.lru_cache(maxsize=32)
+def _fused_score_fn(max_depth: int, nclasses: int, per_class: bool = False):
+    """Serving fast path: binning + traversal + init margin in ONE program.
 
-        acc0 = jnp.zeros((N, K), jnp.float32) if K > 1 else jnp.zeros(N, jnp.float32)
-        acc, _ = jax.lax.scan(
-            walk_one_tree, acc0,
-            (feat, thresh, na_left, left, right, leaf_val, cat_split, tree_class))
-        return acc
+    Takes raw features as a dense (N, F) float32 matrix (categoricals as
+    their integer codes, NA as NaN for numerics / negative for cats) plus
+    the BinSpec tables, so the per-request host work is a single
+    device_put. Binning matches BinSpec.bin_columns bit-for-bit:
+    numeric bin = #edges < x (== searchsorted side='left'); categorical
+    bin = code, with out-of-range/NA clamped to the feature's NA bin."""
+    import jax
+    import jax.numpy as jnp
+
+    K = nclasses if (nclasses > 2 or per_class) else 1
+
+    @jax.jit
+    def run(X, edges, is_cat, init, feat, thresh, na_left, left, right,
+            leaf_val, cat_split, cat_table, tree_class, na_bins):
+        nb = na_bins[None, :]
+        # numeric: padded edge slots are +inf so they never count
+        num_b = jnp.sum(edges[None, :, :] < X[:, :, None],
+                        axis=-1).astype(jnp.int32)
+        num_b = jnp.where(jnp.isnan(X), nb, num_b)
+        # categorical: NaN→-1 before the int cast (NaN→int is undefined)
+        codes = jnp.where(jnp.isnan(X), -1.0, X).astype(jnp.int32)
+        cat_b = jnp.where((codes < 0) | (codes >= nb), nb, codes)
+        binned = jnp.where(is_cat[None, :], cat_b, num_b)
+        acc = _forest_margins(binned, feat, thresh, na_left, left, right,
+                              leaf_val, cat_split, cat_table, tree_class,
+                              na_bins, max_depth, K)
+        return acc + init
 
     return run
 

@@ -45,6 +45,8 @@ TPU-native design (round 3 + the round-4 deep-tree unification):
 
 from __future__ import annotations
 
+from h2o3_tpu.compat import pcast as _compat_pcast
+from h2o3_tpu.compat import shard_map as _compat_shard_map
 import functools
 import os
 from typing import List, Optional, Tuple
@@ -257,7 +259,7 @@ def _grow_fn(max_depth: int, F: int, maxB: int, nbins: tuple, is_cat: tuple,
             return acc + jnp.dot(Ob.T, V.astype(jnp.bfloat16),
                                  preferred_element_type=jnp.float32)
 
-        acc0 = jax.lax.pcast(jnp.zeros((F * maxB, S * 3), jnp.float32),
+        acc0 = _compat_pcast(jnp.zeros((F * maxB, S * 3), jnp.float32),
                              ("rows",), to="varying")
         acc = jax.lax.fori_loop(0, nblk, body, acc0)
         acc = jax.lax.psum(acc, "rows")
@@ -271,7 +273,7 @@ def _grow_fn(max_depth: int, F: int, maxB: int, nbins: tuple, is_cat: tuple,
         base = (node[:, None] * F + jnp.arange(F)[None, :]) * maxB + binned
         w_live = jnp.where(live, w, 0.0)
         vals = jnp.stack([w_live, w_live * y, w_live * y * y], -1)  # (n, 3)
-        acc0 = jax.lax.pcast(jnp.zeros(((S + 1) * F * maxB, 3), jnp.float32),
+        acc0 = _compat_pcast(jnp.zeros(((S + 1) * F * maxB, 3), jnp.float32),
                              ("rows",), to="varying")
         acc = acc0.at[base.reshape(-1)].add(
             jnp.broadcast_to(vals[:, None, :],
@@ -283,7 +285,7 @@ def _grow_fn(max_depth: int, F: int, maxB: int, nbins: tuple, is_cat: tuple,
         """(tot_slots, C) per-leaf sums (scatter; O(N) at any tree size)."""
         idx = jnp.where(row_leaf >= 0, row_leaf, tot_slots)
         idx = jnp.minimum(idx, tot_slots)
-        acc0 = jax.lax.pcast(
+        acc0 = _compat_pcast(
             jnp.zeros((tot_slots + 1, cols.shape[1]), jnp.float32),
             ("rows",), to="varying")
         acc = acc0.at[idx].add(cols)
@@ -396,7 +398,7 @@ def _grow_fn(max_depth: int, F: int, maxB: int, nbins: tuple, is_cat: tuple,
     # internal index constants carry empty vma sets, tripping check_vma;
     # compiled TPU lowering annotates properly, so only interpret relaxes it
     check_vma = not (use_pallas and jax.default_backend() != "tpu")
-    fn = jax.shard_map(tree_program, mesh=mesh,
+    fn = _compat_shard_map(tree_program, mesh=mesh,
                        in_specs=in_specs,
                        out_specs=(P(), P(), P("rows")),
                        check_vma=check_vma)
@@ -496,7 +498,7 @@ def _apply_fn(max_depth: int, maxB: int, mesh, cap: int):
                                  jnp.where(gl, ls[node], rs[node]), 0)
         return values[jnp.maximum(row_leaf, 0)]
 
-    fn = jax.shard_map(apply, mesh=mesh,
+    fn = _compat_shard_map(apply, mesh=mesh,
                        in_specs=(P("rows", None), P(), P()),
                        out_specs=P("rows"))
     return jax.jit(fn)

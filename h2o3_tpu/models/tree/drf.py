@@ -71,10 +71,11 @@ def _node_feat_mask_fn(rng, F: int, mtries: int):
 class DRFModel(SharedTreeModel):
     algo_name = "drf"
 
-    def _predict_raw(self, frame):
+    def _margin_to_raw(self, f):
+        # f = mean leaf response across trees; _predict_raw stays the
+        # inherited margin→raw pipeline so DRF rides the serving fast path
         import jax.numpy as jnp
 
-        f = self._margin(frame)      # mean leaf response across trees
         cat = self._output.model_category
         if cat == ModelCategory.Binomial:
             if f.ndim == 2:          # binomial_double_trees: per-class votes

@@ -15,6 +15,7 @@ into the same program.
 
 from __future__ import annotations
 
+from h2o3_tpu.compat import shard_map as _compat_shard_map
 import functools
 
 import jax
@@ -51,7 +52,7 @@ def _build_hist_fn(n_nodes: int, tot_bins: int, F: int, mesh):
         acc = acc.at[idx.reshape(-1)].add(upd.reshape(-1, 3), mode="drop")
         return jax.lax.psum(acc, "rows")
 
-    fn = jax.shard_map(
+    fn = _compat_shard_map(
         local_hist, mesh=mesh,
         in_specs=(P("rows", None), P("rows"), P("rows"), P("rows"), P()),
         out_specs=P(),
@@ -96,7 +97,7 @@ def _build_route_fn(S: int, maxB: int, mesh):
         new_leaf = jnp.where(active & terminal, leaf_id[node], row_leaf)
         return new_node, new_leaf
 
-    fn = jax.shard_map(
+    fn = _compat_shard_map(
         route, mesh=mesh,
         in_specs=(P("rows", None), P("rows"), P("rows"), P(), P(), P(), P(), P()),
         out_specs=(P("rows"), P("rows")),
@@ -135,7 +136,7 @@ def _build_leaf_stats_fn(L: int, mesh):
         d = nz.at[leaf].add(jnp.where(valid, den, 0.0), mode="drop")
         return jax.lax.psum(n, "rows"), jax.lax.psum(d, "rows")
 
-    fn = jax.shard_map(stats, mesh=mesh,
+    fn = _compat_shard_map(stats, mesh=mesh,
                        in_specs=(P("rows"), P("rows"), P("rows")),
                        out_specs=(P(), P()))
     return jax.jit(fn)

@@ -190,9 +190,16 @@ class SharedTreeModel(Model):
         return out
 
     def _predict_raw(self, frame: Frame):
+        return self._margin_to_raw(self._margin(frame))
+
+    def _margin_to_raw(self, f):
+        """Margin(s) → raw prediction dict — split from _predict_raw so the
+        serving fast path (scoring.py) can post-process margins computed by
+        its fused bucketed program. Must stay pure margin math (no frame
+        access): anything frame-dependent belongs in a _predict_raw
+        override, which also opts the model OUT of the fast path."""
         import jax.numpy as jnp
 
-        f = self._margin(frame)
         cat = self._output.model_category
         if cat == ModelCategory.Binomial:
             p = self._distribution.linkinv(f)

@@ -10,6 +10,7 @@ columns via the shared-gather path (ops/filters.take-style)."""
 
 from __future__ import annotations
 
+from h2o3_tpu.compat import shard_map as _compat_shard_map
 from typing import List, Sequence, Union
 
 import jax
@@ -64,7 +65,7 @@ def _bucket_count_fn(mesh, n_shard: int, n_samples: int):
         bucket = jnp.searchsorted(splits, ks, side="right")
         return jnp.zeros(p, jnp.int32).at[bucket].add(1, mode="drop")
 
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(P("rows"),),
+    fn = _compat_shard_map(local, mesh=mesh, in_specs=(P("rows"),),
                        out_specs=P("rows"))                # (p*p,) stacked
     return jax.jit(fn)
 
@@ -111,7 +112,7 @@ def _sample_sort_fn(mesh, n_shard: int, n_samples: int, cap: int):
         o2 = jnp.argsort(kx)
         return kx[o2], rx[o2]
 
-    fn = jax.shard_map(local, mesh=mesh,
+    fn = _compat_shard_map(local, mesh=mesh,
                        in_specs=(P("rows"), P("rows")),
                        out_specs=(P("rows"), P("rows")))
     return jax.jit(fn)

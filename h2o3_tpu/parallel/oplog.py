@@ -167,6 +167,21 @@ def _apply(kind: str, p: Dict[str, Any]) -> None:
             # the v3 handler also computes metrics: same program sequence
             m.model_performance(fr)
         return
+    if kind == "score_batch":
+        # the serving fast path's coalesced op: ONE replay scores every
+        # request of the coordinator's micro-batch through the same
+        # executor (scoring.execute_batch), so the device program sequence
+        # — fused traversal dispatches or, multi-process, the generic
+        # predict + metrics passes — lines up exactly
+        from h2o3_tpu import scoring
+        from h2o3_tpu.core.dkv import DKV
+
+        m = DKV.get(p["model"])
+        entries = [(DKV.get(r["frame"]), r.get("destination_frame"),
+                    bool(r.get("with_metrics")))
+                   for r in p.get("requests", [])]
+        scoring.execute_batch(m, entries)
+        return
     if kind == "rapids":
         from h2o3_tpu.rapids import Session, exec_rapids
 

@@ -584,8 +584,11 @@ def _cum(op):
     def run(d):
         x = jnp.where(jnp.isnan(d), {"add": 0.0, "mul": 1.0, "min": jnp.inf,
                                      "max": -jnp.inf}[op], d)
+        # jnp ufuncs only grew .accumulate in jax>=0.5; lax has always had
+        # the cumulative reductions
         f = {"add": jnp.cumsum, "mul": jnp.cumprod,
-             "min": jnp.minimum.accumulate, "max": jnp.maximum.accumulate}[op]
+             "min": getattr(jnp.minimum, "accumulate", jax.lax.cummin),
+             "max": getattr(jnp.maximum, "accumulate", jax.lax.cummax)}[op]
         return f(x).astype(jnp.float32)
 
     return run

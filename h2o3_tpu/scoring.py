@@ -1,0 +1,483 @@
+"""Serving fast path: compile-once, device-resident scoring sessions.
+
+Reference: H2O-3 solves high-QPS serving with standalone MOJO scorers
+(genmodel) that keep the tree bytes resident and score without touching
+the training stack. The TPU-native equivalent is a per-model
+:class:`ScoringSession` that keeps the CompressedForest arrays
+device-resident and dispatches ONE fused XLA program (bin + traverse +
+init margin) per request batch.
+
+Two properties make this a serving engine rather than a batch scorer
+(cf. "Memory Safe Computations with XLA Compiler" / Podracer, PAPERS.md):
+
+- **Shape buckets**: incoming batches are padded to power-of-two row
+  buckets (env ``H2O_TPU_SCORE_BUCKETS``, default 256/1k/4k/16k), so the
+  traversal compiles once per (bucket, forest-shape) instead of once per
+  request row count. Requests above the largest bucket are chunked at it,
+  keeping the trace count bounded. Padded rows are zero-filled and sliced
+  off before anything reads them.
+- **Micro-batching**: concurrent requests against the SAME model coalesce
+  into one dispatch inside a time-boxed window
+  (``H2O_TPU_SCORE_BATCH_WINDOW_MS``, default 2 ms); each request gets its
+  exact row-slice back. Requests against different models never block
+  each other (per-model queues). On a multi-process cloud the whole batch
+  ships as ONE oplog op ("score_batch") that followers replay once.
+
+Per-model serving metrics (requests, batch sizes, latency percentiles,
+traversal compile count) land in the timeline ring and are snapshotted by
+``GET /3/ScoringMetrics``.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_DEFAULT_BUCKETS = (256, 1024, 4096, 16384)
+
+
+def _env_buckets() -> Tuple[int, ...]:
+    raw = os.environ.get("H2O_TPU_SCORE_BUCKETS", "")
+    if not raw.strip():
+        return _DEFAULT_BUCKETS
+    try:
+        vals = sorted({int(v) for v in raw.replace(";", ",").split(",")
+                       if v.strip()})
+    except ValueError:
+        return _DEFAULT_BUCKETS
+    return tuple(v for v in vals if v > 0) or _DEFAULT_BUCKETS
+
+
+def _window_s() -> float:
+    try:
+        ms = float(os.environ.get("H2O_TPU_SCORE_BATCH_WINDOW_MS", "2"))
+    except ValueError:
+        ms = 2.0
+    return max(ms, 0.0) / 1000.0
+
+
+def enabled() -> bool:
+    return os.environ.get("H2O_TPU_SCORE_FAST", "1").lower() not in (
+        "0", "false", "off")
+
+
+def supports(model) -> bool:
+    """True when `model` can ride the fused bucketed path: a SharedTree
+    forest whose raw-prediction semantics are pure margin post-processing
+    (subclasses overriding _predict_raw — e.g. IsolationForest's
+    mean-length output — stay on the generic path)."""
+    if not enabled():
+        return False
+    from h2o3_tpu.models.tree.shared_tree import SharedTreeModel
+
+    return (isinstance(model, SharedTreeModel)
+            and model.forest is not None and model.spec is not None
+            and type(model)._predict_raw is SharedTreeModel._predict_raw)
+
+
+class SessionStats:
+    """Per-model serving counters behind a small lock; p50/p99 computed at
+    read time over a bounded latency ring."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.batches = 0
+        self.rows = 0
+        self.max_batch_requests = 0
+        self._lat_ms: collections.deque = collections.deque(maxlen=512)
+
+    def record_batch(self, n_requests: int, n_rows: int, ms: float) -> None:
+        with self._lock:
+            self.requests += n_requests
+            self.batches += 1
+            self.rows += n_rows
+            self.max_batch_requests = max(self.max_batch_requests, n_requests)
+            self._lat_ms.append(float(ms))
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            lat = np.asarray(self._lat_ms, np.float64)
+            out = {"requests": self.requests, "batches": self.batches,
+                   "rows": self.rows,
+                   "max_batch_requests": self.max_batch_requests}
+        if lat.size:
+            out["p50_ms"] = round(float(np.percentile(lat, 50)), 3)
+            out["p99_ms"] = round(float(np.percentile(lat, 99)), 3)
+        return out
+
+
+class ScoringSession:
+    """Device-resident scorer for ONE trained forest.
+
+    Holds the forest arrays + BinSpec tables on device and a fused
+    bin+traverse program compiled per row bucket. All margins it returns
+    are bitwise-identical to spec.bin_columns + forest.predict_binned."""
+
+    def __init__(self, model):
+        import jax.numpy as jnp
+
+        from h2o3_tpu.core.runtime import cluster
+        from h2o3_tpu.models.tree.compressed import _fused_score_fn
+
+        self.model = model
+        self.forest = model.forest
+        self.spec = model.spec
+        self._cl = cluster()
+        self._arrays = self.forest.arrays()          # device-resident
+        F = self.spec.F
+        emax = max((len(e) for e in self.spec.edges), default=0) or 1
+        ep = np.full((F, emax), np.inf, np.float32)
+        for i, e in enumerate(self.spec.edges):
+            ep[i, : len(e)] = e
+        self._edges = jnp.asarray(ep)
+        self._is_cat = jnp.asarray(np.asarray(self.spec.is_cat, bool))
+        if self.forest.init_class is not None:
+            self._init = jnp.asarray(np.asarray(self.forest.init_class,
+                                                np.float32))
+        else:
+            self._init = jnp.float32(self.forest.init_f)
+        # buckets rounded up to shard-divisible sizes so row sharding holds
+        self.buckets = tuple(sorted({self._cl.pad_rows(b)
+                                     for b in _env_buckets()}))
+        self._fn = _fused_score_fn(self.forest.max_depth,
+                                   self.forest.nclasses,
+                                   self.forest.per_class_trees)
+        self._traced: set = set()        # buckets compiled so far
+        self.stats = SessionStats()
+
+    # -- feature packing ---------------------------------------------------
+    def _features(self, adapted, n: int) -> np.ndarray:
+        """(n, F) float32 host matrix in training-column order: numerics
+        as-is (NaN = NA), categoricals as their (already remapped) integer
+        codes — NA_CAT stays negative and bins to the NA bin."""
+        X = np.empty((n, self.spec.F), np.float32)
+        for i, name in enumerate(self.spec.names):
+            X[:, i] = np.asarray(adapted.col(name).data)[:n]
+        return X
+
+    def _bucket_for(self, m: int) -> int:
+        for b in self.buckets:
+            if b >= m:
+                return b
+        return self.buckets[-1]
+
+    # -- bucketed dispatch -------------------------------------------------
+    def _margin_x(self, X: np.ndarray) -> np.ndarray:
+        """Margins for an (n, F) feature matrix via bucketed fused
+        dispatch; returns host (n,) or (n, K) float32, exact per row.
+        Rows beyond the largest bucket are chunked at it, so the set of
+        compiled traversal programs never exceeds len(self.buckets)."""
+        import jax
+
+        n = X.shape[0]
+        maxb = self.buckets[-1]
+        outs: List[np.ndarray] = []
+        sharding = self._cl.row_sharding()
+        pos = 0
+        while pos < n:
+            chunk = X[pos: pos + maxb]
+            m = chunk.shape[0]
+            bucket = self._bucket_for(m)
+            buf = np.zeros((bucket, X.shape[1]), np.float32)
+            buf[:m] = chunk
+            xd = jax.device_put(buf, sharding)
+            out = self._fn(xd, self._edges, self._is_cat, self._init,
+                           *self._arrays)
+            self._traced.add(bucket)
+            outs.append(np.asarray(out)[:m])
+            pos += m
+        if not outs:
+            K = (self.forest.nclasses if (self.forest.nclasses > 2
+                                          or self.forest.per_class_trees)
+                 else 1)
+            return np.zeros((0,) if K == 1 else (0, K), np.float32)
+        return outs[0] if len(outs) == 1 else np.concatenate(outs)
+
+    @property
+    def traversal_compiles(self) -> int:
+        return len(self._traced)
+
+    # -- request-level API -------------------------------------------------
+    def _raw_for_slice(self, margin: np.ndarray, n: int):
+        """Pad an exact (n,)/(n, K) margin slice back out to the cluster's
+        padded row count and lift to a row-sharded device array, then run
+        the model's margin→raw post-processing. Pad rows carry zeros; they
+        are weight-masked out of metrics and sliced off of frames, exactly
+        like the generic path's NA-binned pad rows."""
+        import jax.numpy as jnp
+
+        padded = self._cl.pad_rows(n)
+        buf = np.zeros((padded,) + margin.shape[1:], np.float32)
+        buf[:n] = margin
+        f = self._cl.put_rows(buf)
+        return self.model._margin_to_raw(jnp.asarray(f))
+
+    def predict_batch(self, entries: List[Tuple[Any, Optional[str], bool]]):
+        """Score a coalesced batch: entries = [(frame, dest_key,
+        with_metrics)]. Returns [(prediction_frame, metrics_or_None)] in
+        entry order; prediction frames are installed under dest_key.
+
+        Single-process: one fused bucketed dispatch over the concatenated
+        rows. Multi-process cloud: the entries run through the generic
+        predict path sequentially INSIDE the one op — followers replay the
+        identical program sequence (the fused path's host-side feature
+        packing cannot see non-addressable shards)."""
+        import jax
+
+        t0 = time.perf_counter()
+        if jax.process_count() > 1:
+            results = []
+            for frame, dest, with_metrics in entries:
+                pred = self.model.predict(frame, key=dest)
+                pred.install()
+                mm = self.model.model_performance(frame) if with_metrics \
+                    else None
+                results.append((pred, mm))
+            total_rows = sum(frame.nrows for frame, _, _ in entries)
+        else:
+            adapteds = [self.model.adapt_test(frame)
+                        for frame, _, _ in entries]
+            ns = [frame.nrows for frame, _, _ in entries]
+            X = np.concatenate([self._features(a, n)
+                                for a, n in zip(adapteds, ns)]) \
+                if entries else np.zeros((0, self.spec.F), np.float32)
+            margins = self._margin_x(X)
+            results = []
+            off = 0
+            for (frame, dest, with_metrics), n in zip(entries, ns):
+                raw = self._raw_for_slice(margins[off: off + n], n)
+                off += n
+                pred = self.model._raw_to_frame(raw, n, key=dest)
+                pred.install()
+                mm = self.model._make_metrics(frame, raw) if with_metrics \
+                    else None
+                results.append((pred, mm))
+            total_rows = sum(ns)
+        ms = (time.perf_counter() - t0) * 1000
+        self.stats.record_batch(len(entries), total_rows, ms)
+        from h2o3_tpu.utils import timeline
+
+        timeline.record("scoring", str(self.model.key), ms=ms,
+                        requests=len(entries), rows=total_rows,
+                        compiles=self.traversal_compiles)
+        return results
+
+    def predict(self, frame, key: Optional[str] = None):
+        """Single-request convenience (no micro-batching, no oplog)."""
+        return self.predict_batch([(frame, key, False)])[0][0]
+
+
+# ---------------------------------------------------------------------------
+# session registry (bounded; a retrain under the same key gets a fresh
+# session because the CompressedForest identity changes)
+# ---------------------------------------------------------------------------
+
+_REG_LOCK = threading.Lock()
+_REGISTRY: "collections.OrderedDict[tuple, ScoringSession]" = \
+    collections.OrderedDict()
+_REGISTRY_CAP = 16
+
+
+def session_for(model) -> ScoringSession:
+    key = (str(model.key), id(model.forest))
+    with _REG_LOCK:
+        sess = _REGISTRY.get(key)
+        if sess is not None:
+            _REGISTRY.move_to_end(key)
+            return sess
+    sess = ScoringSession(model)
+    with _REG_LOCK:
+        cur = _REGISTRY.setdefault(key, sess)
+        _REGISTRY.move_to_end(key)
+        while len(_REGISTRY) > _REGISTRY_CAP:
+            _REGISTRY.popitem(last=False)
+        return cur
+
+
+def purge(model_key: Optional[str] = None) -> None:
+    """Drop sessions for a deleted model (all sessions when key is None)."""
+    with _REG_LOCK:
+        if model_key is None:
+            _REGISTRY.clear()
+            return
+        for k in [k for k in _REGISTRY if k[0] == str(model_key)]:
+            del _REGISTRY[k]
+
+
+def metrics_snapshot() -> List[Dict[str, Any]]:
+    with _REG_LOCK:
+        items = [(k[0], s) for k, s in _REGISTRY.items()]
+    out = []
+    for mk, sess in items:
+        entry = {"model": mk, "buckets": list(sess.buckets),
+                 "traversal_compiles": sess.traversal_compiles}
+        entry.update(sess.stats.snapshot())
+        out.append(entry)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher
+# ---------------------------------------------------------------------------
+
+class _Pending:
+    __slots__ = ("frame", "dest", "with_metrics", "event", "pred", "mm",
+                 "error", "promoted")
+
+    def __init__(self, frame, dest, with_metrics):
+        self.frame = frame
+        self.dest = dest
+        self.with_metrics = with_metrics
+        self.event = threading.Event()
+        self.pred = None
+        self.mm = None
+        self.error: Optional[BaseException] = None
+        self.promoted = False      # woken to take over flush leadership
+
+
+def execute_batch(model, entries: List[Tuple[Any, Optional[str], bool]]):
+    """Run one coalesced batch (shared by the coordinator's flush and the
+    follower's oplog replay, so both sides execute the identical device
+    program sequence)."""
+    return session_for(model).predict_batch(entries)
+
+
+class ScoreBatcher:
+    """Coalesces concurrent scoring requests per model key.
+
+    The first request for a model becomes the flush leader: it sleeps the
+    batch window, drains everything queued for that model, broadcasts ONE
+    'score_batch' oplog op, and dispatches the whole batch inside the
+    op's execution turn. Followers of the request (other handler threads)
+    block on their entry's event and get their exact slice back. Per-model
+    queues mean requests against different models proceed independently."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queues: Dict[str, List[_Pending]] = {}
+        self._leaders: set = set()
+
+    def submit(self, model, frame, dest: Optional[str] = None,
+               with_metrics: bool = False, timeout_s: float = 600.0):
+        mk = str(model.key)
+        ent = _Pending(frame, dest, with_metrics)
+        with self._lock:
+            self._queues.setdefault(mk, []).append(ent)
+            lead = mk not in self._leaders
+            if lead:
+                self._leaders.add(mk)
+        if lead:
+            self._lead(model, mk)
+        else:
+            if not ent.event.wait(timeout=timeout_s):
+                # withdraw BEFORE erroring: a still-queued entry must not
+                # be scored later (its client already got the failure) —
+                # if it is mid-flush, give that dispatch a grace period
+                with self._lock:
+                    q = self._queues.get(mk)
+                    if q and ent in q:
+                        q.remove(ent)
+                        if ent.promoted:
+                            # leadership was handed to us in the same
+                            # instant we gave up — pass it on, don't let
+                            # the queue stall behind a departed leader
+                            if q:
+                                q[0].promoted = True
+                                q[0].event.set()
+                            else:
+                                self._queues.pop(mk, None)
+                                self._leaders.discard(mk)
+                        raise TimeoutError(
+                            f"scoring batch for model {mk!r} did not "
+                            f"flush within {timeout_s}s")
+                if not ent.event.wait(timeout=60.0):
+                    raise TimeoutError(
+                        f"scoring dispatch for model {mk!r} wedged "
+                        f"mid-batch")
+            if ent.promoted and not (ent.pred or ent.error):
+                # the previous leader finished its batch with us still
+                # queued and handed leadership over: our flush (which
+                # includes our own entry) runs on THIS thread
+                self._lead(model, mk)
+        if ent.error is not None:
+            raise ent.error
+        return ent.pred, ent.mm
+
+    def _lead(self, model, mk: str) -> None:
+        """Flush ONE batch (window sleep → drain → dispatch), then either
+        release leadership or hand it to the first still-queued waiter —
+        the leader's own request is never delayed past its batch, even
+        under a sustained request stream."""
+        try:
+            w = _window_s()
+            if w > 0:
+                time.sleep(w)
+            with self._lock:
+                batch = self._queues.get(mk) or []
+                self._queues[mk] = []
+            if batch:
+                self._flush(model, batch)
+            with self._lock:
+                rest = self._queues.get(mk)
+                if rest:
+                    # leadership stays marked; the promoted waiter's
+                    # thread continues the flush loop
+                    rest[0].promoted = True
+                    rest[0].event.set()
+                    return
+                self._queues.pop(mk, None)
+                self._leaders.discard(mk)
+        except BaseException as ex:   # noqa: BLE001 — never strand waiters
+            with self._lock:
+                stranded = self._queues.pop(mk, [])
+                self._leaders.discard(mk)
+            for e in stranded:
+                if e.error is None and not e.event.is_set():
+                    e.error = ex
+                    e.event.set()
+            raise
+
+    @staticmethod
+    def _flush(model, batch: List[_Pending]) -> None:
+        from h2o3_tpu.parallel import oplog
+
+        try:
+            # broadcast ONE op for the whole batch; followers replay it
+            # once. Existence/compat validation already happened
+            # pre-broadcast in the REST handler, so coordinator and
+            # follower fail symmetrically. The broadcast sits INSIDE the
+            # try: a KV failure must error the waiters, not strand them.
+            op_seq = oplog.broadcast("score_batch", {
+                "model": str(model.key),
+                "requests": [{"frame": str(e.frame.key),
+                              "destination_frame": e.dest,
+                              "with_metrics": bool(e.with_metrics)}
+                             for e in batch]})
+            with oplog.turn(op_seq):
+                results = execute_batch(
+                    model, [(e.frame, e.dest, e.with_metrics)
+                            for e in batch])
+            for e, (pred, mm) in zip(batch, results):
+                e.pred, e.mm = pred, mm
+        except BaseException as ex:   # noqa: BLE001 — propagate per-request
+            for e in batch:
+                e.error = ex
+        finally:
+            for e in batch:
+                e.event.set()
+
+
+BATCHER = ScoreBatcher()
+
+
+def score_request(model, frame, dest: Optional[str] = None,
+                  with_metrics: bool = False):
+    """Entry point for the REST layer: coalescing, bucketed, oplog-mirrored
+    scoring of one request. Returns (prediction_frame, metrics_or_None)."""
+    return BATCHER.submit(model, frame, dest, with_metrics)

@@ -76,7 +76,28 @@ _SMOKE_MODULES = {"test_core", "test_glm", "test_rapids", "test_java_mojo",
                   "test_h2or_client", "test_narrow_dtypes"}
 
 
+# tier-1 budget ordering: the ROADMAP tier-1 run is time-boxed (870 s), so
+# cheap host-dominated modules run FIRST and the compile-heavy device
+# trainers (tree/DL/AutoML fits, subprocess clouds) run LAST — a truncated
+# run banks every fast test's result instead of burning the budget on the
+# first few expensive modules in alphabetical order. Stable sort: original
+# file order is kept within each cost class.
+_HEAVY_MODULES = [
+    # many passing tests per second of training — earliest of the tail
+    "test_trees", "test_checkpoint", "test_genmodel", "test_mojo",
+    "test_mojo_families", "test_explain", "test_ensemble",
+    "test_survival_gam_rulefit", "test_grid",
+    # long single fits / many submodels
+    "test_automl", "test_automl_bindings", "test_deep_trees",
+    "test_deeplearning", "test_pallas_hist",
+    # 2-process localhost clouds: minutes per test, run dead last
+    "test_multiprocess",
+]
+
+
 def pytest_collection_modifyitems(config, items):
     for item in items:
         if item.module.__name__ in _SMOKE_MODULES:
             item.add_marker(pytest.mark.smoke)
+    rank = {m: i for i, m in enumerate(_HEAVY_MODULES, start=1)}
+    items.sort(key=lambda item: rank.get(item.module.__name__, 0))

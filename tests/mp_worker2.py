@@ -16,11 +16,23 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# 2 local virtual CPU devices; jax<0.5 only honors the XLA flag (set before
+# backend init), newer jax the config option — apply whichever exists
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
 
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:      # jax<0.5: the XLA flag above already did it
+    pass
+try:
+    # jax<0.5 CPU backend needs gloo for cross-process collectives
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except (AttributeError, ValueError):
+    pass
 
 import numpy as np
 

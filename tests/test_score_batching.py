@@ -1,0 +1,244 @@
+"""Micro-batcher for /3/Predictions (scoring.ScoreBatcher).
+
+Concurrent requests against the same model coalesce into one dispatch and
+get their exact per-request slices back; requests against different models
+ride independent queues. The REST fast path returns the same payload shape
+(and bitwise-identical frames) as the legacy per-request route."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame
+
+
+def _train_frame(n=1200, seed=0):
+    rng = np.random.default_rng(seed)
+    fr = Frame()
+    x1 = rng.standard_normal(n)
+    x2 = rng.standard_normal(n)
+    fr.add("x1", Column.from_numpy(x1))
+    fr.add("x2", Column.from_numpy(x2))
+    y = np.where(rng.random(n) < 1 / (1 + np.exp(-(1.2 * x1 - x2))),
+                 "Y", "N")
+    fr.add("y", Column.from_numpy(y, ctype="enum"))
+    return fr
+
+
+def _score_frame(n, seed):
+    rng = np.random.default_rng(seed)
+    fr = Frame()
+    fr.add("x1", Column.from_numpy(rng.standard_normal(n)))
+    fr.add("x2", Column.from_numpy(rng.standard_normal(n)))
+    return fr
+
+
+@pytest.fixture(scope="module")
+def gbm(cl):
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    return GBM(ntrees=6, max_depth=3, seed=1).train(
+        y="y", training_frame=_train_frame())
+
+
+@pytest.fixture(scope="module")
+def gbm2(cl):
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    return GBM(ntrees=4, max_depth=2, seed=2).train(
+        y="y", training_frame=_train_frame(seed=5))
+
+
+def _assert_frames_bitwise(a, b, n):
+    assert a.names == b.names
+    for name in a.names:
+        av = np.asarray(a.col(name).data)[:n]
+        bv = np.asarray(b.col(name).data)[:n]
+        assert np.array_equal(av, bv), name
+
+
+def _concurrent_scores(model, frames, n_threads=None):
+    """Submit every frame from its own thread through the micro-batcher;
+    returns predictions in frame order (raises the first worker error)."""
+    from h2o3_tpu import scoring
+
+    n_threads = n_threads or len(frames)
+    results = [None] * len(frames)
+    errors = []
+    barrier = threading.Barrier(n_threads)
+
+    def worker(i):
+        try:
+            barrier.wait(timeout=30)
+            pred, _mm = scoring.score_request(model, frames[i])
+            results[i] = pred
+        except BaseException as e:   # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(frames))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    if errors:
+        raise errors[0]
+    return results
+
+
+class TestCoalescing:
+    def test_concurrent_same_model_exact_slices(self, cl, gbm, monkeypatch):
+        """Concurrent requests coalesce into fewer dispatches, and every
+        request gets back exactly its own rows."""
+        from h2o3_tpu import scoring
+
+        sizes = (50, 120, 77, 333)
+        frames = [_score_frame(s, s) for s in sizes]
+        expected = [gbm.predict(fr) for fr in frames]
+        # wide window so barrier-released threads land in ONE batch
+        monkeypatch.setenv("H2O_TPU_SCORE_BATCH_WINDOW_MS", "250")
+        scoring.purge(str(gbm.key))         # fresh stats
+        sess = scoring.session_for(gbm)
+        preds = _concurrent_scores(gbm, frames)
+        for fr, exp, got in zip(frames, expected, preds):
+            _assert_frames_bitwise(exp, got, fr.nrows)
+        stats = sess.stats.snapshot()
+        assert stats["requests"] == len(frames)
+        assert stats["max_batch_requests"] >= 2, stats   # coalesced
+        assert stats["batches"] < stats["requests"], stats
+
+    def test_different_models_do_not_block(self, cl, gbm, gbm2,
+                                           monkeypatch):
+        """A leader sleeping out model A's window must not delay model B:
+        B (window 0) completes while A's batch is still open."""
+        from h2o3_tpu import scoring
+
+        # warm both sessions so execution time is dispatch-only
+        scoring.score_request(gbm, _score_frame(40, 1))
+        scoring.score_request(gbm2, _score_frame(40, 2))
+
+        monkeypatch.setenv("H2O_TPU_SCORE_BATCH_WINDOW_MS", "1500")
+        a_done = threading.Event()
+        a_res = {}
+
+        def run_a():
+            a_res["pred"], _ = scoring.score_request(gbm, _score_frame(64, 3))
+            a_done.set()
+
+        ta = threading.Thread(target=run_a)
+        ta.start()
+        time.sleep(0.2)          # A's leader is inside its window now
+        monkeypatch.setenv("H2O_TPU_SCORE_BATCH_WINDOW_MS", "0")
+        pred_b, _ = scoring.score_request(gbm2, _score_frame(32, 4))
+        assert pred_b.nrows == 32
+        assert not a_done.is_set(), \
+            "model B's request should finish while model A's batch is open"
+        assert a_done.wait(timeout=60)
+        ta.join(timeout=30)
+        assert a_res["pred"].nrows == 64
+
+    def test_batch_error_propagates_to_each_request(self, cl, gbm,
+                                                    monkeypatch):
+        """A failing frame inside a batch must fail its request (and not
+        strand the batcher's leader slot for later requests)."""
+        from h2o3_tpu import scoring
+
+        monkeypatch.setenv("H2O_TPU_SCORE_BATCH_WINDOW_MS", "0")
+        bad = Frame()
+        bad.add("x1", Column.from_numpy(np.array(["a", "b"] * 8),
+                                        ctype="enum"))
+        bad.add("x2", Column.from_numpy(np.zeros(16)))
+        with pytest.raises(ValueError):
+            scoring.score_request(gbm, bad)
+        # batcher recovered: next request works
+        pred, _ = scoring.score_request(gbm, _score_frame(20, 6))
+        assert pred.nrows == 20
+
+
+class TestRestFastPath:
+    def test_predictions_route_fast_vs_legacy(self, cl, gbm, monkeypatch):
+        import json
+        import urllib.request
+
+        from h2o3_tpu.api.server import start_server
+        from h2o3_tpu.core.dkv import DKV
+
+        rng = np.random.default_rng(7)
+        fr = Frame(key="score_batch_rest.hex")
+        fr.add("x1", Column.from_numpy(rng.standard_normal(210)))
+        fr.add("x2", Column.from_numpy(rng.standard_normal(210)))
+        fr.install()
+        srv = start_server(port=0)
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+
+            def post(path):
+                req = urllib.request.Request(base + path, data=b"",
+                                             method="POST")
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    return json.loads(r.read())
+
+            fkey = str(fr.key)
+            out = post(f"/3/Predictions/models/{gbm.key}/frames/{fkey}"
+                       "?predictions_frame=fastpred")
+            assert out["predictions_frame"]["name"] == "fastpred"
+            monkeypatch.setenv("H2O_TPU_SCORE_FAST", "0")
+            post(f"/3/Predictions/models/{gbm.key}/frames/{fkey}"
+                 "?predictions_frame=slowpred")
+            monkeypatch.delenv("H2O_TPU_SCORE_FAST")
+            _assert_frames_bitwise(DKV.get("fastpred"), DKV.get("slowpred"),
+                                   fr.nrows)
+            # observability: the session shows up in /3/ScoringMetrics
+            with urllib.request.urlopen(base + "/3/ScoringMetrics",
+                                        timeout=30) as r:
+                sm = json.loads(r.read())
+            assert any(e["model"] == str(gbm.key) for e in sm["models"])
+        finally:
+            srv.stop()
+
+    def test_incompatible_columns_rejected_before_broadcast(self, cl, gbm):
+        """Satellite: column-compat validation happens pre-broadcast and
+        returns 400 (not a 500 from inside adapt_test)."""
+        import json
+        import urllib.error
+        import urllib.request
+
+        from h2o3_tpu.api.server import start_server
+
+        bad = Frame()
+        bad.add("x1", Column.from_numpy(np.array(["a", "b"] * 30),
+                                        ctype="enum"))
+        bad.add("x2", Column.from_numpy(np.zeros(60)))
+        bad.install()
+        srv = start_server(port=0)
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            for route in ("/3/Predictions", "/4/Predictions"):
+                req = urllib.request.Request(
+                    f"{base}{route}/models/{gbm.key}/frames/{bad.key}",
+                    data=b"", method="POST")
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(req, timeout=60)
+                assert ei.value.code == 400
+                body = json.loads(ei.value.read())
+                assert "numeric in training, enum in test" \
+                    in json.dumps(body)
+        finally:
+            srv.stop()
+
+
+@pytest.mark.slow
+class TestBatchingStress:
+    def test_many_concurrent_mixed_sizes(self, cl, gbm, monkeypatch):
+        """Soak: 24 concurrent mixed-size requests through the batcher —
+        every response is the exact per-request slice."""
+        rng = np.random.default_rng(11)
+        sizes = [int(s) for s in rng.integers(5, 2000, 24)]
+        frames = [_score_frame(s, 1000 + i) for i, s in enumerate(sizes)]
+        expected = [gbm.predict(fr) for fr in frames]
+        monkeypatch.setenv("H2O_TPU_SCORE_BATCH_WINDOW_MS", "20")
+        preds = _concurrent_scores(gbm, frames)
+        for fr, exp, got in zip(frames, expected, preds):
+            _assert_frames_bitwise(exp, got, fr.nrows)

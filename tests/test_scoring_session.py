@@ -1,0 +1,203 @@
+"""Serving fast path (scoring.py): shape-bucketed fused scoring sessions.
+
+Covers the ISSUE-2 acceptance bar: scoring requests with distinct row
+counts against one trained GBM compiles at most len(buckets) traversal
+programs (asserted with JAX's compilation counters), and padded rows never
+leak — the bucketed path returns BITWISE-identical predictions to the
+per-request unbatched path."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame
+
+
+def _train_frame(n=1500, seed=0, classes=2):
+    rng = np.random.default_rng(seed)
+    fr = Frame()
+    x1 = rng.standard_normal(n)
+    x2 = rng.standard_normal(n)
+    g = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+    fr.add("x1", Column.from_numpy(x1))
+    fr.add("x2", Column.from_numpy(x2))
+    fr.add("g", Column.from_numpy(g, ctype="enum"))
+    logit = 1.2 * x1 - x2 + (g == "a") * 0.5
+    if classes == 2:
+        y = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "Y", "N")
+    else:
+        y = np.array(["r", "s", "t"])[
+            np.clip((logit + rng.normal(0, 0.5, n) + 1.5).astype(int), 0,
+                    classes - 1)]
+    fr.add("y", Column.from_numpy(y, ctype="enum"))
+    return fr
+
+
+def _score_frame(n, seed, with_nas=False):
+    rng = np.random.default_rng(seed)
+    fr = Frame()
+    x1 = rng.standard_normal(n)
+    x2 = rng.standard_normal(n)
+    if with_nas:
+        x1[:: 7] = np.nan
+    fr.add("x1", Column.from_numpy(x1))
+    fr.add("x2", Column.from_numpy(x2))
+    fr.add("g", Column.from_numpy(
+        np.array(["a", "b", "c"])[rng.integers(0, 3, n)], ctype="enum"))
+    return fr
+
+
+@pytest.fixture(scope="module")
+def gbm(cl):
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    return GBM(ntrees=8, max_depth=3, seed=1).train(
+        y="y", training_frame=_train_frame())
+
+
+def _assert_frames_bitwise(a, b, n):
+    assert a.names == b.names
+    for name in a.names:
+        av = np.asarray(a.col(name).data)[:n]
+        bv = np.asarray(b.col(name).data)[:n]
+        assert np.array_equal(av, bv), (name, av[:5], bv[:5])
+
+
+class TestCompileStability:
+    SIZES = (17, 300, 1000, 4096, 9999)
+
+    def test_at_most_len_buckets_traversal_traces(self, cl, gbm):
+        """5 distinct request row counts → ≤ len(buckets) compiled
+        programs, counted with JAX's own jit-lowering counter over the
+        bucketed dispatch (the only jitted program on that path)."""
+        import jax._src.test_util as jtu
+
+        from h2o3_tpu import scoring
+
+        sess = scoring.ScoringSession(gbm)      # fresh: nothing traced yet
+        feats = {n: sess._features(gbm.adapt_test(_score_frame(n, n)), n)
+                 for n in self.SIZES}
+        with jtu.count_jit_and_pmap_lowerings() as lowerings:
+            margins = {n: sess._margin_x(feats[n]) for n in self.SIZES}
+        assert lowerings[0] <= len(sess.buckets), (lowerings[0], sess.buckets)
+        assert sess.traversal_compiles <= len(sess.buckets)
+        # margins are exact vs the unbatched binned traversal
+        for n, mg in margins.items():
+            ref = np.asarray(gbm._margin(gbm.adapt_test(_score_frame(n, n))))
+            assert np.array_equal(mg[:n], ref[:n]), n
+
+        # NEW row counts that land in warm buckets compile AND retrace
+        # nothing — the per-request-shape jit cost is gone entirely
+        feats2 = {n: sess._features(gbm.adapt_test(_score_frame(n, 99 + n)),
+                                    n) for n in (60, 900, 2222)}
+        with jtu.count_jit_and_pmap_lowerings() as lowerings, \
+                jtu.count_jit_tracing_cache_miss() as misses:
+            for n, x in feats2.items():
+                sess._margin_x(x)
+        assert lowerings[0] == 0, lowerings[0]
+        assert misses[0] == 0, misses[0]
+
+    def test_padded_rows_never_leak(self, cl, gbm):
+        """Bucket padding must be invisible: bucketed predictions are
+        bitwise-identical to the per-request unbatched path, including
+        frames with NAs."""
+        from h2o3_tpu import scoring
+
+        sess = scoring.session_for(gbm)
+        for n in self.SIZES:
+            fr = _score_frame(n, n, with_nas=True)
+            _assert_frames_bitwise(gbm.predict(fr), sess.predict(fr), n)
+
+
+class TestBucketConfig:
+    def test_env_buckets_and_chunking(self, cl, gbm, monkeypatch):
+        """H2O_TPU_SCORE_BUCKETS overrides the ladder; requests above the
+        largest bucket chunk at it instead of compiling new shapes."""
+        from h2o3_tpu import scoring
+
+        monkeypatch.setenv("H2O_TPU_SCORE_BUCKETS", "64,256")
+        sess = scoring.ScoringSession(gbm)
+        assert sess.buckets == (64, 256)
+        fr = _score_frame(700, 5)     # 700 > 256 → 3 chunks of ≤256
+        _assert_frames_bitwise(gbm.predict(fr), sess.predict(fr), 700)
+        assert sess.traversal_compiles <= 2
+
+    def test_bad_env_falls_back(self, cl, monkeypatch):
+        from h2o3_tpu import scoring
+
+        monkeypatch.setenv("H2O_TPU_SCORE_BUCKETS", "nope")
+        assert scoring._env_buckets() == scoring._DEFAULT_BUCKETS
+
+
+class TestModelFamilies:
+    def test_multinomial_bitwise(self, cl):
+        from h2o3_tpu import scoring
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        m = GBM(ntrees=4, max_depth=3, seed=2).train(
+            y="y", training_frame=_train_frame(seed=3, classes=3))
+        assert scoring.supports(m)
+        sess = scoring.session_for(m)
+        fr = _score_frame(333, 11)
+        _assert_frames_bitwise(m.predict(fr), sess.predict(fr), 333)
+
+    def test_regression_bitwise(self, cl):
+        from h2o3_tpu import scoring
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        rng = np.random.default_rng(4)
+        n = 1200
+        fr = Frame()
+        x = rng.standard_normal(n)
+        fr.add("x1", Column.from_numpy(x))
+        fr.add("x2", Column.from_numpy(rng.standard_normal(n)))
+        fr.add("g", Column.from_numpy(
+            np.array(["a", "b"])[rng.integers(0, 2, n)], ctype="enum"))
+        fr.add("y", Column.from_numpy(2 * x + rng.normal(0, 0.1, n)))
+        m = GBM(ntrees=5, max_depth=3, seed=2).train(y="y",
+                                                     training_frame=fr)
+        sess = scoring.session_for(m)
+        tf = _score_frame(97, 7)
+        _assert_frames_bitwise(m.predict(tf), sess.predict(tf), 97)
+
+    def test_drf_supported_isofor_not(self, cl, gbm):
+        from h2o3_tpu import scoring
+        from h2o3_tpu.models.tree.drf import DRF
+        from h2o3_tpu.models.tree.isofor import IsolationForest
+
+        drf = DRF(ntrees=4, max_depth=4, seed=5).train(
+            y="y", training_frame=_train_frame(seed=6))
+        assert scoring.supports(drf)
+        fr = _score_frame(150, 8)
+        _assert_frames_bitwise(drf.predict(fr),
+                               scoring.session_for(drf).predict(fr), 150)
+        isf = IsolationForest(ntrees=4, max_depth=4, seed=5).train(
+            training_frame=_score_frame(300, 9))
+        # IsolationForest overrides _predict_raw (mean_length output) →
+        # generic path, fast path refuses it
+        assert not scoring.supports(isf)
+
+    def test_kill_switch(self, cl, gbm, monkeypatch):
+        from h2o3_tpu import scoring
+
+        monkeypatch.setenv("H2O_TPU_SCORE_FAST", "0")
+        assert not scoring.supports(gbm)
+
+
+class TestSessionRegistry:
+    def test_reuse_and_purge(self, cl, gbm):
+        from h2o3_tpu import scoring
+
+        s1 = scoring.session_for(gbm)
+        assert scoring.session_for(gbm) is s1
+        scoring.purge(str(gbm.key))
+        assert scoring.session_for(gbm) is not s1
+
+    def test_metrics_snapshot_shape(self, cl, gbm):
+        from h2o3_tpu import scoring
+
+        sess = scoring.session_for(gbm)
+        sess.predict(_score_frame(40, 12))
+        snap = [e for e in scoring.metrics_snapshot()
+                if e["model"] == str(gbm.key)]
+        assert snap and snap[0]["requests"] >= 1
+        assert "p50_ms" in snap[0] and snap[0]["buckets"] == list(sess.buckets)
