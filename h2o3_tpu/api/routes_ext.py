@@ -704,6 +704,30 @@ def h_steam_metrics(ctx: Ctx):
             "idle_millis": 0, "cloud_size": info["cloud_size"]}
 
 
+def h_cloud_status(ctx: Ctx):
+    """GET /3/CloudStatus — the supervised cloud health state machine
+    (HEALTHY/DEGRADED/FAILED) with its evidence: per-process heartbeat
+    ages, follower replay failures (remote tracebacks), and the recent
+    transition history. The terse headline rides on /3/Cloud as
+    ``cloud_status``; this route is the operator's drill-down."""
+    from h2o3_tpu.core.failure import cluster_health, heartbeat_stale_s
+    from h2o3_tpu.parallel import oplog, supervisor
+
+    st = supervisor.status()
+    return {"__meta": S.meta("CloudStatusV3"),
+            "state": st["state"],
+            "since": st["since"],
+            "reason": st["reason"],
+            "remote_trace": st["remote_trace"],
+            "transitions": st["transitions"],
+            "process_health": cluster_health(),
+            "heartbeat_stale_s": heartbeat_stale_s(),
+            "expected_acks": oplog.expected_acks(),
+            "oplog_errors": [{"seq": seq, "kind": rec.get("kind"),
+                              "trace": rec.get("trace")}
+                             for seq, rec in oplog.error_records()]}
+
+
 def h_scoring_metrics(ctx: Ctx):
     """GET /3/ScoringMetrics — per-model serving fast-path statistics
     (scoring.py ScoringSession): request/batch/row counts, micro-batch
@@ -1184,6 +1208,8 @@ EXTRA_ROUTES = [
     ("POST", "/3/GarbageCollect", h_gc, "Run GC + cleaner sweep"),
     ("POST", "/3/UnlockKeys", h_unlock_keys, "Unlock all keys"),
     ("GET", "/3/SteamMetrics", h_steam_metrics, "Steam health metrics"),
+    ("GET", "/3/CloudStatus", h_cloud_status,
+     "Supervised cloud health state machine"),
     ("GET", "/3/ScoringMetrics", h_scoring_metrics,
      "Serving fast-path scoring metrics"),
     ("GET", "/3/WaterMeterCpuTicks/{nodeidx}", h_watermeter_cpu,

@@ -38,9 +38,11 @@ import numpy as np
 
 from h2o3_tpu.api import schemas as S
 from h2o3_tpu.core.dkv import DKV, Key
+from h2o3_tpu.core.failure import CloudUnhealthyError
 from h2o3_tpu.core.frame import Frame
 from h2o3_tpu.core.job import Job
 from h2o3_tpu.models.model import Model
+from h2o3_tpu.parallel.oplog import OplogPublishError, OplogTurnTimeout
 from h2o3_tpu.rapids import Session, exec_rapids
 
 _SESSIONS: Dict[str, Session] = {}
@@ -178,6 +180,7 @@ class Ctx:
 def h_cloud(ctx: Ctx):
     from h2o3_tpu.core.failure import cluster_health
     from h2o3_tpu.core.runtime import cluster_info
+    from h2o3_tpu.parallel import supervisor
 
     out = S.cloud_v3(cluster_info())
     hb = cluster_health()
@@ -185,6 +188,11 @@ def h_cloud(ctx: Ctx):
         out["process_health"] = hb
         out["cloud_healthy"] = bool(out.get("cloud_healthy", True)) and \
             all(r["healthy"] for r in hb)
+    # supervised health state machine (HEALTHY/DEGRADED/FAILED); detail at
+    # GET /3/CloudStatus
+    out["cloud_status"] = supervisor.state()
+    if out["cloud_status"] != supervisor.HEALTHY:
+        out["cloud_healthy"] = False
     return out
 
 
@@ -1729,6 +1737,14 @@ class _Handler(BaseHTTPRequestHandler):
         except ApiError as e:
             status = e.status
             return self._reply_error(str(e), e.status, e.schema)
+        except (CloudUnhealthyError, OplogPublishError,
+                OplogTurnTimeout) as e:
+            # supervised degraded-mode fail-fast: the cloud cannot complete
+            # multi-process work (dead/stale/crashed follower, lost op
+            # publish, wedged turnstile) — 503 with the diagnosis (incl.
+            # any remote traceback) instead of a hang
+            status = 503
+            return self._reply_error(str(e), 503)
         except NotImplementedError as e:
             from h2o3_tpu.errors import CapabilityGate
 
@@ -1768,6 +1784,10 @@ class ApiServer:
         self.port = port
         self.httpd: Optional[ThreadingHTTPServer] = None
         self.thread: Optional[threading.Thread] = None
+        # cloud supervision (multi-process only; wired by start_server):
+        # liveness beater + health state machine evaluator
+        self.heartbeat_thread = None
+        self.supervisor = None
         # TLS on the REST bind (reference: water/network/SSLProperties +
         # jetty h2o_ssl_jks options; here a PEM cert/key pair, the
         # standard python-stack equivalent)
@@ -1837,6 +1857,12 @@ class ApiServer:
         if self.httpd:
             self.httpd.shutdown()
             self.httpd = None
+        if self.heartbeat_thread is not None:
+            self.heartbeat_thread.stop()
+            self.heartbeat_thread = None
+        if self.supervisor is not None:
+            self.supervisor.stop()
+            self.supervisor = None
         from h2o3_tpu.parallel import oplog
 
         oplog.REST_SERVING = False
@@ -1846,12 +1872,38 @@ def start_server(port: int = 54321, auth_file: Optional[str] = None,
                  host: Optional[str] = None,
                  ssl_certfile: Optional[str] = None,
                  ssl_keyfile: Optional[str] = None) -> ApiServer:
+    from h2o3_tpu.parallel import distributed as D
     from h2o3_tpu.parallel import oplog
 
     oplog.REST_SERVING = True     # handler-thread collectives need op turns
-    return ApiServer(port, auth_file=auth_file, host=host,
-                     ssl_certfile=ssl_certfile,
-                     ssl_keyfile=ssl_keyfile).start()
+    srv = ApiServer(port, auth_file=auth_file, host=host,
+                    ssl_certfile=ssl_certfile,
+                    ssl_keyfile=ssl_keyfile).start()
+    if D.process_count() > 1:
+        # multi-process cloud: the coordinator beats + supervises without
+        # manual wiring, so /3/Cloud liveness and the /3/CloudStatus state
+        # machine are live for every REST-served cloud (stopped by stop())
+        from h2o3_tpu.core.failure import HeartbeatThread
+        from h2o3_tpu.parallel import supervisor as _sup
+
+        # a RE-started cloud begins from evidence, not from the previous
+        # incarnation's sticky verdict: reset, then let Supervisor.start's
+        # synchronous first evaluate() re-derive FAILED from any error
+        # keys still in the coordination KV
+        _sup.reset()
+        # core.runtime's cluster boot already runs a beater on every
+        # process of a REAL multi-process cloud — only start our own when
+        # none is running (REST served without a booted Runtime); the
+        # runtime's beater outlives stop() on purpose: the process is
+        # still a live cloud member after its HTTP server closes
+        import sys as _sys
+
+        _rt = _sys.modules.get("h2o3_tpu.core.runtime")
+        _cl = getattr(_rt, "_CLUSTER", None) if _rt else None
+        if getattr(_cl, "_heartbeat", None) is None:
+            srv.heartbeat_thread = HeartbeatThread().start()
+        srv.supervisor = _sup.Supervisor().start()
+    return srv
 
 
 # ---------------------------------------------------------------------------

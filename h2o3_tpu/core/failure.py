@@ -29,6 +29,27 @@ HEARTBEAT_STALE_S = 30.0
 _FAULTS: Dict[str, int] = {}
 
 
+class CloudUnhealthyError(RuntimeError):
+    """The cloud cannot complete multi-process work right now: a follower
+    crashed mid-replay (its traceback rides along), stopped acknowledging
+    ops, or went heartbeat-stale. The REST layer maps this to HTTP 503;
+    the supervisor marks in-flight jobs FAILED with the same message."""
+
+    def __init__(self, msg: str, remote_trace: str = ""):
+        if remote_trace:
+            msg = f"{msg}\n--- remote traceback ---\n{remote_trace}"
+        super().__init__(msg)
+        self.remote_trace = remote_trace
+
+
+def heartbeat_stale_s() -> float:
+    """Staleness threshold: beats older than this mark a process dead
+    (env ``H2O_TPU_HEARTBEAT_STALE_S``, default 30 s)."""
+    from h2o3_tpu.parallel.retry import env_float
+
+    return env_float("H2O_TPU_HEARTBEAT_STALE_S", HEARTBEAT_STALE_S)
+
+
 def heartbeat() -> bool:
     """Publish this process's liveness beat (HeartBeatThread analog).
     False in single-process mode (nothing to police)."""
@@ -36,16 +57,19 @@ def heartbeat() -> bool:
 
     from h2o3_tpu.parallel import distributed as D
 
+    faultpoint("failure.heartbeat")
     return D.kv_put(_HB_PREFIX + str(jax.process_index()),
                     json.dumps({"ts": time.time(),
                                 "proc": jax.process_index()}))
 
 
-def cluster_health(stale_after_s: float = HEARTBEAT_STALE_S) -> List[dict]:
+def cluster_health(stale_after_s: Optional[float] = None) -> List[dict]:
     """Per-process liveness from the heartbeat table: one row per process
     that has ever beat, with age and a healthy flag."""
     from h2o3_tpu.parallel import distributed as D
 
+    if stale_after_s is None:
+        stale_after_s = heartbeat_stale_s()
     now = time.time()
     out = []
     for key, val in D.kv_dir(_HB_PREFIX):

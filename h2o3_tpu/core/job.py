@@ -34,6 +34,9 @@ class Job(Keyed):
         self.end_time = 0.0
         self._cancel_requested = False
         self._thread: Optional[threading.Thread] = None
+        # serializes terminal-status writes: the worker thread's DONE and
+        # the cloud supervisor's external FAILED must not interleave
+        self._status_lock = threading.Lock()
         self.result: Any = None
         self.install()
 
@@ -42,19 +45,39 @@ class Job(Keyed):
         """Run fn(job) (the Driver.computeImpl analog, hex/ModelBuilder.java:224)."""
 
         def run():
-            self.status = Job.RUNNING
+            with self._status_lock:
+                if self.status == Job.FAILED:
+                    # the supervisor failed this job while still CREATED
+                    # (cloud died between submit and thread start): honor
+                    # the verdict, never run work against a dead cloud
+                    return
+                self.status = Job.RUNNING
             self.start_time = time.time()
             try:
                 self.result = fn(self)
-                if self.dest and self.result is not None:
-                    DKV.put(self.dest, self.result)
-                self.status = Job.DONE
-                self.progress = 1.0
+                with self._status_lock:
+                    if self.status == Job.FAILED:
+                        # the supervisor declared this job dead (cloud
+                        # FAILED) while in flight: keep that verdict and
+                        # do NOT install the result — it was built
+                        # against a diverged cloud
+                        return
+                    if self.dest and self.result is not None:
+                        DKV.put(self.dest, self.result)
+                    self.status = Job.DONE
+                    self.progress = 1.0
             except JobCancelled:
-                self.status = Job.CANCELLED
+                with self._status_lock:
+                    if self.status != Job.FAILED:
+                        self.status = Job.CANCELLED
             except Exception:
-                self.exception = traceback.format_exc()
-                self.status = Job.FAILED
+                with self._status_lock:
+                    if self.status != Job.FAILED:
+                        # a supervisor verdict (remote traceback) already
+                        # landed: keep it — the worker's own exception is
+                        # a downstream symptom of the same cloud failure
+                        self.exception = traceback.format_exc()
+                        self.status = Job.FAILED
             finally:
                 self.end_time = time.time()
 
@@ -72,6 +95,19 @@ class Job(Keyed):
         self.progress = float(progress)
         if msg:
             self.progress_msg = msg
+
+    def fail(self, exception_text: str) -> None:
+        """Mark FAILED from OUTSIDE the worker thread (cloud supervisor,
+        degraded mode): the worker may be wedged inside a dead collective
+        and never unwind to record its own failure. No-op once terminal;
+        the status lock keeps a worker unwinding at the same instant from
+        overwriting the verdict with DONE."""
+        with self._status_lock:
+            if not self.is_running:
+                return
+            self.exception = exception_text
+            self.status = Job.FAILED
+            self.end_time = time.time()
 
     # -- client side ------------------------------------------------------
     def cancel(self) -> None:

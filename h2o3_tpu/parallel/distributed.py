@@ -13,8 +13,9 @@ supported elastic join after the first job either (SURVEY.md §5.3)."""
 
 from __future__ import annotations
 
+import contextlib
 import os
-from typing import Optional
+from typing import Dict, Optional
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -56,6 +57,27 @@ def process_count() -> int:
 # sharded jax.Arrays; this channel carries metadata and small host objects.
 # ---------------------------------------------------------------------------
 
+# in-memory KV override: a plain dict standing in for the coordination
+# service when no real cloud exists. The supervision/chaos test tier uses
+# this to drive the full oplog/heartbeat/supervisor machinery — follower
+# replay, acks, error keys, health folding — deterministically inside ONE
+# process, with faultpoint() injections supplying the failures a real dead
+# peer would (the 2-process gloo tier is env-flaky on this jax build).
+_MEM_KV: Optional[Dict[str, str]] = None
+
+
+@contextlib.contextmanager
+def memory_kv(initial: Optional[Dict[str, str]] = None):
+    """Install (and on exit remove) a dict-backed cloud KV."""
+    global _MEM_KV
+    prev = _MEM_KV
+    _MEM_KV = dict(initial or {})
+    try:
+        yield _MEM_KV
+    finally:
+        _MEM_KV = prev
+
+
 def _kv_client():
     try:
         from jax._src import distributed as _dist
@@ -65,16 +87,44 @@ def _kv_client():
         return None
 
 
+class KVWriteError(RuntimeError):
+    """A cloud-KV write that neither landed nor was superseded by a
+    concurrent writer (retryable: transient coordination failure)."""
+
+
+class KVTransientError(RuntimeError):
+    """A cloud-KV read that failed at the transport layer (UNAVAILABLE /
+    connection reset — retryable), as opposed to an absent key's deadline
+    expiry (not retryable: it already waited its timeout)."""
+
+
+def _transient(e: BaseException) -> bool:
+    """Transport-level failures worth retrying, as opposed to an absent
+    key's deadline expiry (gRPC status text is all the client exposes)."""
+    s = str(e).upper()
+    return any(t in s for t in ("UNAVAILABLE", "CONNECTION", "RESET",
+                                "INTERNAL", "BROKEN PIPE"))
+
+
 def kv_put(key: str, value: str) -> bool:
     """Publish a small value cloud-wide; False when not in a multi-process
     cloud (callers treat local mode as a no-op). Upsert semantics like
-    DKV.put — re-publishing a key overwrites."""
+    DKV.put — re-publishing a key overwrites. Transient coordination
+    failures are absorbed by a bounded backoff-with-jitter retry budget
+    (water/RPC.java's resend schedule); False after exhaustion."""
+    if _MEM_KV is not None:
+        _MEM_KV[key] = value
+        return True
     c = _kv_client()
     if c is None:
         return False
-    try:
-        c.key_value_set(key, value, allow_overwrite=True)
-    except TypeError:      # older client without the kwarg
+
+    def _attempt():
+        try:
+            c.key_value_set(key, value, allow_overwrite=True)
+            return
+        except TypeError:      # older client without the kwarg
+            pass
         try:
             c.key_value_set(key, value)
         except Exception:  # noqa: BLE001 — ALREADY_EXISTS: delete + retry
@@ -84,21 +134,47 @@ def kv_put(key: str, value: str) -> bool:
             except Exception:   # noqa: BLE001
                 # a CONCURRENT writer winning leaves a value in place —
                 # success; a missing value means a real write failure
-                return kv_try_get(key) is not None
-    return True
+                if kv_try_get(key) is None:
+                    raise KVWriteError(f"kv_put({key!r}) did not land")
+
+    from h2o3_tpu.parallel import retry
+
+    try:
+        retry.retry_call(_attempt, describe=f"kv_put {key}")
+        return True
+    except Exception:   # noqa: BLE001 — budget exhausted
+        return False
 
 
 def kv_get(key: str, timeout_ms: int = 5000) -> Optional[str]:
+    """Blocking get with a server-side deadline. An absent key times out
+    (None); transient transport failures retry with backoff, a plain
+    deadline expiry does NOT (it already waited timeout_ms)."""
+    if _MEM_KV is not None:
+        return _MEM_KV.get(key)
     c = _kv_client()
     if c is None:
         return None
+    from h2o3_tpu.parallel import retry
+
+    def _get():
+        try:
+            return c.blocking_key_value_get(key, timeout_ms)
+        except Exception as e:   # noqa: BLE001 — absent key times out
+            if _transient(e):
+                raise KVTransientError(str(e)) from e
+            return None
+
     try:
-        return c.blocking_key_value_get(key, timeout_ms)
-    except Exception:   # noqa: BLE001 — absent key times out
+        return retry.retry_call(_get, retry_on=(KVTransientError,),
+                                describe=f"kv_get {key}")
+    except KVTransientError:
         return None
 
 
 def kv_try_get(key: str) -> Optional[str]:
+    if _MEM_KV is not None:
+        return _MEM_KV.get(key)
     c = _kv_client()
     if c is None:
         return None
@@ -110,6 +186,9 @@ def kv_try_get(key: str) -> Optional[str]:
 
 def kv_dir(prefix: str):
     """List (key, value) pairs under a prefix (key_value_dir_get)."""
+    if _MEM_KV is not None:
+        return [(k, v) for k, v in list(_MEM_KV.items())
+                if k.startswith(prefix)]
     c = _kv_client()
     if c is None:
         return []
@@ -120,6 +199,9 @@ def kv_dir(prefix: str):
 
 
 def kv_delete(key: str) -> None:
+    if _MEM_KV is not None:
+        _MEM_KV.pop(key, None)
+        return
     c = _kv_client()
     if c is not None:
         try:

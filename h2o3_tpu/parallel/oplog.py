@@ -13,6 +13,17 @@ collectives complete.
 Ops carry ONLY metadata (paths, keys, params) — data stays sharded on
 device; files are read from the shared filesystem by every process, the
 same contract the parse tier already uses.
+
+Supervision (water/RPC.java retry + HeartBeatThread failure propagation):
+every hand-off in this protocol is acknowledged and bounded. Followers
+write ``oplog/ack/{seq}/{proc}`` after each replay; the coordinator's
+`turn()` ends with `wait_acks(seq)` — a bounded wait that raises
+:class:`~h2o3_tpu.core.failure.CloudUnhealthyError` carrying the remote
+traceback from ``oplog/error/{seq}`` when a follower's replay crashed, or
+a timeout error when a follower went silent — instead of letting the next
+collective hang the REST handler forever. `publish()` retries lost KV
+puts with backoff and rolls back its claimed sequence slot on failure, so
+a lost op can never leave the follower stalled at a sequence gap.
 """
 
 from __future__ import annotations
@@ -22,9 +33,12 @@ import json
 import threading
 import time
 import traceback
-from typing import Any, Callable, Dict, Optional
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from h2o3_tpu.core import failure
 from h2o3_tpu.parallel import distributed as D
+from h2o3_tpu.parallel import retry
 
 _SEQ = 0
 _PREFIX = "oplog"
@@ -36,10 +50,56 @@ _RAPIDS_SESSIONS: Dict[str, Any] = {}     # follower-side session mirror
 # order from the follower's strictly sequential replay — a mesh deadlock.
 _EXEC_COND = threading.Condition()
 _NEXT_EXEC = 0
+# ops whose holder gave up (turn timeout) or died: the turnstile skips
+# them instead of waiting forever on a thread that will never arrive
+_ABANDONED: set = set()
+# the seq currently INSIDE its turn (None between turns): lets a timed-out
+# waiter tell a slow-but-alive head holder (leave it be) from one that
+# died before ever entering its turn (release its slot)
+_EXECUTING: Optional[int] = None
+# turnstile epoch: reset() bumps it, and a turn that entered under an
+# older epoch must NOT advance the new epoch's _NEXT_EXEC on exit — a
+# straggler op thread outliving a cloud restart would otherwise clobber
+# the restarted sequence mid-stream
+_GEN = 0
+# when the turnstile head last moved (advance/enter/exit), monotonic. A
+# waiter only declares the head holder DEAD if the head has sat idle —
+# parked on the same slot with nobody executing — for a full grace
+# window: a LIVE holder between publish and turn enters within one
+# cond-wait tick, so transient _EXECUTING==None gaps must not read as
+# death (they would sticky-FAIL a merely backlogged cloud)
+_HEAD_IDLE_SINCE = 0.0
+_HEAD_GRACE_S = 5.0
 # publish() runs on concurrent REST handler threads: sequence allocation
 # and the kv_put must be atomic or two ops can claim the same slot (one
 # overwrites the other in the KV and the follower stalls at the gap)
 _PUB_LOCK = threading.Lock()
+# coordinator-side seq -> op identity token. Acks are matched on the
+# TOKEN, not just the slot number: a rolled-back slot can be reclaimed by
+# a different op (that is the rollback contract), and an indeterminate
+# kv_put (reported lost but actually landed) can leave a follower ack for
+# the ORIGINAL op under the same seq — which must not satisfy wait_acks
+# for the reclaiming op.
+_OP_IDS: Dict[int, str] = {}
+_OP_IDS_CAP = 4096
+
+
+class OplogPublishError(RuntimeError):
+    """An op could not be durably published to the cloud KV (after the
+    retry budget); its claimed sequence slot was rolled back."""
+
+
+class OplogTurnTimeout(RuntimeError):
+    """The coordinator-side execution turnstile did not reach this op's
+    slot within the deadline — an earlier ticket holder is wedged or died
+    before entering its turn. The slot is abandoned (later ops skip it)."""
+
+
+class OplogAckError(RuntimeError):
+    """A follower replayed an op but could not durably write its ack (after
+    a second retry round on top of kv_put's own budget). The follower must
+    not proceed silently: to the coordinator a lost ack is
+    indistinguishable from this process dying."""
 
 
 # reentrancy guard: while the coordinator executes an op inside turn() (or
@@ -74,54 +134,398 @@ def active() -> bool:
     return D.process_count() > 1 and D.is_coordinator() and not _in_op()
 
 
+def _turn_timeout_s() -> float:
+    return retry.env_float("H2O_TPU_TURN_TIMEOUT_S", 1800.0)
+
+
+def _ack_timeout_s() -> float:
+    return retry.env_float("H2O_TPU_OP_ACK_TIMEOUT_S", 300.0)
+
+
+def reset(next_seq: int = 0) -> None:
+    """Reset the coordinator-side protocol state (sequence counter,
+    turnstile, abandoned slots). Test/bootstrap use only."""
+    global _SEQ, _NEXT_EXEC, _EXECUTING, _GEN, _HEAD_IDLE_SINCE
+    with _EXEC_COND:
+        _SEQ = next_seq
+        _NEXT_EXEC = next_seq
+        _EXECUTING = None
+        _GEN += 1
+        _HEAD_IDLE_SINCE = time.monotonic()
+        _ABANDONED.clear()
+        _OP_IDS.clear()
+        _EXEC_COND.notify_all()
+
+
 def publish(kind: str, payload: Dict[str, Any]) -> int:
     """Append one op (coordinator only); followers replay in sequence.
-    Returns the op's sequence number (the coordinator's execution ticket)."""
+    Returns the op's sequence number (the coordinator's execution ticket).
+
+    The KV put is retried with exponential backoff + jitter; if it still
+    does not land, the claimed sequence slot is rolled back and a clear
+    :class:`OplogPublishError` raises — the old silent-False path left
+    the follower stalled at a sequence gap forever."""
     global _SEQ
+    failure.faultpoint("oplog.publish")
+    # _PUB_LOCK spans claim + put: rollback is only sound while no LATER
+    # slot has been claimed (a gap would stall the follower forever). The
+    # hold is bounded — kv_put absorbs transient transport faults with its
+    # own small backoff budget; a put that still fails is a HARD loss that
+    # rolls back and raises (callers that must survive it, e.g. the
+    # scoring micro-batcher, retry the whole publish for a fresh slot).
     with _PUB_LOCK:
         seq = _SEQ
         _SEQ += 1
-        D.kv_put(f"{_PREFIX}/{seq}",
-                 json.dumps({"kind": kind, "payload": payload}))
+        op_id = uuid.uuid4().hex[:16]
+        ok, cause = False, None
+        try:
+            failure.faultpoint("oplog.kv_put")
+            ok = D.kv_put(f"{_PREFIX}/{seq}",
+                          json.dumps({"kind": kind, "payload": payload,
+                                      "op_id": op_id}))
+        except Exception as e:   # noqa: BLE001 — converted below
+            cause = e
+        if not ok:
+            _SEQ = seq           # gapless rollback: next publish reuses it
+            raise OplogPublishError(
+                f"failed to publish oplog op {seq} ({kind}): "
+                f"{cause or 'kv_put did not land'}") from cause
+        _OP_IDS[seq] = op_id     # reclaim overwrites: acks match THIS op
+        if len(_OP_IDS) > _OP_IDS_CAP:
+            for old in sorted(_OP_IDS)[: len(_OP_IDS) - _OP_IDS_CAP]:
+                del _OP_IDS[old]
     return seq
 
 
 def broadcast(kind: str, payload: Dict[str, Any]) -> Optional[int]:
     """Publish when this process is the coordinator of a live multi-process
     cloud; no-op single-process (the common local path pays nothing).
-    Returns the execution ticket (None single-process)."""
+    Returns the execution ticket (None single-process).
+
+    Degraded-mode fail-fast: when the supervisor has marked the cloud
+    DEGRADED/FAILED, new multi-process ops are refused immediately with a
+    clear CloudUnhealthyError instead of being queued toward a collective
+    the dead/stale follower will never join."""
     if active():
+        from h2o3_tpu.parallel import supervisor
+
+        supervisor.ensure_operable()
         return publish(kind, payload)
     return None
 
 
+def _neutralize_slots(slots: List[int], why: str) -> None:
+    """Best-effort cleanup for abandoned turnstile slots, OUTSIDE the
+    condition lock: overwrite each published op with a 'noop' (KV upsert
+    semantics) so a follower that has not reached it yet replays nothing
+    instead of running a program the coordinator never will. If a
+    follower ALREADY acked one of these ops, the divergence is certain —
+    the follower ran a program the coordinator never will — and the
+    cloud FAILs (sticky); otherwise it degrades with a hold. A follower
+    mid-replay that acks after the check is the residual race; the hold
+    window plus the next op's ack matching bounds how long that hides."""
+    diverged = []
+    for s in slots:
+        if acks_for(s, _OP_IDS.get(s)):
+            diverged.append(s)
+        try:
+            D.kv_put(f"{_PREFIX}/{s}",
+                     json.dumps({"kind": "noop",
+                                 "payload": {"abandoned": why}}))
+        except Exception:   # noqa: BLE001 — cleanup stays best-effort
+            pass
+    from h2o3_tpu.parallel import supervisor
+
+    if diverged:
+        supervisor.fail(f"abandoned op(s) {diverged} were already "
+                        f"replayed by a follower ({why}): program "
+                        "counters diverged")
+    else:
+        supervisor.degrade(f"turnstile abandoned op(s) {slots}: {why}",
+                           hold_s=failure.heartbeat_stale_s())
+
+
 @contextlib.contextmanager
-def turn(seq: Optional[int]):
+def turn(seq: Optional[int], timeout_s: Optional[float] = None):
     """Hold the coordinator's device-execution turnstile for op `seq`:
     ops run their device programs in exactly broadcast order, matching the
-    follower's sequential replay. No-op when seq is None."""
-    global _NEXT_EXEC
+    follower's sequential replay. No-op when seq is None.
+
+    Bounded: if the turnstile does not reach `seq` within `timeout_s`
+    (env ``H2O_TPU_TURN_TIMEOUT_S``), this raises
+    :class:`OplogTurnTimeout` and abandons `seq`'s slot so later ops skip
+    it; if the op at the head of the turnstile never ENTERED its turn
+    (its holder died between publish and turn — as opposed to being alive
+    inside a long device program), the head slot is released too, so ops
+    behind it do not each re-pay the full deadline. Abandoned slots are
+    neutralized to 'noop' in the KV and the cloud is degraded.
+    On successful completion the coordinator waits (bounded, env
+    ``H2O_TPU_OP_ACK_TIMEOUT_S``) for every follower's replay ack."""
+    global _NEXT_EXEC, _EXECUTING, _HEAD_IDLE_SINCE
     if seq is None:
         yield
         return
+    if timeout_s is None:
+        timeout_s = _turn_timeout_s()
+    deadline = time.monotonic() + timeout_s
+    abandoned: List[int] = []
     with _EXEC_COND:
-        while _NEXT_EXEC != seq:
-            _EXEC_COND.wait(timeout=1.0)
+        my_gen = _GEN
+        while True:
+            if _GEN != my_gen:
+                raise OplogTurnTimeout(
+                    f"turnstile was reset (cloud restart) while op {seq} "
+                    "waited — op not executed")
+            if seq < _NEXT_EXEC or seq in _ABANDONED:
+                # a timed-out waiter released this slot presuming its
+                # holder dead; executing now would be out of broadcast
+                # order — refuse (the op in the KV is already a noop).
+                # If the turnstile is parked ON this slot, advance it so
+                # waiters behind do not stall on a holder that just left.
+                if _NEXT_EXEC == seq:
+                    _ABANDONED.discard(seq)
+                    _NEXT_EXEC = seq + 1
+                    while _NEXT_EXEC in _ABANDONED:
+                        _ABANDONED.discard(_NEXT_EXEC)
+                        _NEXT_EXEC += 1
+                    _HEAD_IDLE_SINCE = time.monotonic()
+                    _EXEC_COND.notify_all()
+                raise OplogTurnTimeout(
+                    f"op {seq}'s turnstile slot was abandoned (holder "
+                    "presumed dead after a waiter's deadline) — op not "
+                    "executed")
+            while _NEXT_EXEC in _ABANDONED:
+                _ABANDONED.discard(_NEXT_EXEC)
+                _NEXT_EXEC += 1
+                _HEAD_IDLE_SINCE = time.monotonic()
+                _EXEC_COND.notify_all()
+            if _NEXT_EXEC == seq:
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                stuck = _NEXT_EXEC
+                abandoned.append(seq)
+                _ABANDONED.add(seq)
+                # release the head slot ONLY if its holder never entered
+                # for a full grace window: a LIVE holder between publish
+                # and turn enters within one cond-wait tick, so a
+                # transient _EXECUTING gap right after the previous op's
+                # exit must not read as death on a busy-but-healthy cloud
+                grace = min(_HEAD_GRACE_S, timeout_s)
+                if _EXECUTING != stuck and \
+                        time.monotonic() - _HEAD_IDLE_SINCE >= grace:
+                    abandoned.append(stuck)
+                    _ABANDONED.add(stuck)
+                _EXEC_COND.notify_all()
+                break
+            _EXEC_COND.wait(timeout=min(remaining, 1.0))
+        if abandoned:
+            head_note = (f"; released never-entered head slot "
+                         f"{abandoned[1]}" if len(abandoned) > 1 else "")
+            err = OplogTurnTimeout(
+                f"op {seq} waited {timeout_s:.1f}s for the execution "
+                f"turnstile (stuck at op {_NEXT_EXEC} — its holder is "
+                f"wedged or died); slot {seq} abandoned{head_note}")
+        else:
+            _EXECUTING = seq
+            _HEAD_IDLE_SINCE = time.monotonic()
+    if abandoned:
+        _neutralize_slots(abandoned, f"turn timeout after {timeout_s:.1f}s")
+        raise err
     _TLS.in_op = True
     try:
         yield
     finally:
         _TLS.in_op = False
         with _EXEC_COND:
-            _NEXT_EXEC = seq + 1
-            _EXEC_COND.notify_all()
+            if _GEN == my_gen:
+                _EXECUTING = None
+                _NEXT_EXEC = seq + 1
+                while _NEXT_EXEC in _ABANDONED:
+                    _ABANDONED.discard(_NEXT_EXEC)
+                    _NEXT_EXEC += 1
+                _HEAD_IDLE_SINCE = time.monotonic()
+                _EXEC_COND.notify_all()
+            # else: the turnstile was reset() (cloud restart) while this
+            # op was in flight — a straggler must not clobber the new
+            # epoch's sequence position
+    # reached only when the body completed: bounded follower-ack wait, so a
+    # dead/crashed follower surfaces HERE as a clear error instead of
+    # hanging the NEXT collective this handler (or any later op) runs
+    wait_acks(seq)
+
+
+# ---------------------------------------------------------------------------
+# acknowledgment protocol
+# ---------------------------------------------------------------------------
+
+def expected_acks() -> int:
+    """Follower count: every non-coordinator process acks each replay."""
+    return max(D.process_count() - 1, 0)
+
+
+def acks_for(seq: int, op_id: Optional[str] = None) -> List[str]:
+    """Ack keys recorded for op `seq`; with `op_id`, only acks carrying
+    that identity token (stale acks from a lost-then-landed op whose slot
+    was rolled back and reclaimed do not count for the reclaiming op)."""
+    out = []
+    for k, v in D.kv_dir(f"{_PREFIX}/ack/{seq}/"):
+        if op_id is not None:
+            try:
+                if json.loads(v).get("op_id") != op_id:
+                    continue
+            except (ValueError, AttributeError):
+                continue
+        out.append(k)
+    return out
+
+
+def error_for(seq: int) -> Optional[dict]:
+    raw = D.kv_try_get(f"{_PREFIX}/error/{seq}")
+    if raw is None:
+        return None
+    try:
+        return json.loads(raw)
+    except ValueError:
+        return {"kind": "?", "trace": str(raw)}
+
+
+def error_records() -> List[Tuple[int, dict]]:
+    """All follower replay failures, as (seq, {kind, trace}) sorted by seq
+    (the supervisor folds these into the cloud health state)."""
+    out = []
+    for k, v in D.kv_dir(f"{_PREFIX}/error/"):
+        try:
+            seq = int(k.rsplit("/", 1)[-1])
+            out.append((seq, json.loads(v)))
+        except (ValueError, TypeError):
+            continue
+    return sorted(out, key=lambda t: t[0])
+
+
+def wait_acks(seq: Optional[int], timeout_s: Optional[float] = None) -> None:
+    """Bounded wait until every follower acked replaying op `seq`.
+
+    Raises :class:`~h2o3_tpu.core.failure.CloudUnhealthyError` — carrying
+    the follower's traceback when its replay crashed (``oplog/error/{seq}``
+    appears), or a timeout diagnosis when a follower went silent. Either
+    way the supervisor is notified so the cloud health state degrades and
+    subsequent multi-process ops are refused fast. No-op single-process,
+    with acks disabled (timeout <= 0), or for a None ticket."""
+    if seq is None:
+        return
+    n = expected_acks()
+    if n <= 0:
+        return
+    if timeout_s is None:
+        timeout_s = _ack_timeout_s()
+    if timeout_s <= 0:
+        return
+    from h2o3_tpu.parallel import supervisor
+
+    poll = retry.AdaptivePoll(min_s=0.001, max_s=0.25)
+    deadline = time.monotonic() + timeout_s
+    while True:
+        err = error_for(seq)
+        if err is not None:
+            trace = str(err.get("trace", ""))
+            if err.get("fatal", True):
+                msg = (f"follower replay of op {seq} ({err.get('kind', '?')}) "
+                       f"crashed")
+                supervisor.fail(msg, trace)
+            else:
+                # e.g. a lost ack write: the replay itself succeeded, so
+                # states did not diverge — degrade, don't sticky-FAIL
+                msg = (f"follower reported a non-fatal oplog fault at op "
+                       f"{seq} ({err.get('kind', '?')})")
+                supervisor.degrade(msg, hold_s=failure.heartbeat_stale_s())
+            raise failure.CloudUnhealthyError(msg, remote_trace=trace)
+        if supervisor.state() == supervisor.FAILED:
+            # the cloud already failed on ANOTHER op's evidence (a replay
+            # crash elsewhere in the stream): no ack for this op is ever
+            # coming — bail now with that diagnosis, not a generic
+            # timeout 300s later
+            st = supervisor.status()
+            raise failure.CloudUnhealthyError(
+                f"cloud FAILED while waiting for op {seq} acks: "
+                f"{st['reason']}", remote_trace=st["remote_trace"])
+        got = len(acks_for(seq, _OP_IDS.get(seq)))
+        if got >= n:
+            return
+        if time.monotonic() >= deadline:
+            msg = (f"op {seq}: {got}/{n} follower acks within "
+                   f"{timeout_s:.1f}s — follower dead or stalled "
+                   f"(H2O_TPU_OP_ACK_TIMEOUT_S bounds this wait)")
+            # event-derived degrade: hold it past the next heartbeat
+            # evaluation so fresh beats from a wedged-but-beating peer do
+            # not instantly erase the evidence
+            supervisor.degrade(msg, hold_s=failure.heartbeat_stale_s())
+            raise failure.CloudUnhealthyError(msg)
+        poll.wait()
 
 
 # ---------------------------------------------------------------------------
 # follower side
 # ---------------------------------------------------------------------------
 
+def _ack(seq: int, op_id: Optional[str] = None) -> None:
+    """Record this process's replay acknowledgment for op `seq`, carrying
+    the op's identity token so the coordinator can tell this replay from
+    one of a lost op that previously occupied the same slot.
+
+    A lost ack write is NOT swallowed: silently proceeding would convert a
+    SUCCESSFUL replay into a full coordinator ``wait_acks`` stall plus a
+    misleading "follower dead" degrade. After a second retry round (on top
+    of kv_put's own budget) this best-effort records a NON-fatal error for
+    the op — ``wait_acks`` surfaces it immediately with the true story
+    instead of a generic timeout, and the supervisor degrades (states did
+    not diverge, so the cloud is not FAILED) — then raises
+    :class:`OplogAckError`: a follower that cannot write acks cannot
+    participate."""
+    import jax
+
+    failure.faultpoint("oplog.ack")
+    proc = jax.process_index()
+    key = f"{_PREFIX}/ack/{seq}/{proc}"
+    val = json.dumps({"proc": proc, "ts": time.time(), "op_id": op_id})
+    ok = D.kv_put(key, val)
+    for delay in retry.backoff_delays():
+        if ok:
+            return
+        time.sleep(delay)
+        ok = D.kv_put(key, val)
+    if ok:
+        return
+    msg = (f"process {proc} replayed op {seq} but could not write its ack "
+           f"({key}) — replay succeeded, states did not diverge, but this "
+           f"process can no longer confirm replays")
+    _record_error(seq, "ack", msg, fatal=False)
+    raise OplogAckError(msg)
+
+
+def _record_error(seq: int, kind: str, trace: str, fatal: bool = True) -> None:
+    """Best-effort publish of a follower-side failure for op `seq` so the
+    coordinator's ``wait_acks`` and the supervisor see the real story
+    instead of a bare timeout. `fatal=False` marks faults where the replay
+    itself did NOT diverge (e.g. a lost ack write) — the supervisor
+    degrades instead of sticky-FAILing. A loss of the error record itself
+    is logged loudly: there is no further channel left."""
+    if not D.kv_put(f"{_PREFIX}/error/{seq}",
+                    json.dumps({"kind": kind, "trace": trace[-4000:],
+                                "fatal": bool(fatal)})):
+        from h2o3_tpu.utils.log import get_logger
+
+        get_logger().error(
+            "oplog: error record for op %d (%s) could not be written — the "
+            "coordinator will only see a generic ack timeout: %s",
+            seq, kind, trace[-500:])
+
+
 def _apply(kind: str, p: Dict[str, Any]) -> None:
+    if kind == "noop":
+        # liveness probe / chaos-test vehicle: replay + ack with no
+        # framework work
+        return
     if kind == "import_file":
         from h2o3_tpu.ingest.parser import import_file
 
@@ -258,27 +662,36 @@ def follower_loop(idle_timeout_s: float = 120.0,
                   on_op: Optional[Callable[[str, dict], None]] = None) -> int:
     """Replay coordinator ops until a 'shutdown' op (or idle timeout).
     Returns the number of ops applied. Runs on every non-coordinator
-    process of a multi-process cloud whose coordinator serves REST."""
+    process of a multi-process cloud whose coordinator serves REST.
+
+    Each successful replay is acknowledged (``oplog/ack/{seq}/{proc}``);
+    a replay crash is surfaced to the cloud (``oplog/error/{seq}`` with
+    the traceback) BEFORE re-raising, so the coordinator's `wait_acks`
+    and the supervisor see the failure instead of a bare collective hang.
+    Polling is adaptive (1→250 ms): hot while ops stream, cheap idle."""
     i, applied = 0, 0
+    poll = retry.AdaptivePoll(min_s=0.001, max_s=0.25)
     deadline = time.time() + idle_timeout_s
     while time.time() < deadline:
         raw = D.kv_try_get(f"{_PREFIX}/{i}")
         if raw is None:
-            time.sleep(0.05)
+            poll.wait()
             continue
+        poll.reset()
         op = json.loads(raw)
         if op["kind"] == "shutdown":
+            _ack(i, op.get("op_id"))
             return applied
         try:
+            failure.faultpoint("oplog.replay")
             _apply(op["kind"], op["payload"])
         except Exception:
             # surface the replay failure to the cloud BEFORE dying: the
             # coordinator (and operators reading /3/Cloud health) see the
             # error instead of a bare collective hang
-            D.kv_put(f"{_PREFIX}/error/{i}",
-                     json.dumps({"kind": op["kind"],
-                                 "trace": traceback.format_exc()[-4000:]}))
+            _record_error(i, op["kind"], traceback.format_exc())
             raise
+        _ack(i, op.get("op_id"))
         if on_op is not None:
             on_op(op["kind"], op["payload"])
         applied += 1

@@ -1,0 +1,127 @@
+"""Bounded retry with exponential backoff + jitter, and adaptive polling.
+
+Reference: water/RPC.java retries every remote task on a doubling backoff
+schedule (RPC.java `_retry`: resend with exponentially growing delay until
+the target answers or is declared dead). The control-plane calls here —
+coordination-service KV puts/gets, oplog publishes, follower polls — get
+the same treatment: transient coordination hiccups are absorbed by a small
+bounded retry budget, and genuine failures surface quickly instead of
+either hanging or failing on the first blip.
+
+Env knobs (documented in README "Robustness & fault tolerance"):
+- ``H2O_TPU_RETRY_MAX``      attempts per call (default 3)
+- ``H2O_TPU_RETRY_BASE_MS``  first backoff delay (default 10 ms)
+- ``H2O_TPU_RETRY_MAX_MS``   backoff cap (default 2000 ms)
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from typing import Callable, Iterator, Optional, Tuple, Type
+
+
+def env_float(name: str, default: float) -> float:
+    """Float env knob with fallback (shared by every supervision tunable:
+    retry budget, ack/turn timeouts, heartbeat staleness, poll interval)."""
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def max_attempts() -> int:
+    try:
+        n = int(os.environ.get("H2O_TPU_RETRY_MAX", "") or 3)
+    except ValueError:
+        n = 3
+    return max(1, n)
+
+
+def base_delay_s() -> float:
+    return max(env_float("H2O_TPU_RETRY_BASE_MS", 10.0), 0.0) / 1000.0
+
+
+def max_delay_s() -> float:
+    return max(env_float("H2O_TPU_RETRY_MAX_MS", 2000.0), 1.0) / 1000.0
+
+
+def backoff_delays(attempts: Optional[int] = None,
+                   base_s: Optional[float] = None,
+                   max_s: Optional[float] = None,
+                   jitter: float = 0.5,
+                   rng=None) -> Iterator[float]:
+    """Yield the ``attempts - 1`` sleep durations between attempts:
+    ``base * 2^i`` capped at ``max_s``, each multiplied by a uniform
+    ``1 ± jitter`` factor so a fleet of processes retrying the same dead
+    peer doesn't stampede in lockstep."""
+    attempts = max_attempts() if attempts is None else attempts
+    base = base_delay_s() if base_s is None else base_s
+    cap = max_delay_s() if max_s is None else max_s
+    rnd = rng or random
+    for i in range(max(attempts - 1, 0)):
+        d = min(base * (2.0 ** i), cap)
+        if jitter > 0:
+            d *= 1.0 + jitter * (2.0 * rnd.random() - 1.0)
+        yield max(d, 0.0)
+
+
+def retry_call(fn: Callable, *args,
+               retries: Optional[int] = None,
+               base_s: Optional[float] = None,
+               max_s: Optional[float] = None,
+               retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+               describe: str = "",
+               on_retry: Optional[Callable[[int, BaseException], None]] = None,
+               sleep: Callable[[float], None] = time.sleep,
+               **kwargs):
+    """Call ``fn(*args, **kwargs)`` with bounded exponential-backoff-plus-
+    jitter retries on ``retry_on`` exceptions; the final attempt's exception
+    propagates unwrapped (callers keep their existing except clauses)."""
+    attempts = max_attempts() if retries is None else max(1, retries)
+    delays = backoff_delays(attempts, base_s, max_s)
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            if attempt >= attempts:
+                raise
+            if on_retry is not None:
+                try:
+                    on_retry(attempt, e)
+                except Exception:   # noqa: BLE001 — observer must not kill
+                    pass            # the retry loop it observes
+            from h2o3_tpu.utils.log import get_logger
+
+            get_logger().warning("retrying %s (attempt %d/%d): %s",
+                                 describe or getattr(fn, "__name__", "call"),
+                                 attempt, attempts, e)
+            sleep(next(delays))
+
+
+class AdaptivePoll:
+    """Adaptive busy-wait: starts hot (1 ms — a follower mid-replay-stream
+    sees the next op almost instantly) and decays exponentially to a cold
+    cap (250 ms — an idle follower costs ~4 KV reads/s instead of 20).
+    ``reset()`` on activity snaps back to the hot end."""
+
+    def __init__(self, min_s: float = 0.001, max_s: float = 0.25,
+                 factor: float = 2.0,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.min_s = float(min_s)
+        self.max_s = float(max_s)
+        self.factor = float(factor)
+        self._sleep = sleep
+        self._cur = self.min_s
+
+    @property
+    def current_s(self) -> float:
+        return self._cur
+
+    def wait(self) -> None:
+        self._sleep(self._cur)
+        self._cur = min(self._cur * self.factor, self.max_s)
+
+    def reset(self) -> None:
+        self._cur = self.min_s

@@ -1,0 +1,250 @@
+"""Cloud supervision: fold liveness + oplog errors into one health state.
+
+Reference: water/HeartBeatThread.java — a node that misses enough beats is
+declared dead and the cloud reacts (jobs against it fail, new work is
+refused) instead of hanging. Podracer-style TPU fleets (arXiv:2104.06272)
+need the same property layered over the collective runtime: a dead peer
+otherwise manifests only as an indefinite hang inside the next collective.
+
+This module is that layer for the REST-driven cloud:
+
+- a **state machine** HEALTHY → DEGRADED → FAILED. Stale heartbeats
+  degrade the cloud (and it recovers when beats resume); a follower
+  replay crash (an ``oplog/error/{seq}`` key) fails it permanently — the
+  per-process program counters have diverged and only a cloud restart
+  recovers that.
+- a **supervisor thread** on the coordinator that re-evaluates the state
+  every ``H2O_TPU_SUPERVISE_INTERVAL_S`` (default 2 s) and, on failure,
+  marks every in-flight Job FAILED with the follower's traceback (their
+  worker threads may be wedged inside a dead collective and never unwind).
+- **degraded-mode fail-fast**: `ensure_operable()` — called by
+  ``oplog.broadcast`` — refuses new multi-process ops immediately with a
+  clear :class:`~h2o3_tpu.core.failure.CloudUnhealthyError`. Coordinator-
+  local (single-process) scoring keeps serving.
+
+Surfaced via ``GET /3/Cloud`` (``cloud_status`` field) and the dedicated
+``GET /3/CloudStatus`` route.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from h2o3_tpu.parallel import retry
+
+HEALTHY, DEGRADED, FAILED = "HEALTHY", "DEGRADED", "FAILED"
+
+# re-entrant: evaluate() must hold it across its hold_until check AND the
+# recover() transition, or a degrade(hold_s=...) landing between the two is
+# instantly erased together with its hold
+_LOCK = threading.RLock()
+_STATE: Dict = {"state": HEALTHY, "since": time.time(), "reason": "",
+                "remote_trace": "", "hold_until": 0.0}
+_TRANSITIONS: List[dict] = []          # bounded history for /3/CloudStatus
+_TRANSITIONS_MAX = 64
+# first evaluate() timestamp: the grace window for processes that have
+# NEVER heartbeat (a follower that died at startup has no stale row to
+# trip on — absence past the staleness window is itself the signal)
+_FIRST_EVAL_TS: Optional[float] = None
+
+
+def interval_s() -> float:
+    return retry.env_float("H2O_TPU_SUPERVISE_INTERVAL_S", 2.0)
+
+
+def state() -> str:
+    with _LOCK:
+        return _STATE["state"]
+
+
+def status() -> Dict:
+    """Snapshot for the REST surface: current state + why + history."""
+    with _LOCK:
+        out = dict(_STATE)
+        out["transitions"] = list(_TRANSITIONS)
+    return out
+
+
+def reset() -> None:
+    """Back to HEALTHY with a clean history (cloud restart / tests)."""
+    global _FIRST_EVAL_TS
+    with _LOCK:
+        _STATE.update(state=HEALTHY, since=time.time(), reason="",
+                      remote_trace="", hold_until=0.0)
+        _TRANSITIONS.clear()
+        _FIRST_EVAL_TS = None
+
+
+def _transition(new: str, reason: str, remote_trace: str = "") -> bool:
+    """Move to `new` if legal; returns True when the state changed.
+    FAILED is sticky: replay divergence is unrecoverable without a cloud
+    restart, so nothing transitions out of it except reset()."""
+    with _LOCK:
+        cur = _STATE["state"]
+        if cur == new or cur == FAILED:
+            return False
+        _STATE.update(state=new, since=time.time(), reason=reason,
+                      remote_trace=remote_trace)
+        _TRANSITIONS.append({"ts": _STATE["since"], "from": cur, "to": new,
+                             "reason": reason})
+        if len(_TRANSITIONS) > _TRANSITIONS_MAX:
+            del _TRANSITIONS[: len(_TRANSITIONS) - _TRANSITIONS_MAX]
+    from h2o3_tpu.utils import timeline
+    from h2o3_tpu.utils.log import get_logger
+
+    log = get_logger()
+    (log.error if new == FAILED else log.warning)(
+        "cloud %s -> %s: %s", cur, new, reason)
+    timeline.record("cloud", f"{cur}->{new}", reason=reason)
+    return True
+
+
+def degrade(reason: str, hold_s: float = 0.0) -> None:
+    """Mark the cloud DEGRADED: new multi-process ops are refused until it
+    recovers. `hold_s` pins the state for at least that long — degrades
+    whose evidence is NOT in the heartbeat table (ack timeouts, abandoned
+    turnstile slots: the peer may be wedged yet still beating) must not be
+    erased by the supervisor's next fresh-heartbeat evaluation."""
+    changed = _transition(DEGRADED, reason)
+    with _LOCK:
+        if _STATE["state"] != DEGRADED:
+            return
+        if not changed:
+            # already degraded: the newest evidence becomes the headline
+            # (operators reading /3/CloudStatus see why it is STILL down)
+            _STATE["reason"] = reason
+        if hold_s > 0:
+            _STATE["hold_until"] = max(_STATE.get("hold_until", 0.0),
+                                       time.time() + hold_s)
+
+
+def recover(reason: str = "heartbeats fresh, no oplog errors") -> None:
+    """DEGRADED → HEALTHY when liveness evidence returns (never from
+    FAILED — that needs a cloud restart)."""
+    if _transition(HEALTHY, reason):
+        with _LOCK:
+            _STATE["hold_until"] = 0.0
+
+
+def fail(reason: str, remote_trace: str = "") -> None:
+    """Mark the cloud FAILED (follower replay crash: program counters
+    diverged) and fail every in-flight Job with the remote traceback."""
+    if _transition(FAILED, reason, remote_trace):
+        _fail_running_jobs(reason, remote_trace)
+
+
+def ensure_operable() -> None:
+    """Degraded-mode fail-fast for new multi-process ops."""
+    from h2o3_tpu.core.failure import CloudUnhealthyError
+
+    with _LOCK:
+        st, reason, trace = (_STATE["state"], _STATE["reason"],
+                             _STATE["remote_trace"])
+    if st != HEALTHY:
+        raise CloudUnhealthyError(
+            f"cloud is {st} ({reason}) — refusing new multi-process op; "
+            "single-process scoring stays available", remote_trace=trace)
+
+
+def _fail_running_jobs(reason: str, remote_trace: str) -> None:
+    from h2o3_tpu.core.dkv import DKV
+    from h2o3_tpu.core.job import Job
+
+    msg = f"cloud FAILED while this job was in flight: {reason}"
+    if remote_trace:
+        msg += f"\n--- remote traceback ---\n{remote_trace}"
+    for k in list(DKV.keys()):
+        j = DKV.get(k)
+        if isinstance(j, Job) and j.is_running:
+            j.fail(msg)
+
+
+def evaluate() -> str:
+    """One supervision pass: fold oplog error keys and the heartbeat table
+    into the state machine. Returns the resulting state. Deterministic and
+    thread-free — the chaos tests drive it directly; the Supervisor thread
+    just calls it on a timer."""
+    global _FIRST_EVAL_TS
+    from h2o3_tpu.core import failure
+    from h2o3_tpu.parallel import distributed as D
+    from h2o3_tpu.parallel import oplog
+
+    failure.faultpoint("supervisor.evaluate")
+    if _FIRST_EVAL_TS is None:
+        _FIRST_EVAL_TS = time.time()
+    errors = oplog.error_records()
+    fatal = [(s, r) for s, r in errors if r.get("fatal", True)]
+    if fatal:
+        seq, rec = fatal[0]
+        fail(f"follower replay of op {seq} ({rec.get('kind', '?')}) crashed",
+             str(rec.get("trace", "")))
+        return state()
+    if errors:
+        # non-fatal follower faults only (e.g. a lost ack write after a
+        # successful replay): the op stream did not diverge — degrade, and
+        # hold so fresh beats from the faulting peer don't erase it while
+        # the record stands
+        seq, rec = errors[0]
+        degrade(f"follower non-fatal oplog fault at op {seq} "
+                f"({rec.get('kind', '?')}): "
+                f"{str(rec.get('trace', ''))[-200:]}",
+                hold_s=failure.heartbeat_stale_s())
+        return state()
+    health = failure.cluster_health()
+    expected = D.process_count()
+    if expected > 1:
+        stale_s = failure.heartbeat_stale_s()
+        dead = [r for r in health if not r["healthy"]]
+        missing = expected - len(health)
+        if dead:
+            degrade("stale heartbeat from process(es) "
+                    f"{[r['process'] for r in dead]} (age > {stale_s:.1f}s)")
+        elif missing > 0 and time.time() - _FIRST_EVAL_TS > stale_s:
+            # a process that NEVER beat has no stale row to trip on —
+            # absence past the staleness window is the death signal (a
+            # follower that crashed at startup)
+            degrade(f"{missing} process(es) have never heartbeat "
+                    f"(> {stale_s:.1f}s after supervision start)")
+        elif health and missing <= 0:
+            with _LOCK:
+                # check-and-recover under one lock acquisition: a concurrent
+                # degrade(hold_s=...) from an ack-timeout handler must either
+                # land before (hold observed, no recovery) or after (its hold
+                # survives the transition) — never in between
+                if time.time() >= _STATE.get("hold_until", 0.0):
+                    # fresh beats only recover once any event-derived degrade
+                    # (ack timeout / turnstile abandonment) has aged out — a
+                    # wedged peer can keep beating while not replaying
+                    recover()
+    return state()
+
+
+class Supervisor:
+    """Background evaluator (coordinator-side HeartBeatThread analog)."""
+
+    def __init__(self, interval: Optional[float] = None):
+        self.interval = interval_s() if interval is None else float(interval)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Supervisor":
+        def run():
+            while not self._stop.wait(self.interval):
+                try:
+                    evaluate()
+                except Exception:   # noqa: BLE001 — a transient KV hiccup
+                    pass            # must not kill supervision for good
+
+        try:
+            evaluate()
+        except Exception:   # noqa: BLE001
+            pass
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="h2o3-supervisor")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -149,6 +149,7 @@ class ScoringSession:
                                    self.forest.nclasses,
                                    self.forest.per_class_trees)
         self._traced: set = set()        # buckets compiled so far
+        self._local_cache = None         # degraded-mode forest array copies
         self.stats = SessionStats()
 
     # -- feature packing ---------------------------------------------------
@@ -168,17 +169,44 @@ class ScoringSession:
         return self.buckets[-1]
 
     # -- bucketed dispatch -------------------------------------------------
-    def _margin_x(self, X: np.ndarray) -> np.ndarray:
+    def _local_arrays(self):
+        """Coordinator-local copies of the device-resident forest arrays
+        for degraded-cloud serving: the training-time originals may be laid
+        out over the GLOBAL mesh, and any dispatch against that mesh is an
+        SPMD program a dead follower will never join. Host-roundtripped
+        once per session and cached; raises when the arrays themselves need
+        the dead peer."""
+        if self._local_cache is None:
+            import jax.numpy as jnp
+
+            from h2o3_tpu.core.failure import CloudUnhealthyError
+
+            for a in self._arrays:
+                if not getattr(a, "is_fully_addressable", True):
+                    raise CloudUnhealthyError(
+                        "cloud degraded and the model's forest arrays have "
+                        "non-coordinator shards — cannot score without the "
+                        "dead peer")
+            self._local_cache = tuple(jnp.asarray(np.asarray(a))
+                                      for a in self._arrays)
+        return self._local_cache
+
+    def _margin_x(self, X: np.ndarray, local: bool = False) -> np.ndarray:
         """Margins for an (n, F) feature matrix via bucketed fused
         dispatch; returns host (n,) or (n, K) float32, exact per row.
         Rows beyond the largest bucket are chunked at it, so the set of
-        compiled traversal programs never exceeds len(self.buckets)."""
+        compiled traversal programs never exceeds len(self.buckets).
+        `local=True` (degraded-cloud serving on a real multi-process cloud)
+        dispatches on this process's default device with NO mesh sharding —
+        the global row sharding would be a collective the dead peer never
+        runs."""
         import jax
 
         n = X.shape[0]
         maxb = self.buckets[-1]
         outs: List[np.ndarray] = []
-        sharding = self._cl.row_sharding()
+        sharding = None if local else self._cl.row_sharding()
+        arrays = self._local_arrays() if local else self._arrays
         pos = 0
         while pos < n:
             chunk = X[pos: pos + maxb]
@@ -186,9 +214,10 @@ class ScoringSession:
             bucket = self._bucket_for(m)
             buf = np.zeros((bucket, X.shape[1]), np.float32)
             buf[:m] = chunk
-            xd = jax.device_put(buf, sharding)
+            xd = jax.device_put(buf) if local else jax.device_put(buf,
+                                                                  sharding)
             out = self._fn(xd, self._edges, self._is_cat, self._init,
-                           *self._arrays)
+                           *arrays)
             self._traced.add(bucket)
             outs.append(np.asarray(out)[:m])
             pos += m
@@ -204,21 +233,25 @@ class ScoringSession:
         return len(self._traced)
 
     # -- request-level API -------------------------------------------------
-    def _raw_for_slice(self, margin: np.ndarray, n: int):
+    def _raw_for_slice(self, margin: np.ndarray, n: int,
+                       local: bool = False):
         """Pad an exact (n,)/(n, K) margin slice back out to the cluster's
         padded row count and lift to a row-sharded device array, then run
         the model's margin→raw post-processing. Pad rows carry zeros; they
         are weight-masked out of metrics and sliced off of frames, exactly
-        like the generic path's NA-binned pad rows."""
+        like the generic path's NA-binned pad rows. `local=True` keeps the
+        identical padded shape but stays on this process's devices (no
+        cluster `put_rows` — that is a global-mesh materialization)."""
         import jax.numpy as jnp
 
         padded = self._cl.pad_rows(n)
         buf = np.zeros((padded,) + margin.shape[1:], np.float32)
         buf[:n] = margin
-        f = self._cl.put_rows(buf)
+        f = buf if local else self._cl.put_rows(buf)
         return self.model._margin_to_raw(jnp.asarray(f))
 
-    def predict_batch(self, entries: List[Tuple[Any, Optional[str], bool]]):
+    def predict_batch(self, entries: List[Tuple[Any, Optional[str], bool]],
+                      local_only: bool = False):
         """Score a coalesced batch: entries = [(frame, dest_key,
         with_metrics)]. Returns [(prediction_frame, metrics_or_None)] in
         entry order; prediction frames are installed under dest_key.
@@ -227,11 +260,30 @@ class ScoringSession:
         rows. Multi-process cloud: the entries run through the generic
         predict path sequentially INSIDE the one op — followers replay the
         identical program sequence (the fused path's host-side feature
-        packing cannot see non-addressable shards)."""
+        packing cannot see non-addressable shards).
+
+        `local_only=True` is degraded-cloud serving: the followers are
+        dead or stale, so no cross-process program may run. The fused
+        host-packed path serves from this process alone — local-device
+        dispatch, never the global mesh — when every column is addressable
+        here; non-addressable shards raise CloudUnhealthyError (scoring
+        them NEEDS the dead peer)."""
         import jax
 
         t0 = time.perf_counter()
-        if jax.process_count() > 1:
+        local_mp = local_only and jax.process_count() > 1
+        if local_mp:
+            from h2o3_tpu.core.failure import CloudUnhealthyError
+
+            for frame, _, _ in entries:
+                for nm in frame.names:
+                    if not getattr(frame.col(nm).data,
+                                   "is_fully_addressable", True):
+                        raise CloudUnhealthyError(
+                            f"cloud degraded and frame {frame.key} has "
+                            f"non-coordinator shards (column {nm!r}) — "
+                            "cannot score without the dead peer")
+        if jax.process_count() > 1 and not local_only:
             results = []
             for frame, dest, with_metrics in entries:
                 pred = self.model.predict(frame, key=dest)
@@ -247,11 +299,12 @@ class ScoringSession:
             X = np.concatenate([self._features(a, n)
                                 for a, n in zip(adapteds, ns)]) \
                 if entries else np.zeros((0, self.spec.F), np.float32)
-            margins = self._margin_x(X)
+            margins = self._margin_x(X, local=local_mp)
             results = []
             off = 0
             for (frame, dest, with_metrics), n in zip(entries, ns):
-                raw = self._raw_for_slice(margins[off: off + n], n)
+                raw = self._raw_for_slice(margins[off: off + n], n,
+                                          local=local_mp)
                 off += n
                 pred = self.model._raw_to_frame(raw, n, key=dest)
                 pred.install()
@@ -341,11 +394,13 @@ class _Pending:
         self.promoted = False      # woken to take over flush leadership
 
 
-def execute_batch(model, entries: List[Tuple[Any, Optional[str], bool]]):
+def execute_batch(model, entries: List[Tuple[Any, Optional[str], bool]],
+                  local_only: bool = False):
     """Run one coalesced batch (shared by the coordinator's flush and the
     follower's oplog replay, so both sides execute the identical device
-    program sequence)."""
-    return session_for(model).predict_batch(entries)
+    program sequence). `local_only` is the degraded-cloud serving mode:
+    no cross-process program, coordinator-addressable data only."""
+    return session_for(model).predict_batch(entries, local_only=local_only)
 
 
 class ScoreBatcher:
@@ -445,7 +500,7 @@ class ScoreBatcher:
 
     @staticmethod
     def _flush(model, batch: List[_Pending]) -> None:
-        from h2o3_tpu.parallel import oplog
+        from h2o3_tpu.parallel import oplog, retry, supervisor
 
         try:
             # broadcast ONE op for the whole batch; followers replay it
@@ -453,16 +508,46 @@ class ScoreBatcher:
             # pre-broadcast in the REST handler, so coordinator and
             # follower fail symmetrically. The broadcast sits INSIDE the
             # try: a KV failure must error the waiters, not strand them.
-            op_seq = oplog.broadcast("score_batch", {
-                "model": str(model.key),
-                "requests": [{"frame": str(e.frame.key),
-                              "destination_frame": e.dest,
-                              "with_metrics": bool(e.with_metrics)}
-                             for e in batch]})
+            # A transiently-lost publish is retried with backoff (publish
+            # rolled its sequence slot back, so the re-claim is gapless);
+            # on a DEGRADED/FAILED cloud scoring skips the broadcast and
+            # serves coordinator-locally — the one surface that stays up.
+            local_only = (oplog.active()
+                          and supervisor.state() != supervisor.HEALTHY)
+            op_seq = None
+            if not local_only:
+                from h2o3_tpu.core.failure import CloudUnhealthyError
+
+                try:
+                    op_seq = retry.retry_call(
+                        oplog.broadcast, "score_batch", {
+                            "model": str(model.key),
+                            "requests": [{"frame": str(e.frame.key),
+                                          "destination_frame": e.dest,
+                                          "with_metrics":
+                                          bool(e.with_metrics)}
+                                         for e in batch]},
+                        retry_on=(oplog.OplogPublishError,),
+                        describe="score_batch broadcast")
+                except CloudUnhealthyError:
+                    # the cloud degraded between the state snapshot and
+                    # the broadcast's own fail-fast check: scoring is the
+                    # surface that keeps serving — fall back to local
+                    local_only = True
+            if local_only:
+                # local serving installs prediction frames only in the
+                # COORDINATOR's DKV (no oplog record): follower key state
+                # is now behind, so the degraded verdict must never
+                # auto-recover — only a cloud restart re-syncs
+                supervisor.degrade(
+                    "coordinator-local scoring served while degraded: "
+                    "follower DKV state is behind; restart the cloud to "
+                    "re-sync", hold_s=float("inf"))
             with oplog.turn(op_seq):
                 results = execute_batch(
                     model, [(e.frame, e.dest, e.with_metrics)
-                            for e in batch])
+                            for e in batch],
+                    local_only=local_only)
             for e, (pred, mm) in zip(batch, results):
                 e.pred, e.mm = pred, mm
         except BaseException as ex:   # noqa: BLE001 — propagate per-request

@@ -183,6 +183,66 @@ class TestModelFamilies:
         assert not scoring.supports(gbm)
 
 
+class _NoMeshCluster:
+    """Cluster proxy whose global-mesh entry points trip an assertion:
+    degraded-cloud local dispatch must never reach them (a sharded
+    device_put / put_rows against the global mesh is an SPMD program a
+    dead follower never joins)."""
+
+    def __init__(self, cl):
+        self._real = cl
+
+    def pad_rows(self, n):                   # pure arithmetic: allowed
+        return self._real.pad_rows(n)
+
+    def row_sharding(self):
+        raise AssertionError("local dispatch touched the global mesh "
+                             "(row_sharding)")
+
+    def put_rows(self, buf):
+        raise AssertionError("local dispatch touched the global mesh "
+                             "(put_rows)")
+
+
+class TestDegradedLocalDispatch:
+    def test_local_dispatch_never_touches_global_mesh(self, cl, gbm):
+        """`local=True` (degraded-cloud serving) computes margins and raw
+        predictions entirely on this process's devices — and stays
+        bitwise-identical to the normal bucketed path."""
+        from h2o3_tpu import scoring
+
+        sess = scoring.ScoringSession(gbm)
+        n = 300
+        fr = _score_frame(n, 5, with_nas=True)
+        X = sess._features(gbm.adapt_test(fr), n)
+        ref_margin = sess._margin_x(X)
+        ref_raw = sess._raw_for_slice(ref_margin, n)
+
+        sess._cl = _NoMeshCluster(sess._cl)
+        local_margin = sess._margin_x(X, local=True)
+        assert np.array_equal(local_margin, ref_margin)
+        raw = sess._raw_for_slice(local_margin, n, local=True)
+        for k, ref in ref_raw.items():
+            assert np.array_equal(np.asarray(raw[k])[:n],
+                                  np.asarray(ref)[:n]), k
+
+    def test_local_arrays_guard_non_addressable_model(self, cl, gbm):
+        """Forest arrays the coordinator cannot fully read (shards homed on
+        the dead peer) must refuse local serving with a clear error, not
+        crash inside a host transfer."""
+        from h2o3_tpu import scoring
+        from h2o3_tpu.core.failure import CloudUnhealthyError
+
+        sess = scoring.ScoringSession(gbm)
+
+        class _Remote:                 # quacks like a non-addressable array
+            is_fully_addressable = False
+
+        sess._arrays = (_Remote(),)
+        with pytest.raises(CloudUnhealthyError, match="forest arrays"):
+            sess._local_arrays()
+
+
 class TestSessionRegistry:
     def test_reuse_and_purge(self, cl, gbm):
         from h2o3_tpu import scoring

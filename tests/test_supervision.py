@@ -1,0 +1,938 @@
+"""Cloud supervision tier: acknowledged oplog, bounded waits, retry with
+backoff, and the HEALTHY/DEGRADED/FAILED state machine (ISSUE 3).
+
+Reference: water/RPC.java retries every remote task with exponential
+backoff; water/HeartBeatThread.java turns a silent node death into an
+explicit cloud event. The 2-process gloo tier is env-flaky on this jax
+build, so these tests drive the FULL protocol — publish/replay/ack/error/
+heartbeat/supervise — deterministically inside one process: the cloud KV
+is `distributed.memory_kv()` (a dict), the topology is monkeypatched to
+look like a 2-process cloud, and `failure.inject()` supplies the crashes
+a real dead peer would.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from h2o3_tpu.core import failure
+from h2o3_tpu.parallel import distributed as D
+from h2o3_tpu.parallel import oplog, retry, supervisor
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture()
+def mem_cloud(monkeypatch):
+    """Simulated 2-process cloud: dict-backed KV + coordinator topology.
+    jax itself stays single-process (device programs run locally), which
+    is exactly what makes the protocol paths deterministic here."""
+    with D.memory_kv() as kv:
+        monkeypatch.setattr(D, "process_count", lambda: 2)
+        monkeypatch.setattr(D, "is_coordinator", lambda: True)
+        monkeypatch.setenv("H2O_TPU_RETRY_BASE_MS", "1")
+        # bound every ack wait so a test bug can never park a thread on
+        # the production 300 s default (tests override per-case as needed)
+        monkeypatch.setenv("H2O_TPU_OP_ACK_TIMEOUT_S", "30")
+        oplog.reset()
+        supervisor.reset()
+        yield kv
+    oplog.reset()
+    supervisor.reset()
+
+
+# ---------------------------------------------------------------------------
+# retry.py
+# ---------------------------------------------------------------------------
+
+class TestRetry:
+    def test_succeeds_after_transient_failures(self):
+        calls, slept = [], []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        assert retry.retry_call(flaky, retries=4, base_s=0.001,
+                                sleep=slept.append) == "ok"
+        assert len(calls) == 3 and len(slept) == 2
+
+    def test_exhaustion_raises_original_error(self):
+        slept = []
+        with pytest.raises(OSError, match="always"):
+            retry.retry_call(lambda: (_ for _ in ()).throw(OSError("always")),
+                             retries=3, base_s=0.001, sleep=slept.append)
+        assert len(slept) == 2          # attempts-1 backoffs
+
+    def test_retry_on_filters_exception_types(self):
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise ValueError("not retryable here")
+
+        with pytest.raises(ValueError):
+            retry.retry_call(boom, retries=5, retry_on=(OSError,),
+                             sleep=lambda s: None)
+        assert len(calls) == 1          # no retries for non-matching type
+
+    def test_backoff_doubles_and_caps(self):
+        ds = list(retry.backoff_delays(attempts=6, base_s=0.01, max_s=0.05,
+                                       jitter=0.0))
+        assert ds == [0.01, 0.02, 0.04, 0.05, 0.05]
+
+    def test_backoff_jitter_bounded(self):
+        for d, nominal in zip(retry.backoff_delays(attempts=4, base_s=0.01,
+                                                   max_s=10.0, jitter=0.5),
+                              (0.01, 0.02, 0.04)):
+            assert 0.5 * nominal <= d <= 1.5 * nominal
+
+    def test_adaptive_poll_grows_and_resets(self):
+        slept = []
+        p = retry.AdaptivePoll(min_s=0.001, max_s=0.25, sleep=slept.append)
+        for _ in range(12):
+            p.wait()
+        assert slept[0] == pytest.approx(0.001)
+        assert slept[-1] == pytest.approx(0.25)       # capped cold
+        assert all(b >= a for a, b in zip(slept, slept[1:]))
+        p.reset()
+        assert p.current_s == pytest.approx(0.001)    # hot again
+
+
+# ---------------------------------------------------------------------------
+# publish: lost-put rollback + retry (satellite 1)
+# ---------------------------------------------------------------------------
+
+class TestPublish:
+    def test_lost_kv_put_raises_and_rolls_back_seq(self, mem_cloud,
+                                                   monkeypatch):
+        monkeypatch.setenv("H2O_TPU_RETRY_MAX", "2")
+        monkeypatch.setattr(D, "kv_put", lambda k, v: False)
+        with pytest.raises(oplog.OplogPublishError, match="op 0"):
+            oplog.publish("noop", {})
+        # slot rolled back: nothing at seq 0, and the next publish (with a
+        # working KV) re-claims 0 — the follower sees a gapless sequence
+        monkeypatch.undo()
+        monkeypatch.setenv("H2O_TPU_RETRY_BASE_MS", "1")
+        assert oplog.publish("noop", {}) == 0
+        assert "oplog/0" in mem_cloud
+
+    def test_injected_put_loss_rolls_back_and_caller_retry_lands(
+            self, mem_cloud):
+        """A HARD put loss (transport retries exhausted) raises with the
+        slot rolled back; a caller retrying the publish — the scoring
+        micro-batcher's pattern — gets the SAME slot, so the follower
+        still sees a gapless sequence."""
+        with failure.inject("oplog.kv_put", times=1):
+            seq = retry.retry_call(oplog.publish, "noop", {},
+                                   retry_on=(oplog.OplogPublishError,),
+                                   base_s=0.001)
+        assert seq == 0
+        assert json.loads(mem_cloud["oplog/0"])["kind"] == "noop"
+
+    def test_publish_faultpoint_fails_cleanly(self, mem_cloud):
+        with failure.inject("oplog.publish", times=1):
+            with pytest.raises(failure.InjectedFault):
+                oplog.publish("noop", {})
+        assert oplog.publish("noop", {}) == 0         # nothing was claimed
+
+
+# ---------------------------------------------------------------------------
+# turn(): bounded turnstile wait + slot abandonment (satellite 2)
+# ---------------------------------------------------------------------------
+
+class TestTurnDeadline:
+    def test_dead_predecessor_raises_instead_of_hanging(self, mem_cloud,
+                                                        monkeypatch):
+        monkeypatch.setenv("H2O_TPU_OP_ACK_TIMEOUT_S", "0")  # isolate turnstile
+        oplog.publish("noop", {})            # seq 0: holder never turns
+        seq1 = oplog.publish("noop", {})
+        t0 = time.monotonic()
+        with pytest.raises(oplog.OplogTurnTimeout, match="stuck at op 0"):
+            with oplog.turn(seq1, timeout_s=0.3):
+                pass
+        assert time.monotonic() - t0 < 5.0   # bounded, not the old forever
+
+    def test_timed_out_waiter_releases_never_entered_head(self, mem_cloud,
+                                                          monkeypatch):
+        """A head holder that died between publish and turn must not cost
+        every later op its own full deadline: the first timed-out waiter
+        releases the head slot too, neutralizes both ops to noops in the
+        KV, and degrades the cloud."""
+        monkeypatch.setenv("H2O_TPU_OP_ACK_TIMEOUT_S", "0")
+        for _ in range(3):
+            oplog.publish("noop", {})
+        with pytest.raises(oplog.OplogTurnTimeout, match="head slot 0"):
+            with oplog.turn(1, timeout_s=0.2):       # 0 never turned
+                pass
+        # both abandoned ops are neutralized so a lagging follower
+        # replays nothing the coordinator never ran
+        for s in (0, 1):
+            assert json.loads(mem_cloud[f"oplog/{s}"])["kind"] == "noop"
+        assert supervisor.state() == supervisor.DEGRADED
+        # op 2 enters IMMEDIATELY — no serial re-pay of the deadline
+        t0 = time.monotonic()
+        ran = []
+        with oplog.turn(2, timeout_s=5.0):
+            ran.append(2)
+        assert ran == [2] and time.monotonic() - t0 < 1.0
+
+    def test_late_arriving_holder_of_abandoned_slot_refuses(self, mem_cloud,
+                                                            monkeypatch):
+        """The presumed-dead holder shows up after all: it must refuse to
+        execute out of broadcast order (its op is already a noop) and
+        hand the turnstile onward instead of stalling it."""
+        monkeypatch.setenv("H2O_TPU_OP_ACK_TIMEOUT_S", "0")
+        for _ in range(2):
+            oplog.publish("noop", {})
+        with pytest.raises(oplog.OplogTurnTimeout):
+            with oplog.turn(1, timeout_s=0.2):
+                pass
+        with pytest.raises(oplog.OplogTurnTimeout, match="abandoned"):
+            with oplog.turn(0, timeout_s=5.0):       # the late holder
+                raise AssertionError("abandoned op must not execute")
+        # and the turnstile moved on: a fresh op proceeds instantly
+        seq = oplog.publish("noop", {})
+        with oplog.turn(seq, timeout_s=5.0):
+            pass
+
+    def test_slow_executing_head_is_left_alone(self, mem_cloud,
+                                               monkeypatch):
+        """A head holder INSIDE its turn (long device program) is alive —
+        a timed-out waiter abandons only itself, never the head."""
+        monkeypatch.setenv("H2O_TPU_OP_ACK_TIMEOUT_S", "0")
+        oplog.publish("noop", {})
+        seq1 = oplog.publish("noop", {})
+        entered = threading.Event()
+        release = threading.Event()
+        done = []
+
+        def slow_head():
+            with oplog.turn(0, timeout_s=5.0):
+                entered.set()
+                release.wait(10)
+            done.append(0)
+
+        t = threading.Thread(target=slow_head, daemon=True)
+        t.start()
+        assert entered.wait(5)
+        with pytest.raises(oplog.OplogTurnTimeout) as ei:
+            with oplog.turn(seq1, timeout_s=0.2):
+                pass
+        assert "head slot" not in str(ei.value)      # head NOT released
+        release.set()
+        t.join(10)
+        assert done == [0]                           # head completed fine
+
+    def test_none_ticket_stays_free(self):
+        with oplog.turn(None):               # single-process path: no-op
+            pass
+
+
+# ---------------------------------------------------------------------------
+# ack protocol + follower loop
+# ---------------------------------------------------------------------------
+
+class TestAcks:
+    def test_follower_acks_each_replay(self, mem_cloud):
+        t = threading.Thread(
+            target=lambda: oplog.follower_loop(idle_timeout_s=10),
+            daemon=True)
+        t.start()
+        for _ in range(3):
+            seq = oplog.broadcast("noop", {})
+            with oplog.turn(seq, timeout_s=10):
+                pass                          # exit waits for the ack
+        assert {f"oplog/ack/{i}/0" for i in range(3)} <= set(mem_cloud)
+        oplog.publish("shutdown", {})
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+    def test_wait_acks_timeout_degrades_cloud(self, mem_cloud):
+        oplog.publish("noop", {})            # no follower running
+        t0 = time.monotonic()
+        with pytest.raises(failure.CloudUnhealthyError, match="0/1"):
+            oplog.wait_acks(0, timeout_s=0.3)
+        assert time.monotonic() - t0 < 5.0
+        assert supervisor.state() == supervisor.DEGRADED
+        # the degrade is HELD: a wedged peer that keeps beating must not
+        # instantly re-arm the cloud on the next heartbeat evaluation
+        now = time.time()
+        for p in (0, 1):
+            mem_cloud[f"h2o3/heartbeat/{p}"] = json.dumps({"ts": now,
+                                                           "proc": p})
+        assert supervisor.evaluate() == supervisor.DEGRADED
+        # ... and recovers once the hold ages out
+        with supervisor._LOCK:
+            supervisor._STATE["hold_until"] = time.time() - 1
+        assert supervisor.evaluate() == supervisor.HEALTHY
+
+    def test_wait_acks_bails_fast_when_cloud_already_failed(self,
+                                                           mem_cloud):
+        """A replay crash on ANOTHER op must fail this op's ack wait
+        immediately with that diagnosis — not a generic timeout 300s
+        later."""
+        supervisor.fail("follower replay of op 3 crashed",
+                        "Traceback ...\nOtherOpBoom")
+        t0 = time.monotonic()
+        with pytest.raises(failure.CloudUnhealthyError,
+                           match="OtherOpBoom"):
+            oplog.wait_acks(7, timeout_s=300.0)
+        assert time.monotonic() - t0 < 5.0
+
+    def test_wait_acks_surfaces_remote_traceback(self, mem_cloud):
+        mem_cloud["oplog/error/0"] = json.dumps(
+            {"kind": "train", "trace": "Traceback ...\nBoomError: kaput"})
+        with pytest.raises(failure.CloudUnhealthyError,
+                           match="BoomError: kaput") as ei:
+            oplog.wait_acks(0, timeout_s=5)
+        assert "BoomError" in ei.value.remote_trace
+        assert supervisor.state() == supervisor.FAILED
+
+    def test_replay_crash_error_key_before_death(self, mem_cloud):
+        oplog.publish("noop", {})
+        with failure.inject("oplog.replay", times=1):
+            with pytest.raises(failure.InjectedFault):
+                oplog.follower_loop(idle_timeout_s=5)
+        rec = json.loads(mem_cloud["oplog/error/0"])
+        assert rec["kind"] == "noop"
+        assert "injected fault: oplog.replay" in rec["trace"]
+
+    def test_lost_ack_hits_timeout_not_error_path(self, mem_cloud):
+        oplog.publish("noop", {})
+        with failure.inject("oplog.ack", times=1):
+            with pytest.raises(failure.InjectedFault):
+                oplog.follower_loop(idle_timeout_s=5)
+        assert "oplog/error/0" not in mem_cloud   # replay itself succeeded
+        with pytest.raises(failure.CloudUnhealthyError, match="acks"):
+            oplog.wait_acks(0, timeout_s=0.2)
+
+    def test_lost_ack_write_is_loud_and_nonfatal(self, mem_cloud,
+                                                 monkeypatch):
+        """A follower whose ack WRITE is lost (kv_put budget exhausted)
+        must not silently proceed — the coordinator would stall the full
+        ack timeout and then degrade with a misleading 'follower dead'
+        diagnosis. It records a NON-fatal error (the replay succeeded:
+        states did not diverge) and dies; wait_acks surfaces the true
+        story immediately and the cloud DEGRADES rather than
+        sticky-FAILs."""
+        monkeypatch.setenv("H2O_TPU_RETRY_MAX", "2")
+        real = D.kv_put
+        monkeypatch.setattr(
+            D, "kv_put",
+            lambda k, v: False if k.startswith("oplog/ack/")
+            else real(k, v))
+        oplog.publish("noop", {})
+        with pytest.raises(oplog.OplogAckError, match="could not write"):
+            oplog.follower_loop(idle_timeout_s=5)
+        rec = json.loads(mem_cloud["oplog/error/0"])
+        assert rec["kind"] == "ack" and rec["fatal"] is False
+        t0 = time.monotonic()
+        with pytest.raises(failure.CloudUnhealthyError, match="non-fatal"):
+            oplog.wait_acks(0, timeout_s=30)
+        assert time.monotonic() - t0 < 5.0            # no 30 s stall
+        assert supervisor.state() == supervisor.DEGRADED
+        assert supervisor.evaluate() == supervisor.DEGRADED  # not FAILED
+
+    def test_transient_ack_loss_absorbed_by_retry(self, mem_cloud,
+                                                  monkeypatch):
+        """One blipped ack write is absorbed by _ack's second retry round:
+        the ack lands, no error record appears, wait_acks returns."""
+        real = D.kv_put
+        fails = {"left": 1}
+
+        def flaky(k, v):
+            if k.startswith("oplog/ack/") and fails["left"]:
+                fails["left"] -= 1
+                return False
+            return real(k, v)
+
+        monkeypatch.setattr(D, "kv_put", flaky)
+        oplog.publish("noop", {})
+        oplog.publish("shutdown", {})
+        assert oplog.follower_loop(idle_timeout_s=5) == 1
+        assert "oplog/ack/0/0" in mem_cloud
+        assert "oplog/error/0" not in mem_cloud
+        oplog.wait_acks(0, timeout_s=5)               # ack landed: no raise
+
+    def test_stale_ack_cannot_satisfy_a_reclaimed_slot(self, mem_cloud):
+        """Indeterminate put: op 0's kv_put reported lost (slot rolled
+        back) but the follower acked SOMETHING under seq 0. A different
+        op reclaiming the slot must not be satisfied by that stale ack —
+        acks match on the op identity token, not the slot number."""
+        with failure.inject("oplog.kv_put", times=1):
+            with pytest.raises(oplog.OplogPublishError):
+                oplog.publish("noop", {})
+        mem_cloud["oplog/ack/0/1"] = json.dumps(
+            {"proc": 1, "ts": time.time(), "op_id": "the-lost-op"})
+        assert oplog.publish("noop", {"fresh": True}) == 0   # reclaimed
+        with pytest.raises(failure.CloudUnhealthyError, match="0/1"):
+            oplog.wait_acks(0, timeout_s=0.3)
+
+    def test_abandoned_slot_already_replayed_fails_cloud(self, mem_cloud,
+                                                         monkeypatch):
+        """If a follower ALREADY replayed an op whose turnstile slot gets
+        abandoned, the divergence is certain (the follower ran a program
+        the coordinator never will): sticky FAILED, not a held degrade."""
+        monkeypatch.setenv("H2O_TPU_OP_ACK_TIMEOUT_S", "0")
+        oplog.publish("noop", {})            # head; holder never arrives
+        seq1 = oplog.publish("noop", {})
+        op0 = json.loads(mem_cloud["oplog/0"])
+        mem_cloud["oplog/ack/0/1"] = json.dumps(
+            {"proc": 1, "ts": time.time(), "op_id": op0["op_id"]})
+        with pytest.raises(oplog.OplogTurnTimeout):
+            with oplog.turn(seq1, timeout_s=0.2):
+                pass
+        assert supervisor.state() == supervisor.FAILED
+        assert "diverged" in supervisor.status()["reason"]
+
+    def test_follower_idle_timeout_error_path(self, mem_cloud):
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="idle for 0.2s at op 0"):
+            oplog.follower_loop(idle_timeout_s=0.2)
+        assert time.monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------------------------------
+# supervisor state machine
+# ---------------------------------------------------------------------------
+
+class TestSupervisor:
+    def test_stale_heartbeat_degrades_then_recovers(self, mem_cloud):
+        now = time.time()
+        mem_cloud["h2o3/heartbeat/0"] = json.dumps({"ts": now, "proc": 0})
+        mem_cloud["h2o3/heartbeat/1"] = json.dumps({"ts": now - 1000,
+                                                    "proc": 1})
+        assert supervisor.evaluate() == supervisor.DEGRADED
+        st = supervisor.status()
+        assert "stale heartbeat" in st["reason"] and "[1]" in st["reason"]
+        with pytest.raises(failure.CloudUnhealthyError):
+            oplog.broadcast("noop", {})      # degraded: refused fast
+        # the peer comes back: beats refresh, the cloud recovers
+        mem_cloud["h2o3/heartbeat/1"] = json.dumps({"ts": time.time(),
+                                                    "proc": 1})
+        assert supervisor.evaluate() == supervisor.HEALTHY
+        assert oplog.broadcast("noop", {}) == 0      # serving again
+
+    def test_never_beaten_follower_degrades_after_grace(self, mem_cloud,
+                                                        monkeypatch):
+        """A follower that died at STARTUP has no stale heartbeat row to
+        trip on — its absence past the staleness window must degrade the
+        cloud all the same."""
+        now = time.time()
+        mem_cloud["h2o3/heartbeat/0"] = json.dumps({"ts": now, "proc": 0})
+        assert supervisor.evaluate() == supervisor.HEALTHY   # inside grace
+        monkeypatch.setattr(supervisor, "_FIRST_EVAL_TS", now - 100)
+        assert supervisor.evaluate() == supervisor.DEGRADED
+        assert "never heartbeat" in supervisor.status()["reason"]
+        # the missing peer finally boots and beats: cloud recovers
+        mem_cloud["h2o3/heartbeat/1"] = json.dumps({"ts": time.time(),
+                                                    "proc": 1})
+        assert supervisor.evaluate() == supervisor.HEALTHY
+
+    def test_replay_error_fails_cloud_permanently(self, mem_cloud):
+        mem_cloud["oplog/error/4"] = json.dumps({"kind": "predict",
+                                                 "trace": "tb"})
+        assert supervisor.evaluate() == supervisor.FAILED
+        # FAILED is sticky: fresh heartbeats do NOT recover a diverged cloud
+        now = time.time()
+        for p in (0, 1):
+            mem_cloud[f"h2o3/heartbeat/{p}"] = json.dumps({"ts": now,
+                                                           "proc": p})
+        del mem_cloud["oplog/error/4"]
+        assert supervisor.evaluate() == supervisor.FAILED
+
+    def test_failed_cloud_fails_inflight_jobs_with_trace(self, mem_cloud):
+        from h2o3_tpu.core.job import Job
+
+        ev = threading.Event()
+        job = Job(description="wedged collective")
+        job.start(lambda j: ev.wait(10), background=True)
+        try:
+            supervisor.fail("follower replay of op 7 crashed",
+                            "Traceback ...\nRemoteBoom: dead peer")
+            assert job.status == Job.FAILED
+            assert "RemoteBoom: dead peer" in job.exception
+        finally:
+            ev.set()
+        time.sleep(0.05)                     # worker unwinds...
+        assert job.status == Job.FAILED      # ...but cannot resurrect DONE
+
+    def test_created_job_failed_by_supervisor_never_runs(self, mem_cloud):
+        """A job failed while still CREATED (cloud died between submit
+        and thread start) must honor the verdict, not resurrect itself
+        to RUNNING and execute against a dead cloud."""
+        from h2o3_tpu.core.job import Job
+
+        job = Job(description="doomed before start")
+        supervisor.fail("cloud died pre-start", "pre-start trace")
+        assert job.status == Job.FAILED
+        ran = []
+        job.start(lambda j: ran.append(1), background=False)
+        assert ran == []
+        assert job.status == Job.FAILED
+        assert "pre-start trace" in job.exception
+
+    def test_cluster_health_staleness_boundary(self, mem_cloud):
+        now = time.time()
+        mem_cloud["h2o3/heartbeat/0"] = json.dumps({"ts": now - 29.0,
+                                                    "proc": 0})
+        mem_cloud["h2o3/heartbeat/1"] = json.dumps({"ts": now - 31.0,
+                                                    "proc": 1})
+        rows = failure.cluster_health(stale_after_s=30.0)
+        by_proc = {r["process"]: r for r in rows}
+        assert by_proc[0]["healthy"] is True       # just inside the window
+        assert by_proc[1]["healthy"] is False      # just past it
+        assert by_proc[1]["age_s"] > by_proc[0]["age_s"]
+
+    def test_heartbeat_faultpoint_drops_beat(self, mem_cloud):
+        with failure.inject("failure.heartbeat", times=1):
+            with pytest.raises(failure.InjectedFault):
+                failure.heartbeat()
+        assert failure.heartbeat()           # next beat lands
+        assert "h2o3/heartbeat/0" in mem_cloud
+
+    def test_recover_check_is_atomic_with_hold(self, mem_cloud,
+                                               monkeypatch):
+        """evaluate() must hold the state lock ACROSS its hold_until check
+        and the recover() transition: a degrade(hold_s=...) landing from
+        another thread (an ack-timeout handler recording fresh wedged-peer
+        evidence) can then never slip between the two and be erased
+        together with its hold."""
+        supervisor.degrade("old evidence")               # hold expired
+        now = time.time()
+        for p in (0, 1):
+            mem_cloud[f"h2o3/heartbeat/{p}"] = json.dumps({"ts": now,
+                                                           "proc": p})
+        lock_held_during_recover = []
+        real = supervisor.recover
+
+        def spying(*a, **k):
+            got = []
+
+            def probe():
+                ok = supervisor._LOCK.acquire(timeout=0.2)
+                if ok:
+                    supervisor._LOCK.release()
+                got.append(ok)
+
+            t = threading.Thread(target=probe)
+            t.start()
+            t.join()
+            lock_held_during_recover.append(not got[0])
+            return real(*a, **k)
+
+        monkeypatch.setattr(supervisor, "recover", spying)
+        assert supervisor.evaluate() == supervisor.HEALTHY
+        assert lock_held_during_recover == [True]
+
+
+# ---------------------------------------------------------------------------
+# distributed KV fallbacks (satellite 4)
+# ---------------------------------------------------------------------------
+
+class _LegacyKVClient:
+    """jax client without allow_overwrite: set raises on existing keys."""
+
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set(self, key, value, allow_overwrite=None):
+        if allow_overwrite is not None:
+            raise TypeError("no allow_overwrite kwarg")
+        if key in self.store:
+            raise RuntimeError("ALREADY_EXISTS")
+        self.store[key] = value
+
+    def key_value_try_get(self, key):
+        if key not in self.store:
+            raise KeyError(key)
+        return self.store[key]
+
+    def key_value_delete(self, key):
+        self.store.pop(key, None)
+
+
+class TestKVFallbacks:
+    def test_kv_put_overwrite_retry_fallback(self, monkeypatch):
+        c = _LegacyKVClient()
+        monkeypatch.setattr(D, "_kv_client", lambda: c)
+        monkeypatch.setenv("H2O_TPU_RETRY_BASE_MS", "1")
+        assert D.kv_put("k", "v1") is True           # fresh key
+        assert D.kv_put("k", "v2") is True           # delete+retry upsert
+        assert c.store["k"] == "v2"
+
+    def test_kv_put_concurrent_winner_counts_as_success(self, monkeypatch):
+        c = _LegacyKVClient()
+
+        def stubborn_set(key, value, allow_overwrite=None):
+            if allow_overwrite is not None:
+                raise TypeError("no kwarg")
+            # a concurrent writer always beats us to the slot
+            c.store.setdefault(key, "theirs")
+            raise RuntimeError("ALREADY_EXISTS")
+
+        monkeypatch.setattr(c, "key_value_set", stubborn_set)
+        monkeypatch.setattr(D, "_kv_client", lambda: c)
+        monkeypatch.setenv("H2O_TPU_RETRY_BASE_MS", "1")
+        assert D.kv_put("k", "mine") is True         # a value IS in place
+        assert c.store["k"] == "theirs"
+
+    def test_kv_put_real_loss_returns_false(self, monkeypatch):
+        c = _LegacyKVClient()
+
+        def losing_set(key, value, allow_overwrite=None):
+            if allow_overwrite is not None:
+                raise TypeError("no kwarg")
+            raise RuntimeError("ALREADY_EXISTS")     # and nothing lands
+
+        monkeypatch.setattr(c, "key_value_set", losing_set)
+        monkeypatch.setattr(D, "_kv_client", lambda: c)
+        monkeypatch.setenv("H2O_TPU_RETRY_MAX", "2")
+        monkeypatch.setenv("H2O_TPU_RETRY_BASE_MS", "1")
+        assert D.kv_put("k", "v") is False
+
+
+# ---------------------------------------------------------------------------
+# scoring micro-batcher: retry + degraded-mode local serving
+# ---------------------------------------------------------------------------
+
+class _FakeKeyed:
+    def __init__(self, key):
+        self.key = key
+
+
+class TestScoringSupervision:
+    def _pending(self):
+        from h2o3_tpu import scoring
+
+        return scoring._Pending(_FakeKeyed("fr"), None, False)
+
+    def test_flush_retries_lost_broadcast(self, mem_cloud, monkeypatch):
+        from h2o3_tpu import scoring
+
+        attempts = []
+
+        def flaky_broadcast(kind, payload):
+            attempts.append(kind)
+            if len(attempts) == 1:
+                raise oplog.OplogPublishError("lost")
+            return None
+
+        monkeypatch.setattr(oplog, "broadcast", flaky_broadcast)
+        monkeypatch.setattr(scoring, "execute_batch",
+                            lambda m, e, local_only=False: [("PRED", None)])
+        ent = self._pending()
+        scoring.ScoreBatcher._flush(_FakeKeyed("m"), [ent])
+        assert attempts == ["score_batch", "score_batch"]
+        assert ent.error is None and ent.pred == "PRED"
+
+    def test_degrade_race_during_broadcast_falls_back_local(
+            self, mem_cloud, monkeypatch):
+        """The cloud degrades BETWEEN the batcher's state snapshot and the
+        broadcast's own fail-fast check: scoring must fall back to local
+        serving, not 503 the whole batch."""
+        from h2o3_tpu import scoring
+
+        def degrading_broadcast(kind, payload):
+            raise failure.CloudUnhealthyError("degraded mid-flight")
+
+        monkeypatch.setattr(oplog, "broadcast", degrading_broadcast)
+        seen = {}
+
+        def exec_local(m, entries, local_only=False):
+            seen["local_only"] = local_only
+            return [("PRED", None)]
+
+        monkeypatch.setattr(scoring, "execute_batch", exec_local)
+        ent = self._pending()
+        scoring.ScoreBatcher._flush(_FakeKeyed("m"), [ent])
+        assert seen["local_only"] is True
+        assert ent.error is None and ent.pred == "PRED"
+
+    def test_degraded_cloud_serves_locally_without_broadcast(
+            self, mem_cloud, monkeypatch):
+        from h2o3_tpu import scoring
+
+        supervisor.degrade("peer went quiet")
+        seen = {}
+
+        def no_broadcast(kind, payload):
+            raise AssertionError("degraded flush must not broadcast")
+
+        monkeypatch.setattr(oplog, "broadcast", no_broadcast)
+
+        def exec_local(m, entries, local_only=False):
+            seen["local_only"] = local_only
+            return [("PRED", None)]
+
+        monkeypatch.setattr(scoring, "execute_batch", exec_local)
+        ent = self._pending()
+        scoring.ScoreBatcher._flush(_FakeKeyed("m"), [ent])
+        assert seen["local_only"] is True
+        assert ent.error is None and ent.pred == "PRED"
+        # local serving forked the coordinator's DKV from the follower's:
+        # fresh heartbeats must NOT auto-recover this cloud anymore
+        now = time.time()
+        for p in (0, 1):
+            mem_cloud[f"h2o3/heartbeat/{p}"] = json.dumps({"ts": now,
+                                                           "proc": p})
+        assert supervisor.evaluate() == supervisor.DEGRADED
+        assert "restart the cloud" in supervisor.status()["reason"]
+
+
+# ---------------------------------------------------------------------------
+# REST surface: lifecycle wiring + end-to-end chaos (acceptance criteria)
+# ---------------------------------------------------------------------------
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _post(base, path, data):
+    body = "&".join(f"{k}={urllib.request.quote(str(v))}"
+                    for k, v in data.items()).encode()
+    req = urllib.request.Request(base + path, data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def _wait_job(base, key, timeout_s=60.0):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        j = _get(base, f"/3/Jobs/{urllib.request.quote(key, safe='')}")
+        j = j["jobs"][0]
+        if j["status"] not in ("CREATED", "RUNNING"):
+            return j
+        time.sleep(0.05)
+    raise AssertionError(f"job {key} still running after {timeout_s}s")
+
+
+@pytest.fixture()
+def chaos_csv(tmp_path):
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    p = tmp_path / "chaos.csv"
+    with open(p, "w") as f:
+        f.write("x,y\n")
+        for _ in range(200):
+            x = rng.normal()
+            f.write(f"{x:.5f},{'YN'[int(x > 0)]}\n")
+    return str(p)
+
+
+class TestRestSupervision:
+    def test_heartbeat_and_supervisor_autostart_multiprocess(
+            self, cl, mem_cloud, monkeypatch):
+        """Satellite 3 regression: start_server on a multi-process cloud
+        wires the beater + supervisor; stop() tears both down."""
+        from h2o3_tpu.api.server import start_server
+
+        monkeypatch.setenv("H2O_TPU_SUPERVISE_INTERVAL_S", "0.05")
+        srv = start_server(port=0)
+        try:
+            hb, sup = srv.heartbeat_thread, srv.supervisor
+            assert hb is not None and sup is not None
+            deadline = time.time() + 10
+            while time.time() < deadline and \
+                    "h2o3/heartbeat/0" not in mem_cloud:
+                time.sleep(0.02)
+            assert "h2o3/heartbeat/0" in mem_cloud    # /3/Cloud liveness
+            assert _get(f"http://127.0.0.1:{srv.port}",
+                        "/3/CloudStatus")["state"] == "HEALTHY"
+        finally:
+            srv.stop()
+        assert srv.heartbeat_thread is None and srv.supervisor is None
+        assert hb._stop.is_set() and sup._stop.is_set()
+
+    def test_no_duplicate_beater_when_runtime_already_beats(
+            self, cl, mem_cloud, monkeypatch):
+        """On a real multi-process cloud core.runtime already runs the
+        beater on every process — start_server must not stack a second
+        one on the coordinator."""
+        from h2o3_tpu.api.server import start_server
+        from h2o3_tpu.core import runtime
+
+        monkeypatch.setenv("H2O_TPU_SUPERVISE_INTERVAL_S", "3600")
+        sentinel = failure.HeartbeatThread(interval_s=3600)
+        monkeypatch.setattr(runtime._CLUSTER, "_heartbeat", sentinel)
+        srv = start_server(port=0)
+        try:
+            assert srv.heartbeat_thread is None       # runtime's suffices
+            assert srv.supervisor is not None
+        finally:
+            srv.stop()
+
+    def test_restarted_cloud_server_rederives_state_from_evidence(
+            self, cl, mem_cloud, monkeypatch):
+        """A re-started cloud must not inherit the previous incarnation's
+        sticky FAILED verdict — but persistent error keys in the KV must
+        immediately re-derive it."""
+        from h2o3_tpu.api.server import start_server
+
+        monkeypatch.setenv("H2O_TPU_SUPERVISE_INTERVAL_S", "3600")
+        supervisor.fail("old incarnation crashed", "stale trace")
+        srv = start_server(port=0)          # fresh KV: verdict cleared
+        try:
+            assert supervisor.state() == supervisor.HEALTHY
+        finally:
+            srv.stop()
+        # same restart but the error key SURVIVED (same coordination
+        # service): the synchronous first evaluate() re-fails immediately
+        supervisor.fail("old incarnation crashed", "stale trace")
+        mem_cloud["oplog/error/2"] = json.dumps({"kind": "train",
+                                                 "trace": "still here"})
+        srv = start_server(port=0)
+        try:
+            assert supervisor.state() == supervisor.FAILED
+            assert "op 2" in supervisor.status()["reason"]
+        finally:
+            srv.stop()
+
+    def test_single_process_server_skips_supervision_threads(self, cl):
+        from h2o3_tpu.api.server import start_server
+
+        srv = start_server(port=0)
+        try:
+            assert srv.heartbeat_thread is None and srv.supervisor is None
+            out = _get(f"http://127.0.0.1:{srv.port}", "/3/Cloud")
+            assert out["cloud_status"] == "HEALTHY"
+        finally:
+            srv.stop()
+
+    def test_replay_crash_fails_job_with_remote_trace(self, cl, mem_cloud,
+                                                      monkeypatch,
+                                                      chaos_csv):
+        """Acceptance: an injected follower replay crash surfaces on the
+        coordinator as a FAILED job carrying the remote traceback within
+        the ack timeout — the pre-supervision oplog would have sat in the
+        unbounded publish/turn waits forever."""
+        from h2o3_tpu.api.server import start_server
+
+        monkeypatch.setenv("H2O_TPU_OP_ACK_TIMEOUT_S", "20")
+        monkeypatch.setenv("H2O_TPU_SUPERVISE_INTERVAL_S", "0.05")
+        srv = start_server(port=0)
+        base = f"http://127.0.0.1:{srv.port}"
+
+        def doomed_follower():
+            # the injected crash is the POINT — die like a real follower
+            # would, without tripping pytest's unhandled-thread warning
+            with pytest.raises(failure.InjectedFault):
+                oplog.follower_loop(idle_timeout_s=30)
+
+        follower = threading.Thread(target=doomed_follower, daemon=True)
+        try:
+            with failure.inject("oplog.replay", times=1):
+                follower.start()
+                out = _post(base, "/3/Parse",
+                            {"source_frames": f'["{chaos_csv}"]',
+                             "destination_frame": "chaos.hex"})
+                job = _wait_job(base, out["job"]["key"]["name"])
+            assert job["status"] == "FAILED"
+            assert "injected fault: oplog.replay" in (job["exception"] or "")
+            assert "remote traceback" in (job["exception"] or "")
+            # the supervisor folded the error key into cloud state ...
+            st = _get(base, "/3/CloudStatus")
+            assert st["state"] == "FAILED"
+            assert st["oplog_errors"] and \
+                "oplog.replay" in st["oplog_errors"][0]["trace"]
+            cloud = _get(base, "/3/Cloud")
+            assert cloud["cloud_status"] == "FAILED"
+            assert cloud["cloud_healthy"] is False
+            # ... and new multi-process ops are refused fast with a 503
+            t0 = time.monotonic()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(base, "/3/Parse",
+                      {"source_frames": f'["{chaos_csv}"]',
+                       "destination_frame": "chaos2.hex"})
+            assert ei.value.code == 503
+            assert time.monotonic() - t0 < 10.0
+            body = json.loads(ei.value.read())
+            assert "FAILED" in body.get("msg", "")
+        finally:
+            srv.stop()
+            follower.join(timeout=5)
+            # drain the failed job's worker thread (the supervisor marks
+            # the Job FAILED while its thread may still be mid-parse) so
+            # no straggler outlives this test's cloud epoch
+            from h2o3_tpu.core.dkv import DKV
+
+            jobj = DKV.get(job["key"]["name"]) if "job" in locals() else None
+            th = getattr(jobj, "_thread", None)
+            if th is not None:
+                th.join(timeout=30)
+
+    def test_cloudstatus_reflects_stale_heartbeat_transitions(
+            self, cl, mem_cloud, monkeypatch):
+        """Acceptance: GET /3/CloudStatus walks HEALTHY -> DEGRADED ->
+        HEALTHY as a peer's heartbeat goes stale and returns."""
+        from h2o3_tpu.api.server import start_server
+
+        monkeypatch.setenv("H2O_TPU_SUPERVISE_INTERVAL_S", "3600")
+        srv = start_server(port=0)          # evaluate() driven by the test
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            now = time.time()
+            mem_cloud["h2o3/heartbeat/1"] = json.dumps({"ts": now,
+                                                        "proc": 1})
+            supervisor.evaluate()
+            assert _get(base, "/3/CloudStatus")["state"] == "HEALTHY"
+            mem_cloud["h2o3/heartbeat/1"] = json.dumps({"ts": now - 999,
+                                                        "proc": 1})
+            supervisor.evaluate()
+            st = _get(base, "/3/CloudStatus")
+            assert st["state"] == "DEGRADED"
+            assert "stale heartbeat" in st["reason"]
+            assert any(not r["healthy"] for r in st["process_health"])
+            mem_cloud["h2o3/heartbeat/1"] = json.dumps({"ts": time.time(),
+                                                        "proc": 1})
+            supervisor.evaluate()
+            st = _get(base, "/3/CloudStatus")
+            assert st["state"] == "HEALTHY"
+            trans = [(t["from"], t["to"]) for t in st["transitions"]]
+            assert ("HEALTHY", "DEGRADED") in trans
+            assert ("DEGRADED", "HEALTHY") in trans
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos soak: sustained injected loss under a streaming op sequence
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestChaosSoak:
+    def test_streaming_ops_survive_periodic_kv_loss(self, mem_cloud):
+        """200 broadcast/replay/ack rounds with a lost KV put injected
+        every 5th publish: the retry budget absorbs every loss, the
+        follower sees a gapless sequence, and the cloud stays HEALTHY."""
+        applied = []
+        t = threading.Thread(
+            target=lambda: oplog.follower_loop(
+                idle_timeout_s=30, on_op=lambda k, p: applied.append(p["i"])),
+            daemon=True)
+        t.start()
+        for i in range(200):
+            if i % 5 == 0:
+                failure._FAULTS["oplog.kv_put"] = 1
+            # hard put losses roll the slot back; the caller-level retry
+            # (the micro-batcher pattern) re-claims the SAME slot
+            seq = retry.retry_call(oplog.broadcast, "noop", {"i": i},
+                                   retry_on=(oplog.OplogPublishError,),
+                                   base_s=0.001)
+            assert seq == i
+            with oplog.turn(seq, timeout_s=30):
+                pass
+        oplog.publish("shutdown", {})
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert applied == list(range(200))
+        assert supervisor.evaluate() != supervisor.FAILED
