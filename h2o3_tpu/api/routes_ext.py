@@ -706,23 +706,48 @@ def h_steam_metrics(ctx: Ctx):
 
 def h_cloud_status(ctx: Ctx):
     """GET /3/CloudStatus — the supervised cloud health state machine
-    (HEALTHY/DEGRADED/FAILED) with its evidence: per-process heartbeat
-    ages, follower replay failures (remote tracebacks), and the recent
-    transition history. The terse headline rides on /3/Cloud as
-    ``cloud_status``; this route is the operator's drill-down."""
+    (HEALTHY/DEGRADED/FAILED/RECOVERING) with its evidence: per-process
+    heartbeat ages + incarnations + ack lag, follower replay failures
+    (remote tracebacks), rejoin progress, checkpoint/epoch coordinates,
+    and the recent transition history — the fields an operator needs to
+    watch a recovery. The terse headline rides on /3/Cloud as
+    ``cloud_status``; this route is the drill-down."""
     from h2o3_tpu.core.failure import cluster_health, heartbeat_stale_s
-    from h2o3_tpu.parallel import oplog, supervisor
+    from h2o3_tpu.parallel import ckpt, oplog, supervisor
+    from h2o3_tpu.parallel import distributed as D
 
     st = supervisor.status()
+    # fold replay progress (last acked seq, ack lag, incarnation) into the
+    # per-process heartbeat rows so one table tells the recovery story
+    lag_by = {r["process"]: r for r in oplog.follower_lag()}
+    health = []
+    for row in cluster_health():
+        lr = lag_by.pop(row["process"], None)
+        if lr is not None:
+            row = dict(row, last_acked_seq=lr["last_acked_seq"],
+                       ack_lag=lr["ack_lag"])
+        health.append(row)
+    # followers with acks but no heartbeat row yet still show up
+    for p, lr in sorted(lag_by.items()):
+        health.append({"process": p, "age_s": None, "healthy": False,
+                       "incarnation": lr["incarnation"],
+                       "last_acked_seq": lr["last_acked_seq"],
+                       "ack_lag": lr["ack_lag"]})
     return {"__meta": S.meta("CloudStatusV3"),
             "state": st["state"],
             "since": st["since"],
             "reason": st["reason"],
             "remote_trace": st["remote_trace"],
             "transitions": st["transitions"],
-            "process_health": cluster_health(),
+            "process_health": health,
             "heartbeat_stale_s": heartbeat_stale_s(),
             "expected_acks": oplog.expected_acks(),
+            "current_seq": oplog.current_seq(),
+            "checkpoint_seq": ckpt.latest_seq(),
+            "checkpoint_interval_ops": ckpt.interval_ops(),
+            "epoch": D.epoch(),
+            "leader": D.leader(),
+            "rejoins": oplog.rejoin_records(),
             "oplog_errors": [{"seq": seq, "kind": rec.get("kind"),
                               "trace": rec.get("trace")}
                              for seq, rec in oplog.error_records()]}

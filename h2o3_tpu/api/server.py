@@ -1906,6 +1906,23 @@ def start_server(port: int = 54321, auth_file: Optional[str] = None,
     return srv
 
 
+def assume_coordination(port: int = 54321, caught_up_seq=None,
+                        force: bool = False, **server_kw) -> ApiServer:
+    """Standby-coordinator handoff, REST side: win the election
+    (``oplog.assume_coordination`` — deterministic lowest-live-process
+    rule, only past ``H2O_TPU_ELECTION_GRACE_S`` of coordinator silence),
+    then bind THIS process's REST server so ``/3/*`` keeps being served
+    under the new epoch. The old coordinator, if it returns, finds the
+    newer epoch record and demotes to follower (its broadcasts 503).
+
+    Raises ``oplog.ElectionLost`` without side effects when this process
+    is not the winner or the coordinator is not dead enough yet."""
+    from h2o3_tpu.parallel import oplog
+
+    oplog.assume_coordination(caught_up_seq=caught_up_seq, force=force)
+    return start_server(port=port, **server_kw)
+
+
 # ---------------------------------------------------------------------------
 # extended surface (routes_ext.py) — appended after every server name exists
 # so dispatch and /3/Metadata/endpoints see the full table. If routes_ext

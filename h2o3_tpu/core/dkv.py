@@ -102,7 +102,8 @@ class _DKV:
                     "sharded arrays, not the KV")
             blob_b64 = base64.b64encode(blob).decode()
         meta = {"type": type(value).__name__ if value is not None else "?",
-                "proc": __import__("jax").process_index()}
+                "proc": __import__("jax").process_index(),
+                "replicated": blob_b64 is not None}
         if not D.kv_put(self._META_PREFIX + str(key), _json.dumps(meta)):
             return False
         if blob_b64 is not None:
@@ -120,13 +121,44 @@ class _DKV:
 
     def fetch_remote(self, key: str, timeout_ms: int = 5000) -> Any:
         """Get a key from anywhere in the cloud: local store first, then the
-        replicated control-plane payload (publish(..., replicate=True))."""
+        replicated control-plane payload (publish(..., replicate=True)).
+
+        The blob read rides the shared backoff budget (water/RPC.java's
+        resend schedule, parallel/retry.py) like kv_put/kv_get: a key whose
+        metadata says it WAS replicated but whose blob read drops
+        (transient coordination fault) is retried instead of failing the
+        caller's job on the first blip — a recovery would have saved it
+        anyway. Keys announced WITHOUT replication (the normal case for
+        frames/models whose data lives on device) have no blob to find, so
+        they return immediately instead of burning the backoff budget."""
         local = self.get(key)
         if local is not None:
             return local
+        import json as _json
+
         from h2o3_tpu.parallel import distributed as D
+        from h2o3_tpu.parallel import retry
 
         raw = D.kv_get(self._BLOB_PREFIX + str(key), timeout_ms)
+        if raw is None:
+            # only retry when the metadata says a blob SHOULD exist. (The
+            # announcement check lives on the miss path only — the common
+            # successful fetch stays one KV roundtrip.)
+            meta_raw = D.kv_try_get(self._META_PREFIX + str(key))
+            replicated = False
+            if meta_raw is not None:
+                try:
+                    replicated = bool(_json.loads(meta_raw).get("replicated"))
+                except (ValueError, TypeError):
+                    replicated = False
+            if replicated:
+                import time as _time
+
+                for delay in retry.backoff_delays():
+                    _time.sleep(delay)
+                    raw = D.kv_get(self._BLOB_PREFIX + str(key), timeout_ms)
+                    if raw is not None:
+                        break
         if raw is None:
             return None
         import base64
@@ -135,6 +167,61 @@ class _DKV:
         value = pickle.loads(base64.b64decode(raw))
         self.put(key, value)       # cache locally, like Value caching
         return value
+
+    # -- checkpoint support (parallel/ckpt.py) ---------------------------
+    def snapshot_control_plane(self) -> dict:
+        """Serialize the control plane for an oplog checkpoint: every
+        DKV-resident object that pickles (models, frames, metadata — a
+        Job's live thread does not, and is listed in ``skipped``), plus
+        the announced-key metadata and replicated blobs from the cloud
+        KV. Values are pickled PER KEY so one unpicklable object cannot
+        sink the whole checkpoint."""
+        import pickle
+
+        from h2o3_tpu.parallel import distributed as D
+
+        objects: Dict[str, bytes] = {}
+        skipped: List[str] = []
+        with self._lock:
+            items = list(self._store.items())
+        for k, v in items:
+            try:
+                objects[k] = pickle.dumps(v)
+            except Exception:   # noqa: BLE001 — per-key isolation
+                skipped.append(k)
+        kv: Dict[str, str] = {}
+        for prefix in (self._META_PREFIX, self._BLOB_PREFIX):
+            for kk, vv in D.kv_dir(prefix):
+                kv[kk] = vv
+        return {"objects": objects, "skipped": sorted(skipped), "kv": kv}
+
+    def restore_control_plane(self, snap: dict, loads=None) -> List[str]:
+        """Install a checkpoint snapshot into this process's store (rejoin
+        / standby takeover). `loads` lets the caller supply a restricted
+        unpickler. Returns the keys restored; per-key failures are skipped
+        (the object rebuilds from the oplog suffix or a re-import)."""
+        import pickle
+
+        from h2o3_tpu.parallel import distributed as D
+
+        loads = loads or pickle.loads
+        restored: List[str] = []
+        for k, blob in (snap.get("objects") or {}).items():
+            try:
+                self.put(k, loads(blob))
+                restored.append(k)
+            except Exception:   # noqa: BLE001 — per-key isolation
+                continue
+        for kk, vv in (snap.get("kv") or {}).items():
+            # put-if-absent: the live cloud kept publishing while this
+            # process was down, so a key still present in the shared KV is
+            # at least as new as the checkpoint's copy — overwriting it
+            # would hand every OTHER process a stale blob (and their
+            # fetch_remote caches never invalidate). Only resurrect keys
+            # the KV actually lost.
+            if D.kv_try_get(kk) is None:
+                D.kv_put(kk, vv)
+        return restored
 
     def atomic(self, key: str, fn: Callable[[Any], Any]) -> Any:
         """Compare-and-set style update on the stored value

@@ -28,6 +28,11 @@ HEARTBEAT_STALE_S = 30.0
 # fault injection registry: name -> remaining trigger count
 _FAULTS: Dict[str, int] = {}
 
+# this process's incarnation number: bumped by rejoin() so a restarted
+# follower's beats/acks are distinguishable from its dead predecessor's
+# (water/H2ONode.java's _heartbeat "cloud hash" freshness analog)
+_INCARNATION = 0
+
 
 class CloudUnhealthyError(RuntimeError):
     """The cloud cannot complete multi-process work right now: a follower
@@ -42,12 +47,58 @@ class CloudUnhealthyError(RuntimeError):
         self.remote_trace = remote_trace
 
 
+class ShardUnavailableError(CloudUnhealthyError):
+    """Degraded-mode local scoring needs device shards homed on a dead or
+    unreachable peer. Carries the owning process indices so the operator
+    knows WHICH process to restart; the REST layer maps it to HTTP 503
+    with the remediation hint embedded."""
+
+    def __init__(self, what: str, owners: Optional[List[int]] = None):
+        self.owners = sorted(owners or [])
+        owner_s = (f"process(es) {self.owners}" if self.owners
+                   else "a non-coordinator process")
+        super().__init__(
+            f"{what}: shards are homed on {owner_s}, which this degraded "
+            "cloud cannot reach. Remediation: restart the dead process and "
+            "let it rejoin() (FAILED -> RECOVERING -> HEALTHY), or restart "
+            "the cloud and re-import the frame")
+
+
 def heartbeat_stale_s() -> float:
     """Staleness threshold: beats older than this mark a process dead
     (env ``H2O_TPU_HEARTBEAT_STALE_S``, default 30 s)."""
     from h2o3_tpu.parallel.retry import env_float
 
     return env_float("H2O_TPU_HEARTBEAT_STALE_S", HEARTBEAT_STALE_S)
+
+
+def election_grace_s() -> float:
+    """How long past heartbeat-staleness the coordinator must stay silent
+    before a standby follower may assume coordination
+    (env ``H2O_TPU_ELECTION_GRACE_S``, default 2x the staleness window —
+    an election is far more disruptive than a degrade, so the bar is
+    higher)."""
+    from h2o3_tpu.parallel.retry import env_float
+
+    return env_float("H2O_TPU_ELECTION_GRACE_S", 2.0 * heartbeat_stale_s())
+
+
+def incarnation() -> int:
+    return _INCARNATION
+
+
+def set_incarnation(inc: int) -> None:
+    global _INCARNATION
+    _INCARNATION = int(inc)
+
+
+def bump_incarnation() -> int:
+    """New life for this process (rejoin after a crash/restart): beats and
+    acks from here on carry the fresh incarnation so the coordinator can
+    reject anything the dead predecessor left behind."""
+    global _INCARNATION
+    _INCARNATION += 1
+    return _INCARNATION
 
 
 def heartbeat() -> bool:
@@ -60,12 +111,13 @@ def heartbeat() -> bool:
     faultpoint("failure.heartbeat")
     return D.kv_put(_HB_PREFIX + str(jax.process_index()),
                     json.dumps({"ts": time.time(),
-                                "proc": jax.process_index()}))
+                                "proc": jax.process_index(),
+                                "inc": _INCARNATION}))
 
 
 def cluster_health(stale_after_s: Optional[float] = None) -> List[dict]:
     """Per-process liveness from the heartbeat table: one row per process
-    that has ever beat, with age and a healthy flag."""
+    that has ever beat, with age, incarnation and a healthy flag."""
     from h2o3_tpu.parallel import distributed as D
 
     if stale_after_s is None:
@@ -79,6 +131,7 @@ def cluster_health(stale_after_s: Optional[float] = None) -> List[dict]:
             continue
         age = now - float(rec.get("ts", 0))
         out.append({"process": rec.get("proc"), "age_s": round(age, 3),
+                    "incarnation": int(rec.get("inc", 0)),
                     "healthy": age < stale_after_s})
     return sorted(out, key=lambda r: (r["process"] is None, r["process"]))
 

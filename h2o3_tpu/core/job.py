@@ -30,6 +30,11 @@ class Job(Keyed):
         self.progress = 0.0
         self.progress_msg = ""
         self.exception: Optional[str] = None
+        # True when the cloud supervisor failed this job from outside
+        # (dead follower / cloud FAILED) rather than the worker crashing:
+        # such a job stays FAILED across a later cloud recovery — clients
+        # resubmit against the recovered cloud, nothing auto-reruns
+        self.failed_externally = False
         self.start_time = 0.0
         self.end_time = 0.0
         self._cancel_requested = False
@@ -106,6 +111,7 @@ class Job(Keyed):
             if not self.is_running:
                 return
             self.exception = exception_text
+            self.failed_externally = True
             self.status = Job.FAILED
             self.end_time = time.time()
 
@@ -133,6 +139,7 @@ class Job(Keyed):
             "progress_msg": self.progress_msg,
             "dest": self.dest,
             "exception": self.exception,
+            "failed_externally": self.failed_externally,
             "start_time": self.start_time,
             "end_time": self.end_time,
         }

@@ -154,6 +154,9 @@ class Cluster:
     def info(self) -> dict:
         import jax
 
+        from h2o3_tpu.core import failure
+        from h2o3_tpu.parallel import distributed as D
+
         return {
             "cloud_name": self.args.name,
             "version": "h2o3_tpu",
@@ -162,6 +165,12 @@ class Cluster:
             "cloud_healthy": True,
             "locked": self.locked,
             "platform": jax.default_backend(),
+            # recovery-layer identity: which election epoch this cloud is
+            # in, who leads it, and this process's incarnation (bumped by
+            # every rejoin) — surfaced on /3/CloudStatus
+            "epoch": D.epoch(),
+            "leader": D.leader(),
+            "incarnation": failure.incarnation(),
             "nodes": [
                 {"name": str(d), "platform": d.platform, "id": d.id}
                 for d in self.devices

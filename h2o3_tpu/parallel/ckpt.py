@@ -1,0 +1,344 @@
+"""Oplog checkpoint + compaction: bound the control-plane KV footprint.
+
+Reference: H2O-3 never replays history — any node can re-derive state from
+the DKV (SURVEY §1, water/H2O.java), so its control plane carries no log.
+Our REST-driven oplog DOES carry one (parallel/oplog.py), and before this
+module every op slot and ack lived in the coordination KV forever. Podracer
+TPU fleets (arXiv:2104.06272) checkpoint/restore workers as the NORMAL
+response to preemption; this is that layer for the cloud control plane:
+
+- every ``H2O_TPU_OPLOG_CHECKPOINT_OPS`` fully-acknowledged ops the
+  coordinator publishes a ``checkpoint`` op; inside its execution turn
+  (turnstile held: no other op mutates the DKV) it serializes a consistent
+  control-plane snapshot — DKV-resident objects (models, frames, jobs'
+  metadata), announced key metadata + replicated blobs, the next oplog
+  sequence and the recent op identity tokens — to a file under the
+  checkpoint dir, recording ``oplog/ckpt/{seq}`` in the cloud KV;
+- once the checkpoint op is fully acked (every follower has replayed
+  through it), the acknowledged prefix — ``oplog/{s}`` slots and their
+  ``oplog/ack/{s}/*`` records for s <= seq — is truncated, so live oplog
+  keys stay O(interval) no matter how many ops the cloud has served;
+- a restarted follower readmits from the newest checkpoint
+  (``oplog.rejoin``): restore the snapshot, replay the suffix, re-register
+  with a fresh incarnation.
+
+Checkpoint paths resolve through ``persist/`` on load, so a checkpoint dir
+on shared storage (file:// today, s3:// etc. via the scheme registry) lets
+a follower restarted on a DIFFERENT host readmit too.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import pickle
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from h2o3_tpu.core import failure
+from h2o3_tpu.parallel import distributed as D
+from h2o3_tpu.parallel import retry
+
+_CKPT_PREFIX = "oplog/ckpt/"
+
+# acked-op counter since the last checkpoint + single-flight guard: two
+# handler threads crossing the threshold together must not both publish a
+# checkpoint op
+_LOCK = threading.Lock()
+_ACKED_SINCE = 0
+_IN_CKPT = False
+_CKPT_THREAD: Optional[threading.Thread] = None
+# seq of the in-flight (or last) checkpoint op: its OWN ack must not count
+# toward the next interval, but user ops acked while an async checkpoint
+# is still truncating DO — otherwise a slow snapshot under load silently
+# stretches the effective interval past H2O_TPU_OPLOG_CHECKPOINT_OPS and
+# the documented O(interval) bound on live oplog keys
+_CKPT_SEQ: Optional[int] = None
+# highest seq whose slots + acks were truncated. Truncation only runs after
+# the checkpoint op is FULLY acked (every follower replayed through it), so
+# an op at or below this floor is proven-acknowledged even though its ack
+# records are gone — oplog.wait_acks consults it so a waiter still polling
+# for an op the compactor just truncated returns instead of timing out.
+_TRUNCATED_THROUGH = -1
+
+
+def interval_ops() -> int:
+    """Checkpoint every N fully-acked ops (env
+    ``H2O_TPU_OPLOG_CHECKPOINT_OPS``, default 64; <= 0 disables)."""
+    return retry.env_int("H2O_TPU_OPLOG_CHECKPOINT_OPS", 64)
+
+
+def ckpt_dir() -> str:
+    d = os.environ.get("H2O_TPU_OPLOG_CKPT_DIR") or os.path.join(
+        os.environ.get("H2O_TPU_ICE_ROOT", "/tmp/h2o3_tpu"), "oplog_ckpt")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def async_enabled() -> bool:
+    """Run interval checkpoints on a background thread (env
+    ``H2O_TPU_OPLOG_CKPT_ASYNC``, default on). The snapshot + cloud-wide
+    ack of the checkpoint op can take seconds; the user request that
+    happened to cross the interval threshold should not absorb that
+    latency. The chaos tests pin this off: a synchronous checkpoint lands
+    at a deterministic sequence position."""
+    return retry.env_int("H2O_TPU_OPLOG_CKPT_ASYNC", 1) != 0
+
+
+def reset() -> None:
+    """Clear the coordinator-side counter (cloud restart / tests)."""
+    global _ACKED_SINCE, _TRUNCATED_THROUGH, _CKPT_SEQ
+    with _LOCK:
+        _ACKED_SINCE = 0
+        _TRUNCATED_THROUGH = -1
+        _CKPT_SEQ = None
+
+
+def truncated_through() -> int:
+    """Highest seq compacted away — every op at or below it was fully
+    acknowledged cloud-wide before its records were deleted (-1: none)."""
+    return _TRUNCATED_THROUGH
+
+
+def wait_idle(timeout_s: float = 30.0) -> bool:
+    """Join an in-flight background checkpoint, if any (tests / orderly
+    shutdown). True when no checkpoint is running on return."""
+    t = _CKPT_THREAD
+    if t is not None and t.is_alive():
+        t.join(timeout_s)
+        return not t.is_alive()
+    return True
+
+
+class _CkptUnpickler(pickle.Unpickler):
+    """Framework/numeric types only — a checkpoint file (possibly fetched
+    from shared storage) must not smuggle arbitrary callables, same
+    contract as the binary-artifact loader in api/routes_ext.py."""
+
+    _PREFIXES = ("h2o3_tpu.", "numpy.", "jax.", "jaxlib.", "collections.",
+                 "functools.")
+    _MODULES = {"numpy", "jax", "jaxlib", "collections", "functools",
+                "threading"}
+    _BUILTINS = {"set", "frozenset", "slice", "complex", "range",
+                 "bytearray", "object"}
+
+    def find_class(self, module, name):
+        if module == "builtins" and name in self._BUILTINS:
+            return super().find_class(module, name)
+        if module in self._MODULES or \
+                any(module.startswith(pfx) for pfx in self._PREFIXES):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"checkpoint references disallowed type {module}.{name}")
+
+
+def _loads(data: bytes) -> Any:
+    return _CkptUnpickler(io.BytesIO(data)).load()
+
+
+# ---------------------------------------------------------------------------
+# coordinator side: write + truncate
+# ---------------------------------------------------------------------------
+
+def note_acked_op(seq: int) -> None:
+    """Called by the coordinator after op `seq` is fully acknowledged
+    (oplog.turn's tail). Every ``interval_ops()`` acked ops, takes a
+    checkpoint and truncates the acknowledged prefix. Never raises: a
+    checkpoint failure must not fail the user op that crossed the
+    threshold — the next acked op simply re-tries."""
+    global _ACKED_SINCE, _IN_CKPT, _CKPT_THREAD
+    n = interval_ops()
+    if n <= 0:
+        return
+    with _LOCK:
+        if seq == _CKPT_SEQ:            # the checkpoint op's own ack
+            return
+        _ACKED_SINCE += 1
+        if _ACKED_SINCE < n or _IN_CKPT:
+            return                      # counted; _IN_CKPT only gates the
+                                        # single-flight spawn — the next op
+                                        # acked after it clears triggers
+        _IN_CKPT = True
+        _ACKED_SINCE = 0
+    if async_enabled():
+        # off the acked op's thread: the checkpoint op still serializes
+        # behind the turnstile like any other op, but the user request
+        # that crossed the threshold returns without paying for the
+        # snapshot or the cloud-wide ack wait
+        t = threading.Thread(target=_run_checkpoint, daemon=True,
+                             name="h2o3-oplog-ckpt")
+        with _LOCK:
+            _CKPT_THREAD = t
+        t.start()
+    else:
+        _run_checkpoint()
+
+
+def _run_checkpoint() -> None:
+    global _IN_CKPT
+    try:
+        checkpoint_now()
+    except Exception as e:   # noqa: BLE001 — best-effort by contract
+        from h2o3_tpu.utils.log import get_logger
+
+        get_logger().warning("oplog checkpoint failed (will retry at the "
+                             "next interval): %s", e)
+    finally:
+        with _LOCK:
+            _IN_CKPT = False
+
+
+def checkpoint_now() -> Optional[int]:
+    """Publish + execute one ``checkpoint`` op: snapshot under the
+    turnstile (no concurrent op is mutating the DKV), then — once every
+    follower acked it — truncate the acknowledged prefix. Returns the
+    checkpoint's sequence (None when the cloud is not broadcasting or
+    this process no longer leads it: an async checkpoint thread resuming
+    on a stalled ex-coordinator must not publish at a stale seq — or
+    truncate records in the SHARED KV — under an epoch it lost."""
+    global _CKPT_SEQ
+    from h2o3_tpu.parallel import oplog
+
+    oplog.maybe_demote()
+    if oplog.demoted() or not oplog.active():
+        return None
+    epoch0 = D.epoch()
+    seq = oplog.publish("checkpoint", {})
+    with _LOCK:
+        _CKPT_SEQ = seq
+    with oplog.turn(seq):
+        write_checkpoint(seq)
+    # turn()'s exit completed wait_acks(seq): every follower replayed
+    # through seq, so the prefix (seq included) is dead weight — unless
+    # leadership moved while we snapshotted, in which case the records
+    # now belong to the new coordinator's epoch and are not ours to drop
+    oplog.maybe_demote()
+    if oplog.demoted() or not D.is_coordinator() or D.epoch() != epoch0:
+        return None
+    truncate_through(seq)
+    return seq
+
+
+def write_checkpoint(seq: int) -> str:
+    """Serialize the control-plane snapshot for checkpoint op `seq` and
+    record it at ``oplog/ckpt/{seq}``. The snapshot's ``next_seq`` is
+    seq + 1: state includes ops < seq, and op seq is the checkpoint
+    itself (no state change), so a restorer resumes replay after it."""
+    from h2o3_tpu.core.dkv import DKV
+    from h2o3_tpu.parallel import oplog
+
+    failure.faultpoint("ckpt.write")
+    snap = {
+        "seq": int(seq),
+        "next_seq": int(seq) + 1,
+        "epoch": D.epoch(),
+        "ts": time.time(),
+        "op_ids": oplog.snapshot_op_ids(),
+        "dkv": DKV.snapshot_control_plane(),
+    }
+    path = os.path.join(ckpt_dir(), f"ckpt_{int(seq):012d}.pkl")
+    tmp = path + ".part"
+    with open(tmp, "wb") as f:
+        pickle.dump(snap, f)
+    os.replace(tmp, path)                  # readers never see a torn file
+    if not D.kv_put(_CKPT_PREFIX + str(int(seq)),
+                    json.dumps({"seq": int(seq), "next_seq": int(seq) + 1,
+                                "path": path, "epoch": D.epoch(),
+                                "ts": snap["ts"],
+                                "skipped": snap["dkv"].get("skipped", [])})):
+        raise RuntimeError(f"checkpoint {seq}: KV record did not land")
+    _prune_old(keep=2)
+    from h2o3_tpu.utils import timeline
+
+    timeline.record("oplog", "checkpoint", seq=int(seq),
+                    objects=len(snap["dkv"].get("objects", {})),
+                    skipped=len(snap["dkv"].get("skipped", [])))
+    return path
+
+
+def records() -> List[Tuple[int, dict]]:
+    """All checkpoint records, sorted by seq."""
+    out = []
+    for k, v in D.kv_dir(_CKPT_PREFIX):
+        try:
+            out.append((int(k.rsplit("/", 1)[-1]), json.loads(v)))
+        except (ValueError, TypeError):
+            continue
+    return sorted(out, key=lambda t: t[0])
+
+
+def latest() -> Optional[Tuple[int, dict]]:
+    recs = records()
+    return recs[-1] if recs else None
+
+
+def latest_seq() -> Optional[int]:
+    rec = latest()
+    return rec[0] if rec else None
+
+
+def _prune_old(keep: int = 2) -> None:
+    """Drop all but the newest `keep` checkpoints (KV records + files)."""
+    recs = records()
+    for seq, rec in recs[:-keep] if keep > 0 else recs:
+        D.kv_delete(_CKPT_PREFIX + str(seq))
+        p = rec.get("path")
+        if p:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+
+def truncate_through(seq: int) -> int:
+    """Delete acknowledged oplog slots + ack records for seqs <= `seq`.
+    Error records are NOT touched: they are failure evidence, superseded
+    only by a successful rejoin re-replay. Returns keys deleted."""
+    global _TRUNCATED_THROUGH
+    # raise the floor BEFORE deleting: a wait_acks(s<=seq) poller that
+    # races the deletes must see either its ack records or the floor,
+    # never neither (the floor is sound — the caller only truncates a
+    # fully-acknowledged prefix)
+    with _LOCK:
+        _TRUNCATED_THROUGH = max(_TRUNCATED_THROUGH, int(seq))
+    n = 0
+    for k, _v in D.kv_dir("oplog/"):
+        tail = k[len("oplog/"):]
+        parts = tail.split("/")
+        s = None
+        if len(parts) == 1 and parts[0].isdigit():          # oplog/{s}
+            s = int(parts[0])
+        elif len(parts) >= 2 and parts[0] == "ack" and parts[1].isdigit():
+            s = int(parts[1])                               # oplog/ack/{s}/..
+        if s is not None and s <= seq:
+            D.kv_delete(k)
+            n += 1
+    return n
+
+
+# ---------------------------------------------------------------------------
+# restore side (follower rejoin / standby takeover)
+# ---------------------------------------------------------------------------
+
+def load_latest(restore_dkv: bool = True) -> Tuple[int, Optional[dict]]:
+    """Load the newest checkpoint: returns ``(next_seq, snapshot)`` —
+    the oplog cursor to resume replay at, and the raw snapshot dict
+    (``(0, None)`` when no checkpoint exists). With `restore_dkv`, the
+    snapshot's DKV objects and announced-key metadata are installed into
+    this process's store first. The path resolves through ``persist/`` so
+    checkpoints on shared storage restore across hosts."""
+    from h2o3_tpu import persist
+    from h2o3_tpu.core.dkv import DKV
+
+    rec = latest()
+    if rec is None:
+        return 0, None
+    seq, meta = rec
+    path = persist.resolve(meta["path"])
+    with open(path, "rb") as f:
+        snap = _CkptUnpickler(f).load()
+    if restore_dkv:
+        DKV.restore_control_plane(snap.get("dkv") or {}, loads=_loads)
+    return int(snap.get("next_seq", seq + 1)), snap

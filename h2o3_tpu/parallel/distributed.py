@@ -34,16 +34,91 @@ def initialize(coordinator_address: Optional[str] = None,
     )
 
 
+# ---------------------------------------------------------------------------
+# leadership (water/Paxos.java leader = lowest H2ONode; here the epoch
+# record in the cloud KV names the leader, and a standby-coordinator
+# election can move it — see oplog.assume_coordination)
+# ---------------------------------------------------------------------------
+
+# process-local view of who leads: proc index, and the epoch it was
+# learned under. Epoch 0 / leader 0 is the boot default (jax process 0
+# hosts the coordination service, so it is the natural first leader).
+_LEADER = 0
+_EPOCH = 0
+
+_EPOCH_KEY = "oplog/epoch"
+
+
+def leader() -> int:
+    return _LEADER
+
+
+def epoch() -> int:
+    return _EPOCH
+
+
+def set_leader(proc: int, epoch_no: int) -> None:
+    """Adopt a leadership view (election win, or demotion on discovering a
+    newer epoch record)."""
+    global _LEADER, _EPOCH
+    _LEADER = int(proc)
+    _EPOCH = int(epoch_no)
+
+
+def reset_leadership() -> None:
+    """Back to the boot default (tests / cloud restart)."""
+    set_leader(0, 0)
+
+
+def epoch_record() -> dict:
+    """The cloud-wide epoch record ({epoch, leader, ts}); the boot default
+    when none was ever written."""
+    import json as _json
+
+    raw = kv_try_get(_EPOCH_KEY)
+    if raw is None:
+        return {"epoch": 0, "leader": 0, "ts": 0.0}
+    try:
+        rec = _json.loads(raw)
+        return {"epoch": int(rec.get("epoch", 0)),
+                "leader": int(rec.get("leader", 0)),
+                "ts": float(rec.get("ts", 0.0))}
+    except (ValueError, TypeError):
+        return {"epoch": 0, "leader": 0, "ts": 0.0}
+
+
+def write_epoch_record(epoch_no: int, leader_proc: int) -> bool:
+    import json as _json
+    import time as _time
+
+    return kv_put(_EPOCH_KEY, _json.dumps({"epoch": int(epoch_no),
+                                           "leader": int(leader_proc),
+                                           "ts": _time.time()}))
+
+
 def is_coordinator() -> bool:
     import jax
 
-    return jax.process_index() == 0
+    return jax.process_index() == _LEADER
 
 
 def process_count() -> int:
     import jax
 
     return jax.process_count()
+
+
+def rejoin():
+    """Readmit THIS (restarted) process to the cloud: fresh incarnation,
+    state restored from the latest oplog checkpoint, acknowledged suffix
+    replayed, heartbeat re-registered. Returns the oplog sequence this
+    process is caught up to (the follower_loop resume cursor).
+
+    The thin public entry; the protocol lives in ``oplog.rejoin`` (it owns
+    the replay/ack machinery)."""
+    from h2o3_tpu.parallel import oplog
+
+    return oplog.rejoin()
 
 
 # ---------------------------------------------------------------------------

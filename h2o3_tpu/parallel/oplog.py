@@ -116,6 +116,17 @@ _TLS = threading.local()
 # a collective the follower will never join.
 REST_SERVING = False
 
+# set when this process discovers a NEWER epoch record naming another
+# leader while it believed itself the coordinator: it must refuse to run
+# multi-process ops (locally OR broadcast) until it rejoins as a follower
+_DEMOTED = False
+
+
+def demoted() -> bool:
+    """True when this process lost coordination to a newer epoch and has
+    not yet rejoined as a follower (see maybe_demote)."""
+    return _DEMOTED
+
 
 def _in_op() -> bool:
     return bool(getattr(_TLS, "in_op", False))
@@ -144,7 +155,7 @@ def _ack_timeout_s() -> float:
 
 def reset(next_seq: int = 0) -> None:
     """Reset the coordinator-side protocol state (sequence counter,
-    turnstile, abandoned slots). Test/bootstrap use only."""
+    turnstile, abandoned slots). Test/bootstrap/standby-takeover use."""
     global _SEQ, _NEXT_EXEC, _EXECUTING, _GEN, _HEAD_IDLE_SINCE
     with _EXEC_COND:
         _SEQ = next_seq
@@ -155,6 +166,22 @@ def reset(next_seq: int = 0) -> None:
         _ABANDONED.clear()
         _OP_IDS.clear()
         _EXEC_COND.notify_all()
+    from h2o3_tpu.parallel import ckpt
+
+    ckpt.reset()
+
+
+def snapshot_op_ids() -> Dict[int, str]:
+    """Recent op identity tokens, for the control-plane checkpoint: a
+    coordinator restored from it can still match in-flight acks."""
+    with _PUB_LOCK:
+        return dict(_OP_IDS)
+
+
+def current_seq() -> int:
+    """Next sequence to be claimed (ops < this are published)."""
+    with _PUB_LOCK:
+        return _SEQ
 
 
 def publish(kind: str, payload: Dict[str, Any]) -> int:
@@ -205,7 +232,23 @@ def broadcast(kind: str, payload: Dict[str, Any]) -> Optional[int]:
     Degraded-mode fail-fast: when the supervisor has marked the cloud
     DEGRADED/FAILED, new multi-process ops are refused immediately with a
     clear CloudUnhealthyError instead of being queued toward a collective
-    the dead/stale follower will never join."""
+    the dead/stale follower will never join. A DEMOTED ex-coordinator
+    (a standby won the epoch while this process was away) refuses too:
+    silently falling through to local execution would fork its state from
+    the cloud the new coordinator now leads."""
+    if D.process_count() > 1:
+        # leadership-view refresh before publishing: a standby's takeover
+        # must be discovered here, not one supervision tick later. Single-
+        # process there is no standby — that fast path keeps paying
+        # nothing (the docstring's contract).
+        maybe_demote()
+    if _DEMOTED:
+        rec = D.epoch_record()
+        raise failure.CloudUnhealthyError(
+            f"this process was demoted to follower (epoch "
+            f"{rec['epoch']} is led by process {rec['leader']}): refusing "
+            "to execute a multi-process op against a cloud it no longer "
+            "coordinates — rejoin() as a follower or restart")
     if active():
         from h2o3_tpu.parallel import supervisor
 
@@ -353,6 +396,13 @@ def turn(seq: Optional[int], timeout_s: Optional[float] = None):
     # dead/crashed follower surfaces HERE as a clear error instead of
     # hanging the NEXT collective this handler (or any later op) runs
     wait_acks(seq)
+    # the op is fully acknowledged cloud-wide: feed the checkpoint
+    # accountant — every H2O_TPU_OPLOG_CHECKPOINT_OPS acked ops it
+    # snapshots the control plane and truncates the acked prefix, keeping
+    # live oplog/* keys O(interval) (never raises; see parallel/ckpt.py)
+    from h2o3_tpu.parallel import ckpt
+
+    ckpt.note_acked_op(seq)
 
 
 # ---------------------------------------------------------------------------
@@ -364,17 +414,30 @@ def expected_acks() -> int:
     return max(D.process_count() - 1, 0)
 
 
-def acks_for(seq: int, op_id: Optional[str] = None) -> List[str]:
+def acks_for(seq: int, op_id: Optional[str] = None,
+             min_incs: Optional[Dict[int, int]] = None) -> List[str]:
     """Ack keys recorded for op `seq`; with `op_id`, only acks carrying
     that identity token (stale acks from a lost-then-landed op whose slot
-    was rolled back and reclaimed do not count for the reclaiming op)."""
+    was rolled back and reclaimed do not count for the reclaiming op).
+    With `min_incs` ({proc: incarnation}), acks from an OLDER incarnation
+    of a since-rejoined process are rejected too: the dead predecessor's
+    leftover ack must not vouch for a replay only its successor can do."""
     out = []
     for k, v in D.kv_dir(f"{_PREFIX}/ack/{seq}/"):
-        if op_id is not None:
+        try:
+            rec = json.loads(v)
+        except (ValueError, TypeError):
+            continue
+        if not isinstance(rec, dict):
+            continue               # truncated/corrupt ack: doesn't count
+        if op_id is not None and rec.get("op_id") != op_id:
+            continue
+        if min_incs:
             try:
-                if json.loads(v).get("op_id") != op_id:
-                    continue
-            except (ValueError, AttributeError):
+                proc = int(rec.get("proc", k.rsplit("/", 1)[-1]))
+            except (ValueError, TypeError):
+                continue
+            if int(rec.get("inc", 0)) < min_incs.get(proc, 0):
                 continue
         out.append(k)
     return out
@@ -421,10 +484,14 @@ def wait_acks(seq: Optional[int], timeout_s: Optional[float] = None) -> None:
         timeout_s = _ack_timeout_s()
     if timeout_s <= 0:
         return
-    from h2o3_tpu.parallel import supervisor
+    from h2o3_tpu.parallel import ckpt, supervisor
 
     poll = retry.AdaptivePoll(min_s=0.001, max_s=0.25)
     deadline = time.monotonic() + timeout_s
+    # one rejoin-record scan per wait, not per poll tick: an incarnation
+    # bump mid-wait means the follower crashed, which surfaces through the
+    # error/FAILED branches below — the stale-ack floor can't regress
+    min_incs = expected_incarnations()
     while True:
         err = error_for(seq)
         if err is not None:
@@ -449,8 +516,14 @@ def wait_acks(seq: Optional[int], timeout_s: Optional[float] = None) -> None:
             raise failure.CloudUnhealthyError(
                 f"cloud FAILED while waiting for op {seq} acks: "
                 f"{st['reason']}", remote_trace=st["remote_trace"])
-        got = len(acks_for(seq, _OP_IDS.get(seq)))
+        got = len(acks_for(seq, _OP_IDS.get(seq), min_incs))
         if got >= n:
+            return
+        if seq <= ckpt.truncated_through():
+            # the compactor truncated this op's records mid-wait: that
+            # only happens after the checkpoint op covering it was fully
+            # acked, which proves every follower replayed through `seq` —
+            # the acks are gone, not missing
             return
         if time.monotonic() >= deadline:
             msg = (f"op {seq}: {got}/{n} follower acks within "
@@ -487,7 +560,8 @@ def _ack(seq: int, op_id: Optional[str] = None) -> None:
     failure.faultpoint("oplog.ack")
     proc = jax.process_index()
     key = f"{_PREFIX}/ack/{seq}/{proc}"
-    val = json.dumps({"proc": proc, "ts": time.time(), "op_id": op_id})
+    val = json.dumps({"proc": proc, "ts": time.time(), "op_id": op_id,
+                      "inc": failure.incarnation()})
     ok = D.kv_put(key, val)
     for delay in retry.backoff_delays():
         if ok:
@@ -525,6 +599,11 @@ def _apply(kind: str, p: Dict[str, Any]) -> None:
     if kind == "noop":
         # liveness probe / chaos-test vehicle: replay + ack with no
         # framework work
+        return
+    if kind == "checkpoint":
+        # coordinator-side snapshot marker: the follower's ack IS its
+        # participation (it proves the follower replayed everything before
+        # this op, which is what licenses the coordinator's truncation)
         return
     if kind == "import_file":
         from h2o3_tpu.ingest.parser import import_file
@@ -659,7 +738,8 @@ def _apply(kind: str, p: Dict[str, Any]) -> None:
 
 
 def follower_loop(idle_timeout_s: float = 120.0,
-                  on_op: Optional[Callable[[str, dict], None]] = None) -> int:
+                  on_op: Optional[Callable[[str, dict], None]] = None,
+                  start_seq: int = 0) -> int:
     """Replay coordinator ops until a 'shutdown' op (or idle timeout).
     Returns the number of ops applied. Runs on every non-coordinator
     process of a multi-process cloud whose coordinator serves REST.
@@ -668,8 +748,11 @@ def follower_loop(idle_timeout_s: float = 120.0,
     a replay crash is surfaced to the cloud (``oplog/error/{seq}`` with
     the traceback) BEFORE re-raising, so the coordinator's `wait_acks`
     and the supervisor see the failure instead of a bare collective hang.
-    Polling is adaptive (1→250 ms): hot while ops stream, cheap idle."""
-    i, applied = 0, 0
+    Polling is adaptive (1→250 ms): hot while ops stream, cheap idle.
+    `start_seq` resumes the replay cursor after a checkpoint restore
+    (``rejoin()`` returns it): ops before it were truncated or already
+    folded into this process's state."""
+    i, applied = start_seq, 0
     poll = retry.AdaptivePoll(min_s=0.001, max_s=0.25)
     deadline = time.time() + idle_timeout_s
     while time.time() < deadline:
@@ -698,3 +781,316 @@ def follower_loop(idle_timeout_s: float = 120.0,
         i += 1
         deadline = time.time() + idle_timeout_s
     raise TimeoutError(f"oplog follower idle for {idle_timeout_s}s at op {i}")
+
+
+# ---------------------------------------------------------------------------
+# follower readmission (rejoin) — water/Paxos.java re-admission analog:
+# a restarted node re-derives state (here: checkpoint + oplog suffix)
+# instead of the cloud staying FAILED forever
+# ---------------------------------------------------------------------------
+
+_REJOIN_PREFIX = f"{_PREFIX}/rejoin/"
+
+
+def _write_rejoin(proc: int, inc: int, phase: str, seq: int) -> None:
+    D.kv_put(f"{_REJOIN_PREFIX}{proc}",
+             json.dumps({"proc": proc, "inc": inc, "phase": phase,
+                         "seq": int(seq), "ts": time.time()}))
+
+
+def rejoin_records() -> List[dict]:
+    """Per-process readmission records ({proc, inc, phase, seq, ts}),
+    sorted by proc. Phase is 'replaying' while the suffix replay runs and
+    'caught_up' once the process reached the oplog head."""
+    out = []
+    for _k, v in D.kv_dir(_REJOIN_PREFIX):
+        try:
+            rec = json.loads(v)
+        except (ValueError, TypeError):
+            continue
+        if isinstance(rec, dict):       # truncated/corrupt record: skip
+            out.append(rec)
+    return sorted(out, key=lambda r: r.get("proc", -1))
+
+
+def expected_incarnations() -> Dict[int, int]:
+    """Minimum acceptable incarnation per process: a proc that rejoined at
+    incarnation i must ack with inc >= i — anything older is a leftover
+    from its dead predecessor."""
+    return {int(r["proc"]): int(r.get("inc", 0)) for r in rejoin_records()
+            if r.get("proc") is not None}
+
+
+def rejoin() -> int:
+    """Readmit THIS restarted process: bump the incarnation, restore the
+    latest control-plane checkpoint, replay the acknowledged oplog suffix
+    (acking each op under the fresh incarnation), delete the failure
+    evidence this replay supersedes, and publish a 'caught_up' rejoin
+    record the supervisor folds into FAILED -> RECOVERING -> HEALTHY.
+
+    Returns the caught-up sequence — pass it to ``follower_loop(...,
+    start_seq=...)`` to keep replaying live ops. A crash during the
+    suffix replay records ``oplog/error/{seq}`` like the normal loop (the
+    cloud re-FAILs with the true story) and re-raises.
+
+    A DEMOTED ex-coordinator rejoining this way is restored to service:
+    it adopts the newer epoch's leadership view, and on a successful
+    catch-up the demotion flag and the supervisor's demotion hold are
+    cleared — this is exactly the "rejoin() as a follower" remediation
+    the demotion error advertises."""
+    global _DEMOTED
+    import jax
+
+    from h2o3_tpu.parallel import ckpt
+
+    proc = jax.process_index()
+    rec = D.epoch_record()
+    if rec["epoch"] >= D.epoch():
+        # adopt the cloud's current leadership view before replaying: a
+        # standby may have taken a newer epoch while this process was down
+        D.set_leader(rec["leader"], rec["epoch"])
+    # a REAL process restart boots with the local incarnation counter at
+    # 0 — seed it from the cloud's evidence (heartbeat table + standing
+    # rejoin record) first, or the second crash/restart cycle would rejoin
+    # at an incarnation the supervisor's strictly-newer FAILED->RECOVERING
+    # gate has already seen and the cloud would stay FAILED forever
+    on_record = expected_incarnations().get(proc, 0)
+    for r in failure.cluster_health(stale_after_s=float("inf")):
+        if r.get("process") == proc:
+            on_record = max(on_record, int(r.get("incarnation", 0)))
+    if failure.incarnation() < on_record:
+        failure.set_incarnation(on_record)
+    inc = failure.bump_incarnation()
+    failure.heartbeat()                    # announce the fresh incarnation
+    cursor, _snap = ckpt.load_latest()
+    _write_rejoin(proc, inc, "replaying", cursor)
+    while True:
+        raw = D.kv_try_get(f"{_PREFIX}/{cursor}")
+        if raw is None:
+            break                          # reached the head
+        op = json.loads(raw)
+        if op["kind"] == "shutdown":
+            break
+        try:
+            failure.faultpoint("oplog.rejoin.replay")
+            _apply(op["kind"], op["payload"])
+        except Exception:
+            _record_error(cursor, op["kind"], traceback.format_exc())
+            raise
+        _ack(cursor, op.get("op_id"))
+        cursor += 1
+    # a successful re-replay through `cursor` supersedes the dead
+    # incarnation's failure evidence for those ops: the programs ARE
+    # replayable, and this process's state now includes them
+    for s, _rec in error_records():
+        if s < cursor:
+            D.kv_delete(f"{_PREFIX}/error/{s}")
+    _write_rejoin(proc, inc, "caught_up", cursor)
+    if _DEMOTED:
+        # caught up as a follower of the new epoch: the demotion did its
+        # job. Clear the flag and lift the supervisor's infinite demotion
+        # hold so liveness evidence can recover the health state.
+        _DEMOTED = False
+        from h2o3_tpu.parallel import supervisor
+
+        supervisor.release_hold()
+    from h2o3_tpu.utils import timeline
+
+    timeline.record("cloud", "rejoin", proc=proc, inc=inc,
+                    caught_up_seq=cursor)
+    return cursor
+
+
+# ---------------------------------------------------------------------------
+# standby-coordinator handoff — water/Paxos.java leader = lowest live node.
+# A follower assumes coordination when the coordinator's heartbeat stays
+# silent past the election grace; the old coordinator, if it returns,
+# detects the newer epoch and demotes.
+# ---------------------------------------------------------------------------
+
+class ElectionLost(RuntimeError):
+    """This process is not the deterministic election winner (the lowest
+    live process index), or the coordinator is not dead enough yet."""
+
+
+def _sealed_next_seq(caught_up_seq: Optional[int] = None) -> int:
+    """Where the new epoch's sequence starts: past everything any
+    follower acknowledged, past the newest checkpoint, and past whatever
+    the caller itself replayed — the new coordinator must never reuse a
+    slot some process already ran a program for."""
+    from h2o3_tpu.parallel import ckpt
+
+    hi = -1
+    for k, _v in D.kv_dir(f"{_PREFIX}/ack/"):
+        parts = k.split("/")
+        if len(parts) >= 3 and parts[1] == "ack" and parts[2].isdigit():
+            hi = max(hi, int(parts[2]))
+    rec = ckpt.latest()
+    if rec is not None:
+        hi = max(hi, int(rec[1].get("next_seq", rec[0] + 1)) - 1)
+    if caught_up_seq is not None:
+        hi = max(hi, int(caught_up_seq) - 1)
+    return hi + 1
+
+
+def assume_coordination(caught_up_seq: Optional[int] = None,
+                        force: bool = False) -> dict:
+    """Deterministic standby takeover (Paxos-lite: lowest live process
+    index wins). Preconditions unless `force`: the recorded leader's
+    heartbeat is silent past ``H2O_TPU_ELECTION_GRACE_S`` AND this
+    process is the lowest-indexed live one. On win: seal the old epoch's
+    oplog at the last acknowledged sequence, write the new epoch record,
+    adopt leadership locally (``distributed.is_coordinator`` flips), and
+    reset the turnstile at the sealed sequence. Device-resident scoring
+    sessions are dropped (they rebuild from the DKV on first use).
+
+    Returns {epoch, leader, next_seq}. The caller re-binds the REST
+    server (``api.server.assume_coordination`` does both)."""
+    import jax
+
+    proc = jax.process_index()
+    rec = D.epoch_record()
+    old_leader, old_epoch = rec["leader"], rec["epoch"]
+    if not force:
+        if proc == old_leader:
+            raise ElectionLost(
+                f"process {proc} already leads epoch {old_epoch}")
+        grace = failure.election_grace_s()
+        health = failure.cluster_health(stale_after_s=grace)
+        by_proc = {r["process"]: r for r in health}
+        lead_row = by_proc.get(old_leader)
+        if lead_row is not None and lead_row["age_s"] < grace:
+            raise ElectionLost(
+                f"coordinator {old_leader} beat {lead_row['age_s']:.1f}s "
+                f"ago — inside the election grace "
+                f"({grace:.1f}s, H2O_TPU_ELECTION_GRACE_S); not assuming")
+        live = sorted(r["process"] for r in failure.cluster_health()
+                      if r["healthy"] and r["process"] != old_leader)
+        winner = live[0] if live else proc
+        if winner != proc:
+            raise ElectionLost(
+                f"election winner is process {winner} (lowest live index; "
+                f"this is {proc}) — standing by")
+    failure.faultpoint("oplog.election")
+    sealed_next = _sealed_next_seq(caught_up_seq)
+    D.kv_put(f"{_PREFIX}/sealed/{old_epoch}",
+             json.dumps({"next_seq": sealed_next, "by": proc,
+                         "ts": time.time()}))
+    new_epoch = old_epoch + 1
+    if not D.write_epoch_record(new_epoch, proc):
+        raise failure.CloudUnhealthyError(
+            f"could not write epoch record {new_epoch} — election aborted")
+    # the epoch record is a last-writer-wins upsert: a concurrent standby
+    # racing this election may have written its own claim on top of ours.
+    # Re-read before adopting leadership — the overwritten claimant is the
+    # only one who can see it lost (the overwriter never sees our write),
+    # so it must stand down here; maybe_demote's same-epoch check catches
+    # the residual window where the overwrite lands after this read-back.
+    rb = D.epoch_record()
+    if rb["epoch"] != new_epoch or rb["leader"] != proc:
+        D.set_leader(rb["leader"], rb["epoch"])
+        raise ElectionLost(
+            f"concurrent election: process {rb['leader']} claimed epoch "
+            f"{rb['epoch']} over this claim of {new_epoch} — standing down")
+    D.set_leader(proc, new_epoch)
+    global _DEMOTED
+    _DEMOTED = False
+    reset(next_seq=sealed_next)
+    # device-resident scoring sessions belonged to the old epoch's program
+    # stream; drop them so first use rebuilds from the (checkpoint-
+    # restored) DKV models on THIS process's devices
+    from h2o3_tpu import scoring
+
+    scoring.purge()
+    # supervision restarts from evidence: the dead old leader's stale beat
+    # will degrade the cloud until it rejoins as a follower
+    from h2o3_tpu.parallel import supervisor
+
+    supervisor.reset()
+    failure.heartbeat()
+    from h2o3_tpu.utils import timeline
+
+    timeline.record("cloud", "assume_coordination", epoch=new_epoch,
+                    leader=proc, next_seq=sealed_next)
+    from h2o3_tpu.utils.log import get_logger
+
+    get_logger().warning(
+        "process %d assumed cloud coordination: epoch %d (was %d led by "
+        "%d), oplog sealed at next_seq=%d", proc, new_epoch, old_epoch,
+        old_leader, sealed_next)
+    return {"epoch": new_epoch, "leader": proc, "next_seq": sealed_next}
+
+
+def maybe_demote() -> Optional[dict]:
+    """Leadership-view refresh: if the cloud's epoch record is newer than
+    this process's view, adopt it. When this process BELIEVED it was the
+    coordinator (it returned from a stall to find a standby leading a
+    newer epoch), it demotes: the flag makes `broadcast` refuse ops, and
+    the supervisor records why. Returns the adopted record, else None."""
+    global _DEMOTED
+    import jax
+
+    rec = D.epoch_record()
+    if rec["epoch"] < D.epoch():
+        return None
+    if rec["epoch"] == D.epoch() and rec["leader"] == D.leader():
+        return None
+    # same-epoch leader mismatch happens when two standbys raced an
+    # election and both wrote epoch N+1 (the record is a last-writer-wins
+    # upsert): the overwritten winner must discover it lost here, or the
+    # cloud splits brain with two coordinators publishing under one epoch
+    was_leading = D.is_coordinator()
+    D.set_leader(rec["leader"], rec["epoch"])
+    if was_leading and rec["leader"] != jax.process_index():
+        _DEMOTED = True
+        from h2o3_tpu.parallel import supervisor
+
+        supervisor.degrade(
+            f"demoted: process {rec['leader']} assumed coordination "
+            f"(epoch {rec['epoch']}) while this ex-coordinator was away — "
+            "rejoin() as a follower or restart this process",
+            hold_s=float("inf"))
+        from h2o3_tpu.utils import timeline
+
+        timeline.record("cloud", "demoted", epoch=rec["epoch"],
+                        leader=rec["leader"])
+    return rec
+
+
+def follower_lag() -> List[dict]:
+    """Per-follower replay progress for GET /3/CloudStatus: last acked
+    sequence, ack lag vs the coordinator's published head, incarnation.
+    Truncated (checkpointed) acks count as caught-up-to-checkpoint."""
+    from h2o3_tpu.parallel import ckpt
+
+    head = current_seq()                 # ops < head are published
+    last: Dict[int, int] = {}
+    incs: Dict[int, int] = {}
+    for k, v in D.kv_dir(f"{_PREFIX}/ack/"):
+        parts = k.split("/")
+        if len(parts) < 4 or not parts[2].isdigit():
+            continue
+        try:
+            s, p = int(parts[2]), int(parts[3])
+        except ValueError:
+            continue
+        if s >= last.get(p, -1):
+            last[p] = s
+            try:
+                rec = json.loads(v)
+            except (ValueError, TypeError):
+                rec = None
+            if isinstance(rec, dict):   # guard like acks_for: a corrupt
+                incs[p] = int(rec.get("inc", 0))   # ack must not 500 the
+                                                   # status route
+    base = ckpt.latest_seq()
+    exp_incs = expected_incarnations()
+    procs = set(last) | set(exp_incs)
+    rows = []
+    for p in sorted(procs):
+        la = last.get(p, base if base is not None else -1)
+        rows.append({"process": p,
+                     "incarnation": incs.get(p, exp_incs.get(p, 0)),
+                     "last_acked_seq": la,
+                     "ack_lag": max(head - 1 - la, 0)})
+    return rows

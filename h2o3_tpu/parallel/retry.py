@@ -31,6 +31,14 @@ def env_float(name: str, default: float) -> float:
         return default
 
 
+def env_int(name: str, default: int) -> int:
+    """Integer env knob with fallback (checkpoint interval, caps)."""
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
 def max_attempts() -> int:
     try:
         n = int(os.environ.get("H2O_TPU_RETRY_MAX", "") or 3)

@@ -8,11 +8,17 @@ otherwise manifests only as an indefinite hang inside the next collective.
 
 This module is that layer for the REST-driven cloud:
 
-- a **state machine** HEALTHY → DEGRADED → FAILED. Stale heartbeats
-  degrade the cloud (and it recovers when beats resume); a follower
-  replay crash (an ``oplog/error/{seq}`` key) fails it permanently — the
-  per-process program counters have diverged and only a cloud restart
-  recovers that.
+- a **state machine** HEALTHY → DEGRADED → FAILED → RECOVERING. Stale
+  heartbeats degrade the cloud (and it recovers when beats resume); a
+  follower replay crash (an ``oplog/error/{seq}`` key) fails it — the
+  per-process program counters have diverged. FAILED is no longer
+  terminal: a restarted follower that readmits (``oplog.rejoin``:
+  checkpoint restore + suffix re-replay under a fresh incarnation) moves
+  the cloud FAILED → RECOVERING, and when every rejoined incarnation is
+  caught up with fresh beats and no error evidence remains, RECOVERING →
+  HEALTHY — new multi-process ops are accepted again. Jobs failed while
+  the cloud was down STAY failed (clients resubmit); only FAILED →
+  HEALTHY without passing through RECOVERING is forbidden.
 - a **supervisor thread** on the coordinator that re-evaluates the state
   every ``H2O_TPU_SUPERVISE_INTERVAL_S`` (default 2 s) and, on failure,
   marks every in-flight Job FAILED with the follower's traceback (their
@@ -35,13 +41,15 @@ from typing import Dict, List, Optional
 from h2o3_tpu.parallel import retry
 
 HEALTHY, DEGRADED, FAILED = "HEALTHY", "DEGRADED", "FAILED"
+RECOVERING = "RECOVERING"
 
 # re-entrant: evaluate() must hold it across its hold_until check AND the
 # recover() transition, or a degrade(hold_s=...) landing between the two is
 # instantly erased together with its hold
 _LOCK = threading.RLock()
 _STATE: Dict = {"state": HEALTHY, "since": time.time(), "reason": "",
-                "remote_trace": "", "hold_until": 0.0}
+                "remote_trace": "", "hold_until": 0.0,
+                "incs_at_failure": {}}
 _TRANSITIONS: List[dict] = []          # bounded history for /3/CloudStatus
 _TRANSITIONS_MAX = 64
 # first evaluate() timestamp: the grace window for processes that have
@@ -72,18 +80,20 @@ def reset() -> None:
     global _FIRST_EVAL_TS
     with _LOCK:
         _STATE.update(state=HEALTHY, since=time.time(), reason="",
-                      remote_trace="", hold_until=0.0)
+                      remote_trace="", hold_until=0.0, incs_at_failure={})
         _TRANSITIONS.clear()
         _FIRST_EVAL_TS = None
 
 
 def _transition(new: str, reason: str, remote_trace: str = "") -> bool:
     """Move to `new` if legal; returns True when the state changed.
-    FAILED is sticky: replay divergence is unrecoverable without a cloud
-    restart, so nothing transitions out of it except reset()."""
+    FAILED is sticky EXCEPT toward RECOVERING: replay divergence is only
+    healed by a follower readmission (checkpoint restore + suffix
+    re-replay under a fresh incarnation) or a cloud restart — never by
+    fresh heartbeats alone."""
     with _LOCK:
         cur = _STATE["state"]
-        if cur == new or cur == FAILED:
+        if cur == new or (cur == FAILED and new != RECOVERING):
             return False
         _STATE.update(state=new, since=time.time(), reason=reason,
                       remote_trace=remote_trace)
@@ -120,19 +130,61 @@ def degrade(reason: str, hold_s: float = 0.0) -> None:
                                        time.time() + hold_s)
 
 
+def release_hold() -> None:
+    """Lift an event-derived degrade hold ahead of its expiry — used when
+    the event is positively resolved (e.g. a demoted ex-coordinator
+    completed its rejoin as a follower), so the next evaluation can
+    recover on liveness evidence instead of waiting out (or never
+    outliving) the pin."""
+    with _LOCK:
+        _STATE["hold_until"] = 0.0
+
+
 def recover(reason: str = "heartbeats fresh, no oplog errors") -> None:
-    """DEGRADED → HEALTHY when liveness evidence returns (never from
-    FAILED — that needs a cloud restart)."""
+    """DEGRADED/RECOVERING → HEALTHY when liveness (and, for RECOVERING,
+    catch-up) evidence returns — never straight from FAILED: that edge
+    only exists through RECOVERING (readmission) or reset()."""
     if _transition(HEALTHY, reason):
         with _LOCK:
             _STATE["hold_until"] = 0.0
 
 
+def _incarnations_now() -> Dict[int, int]:
+    """Highest incarnation currently on record per process, folded from
+    the heartbeat table and any standing rejoin records. Snapshotted at
+    fail() time so the FAILED -> RECOVERING gate can demand a STRICTLY
+    newer incarnation — wall-clock comparisons would let cross-host clock
+    skew block (or leftover records trigger) recovery."""
+    from h2o3_tpu.core import failure
+    from h2o3_tpu.parallel import oplog
+
+    incs: Dict[int, int] = {}
+    for r in failure.cluster_health(stale_after_s=float("inf")):
+        if r.get("process") is not None:
+            incs[int(r["process"])] = int(r.get("incarnation", 0))
+    for p, i in oplog.expected_incarnations().items():
+        incs[p] = max(incs.get(p, 0), i)
+    return incs
+
+
 def fail(reason: str, remote_trace: str = "") -> None:
     """Mark the cloud FAILED (follower replay crash: program counters
-    diverged) and fail every in-flight Job with the remote traceback."""
-    if _transition(FAILED, reason, remote_trace):
-        _fail_running_jobs(reason, remote_trace)
+    diverged) and fail every in-flight Job with the remote traceback.
+    Jobs are failed ONCE, here — a later recovery readmits the cloud for
+    NEW ops but never resurrects a job built against the diverged state."""
+    incs = _incarnations_now()
+    with _LOCK:
+        if not _transition(FAILED, reason, remote_trace):
+            return
+        _STATE["incs_at_failure"] = incs
+    _fail_running_jobs(reason, remote_trace)
+
+
+def begin_recovery(reason: str) -> bool:
+    """FAILED → RECOVERING: readmission evidence arrived (a rejoin record
+    under a fresh incarnation). New multi-process ops stay refused until
+    every rejoined incarnation is caught up (then RECOVERING → HEALTHY)."""
+    return _transition(RECOVERING, reason)
 
 
 def ensure_operable() -> None:
@@ -174,6 +226,11 @@ def evaluate() -> str:
     failure.faultpoint("supervisor.evaluate")
     if _FIRST_EVAL_TS is None:
         _FIRST_EVAL_TS = time.time()
+    if D.process_count() > 1:
+        # leadership-view refresh: a returned ex-coordinator discovers a
+        # standby's newer epoch here (within one supervision tick) and
+        # demotes instead of broadcasting against a cloud it lost
+        oplog.maybe_demote()
     errors = oplog.error_records()
     fatal = [(s, r) for s, r in errors if r.get("fatal", True)]
     if fatal:
@@ -191,6 +248,46 @@ def evaluate() -> str:
                 f"({rec.get('kind', '?')}): "
                 f"{str(rec.get('trace', ''))[-200:]}",
                 hold_s=failure.heartbeat_stale_s())
+        return state()
+    # -- readmission arc: FAILED -> RECOVERING -> HEALTHY ----------------
+    if state() == FAILED:
+        # fresh = an incarnation STRICTLY newer than the one on record at
+        # fail() time — not a wall-clock comparison, which cross-host
+        # clock skew would defeat (a rejoin stamped a few seconds "before"
+        # the failure would block the arc forever)
+        incs0 = status().get("incs_at_failure") or {}
+        fresh = [r for r in oplog.rejoin_records()
+                 if r.get("proc") is not None
+                 and int(r.get("inc", 0)) > int(incs0.get(int(r["proc"]), 0))]
+        if fresh:
+            begin_recovery(
+                f"process(es) {[r.get('proc') for r in fresh]} rejoined "
+                "with fresh incarnation(s); replaying oplog suffix from "
+                "checkpoint")
+    if state() == RECOVERING:
+        recs = oplog.rejoin_records()
+        health = failure.cluster_health()
+        health_by = {r["process"]: r for r in health}
+        # every rejoined incarnation caught up AND no process anywhere in
+        # the cluster gone stale — a SECOND follower dying during the
+        # outage (no rejoin record of its own) must keep us out of
+        # HEALTHY, or new ops get accepted and burn the full ack timeout
+        stale = [r["process"] for r in health if not r["healthy"]]
+        # ... including a peer that died leaving NO heartbeat row (same
+        # never-beat signal as the degrade path below: absence past the
+        # staleness window, measured from supervision start)
+        missing_dead = (D.process_count() - len(health) > 0
+                        and time.time() - _FIRST_EVAL_TS
+                        > failure.heartbeat_stale_s())
+        caught_up = bool(recs) and not stale and not missing_dead and all(
+            r.get("phase") == "caught_up"
+            and health_by.get(r.get("proc"), {}).get("healthy", False)
+            and health_by.get(r.get("proc"), {}).get("incarnation", 0)
+            >= int(r.get("inc", 0))
+            for r in recs)
+        if caught_up:
+            recover("all rejoined incarnations caught up (checkpoint + "
+                    "suffix replayed, heartbeats fresh, no oplog errors)")
         return state()
     health = failure.cluster_health()
     expected = D.process_count()

@@ -41,6 +41,19 @@ import numpy as np
 _DEFAULT_BUCKETS = (256, 1024, 4096, 16384)
 
 
+def _shard_owners(arr) -> list:
+    """Process indices (other than ours) owning shards of a device array —
+    the processes a degraded cloud would need to reach to score it."""
+    import jax
+
+    try:
+        me = jax.process_index()
+        return sorted({d.process_index for d in arr.sharding.device_set}
+                      - {me})
+    except Exception:   # noqa: BLE001 — sharding introspection best-effort
+        return []
+
+
 def _env_buckets() -> Tuple[int, ...]:
     raw = os.environ.get("H2O_TPU_SCORE_BUCKETS", "")
     if not raw.strip():
@@ -179,14 +192,14 @@ class ScoringSession:
         if self._local_cache is None:
             import jax.numpy as jnp
 
-            from h2o3_tpu.core.failure import CloudUnhealthyError
+            from h2o3_tpu.core.failure import ShardUnavailableError
 
             for a in self._arrays:
                 if not getattr(a, "is_fully_addressable", True):
-                    raise CloudUnhealthyError(
-                        "cloud degraded and the model's forest arrays have "
-                        "non-coordinator shards — cannot score without the "
-                        "dead peer")
+                    raise ShardUnavailableError(
+                        f"cloud degraded and model {self.model.key}'s "
+                        "forest arrays are not fully addressable here",
+                        owners=_shard_owners(a))
             self._local_cache = tuple(jnp.asarray(np.asarray(a))
                                       for a in self._arrays)
         return self._local_cache
@@ -273,16 +286,16 @@ class ScoringSession:
         t0 = time.perf_counter()
         local_mp = local_only and jax.process_count() > 1
         if local_mp:
-            from h2o3_tpu.core.failure import CloudUnhealthyError
+            from h2o3_tpu.core.failure import ShardUnavailableError
 
             for frame, _, _ in entries:
                 for nm in frame.names:
-                    if not getattr(frame.col(nm).data,
-                                   "is_fully_addressable", True):
-                        raise CloudUnhealthyError(
+                    data = frame.col(nm).data
+                    if not getattr(data, "is_fully_addressable", True):
+                        raise ShardUnavailableError(
                             f"cloud degraded and frame {frame.key} has "
-                            f"non-coordinator shards (column {nm!r}) — "
-                            "cannot score without the dead peer")
+                            f"non-coordinator shards (column {nm!r})",
+                            owners=_shard_owners(data))
         if jax.process_count() > 1 and not local_only:
             results = []
             for frame, dest, with_metrics in entries:

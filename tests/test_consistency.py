@@ -1,0 +1,85 @@
+"""Tier-1-safe consistency guards: test/code drift detectors.
+
+1. Every faultpoint a test arms (``failure.inject("name")`` /
+   ``_FAULTS["name"]``) must exist as a ``faultpoint("name")`` call in
+   ``h2o3_tpu/`` — a renamed faultpoint otherwise silently turns a chaos
+   test into a no-op that "passes" without injecting anything.
+2. The ``[tool.pytest.ini_options] markers`` list in pyproject.toml must
+   stay in sync with the custom markers actually used under ``tests/``:
+   a marker used but not declared breaks ``--strict-markers`` runs, a
+   marker declared but never used is dead registry weight.
+
+Pure text scans — no jax, no devices, milliseconds.
+"""
+
+import re
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+SRC = ROOT / "h2o3_tpu"
+TESTS = ROOT / "tests"
+
+# pytest's own marks + common third-party ones: not ours to declare
+_BUILTIN_MARKS = {"parametrize", "skip", "skipif", "xfail", "usefixtures",
+                  "filterwarnings", "timeout"}
+
+
+def _py_sources(root):
+    for p in sorted(root.rglob("*.py")):
+        if "__pycache__" in p.parts:
+            continue
+        yield p, p.read_text(encoding="utf-8", errors="replace")
+
+
+def test_faultpoints_armed_by_tests_exist_in_code():
+    defined = set()
+    for _p, text in _py_sources(SRC):
+        defined |= set(re.findall(r"faultpoint\(\s*['\"]([^'\"]+)['\"]",
+                                  text))
+    armed = set()
+    here = Path(__file__).resolve()
+    for p, text in _py_sources(TESTS):
+        if p.resolve() == here:
+            continue                     # this guard's own docstring
+        armed |= set(re.findall(r"\binject\(\s*['\"]([^'\"]+)['\"]", text))
+        armed |= set(re.findall(r"_FAULTS\[\s*['\"]([^'\"]+)['\"]\s*\]",
+                                text))
+        # the inject/faultpoint MECHANISM self-tests define their own
+        # throwaway faultpoints inline — those count as defined
+        defined |= set(re.findall(r"faultpoint\(\s*['\"]([^'\"]+)['\"]",
+                                  text))
+    missing = armed - defined
+    assert not missing, (
+        f"tests arm faultpoint(s) {sorted(missing)} that no longer exist "
+        f"in h2o3_tpu/ — a renamed faultpoint silently defuses its chaos "
+        f"tests (defined: {sorted(defined)})")
+
+
+def _declared_markers():
+    text = (ROOT / "pyproject.toml").read_text()
+    m = re.search(r"markers\s*=\s*\[(.*?)\]", text, re.S)
+    assert m, "pyproject.toml has no [tool.pytest.ini_options] markers list"
+    # each entry is "name: description" — take the leading identifier
+    # (descriptions may contain nested quotes/colons/parens)
+    return set(re.findall(r"['\"]\s*([A-Za-z_]\w*)\s*:", m.group(1)))
+
+
+def _used_markers():
+    used = set()
+    for _p, text in _py_sources(TESTS):
+        used |= set(re.findall(r"pytest\.mark\.(\w+)", text))
+    return used - _BUILTIN_MARKS
+
+
+def test_pyproject_markers_match_test_usage():
+    declared = _declared_markers()
+    used = _used_markers()
+    undeclared = used - declared
+    assert not undeclared, (
+        f"marker(s) {sorted(undeclared)} are used under tests/ but not "
+        "declared in pyproject.toml [tool.pytest.ini_options] markers — "
+        "--strict-markers runs will fail")
+    unused = declared - used
+    assert not unused, (
+        f"marker(s) {sorted(unused)} are declared in pyproject.toml but "
+        "never used under tests/ — drop them or mark the tests")

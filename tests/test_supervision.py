@@ -12,6 +12,7 @@ a real dead peer would.
 """
 
 import json
+import re
 import threading
 import time
 import urllib.error
@@ -20,6 +21,7 @@ import urllib.request
 import pytest
 
 from h2o3_tpu.core import failure
+from h2o3_tpu.parallel import ckpt
 from h2o3_tpu.parallel import distributed as D
 from h2o3_tpu.parallel import oplog, retry, supervisor
 
@@ -38,9 +40,23 @@ def mem_cloud(monkeypatch):
         # bound every ack wait so a test bug can never park a thread on
         # the production 300 s default (tests override per-case as needed)
         monkeypatch.setenv("H2O_TPU_OP_ACK_TIMEOUT_S", "30")
+        # checkpointing off by default: tests that exercise it opt in (a
+        # surprise 'checkpoint' op would shift every seq assertion here)
+        monkeypatch.setenv("H2O_TPU_OPLOG_CHECKPOINT_OPS", "0")
+        # synchronous checkpoints: the ckpt op lands at a deterministic
+        # seq and the chaos fault injections hit the op they target (the
+        # async path has its own dedicated test)
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_ASYNC", "0")
+        failure.set_incarnation(0)
+        D.reset_leadership()
+        oplog._DEMOTED = False
         oplog.reset()
         supervisor.reset()
         yield kv
+        ckpt.wait_idle()       # never leak an in-flight ckpt across tests
+    failure.set_incarnation(0)
+    D.reset_leadership()
+    oplog._DEMOTED = False
     oplog.reset()
     supervisor.reset()
 
@@ -902,6 +918,711 @@ class TestRestSupervision:
             assert ("DEGRADED", "HEALTHY") in trans
         finally:
             srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint + compaction (ISSUE 4 tentpole 1)
+# ---------------------------------------------------------------------------
+
+def _live_oplog_keys(kv):
+    slots = [k for k in kv if re.fullmatch(r"oplog/\d+", k)]
+    acks = [k for k in kv if k.startswith("oplog/ack/")]
+    return slots, acks
+
+
+class TestCheckpointCompaction:
+    def test_footprint_stays_o_interval_after_many_ops(self, mem_cloud,
+                                                       monkeypatch,
+                                                       tmp_path):
+        """Acceptance: after N >> interval acknowledged ops, live oplog/*
+        keys stay O(interval) — the acked prefix is truncated at every
+        checkpoint instead of living in the KV forever."""
+        monkeypatch.setenv("H2O_TPU_OPLOG_CHECKPOINT_OPS", "8")
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        t = threading.Thread(
+            target=lambda: oplog.follower_loop(idle_timeout_s=15),
+            daemon=True)
+        t.start()
+        for i in range(50):
+            seq = oplog.broadcast("noop", {"i": i})
+            with oplog.turn(seq, timeout_s=15):
+                pass
+        slots, acks = _live_oplog_keys(mem_cloud)
+        # 50 user ops (+ interleaved checkpoint ops) went through; only
+        # the un-truncated tail may remain
+        assert len(slots) <= 2 * 8, sorted(slots)
+        assert len(acks) <= 2 * 8, sorted(acks)
+        assert ckpt.latest_seq() is not None and ckpt.latest_seq() >= 32
+        # checkpoint records themselves are pruned (keep 2)
+        assert len([k for k in mem_cloud
+                    if k.startswith("oplog/ckpt/")]) <= 2
+        assert supervisor.evaluate() != supervisor.FAILED
+        oplog.publish("shutdown", {})
+        t.join(timeout=15)
+        assert not t.is_alive()
+
+    def test_checkpoint_restores_dkv_control_plane(self, cl, mem_cloud,
+                                                   monkeypatch, tmp_path):
+        """A checkpoint carries the DKV control plane: an object installed
+        before the snapshot comes back via load_latest (the rejoin
+        restore path), and the resume cursor points past the ckpt op."""
+        from h2o3_tpu.core.dkv import DKV
+
+        monkeypatch.setenv("H2O_TPU_OPLOG_CHECKPOINT_OPS", "2")
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        DKV.put("ckpt_probe_key", {"hello": 1})
+        t = threading.Thread(
+            target=lambda: oplog.follower_loop(idle_timeout_s=10),
+            daemon=True)
+        t.start()
+        try:
+            for i in range(3):
+                seq = oplog.broadcast("noop", {"i": i})
+                with oplog.turn(seq, timeout_s=10):
+                    pass
+            assert ckpt.latest_seq() is not None
+            DKV.remove("ckpt_probe_key")
+            next_seq, snap = ckpt.load_latest()
+            assert DKV.get("ckpt_probe_key") == {"hello": 1}
+            assert next_seq == ckpt.latest_seq() + 1
+            assert "ckpt_probe_key" in snap["dkv"]["objects"]
+        finally:
+            DKV.remove("ckpt_probe_key")
+            oplog.publish("shutdown", {})
+            t.join(timeout=10)
+
+    def test_checkpoint_failure_never_fails_the_user_op(self, mem_cloud,
+                                                        monkeypatch,
+                                                        tmp_path):
+        """A failed snapshot write is logged and retried at the next
+        interval — the op that crossed the threshold still succeeds."""
+        monkeypatch.setenv("H2O_TPU_OPLOG_CHECKPOINT_OPS", "2")
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        t = threading.Thread(
+            target=lambda: oplog.follower_loop(idle_timeout_s=10),
+            daemon=True)
+        t.start()
+        try:
+            with failure.inject("ckpt.write", times=1):
+                for i in range(2):
+                    seq = oplog.broadcast("noop", {"i": i})
+                    with oplog.turn(seq, timeout_s=10):
+                        pass          # 2nd turn triggers the doomed ckpt
+            assert ckpt.latest_seq() is None          # write was injected
+            for i in range(2):
+                seq = oplog.broadcast("noop", {"i": i})
+                with oplog.turn(seq, timeout_s=10):
+                    pass
+            assert ckpt.latest_seq() is not None      # next interval landed
+        finally:
+            oplog.publish("shutdown", {})
+            t.join(timeout=10)
+
+    def test_async_checkpoint_does_not_block_crossing_op(self, mem_cloud,
+                                                         monkeypatch,
+                                                         tmp_path):
+        """With H2O_TPU_OPLOG_CKPT_ASYNC (the production default) the user
+        op that crosses the interval threshold returns while the snapshot
+        is still in flight on the background thread; the checkpoint and
+        truncation land shortly after (ckpt.wait_idle joins them)."""
+        monkeypatch.setenv("H2O_TPU_OPLOG_CHECKPOINT_OPS", "2")
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_ASYNC", "1")
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        gate = threading.Event()
+        real_write = ckpt.write_checkpoint
+
+        def gated_write(seq):
+            gate.wait(10)              # park the snapshot until released
+            return real_write(seq)
+
+        monkeypatch.setattr(ckpt, "write_checkpoint", gated_write)
+        t = threading.Thread(
+            target=lambda: oplog.follower_loop(idle_timeout_s=15),
+            daemon=True)
+        t.start()
+        try:
+            for i in range(2):
+                seq = oplog.broadcast("noop", {"i": i})
+                with oplog.turn(seq, timeout_s=15):
+                    pass               # 2nd op's turn tail spawns the ckpt
+            # the crossing op is DONE while the snapshot is still parked
+            # behind the gate: async checkpointing never billed it
+            assert ckpt.latest_seq() is None
+            gate.set()
+            assert ckpt.wait_idle(timeout_s=15)
+            assert ckpt.latest_seq() == 2            # ops 0,1 then ckpt op
+            slots, acks = _live_oplog_keys(mem_cloud)
+            assert not slots and not acks            # prefix truncated
+        finally:
+            gate.set()
+            oplog.publish("shutdown", {})
+            t.join(timeout=15)
+
+    def test_ops_acked_during_inflight_ckpt_still_count(self, mem_cloud,
+                                                        monkeypatch,
+                                                        tmp_path):
+        """User ops acknowledged while an async checkpoint is still
+        truncating must count toward the NEXT interval — dropping them
+        would stretch the effective interval past
+        H2O_TPU_OPLOG_CHECKPOINT_OPS under load and break the documented
+        O(interval) bound on live oplog keys."""
+        monkeypatch.setenv("H2O_TPU_OPLOG_CHECKPOINT_OPS", "2")
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_ASYNC", "1")
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        gate, entered = threading.Event(), threading.Event()
+        real_trunc = ckpt.truncate_through
+
+        def gated_trunc(seq):
+            entered.set()
+            gate.wait(10)              # park the compaction tail
+            return real_trunc(seq)
+
+        monkeypatch.setattr(ckpt, "truncate_through", gated_trunc)
+        t = threading.Thread(
+            target=lambda: oplog.follower_loop(idle_timeout_s=15),
+            daemon=True)
+        t.start()
+        try:
+            for i in range(2):
+                seq = oplog.broadcast("noop", {"i": i})
+                with oplog.turn(seq, timeout_s=15):
+                    pass
+            assert entered.wait(10)    # ckpt op acked, truncation parked
+            # a full interval's worth of user ops acks while the first
+            # checkpoint is still in flight
+            for i in range(2):
+                seq = oplog.broadcast("noop", {"i": i})
+                with oplog.turn(seq, timeout_s=15):
+                    pass
+            gate.set()
+            assert ckpt.wait_idle(timeout_s=15)
+            first = ckpt.latest_seq()
+            assert first == 2                        # ops 0,1 then ckpt op
+            # the next acked op crosses the (already-reached) threshold:
+            # checkpoint 2 fires — the in-flight window lost no counts
+            seq = oplog.broadcast("noop", {"final": True})
+            with oplog.turn(seq, timeout_s=15):
+                pass
+            assert ckpt.wait_idle(timeout_s=15)
+            assert ckpt.latest_seq() > first
+        finally:
+            gate.set()
+            oplog.publish("shutdown", {})
+            t.join(timeout=15)
+
+    def test_demoted_excoordinator_checkpoint_refuses(self, mem_cloud):
+        """A stalled ex-coordinator's in-flight checkpoint thread resuming
+        after a standby won the epoch must not publish (at a stale seq) or
+        truncate the shared KV — same gate broadcast() enforces."""
+        oplog._DEMOTED = True
+        assert ckpt.checkpoint_now() is None
+        assert oplog.current_seq() == 0              # nothing published
+
+    def test_truncation_mid_wait_is_not_an_ack_timeout(self, mem_cloud,
+                                                       monkeypatch,
+                                                       tmp_path):
+        """A wait_acks(N) poller racing the compactor must treat a
+        truncated prefix as satisfied: truncation only runs after the
+        covering checkpoint op was fully acked, so op N's vanished ack
+        records prove success — timing out (and degrading the cloud) for
+        a fully-acknowledged op would be a false alarm."""
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        seq = oplog.publish("noop", {})
+        oplog._ack(seq, json.loads(mem_cloud[f"oplog/{seq}"])["op_id"])
+        # the compactor truncates the acked prefix between two of the
+        # waiter's polls: the ack record disappears
+        ckpt.truncate_through(seq)
+        assert f"oplog/ack/{seq}/0" not in mem_cloud
+        t0 = time.monotonic()
+        oplog.wait_acks(seq, timeout_s=5)            # returns, no raise
+        assert time.monotonic() - t0 < 2.0
+        assert supervisor.state() == supervisor.HEALTHY
+
+
+# ---------------------------------------------------------------------------
+# incarnations + follower readmission (ISSUE 4 tentpole 2)
+# ---------------------------------------------------------------------------
+
+class TestIncarnations:
+    def test_stale_incarnation_ack_rejected(self, mem_cloud):
+        """A proc that rejoined at incarnation 1 must ack with inc >= 1:
+        an ack its dead predecessor (inc 0) left behind — even with the
+        RIGHT op identity token — cannot satisfy wait_acks."""
+        oplog._write_rejoin(0, 1, "caught_up", 0)
+        seq = oplog.publish("noop", {})
+        op_id = json.loads(mem_cloud[f"oplog/{seq}"])["op_id"]
+        mem_cloud[f"oplog/ack/{seq}/0"] = json.dumps(
+            {"proc": 0, "ts": time.time(), "op_id": op_id, "inc": 0})
+        with pytest.raises(failure.CloudUnhealthyError, match="0/1"):
+            oplog.wait_acks(seq, timeout_s=0.3)
+        # the fresh incarnation's ack does satisfy it
+        mem_cloud[f"oplog/ack/{seq}/0"] = json.dumps(
+            {"proc": 0, "ts": time.time(), "op_id": op_id, "inc": 1})
+        oplog.wait_acks(seq, timeout_s=5)
+
+    def test_heartbeat_carries_incarnation(self, mem_cloud):
+        failure.set_incarnation(3)
+        failure.heartbeat()
+        rows = failure.cluster_health()
+        assert rows[0]["incarnation"] == 3
+
+
+class TestRejoinRecovery:
+    def test_full_loop_crash_rejoin_recover_new_op(self, cl, mem_cloud,
+                                                   monkeypatch, tmp_path):
+        """Acceptance (ISSUE 4): follower replay crash -> cloud FAILED ->
+        follower rejoins from the checkpoint (fresh incarnation, suffix
+        re-replayed, error evidence superseded) -> supervisor walks
+        FAILED -> RECOVERING -> HEALTHY, reported via GET /3/CloudStatus
+        -> a NEW multi-process op (oplog broadcast) succeeds."""
+        from h2o3_tpu.api.server import start_server
+
+        monkeypatch.setenv("H2O_TPU_OPLOG_CHECKPOINT_OPS", "4")
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        monkeypatch.setenv("H2O_TPU_OP_ACK_TIMEOUT_S", "15")
+        monkeypatch.setenv("H2O_TPU_SUPERVISE_INTERVAL_S", "3600")
+        srv = start_server(port=0)
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            # phase 1: healthy op stream deep enough to land a checkpoint
+            def doomed():
+                with pytest.raises(failure.InjectedFault):
+                    oplog.follower_loop(idle_timeout_s=15)
+
+            t1 = threading.Thread(target=doomed, daemon=True)
+            t1.start()
+            for i in range(5):
+                seq = oplog.broadcast("noop", {"i": i})
+                with oplog.turn(seq, timeout_s=15):
+                    pass
+            assert ckpt.latest_seq() is not None
+            # phase 2: follower killed mid-replay -> FAILED
+            with failure.inject("oplog.replay", times=1):
+                seq = oplog.broadcast("noop", {"crash": True})
+                with pytest.raises(failure.CloudUnhealthyError,
+                                   match="injected fault"):
+                    with oplog.turn(seq, timeout_s=15):
+                        pass
+            t1.join(timeout=10)
+            assert supervisor.state() == supervisor.FAILED
+            assert _get(base, "/3/CloudStatus")["state"] == "FAILED"
+            with pytest.raises(failure.CloudUnhealthyError):
+                oplog.broadcast("noop", {})          # refused while down
+            # phase 3: the follower restarts and rejoins from the ckpt
+            cursor = oplog.rejoin()
+            assert cursor == oplog.current_seq()     # crashed op included
+            assert failure.incarnation() == 1
+            assert not oplog.error_records()         # evidence superseded
+            assert supervisor.evaluate() == supervisor.HEALTHY
+            st = _get(base, "/3/CloudStatus")
+            assert st["state"] == "HEALTHY"
+            trans = [(t["from"], t["to"]) for t in st["transitions"]]
+            assert ("FAILED", "RECOVERING") in trans
+            assert ("RECOVERING", "HEALTHY") in trans
+            assert st["checkpoint_seq"] is not None
+            rows = {r["process"]: r for r in st["process_health"]}
+            assert rows[0]["incarnation"] == 1
+            assert rows[0]["ack_lag"] == 0
+            assert st["rejoins"][0]["phase"] == "caught_up"
+            # phase 4: NEW multi-process ops are accepted and complete
+            t2 = threading.Thread(
+                target=lambda: oplog.follower_loop(idle_timeout_s=15,
+                                                   start_seq=cursor),
+                daemon=True)
+            t2.start()
+            seq = oplog.broadcast("noop", {"post_recovery": True})
+            with oplog.turn(seq, timeout_s=15):
+                pass                                  # acked by inc 1
+            oplog.publish("shutdown", {})
+            t2.join(timeout=15)
+            assert not t2.is_alive()
+        finally:
+            srv.stop()
+
+    def test_rejoin_crash_records_error_and_refails(self, mem_cloud,
+                                                    monkeypatch, tmp_path):
+        """A follower killed AGAIN mid-rejoin-replay surfaces the true
+        story (error key) and the cloud re-FAILs instead of reporting a
+        phantom recovery."""
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        oplog.publish("noop", {})
+        supervisor.fail("follower died", "tb")
+        with failure.inject("oplog.rejoin.replay", times=1):
+            with pytest.raises(failure.InjectedFault):
+                oplog.rejoin()
+        assert oplog.error_records()
+        assert supervisor.evaluate() == supervisor.FAILED
+        # second restart completes the rejoin; cloud recovers
+        cursor = oplog.rejoin()
+        assert cursor == 1
+        assert supervisor.evaluate() == supervisor.HEALTHY
+        assert failure.incarnation() == 2
+
+    def test_recovering_waits_for_caught_up_phase(self, mem_cloud):
+        """A rejoin record still in phase 'replaying' moves the cloud to
+        RECOVERING but NOT to HEALTHY — new ops stay refused until the
+        suffix replay completes."""
+        supervisor.fail("follower died", "tb")
+        failure.set_incarnation(1)
+        failure.heartbeat()
+        oplog._write_rejoin(0, 1, "replaying", 0)
+        assert supervisor.evaluate() == supervisor.RECOVERING
+        with pytest.raises(failure.CloudUnhealthyError, match="RECOVERING"):
+            oplog.broadcast("noop", {})
+        oplog._write_rejoin(0, 1, "caught_up", 0)
+        assert supervisor.evaluate() == supervisor.HEALTHY
+
+    def test_rejoin_gate_is_incarnation_not_wallclock(self, mem_cloud):
+        """FAILED -> RECOVERING is gated on an incarnation STRICTLY newer
+        than the one on record at fail() time: a leftover rejoin record
+        from a previous recovery must not re-trigger the arc, and a
+        genuinely fresh rejoin stamped by a skewed clock (ts 'before' the
+        failure) must not be blocked by it."""
+        # a previous recovery left proc 0's inc-1 rejoin record standing
+        failure.set_incarnation(1)
+        failure.heartbeat()
+        oplog._write_rejoin(0, 1, "caught_up", 0)
+        supervisor.fail("follower died again", "tb")
+        assert supervisor.evaluate() == supervisor.FAILED   # stale record
+        # the restarted follower rejoins at inc 2, but its host clock runs
+        # an hour behind the coordinator's
+        failure.set_incarnation(2)
+        failure.heartbeat()
+        oplog._write_rejoin(0, 2, "caught_up", 0)
+        k = f"{oplog._REJOIN_PREFIX}0"
+        rec = json.loads(mem_cloud[k])
+        rec["ts"] -= 3600.0
+        mem_cloud[k] = json.dumps(rec)
+        assert supervisor.evaluate() == supervisor.HEALTHY
+
+    def test_second_real_restart_rejoins_strictly_newer(self, mem_cloud,
+                                                        monkeypatch,
+                                                        tmp_path):
+        """A REAL process restart boots with the local incarnation counter
+        at 0. The second crash/restart cycle must still produce an
+        incarnation strictly newer than the one on cloud record at
+        failure time — otherwise the FAILED -> RECOVERING gate can never
+        be satisfied again and the cloud is permanently down."""
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        oplog.publish("noop", {})
+        supervisor.fail("follower died", "tb")
+        oplog.rejoin()
+        assert failure.incarnation() == 1
+        assert supervisor.evaluate() == supervisor.HEALTHY
+        # crash again; the restarted process forgot its local counter
+        supervisor.fail("follower died again", "tb2")
+        failure.set_incarnation(0)
+        oplog.rejoin()
+        assert failure.incarnation() == 2    # seeded from cloud evidence
+        assert supervisor.evaluate() == supervisor.HEALTHY
+
+    def test_recovering_blocked_by_never_beaten_process(self, mem_cloud):
+        """RECOVERING -> HEALTHY is also blocked by a peer that died
+        leaving NO heartbeat row — same never-beat signal as the degrade
+        path: absence past the staleness window."""
+        supervisor.fail("follower 0 replay crashed", "tb")
+        failure.set_incarnation(1)
+        failure.heartbeat()
+        oplog._write_rejoin(0, 1, "caught_up", 0)
+        supervisor._FIRST_EVAL_TS = time.time() - 3600   # long past grace
+        assert supervisor.evaluate() == supervisor.RECOVERING
+        # the absent process finally beats: recovery completes
+        mem_cloud["h2o3/heartbeat/1"] = json.dumps(
+            {"ts": time.time(), "proc": 1, "inc": 0})
+        assert supervisor.evaluate() == supervisor.HEALTHY
+
+    def test_recovering_blocked_by_other_stale_process(self, mem_cloud):
+        """RECOVERING -> HEALTHY demands the WHOLE cluster be live, not
+        just the processes with rejoin records: a second follower that
+        went silent during the outage (stale beat, no rejoin of its own)
+        must keep new ops refused instead of letting each one burn the
+        full ack timeout against a dead peer."""
+        supervisor.fail("follower 0 replay crashed", "tb")
+        mem_cloud["h2o3/heartbeat/1"] = json.dumps(
+            {"ts": time.time() - 3600, "proc": 1, "inc": 0})
+        failure.set_incarnation(1)
+        failure.heartbeat()
+        oplog._write_rejoin(0, 1, "caught_up", 0)
+        assert supervisor.evaluate() == supervisor.RECOVERING
+        with pytest.raises(failure.CloudUnhealthyError):
+            oplog.broadcast("noop", {})
+        # the silent process comes back: recovery completes
+        mem_cloud["h2o3/heartbeat/1"] = json.dumps(
+            {"ts": time.time(), "proc": 1, "inc": 0})
+        assert supervisor.evaluate() == supervisor.HEALTHY
+
+    def test_jobs_failed_once_stay_failed_across_recovery(self, mem_cloud):
+        """Jobs in flight when the cloud died are failed ONCE (externally,
+        with the remote trace); a later recovery never resurrects them."""
+        from h2o3_tpu.core.job import Job
+
+        ev = threading.Event()
+        job = Job(description="in flight at failure")
+        job.start(lambda j: ev.wait(10), background=True)
+        try:
+            supervisor.fail("follower died", "RemoteBoom")
+            assert job.status == Job.FAILED
+            assert job.failed_externally is True
+        finally:
+            ev.set()
+        failure.set_incarnation(1)
+        failure.heartbeat()
+        oplog._write_rejoin(0, 1, "caught_up", 0)
+        assert supervisor.evaluate() == supervisor.HEALTHY
+        assert job.status == Job.FAILED              # still failed
+        assert "RemoteBoom" in job.exception
+
+
+# ---------------------------------------------------------------------------
+# standby-coordinator handoff (ISSUE 4 tentpole 3)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def standby_cloud(monkeypatch):
+    """Simulated 2-process cloud where THIS process (jax index 0) is a
+    FOLLOWER: the epoch record names process 1 as leader. is_coordinator
+    stays REAL (leader-based) so the election can flip it."""
+    with D.memory_kv() as kv:
+        monkeypatch.setattr(D, "process_count", lambda: 2)
+        monkeypatch.setenv("H2O_TPU_RETRY_BASE_MS", "1")
+        monkeypatch.setenv("H2O_TPU_OP_ACK_TIMEOUT_S", "30")
+        monkeypatch.setenv("H2O_TPU_OPLOG_CHECKPOINT_OPS", "0")
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_ASYNC", "0")
+        failure.set_incarnation(0)
+        D.write_epoch_record(0, 1)
+        D.set_leader(1, 0)
+        oplog._DEMOTED = False
+        oplog.reset()
+        supervisor.reset()
+        yield kv
+    failure.set_incarnation(0)
+    D.reset_leadership()
+    oplog._DEMOTED = False
+    oplog.reset()
+    supervisor.reset()
+
+
+def _gbm_and_frame(seed=7):
+    import numpy as np
+
+    from h2o3_tpu.core.frame import Column, Frame
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    rng = np.random.default_rng(seed)
+    n = 400
+    fr = Frame()
+    x1 = rng.standard_normal(n)
+    x2 = rng.standard_normal(n)
+    fr.add("x1", Column.from_numpy(x1))
+    fr.add("x2", Column.from_numpy(x2))
+    y = np.where(x1 - 0.5 * x2 > 0, "Y", "N")
+    fr.add("y", Column.from_numpy(y, ctype="enum"))
+    model = GBM(ntrees=5, max_depth=3, seed=1).train(y="y",
+                                                     training_frame=fr)
+    score = Frame()
+    score.add("x1", Column.from_numpy(rng.standard_normal(64)))
+    score.add("x2", Column.from_numpy(rng.standard_normal(64)))
+    return model, score
+
+
+class TestHandoff:
+    def test_election_refused_inside_grace_or_when_not_winner(
+            self, cl, standby_cloud, monkeypatch):
+        monkeypatch.setenv("H2O_TPU_ELECTION_GRACE_S", "60")
+        now = time.time()
+        # the leader is still beating: no election
+        standby_cloud["h2o3/heartbeat/1"] = json.dumps({"ts": now,
+                                                        "proc": 1})
+        failure.heartbeat()
+        with pytest.raises(oplog.ElectionLost, match="inside the election"):
+            oplog.assume_coordination()
+        assert not D.is_coordinator()
+        # the leader itself never runs an election
+        D.set_leader(0, 0)
+        D.write_epoch_record(0, 0)
+        with pytest.raises(oplog.ElectionLost, match="already leads"):
+            oplog.assume_coordination()
+
+    def test_follower_assumes_epoch_and_serves_scoring_bitwise(
+            self, cl, standby_cloud, monkeypatch, tmp_path):
+        """Acceptance (ISSUE 4): the coordinator dies with a score_batch
+        op in flight; the surviving follower (which replayed + acked it)
+        wins the deterministic election, seals the oplog past it, writes
+        epoch 1, re-binds the REST server, and serves a scoring request
+        whose predictions are BITWISE-identical to the pre-handoff
+        replay's."""
+        import numpy as np
+
+        from h2o3_tpu import scoring
+        from h2o3_tpu.api import server as api_server
+        from h2o3_tpu.core.dkv import DKV
+
+        monkeypatch.setenv("H2O_TPU_ELECTION_GRACE_S", "5")
+        monkeypatch.setenv("H2O_TPU_SUPERVISE_INTERVAL_S", "3600")
+        model, score_fr = _gbm_and_frame()
+        DKV.put(str(score_fr.key), score_fr)
+        # the old coordinator published a score_batch op; we are the
+        # follower replaying it (the in-flight op at the handoff boundary)
+        standby_cloud["oplog/0"] = json.dumps({
+            "kind": "score_batch", "op_id": "inflight-op",
+            "payload": {"model": str(model.key),
+                        "requests": [{"frame": str(score_fr.key),
+                                      "destination_frame": "pred_before",
+                                      "with_metrics": False}]}})
+        with pytest.raises(TimeoutError):
+            oplog.follower_loop(idle_timeout_s=0.3)   # replays op 0, acks
+        assert "oplog/ack/0/0" in standby_cloud
+        before = DKV.get("pred_before")
+        assert before is not None
+        before_vals = {c: np.asarray(before.col(c).data).copy()
+                       for c in before.names}
+        # the coordinator goes silent past the election grace
+        standby_cloud["h2o3/heartbeat/1"] = json.dumps(
+            {"ts": time.time() - 999, "proc": 1})
+        failure.heartbeat()
+        srv = api_server.assume_coordination(port=0, caught_up_seq=1)
+        try:
+            assert D.is_coordinator() and D.epoch() == 1
+            rec = D.epoch_record()
+            assert rec["epoch"] == 1 and rec["leader"] == 0
+            sealed = json.loads(standby_cloud["oplog/sealed/0"])
+            assert sealed["next_seq"] == 1           # past the acked op
+            base = f"http://127.0.0.1:{srv.port}"
+            st = _get(base, "/3/CloudStatus")
+            assert st["epoch"] == 1 and st["leader"] == 0
+            # the dead ex-coordinator degrades the cloud, but scoring is
+            # the surface that keeps serving (coordinator-local)
+            out = _post(base, f"/3/Predictions/models/"
+                        f"{urllib.request.quote(str(model.key), safe='')}"
+                        f"/frames/"
+                        f"{urllib.request.quote(str(score_fr.key), safe='')}",
+                        {"predictions_frame": "pred_after"})
+            after = DKV.get(out["predictions_frame"]["name"])
+            assert after is not None
+            for c in before.names:
+                av = np.asarray(after.col(c).data)
+                bv = before_vals[c]
+                assert np.array_equal(av[: len(bv)], bv[: len(av)]), c
+        finally:
+            srv.stop()
+            DKV.remove("pred_before")
+            DKV.remove("pred_after")
+            DKV.remove(str(score_fr.key))
+            DKV.remove(str(model.key))
+            scoring.purge()
+
+    def test_returned_ex_coordinator_demotes_on_newer_epoch(
+            self, cl, standby_cloud):
+        """The old coordinator comes back from a stall to find a standby
+        leading a newer epoch: it adopts the record, refuses to run
+        multi-process ops, and the supervisor says why."""
+        D.set_leader(0, 0)                 # we BELIEVE we lead epoch 0
+        D.write_epoch_record(2, 1)         # but proc 1 took epoch 2
+        assert oplog.maybe_demote() is not None
+        assert not D.is_coordinator() and D.epoch() == 2
+        with pytest.raises(failure.CloudUnhealthyError, match="demoted"):
+            oplog.broadcast("noop", {})
+        assert "demoted" in supervisor.status()["reason"]
+
+    def test_concurrent_election_loser_stands_down(self, cl, standby_cloud,
+                                                   monkeypatch):
+        """Two standbys race an election and both write epoch 1 (the epoch
+        record is a last-writer-wins upsert). The one whose claim was
+        overwritten must detect it on the read-back and stand down — NOT
+        proceed to serve as a second coordinator under the same epoch."""
+        monkeypatch.setenv("H2O_TPU_ELECTION_GRACE_S", "1")
+        standby_cloud["h2o3/heartbeat/1"] = json.dumps(
+            {"ts": time.time() - 999, "proc": 1})     # old leader dead
+        failure.heartbeat()
+        real_write = D.write_epoch_record
+
+        def racing_write(epoch_no, leader_proc):
+            ok = real_write(epoch_no, leader_proc)
+            # a concurrent standby's claim lands on top of ours
+            real_write(epoch_no, 2)
+            return ok
+
+        monkeypatch.setattr(D, "write_epoch_record", racing_write)
+        with pytest.raises(oplog.ElectionLost, match="concurrent election"):
+            oplog.assume_coordination()
+        # the loser adopted the winner's record and is NOT coordinator
+        assert D.leader() == 2 and D.epoch() == 1
+        assert not D.is_coordinator()
+
+    def test_same_epoch_leader_overwrite_demotes(self, cl, standby_cloud):
+        """Residual split-brain window: both racing standbys pass their
+        read-back before the other's overwrite lands, so both briefly
+        believe they lead epoch 1. The periodic maybe_demote must catch
+        the same-epoch leader mismatch and demote the overwritten one."""
+        D.set_leader(0, 1)                 # we BELIEVE we lead epoch 1
+        D.write_epoch_record(1, 2)         # but proc 2's claim won the KV
+        assert oplog.maybe_demote() is not None
+        assert not D.is_coordinator()
+        assert D.leader() == 2 and D.epoch() == 1
+        with pytest.raises(failure.CloudUnhealthyError, match="demoted"):
+            oplog.broadcast("noop", {})
+        # matching view + record is a no-op (no demotion churn)
+        D.write_epoch_record(1, 2)
+        assert oplog.maybe_demote() is None
+
+
+# ---------------------------------------------------------------------------
+# satellites: typed shard error + fetch_remote retry
+# ---------------------------------------------------------------------------
+
+class TestSatelliteFixes:
+    def test_shard_unavailable_error_names_owner_and_remedy(self):
+        err = failure.ShardUnavailableError("cannot score frame f1",
+                                            owners=[1, 2])
+        assert isinstance(err, failure.CloudUnhealthyError)   # -> HTTP 503
+        assert err.owners == [1, 2]
+        assert "process(es) [1, 2]" in str(err)
+        assert "Remediation" in str(err) and "rejoin" in str(err)
+
+    def test_fetch_remote_retries_dropped_blob_read(self, mem_cloud,
+                                                    monkeypatch):
+        """An announced key whose blob read drops once is retried with
+        backoff instead of failing the caller on the first blip."""
+        import base64
+        import pickle
+
+        from h2o3_tpu.core.dkv import DKV
+
+        value = {"model": "meta"}
+        blob = base64.b64encode(pickle.dumps(value)).decode()
+        mem_cloud["h2o3/dkv/meta/K1"] = json.dumps({"type": "dict",
+                                                    "proc": 1,
+                                                    "replicated": True})
+        calls = {"n": 0}
+
+        def flaky_get(key, timeout_ms=5000):
+            if key == "h2o3/dkv/blob/K1":
+                calls["n"] += 1
+                return None if calls["n"] == 1 else blob
+            return mem_cloud.get(key)
+
+        monkeypatch.setattr(D, "kv_get", flaky_get)
+        try:
+            assert DKV.fetch_remote("K1") == value
+            assert calls["n"] == 2                   # dropped once, retried
+        finally:
+            DKV.remove("K1")
+
+    def test_fetch_remote_unannounced_key_does_not_retry(self, mem_cloud,
+                                                         monkeypatch):
+        """A key with NO cloud-wide announcement is genuinely absent:
+        fetch_remote must not burn the backoff budget on it."""
+        from h2o3_tpu.core.dkv import DKV
+
+        calls = {"n": 0}
+
+        def counting_get(key, timeout_ms=5000):
+            calls["n"] += 1
+            return None
+
+        monkeypatch.setattr(D, "kv_get", counting_get)
+        assert DKV.fetch_remote("nope") is None
+        assert calls["n"] == 1
 
 
 # ---------------------------------------------------------------------------
