@@ -156,7 +156,11 @@ def main():
             for sname, env in (("score", {"H2O3_BENCH_ONLY": "score"}),
                                ("drf-deep", {"H2O3_BENCH_ONLY": "drf"}),
                                ("pallas", {"H2O3_BENCH_ONLY": "pallas"}),
-                               ("glm", {"H2O3_BENCH_ONLY": "glm"})):
+                               ("glm", {"H2O3_BENCH_ONLY": "glm"}),
+                               # kill->elect->HEALTHY drill: control-plane
+                               # only, so it bypasses the accelerator tunnel
+                               ("recover", {"H2O3_BENCH_ONLY": "recover",
+                                            "JAX_PLATFORMS": "cpu"})):
                 if remaining() < 180:
                     _record(sname, ok=False, error="skipped: deadline")
                     continue
@@ -183,6 +187,14 @@ def main():
                 got = score
         else:
             _record("cpu-score", ok=False, error="skipped: deadline")
+        if remaining() > 90:
+            # recovery drill is pure control plane: always measurable
+            _stage("recover", [py, "-m", "h2o3_tpu.bench"], 80,
+                   env_extra={"PALLAS_AXON_POOL_IPS": "",
+                              "JAX_PLATFORMS": "cpu",
+                              "H2O3_BENCH_ONLY": "recover"})
+        else:
+            _record("recover", ok=False, error="skipped: deadline")
     if got is None:
         print(json.dumps({"metric": "bench_failed", "value": 0.0,
                           "unit": "none", "vs_baseline": 0.0}))

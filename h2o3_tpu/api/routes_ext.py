@@ -713,7 +713,7 @@ def h_cloud_status(ctx: Ctx):
     watch a recovery. The terse headline rides on /3/Cloud as
     ``cloud_status``; this route is the drill-down."""
     from h2o3_tpu.core.failure import cluster_health, heartbeat_stale_s
-    from h2o3_tpu.parallel import ckpt, oplog, supervisor
+    from h2o3_tpu.parallel import ckpt, oplog, supervisor, watchdog
     from h2o3_tpu.parallel import distributed as D
 
     st = supervisor.status()
@@ -747,6 +747,10 @@ def h_cloud_status(ctx: Ctx):
             "checkpoint_interval_ops": ckpt.interval_ops(),
             "epoch": D.epoch(),
             "leader": D.leader(),
+            # autonomous recovery watchdog: enabled/running, action
+            # counters (elections, rejoins, jobs resumed), last action
+            "watchdog": watchdog.status(),
+            "job_progress": ckpt.job_progress_records(),
             "rejoins": oplog.rejoin_records(),
             "oplog_errors": [{"seq": seq, "kind": rec.get("kind"),
                               "trace": rec.get("trace")}

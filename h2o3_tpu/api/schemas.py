@@ -120,8 +120,34 @@ def cloud_v3(info: Dict[str, Any]) -> dict:
 # Jobs
 # ---------------------------------------------------------------------------
 
+def _auto_recoverable(job, status: str) -> bool:
+    """True only while the watchdog could actually still resume this job:
+    it has a re-dispatch recipe, is not terminal-successful, and has not
+    been parked at the attempt cap (a stale True makes operators wait for
+    a recovery that can never happen instead of resubmitting)."""
+    from h2o3_tpu.parallel.watchdog import MAX_ATTEMPTS, enabled
+
+    if not enabled():
+        return False                 # manual drills: nothing will resume it
+    if status == "FAILED":
+        if not getattr(job, "failed_externally", False):
+            return False             # worker-crashed: client resubmits
+        from h2o3_tpu.parallel import ckpt
+
+        if not ckpt.has_job_progress(str(job.key)):
+            return False             # died before the first durable save
+    return (bool(getattr(job, "resume_spec", None))
+            and status not in ("DONE", "CANCELLED")
+            and int(getattr(job, "attempt", 1) or 1) < MAX_ATTEMPTS)
+
+
 def job_v3(job) -> dict:
     status = str(job.status)
+    if status == "RESUMING":
+        # internal recovery state: h2o-py pollers treat anything beyond
+        # CREATED/RUNNING as terminal, so on the wire a resuming job is
+        # simply RUNNING (attempt/resumed_from_iteration tell the story)
+        status = "RUNNING"
     dest = getattr(job, "dest_key", None) or getattr(job, "dest", None)
     start = getattr(job, "start_time", 0.0) or 0.0
     end = getattr(job, "end_time", 0.0) or 0.0
@@ -139,7 +165,14 @@ def job_v3(job) -> dict:
         or {"name": None},
         "exception": getattr(job, "exception", None),
         "warnings": list(getattr(job, "warnings", []) or []),
-        "auto_recoverable": False, "ready_for_view": True,
+        # crash-survivable jobs: dispatch count (1 = original submit) and,
+        # after a watchdog resume, the iteration training continued from
+        "attempt": int(getattr(job, "attempt", 1) or 1),
+        "resumed_from_iteration": getattr(job, "resumed_from_iteration",
+                                          None),
+        "failed_externally": bool(getattr(job, "failed_externally", False)),
+        "auto_recoverable": _auto_recoverable(job, status),
+        "ready_for_view": True,
     }
     if status == "FAILED" and getattr(job, "exception", None):
         out["stacktrace"] = job.exception

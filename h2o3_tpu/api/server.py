@@ -745,9 +745,10 @@ def h_modelbuilder_train(ctx: Ctx):
     job.dest_type = "Key<Model>"
     job.dest_key = dest
 
-    from h2o3_tpu.parallel import oplog
+    from h2o3_tpu.parallel import ckpt, oplog
 
     op_seq = None
+    wire_params = None
     if oplog.active():
         wire_params = _pin_seed_and_wire(builder.params)
         op_seq = oplog.broadcast("train", {
@@ -755,11 +756,30 @@ def h_modelbuilder_train(ctx: Ctx):
             "training_frame": str(train.key),
             "validation_frame": str(valid.key) if valid is not None else None,
             "y": y, "model_id": dest})
+    if ckpt.job_ckpt_iters() > 0 and builder.supports_iteration_resume:
+        # crash-survivable build: pin the wildcard seed NOW (a resumed
+        # dispatch must re-derive the identical RNG streams) and record
+        # the re-dispatch recipe on the job — the trainer's fit loop
+        # persists durable progress against it every
+        # H2O_TPU_JOB_CKPT_ITERS iterations
+        if wire_params is None:
+            wire_params = _pin_seed_and_wire(builder.params)
+        job.resume_spec = {
+            "algo": algo, "params": wire_params,
+            "training_frame": str(train.key),
+            "validation_frame": str(valid.key) if valid is not None else None,
+            "y": y, "model_id": dest, "description": job.description}
+        builder._progress_job = job
 
     def run(j: Job):
         with oplog.turn(op_seq):
             model = builder.train(y=y, training_frame=train,
                                   validation_frame=valid)
+        if j.status == Job.FAILED:
+            # supervisor failed this job from outside mid-train: don't
+            # install the result at dest — Job.start's wrapper is about to
+            # discard it, and a pre-installed model would outlive that
+            return model
         # the client captured dest at submit time (h2o-py H2OJob.__init__
         # reads dest.name once) — re-home the model under the advertised key
         old = str(model.key)

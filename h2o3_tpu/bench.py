@@ -128,6 +128,69 @@ def run_scoring(train_rows: int = 20_000, ntrees: int = 10,
     return rows / dt, "score_rows_per_sec"
 
 
+def run_recover():
+    """Recovery drill metric: wallclock seconds from coordinator-kill to
+    the cloud re-entering HEALTHY, with the autonomous watchdog doing the
+    election and the simulated ex-coordinator's rejoin being the only
+    external event. Control-plane only (memory KV), so it runs on CPU and
+    measures the watchdog/supervisor machinery, not device compiles."""
+    import json
+    import os
+    import tempfile
+    import time as _time
+
+    # isolated checkpoint dir: the live watchdog must never see (let alone
+    # strike-GC) a production cloud's real durable job-progress records on
+    # this host — memory_kv isolates the KV but not files
+    os.environ["H2O_TPU_OPLOG_CKPT_DIR"] = tempfile.mkdtemp(
+        prefix="h2o3_bench_recover_")
+    os.environ["H2O_TPU_ELECTION_GRACE_S"] = "0.2"
+    os.environ["H2O_TPU_HEARTBEAT_STALE_S"] = "1.0"
+    os.environ["H2O_TPU_AUTO_RECOVER"] = "1"
+    os.environ["H2O_TPU_OPLOG_CHECKPOINT_OPS"] = "0"
+    from h2o3_tpu.core import failure
+    from h2o3_tpu.parallel import distributed as D
+    from h2o3_tpu.parallel import oplog, supervisor, watchdog
+
+    with D.memory_kv() as kv:
+        D.process_count = lambda: 2          # bench subprocess: safe to pin
+        D.write_epoch_record(0, 1)           # process 1 leads ...
+        D.set_leader(1, 0)                   # ... and just died
+        kv["h2o3/heartbeat/1"] = json.dumps({"ts": _time.time() - 999,
+                                             "proc": 1})
+        failure.heartbeat()
+        oplog.reset()
+        supervisor.reset()
+        watchdog.reset()
+        t0 = time.perf_counter()
+        wd = watchdog.Watchdog(interval=0.05, follow=False).start()
+        try:
+            deadline = _time.time() + 30
+            while not D.is_coordinator() and _time.time() < deadline:
+                _time.sleep(0.01)
+            # the restarted ex-coordinator rejoins: fresh beat + record
+            kv["h2o3/heartbeat/1"] = json.dumps({"ts": _time.time(),
+                                                 "proc": 1, "inc": 1})
+            # HEALTHY must come from a fresh evidence fold (not the
+            # election's reset): poll evaluate() itself
+            while _time.time() < deadline:
+                if D.is_coordinator() and \
+                        supervisor.evaluate() == supervisor.HEALTHY:
+                    break
+                _time.sleep(0.01)
+            dt = time.perf_counter() - t0
+            ok = D.is_coordinator() and \
+                supervisor.state() == supervisor.HEALTHY
+        finally:
+            wd.stop()
+            oplog.reset()
+            supervisor.reset()
+            D.reset_leadership()
+    if not ok:
+        raise RuntimeError("recovery drill did not reach HEALTHY")
+    return dt, "recover_secs_to_healthy"
+
+
 def run_glm(n_rows: int = 1_000_000, p: int = 32, iters: int = 20):
     """GLM IRLS secondary metric (matches the repo-root bench_glm shape)."""
     import jax
@@ -185,6 +248,8 @@ if __name__ == "__main__":
         value, metric = run_compile_probe()
     elif mode == "glm":
         value, metric = run_glm()
+    elif mode == "recover":
+        value, metric = run_recover()
     elif mode == "score":
         value, metric = run_scoring(
             train_rows=int(os.environ.get("H2O3_BENCH_SCORE_TRAIN_ROWS",

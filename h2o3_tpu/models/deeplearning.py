@@ -165,6 +165,9 @@ class DeepLearning(ModelBuilder):
     algo_name = "deeplearning"
     model_class = DeepLearningModel
     supports_checkpoint = True
+    # crash-survivable builds: per-epoch durable progress (weights,
+    # optimizer moments, RNG key) and exact continuation from it
+    supports_iteration_resume = True
 
     @classmethod
     def default_params(cls):
@@ -451,6 +454,20 @@ class DeepLearning(ModelBuilder):
         tol = float(p.get("stopping_tolerance", 1e-3))
         history: List[float] = []
         ep_done = ep_start
+        rs = self._take_resume_state("dl_epochs")
+        if rs is not None:
+            # durable-progress fast-forward: weights, optimizer moments and
+            # the LIVE RNG key (all epoch splits already consumed), so the
+            # continued run walks the identical batch/dropout draws
+            ep_start = int(rs["epoch"])
+            ep_done = ep_start
+            params_t = jax.tree.map(jnp.asarray, rs["params"])
+            opt_state = jax.tree.map(jnp.asarray, rs["opt_state"])
+            key = jnp.asarray(rs["key"])
+            history = [float(v) for v in rs["history"]]
+            model._output.scoring_history = [dict(h)
+                                             for h in rs["scoring_history"]]
+        jp_every = self._job_ckpt_every()
         for ep in range(ep_start, n_epochs):
             params_t, opt_state, key = run_epoch(params_t, opt_state, key)
             ep_done = ep + 1
@@ -461,6 +478,15 @@ class DeepLearning(ModelBuilder):
             if self.job:
                 self.job.update(progress=(ep + 1) / n_epochs,
                                 msg=f"epoch {ep+1}/{n_epochs} loss={tr_loss:.5f}")
+            if jp_every and (ep + 1) % jp_every == 0:
+                self._tick_job_progress(ep + 1, lambda: {
+                    "phase": "dl_epochs", "epoch": ep_done,
+                    "params": jax.tree.map(np.asarray, params_t),
+                    "opt_state": jax.tree.map(np.asarray, opt_state),
+                    "key": np.asarray(key),
+                    "history": list(history),
+                    "scoring_history":
+                        [dict(h) for h in model._output.scoring_history]})
             if stop_rounds > 0 and len(history) > stop_rounds:
                 best_recent = min(history[-stop_rounds:])
                 best_before = min(history[:-stop_rounds])

@@ -616,6 +616,10 @@ class GLMModel(Model):
 class GLM(ModelBuilder):
     algo_name = "glm"
     model_class = GLMModel
+    # crash-survivable builds: the single-lambda IRLS runs in warm-started
+    # chunks with durable beta between them, and the lambda-search path
+    # persists per-lambda progress (model_builder._tick_job_progress)
+    supports_iteration_resume = True
 
     @classmethod
     def default_params(cls):
@@ -772,14 +776,14 @@ class GLM(ModelBuilder):
             lam = 0.0 if self.params.get("compute_p_values") else 1e-5
         max_iter = int(self.params["max_iterations"])
 
-        def fit_one(lam_val, beta_init):
+        def fit_one(lam_val, beta_init, max_it=None):
             l2 = float(lam_val) * (1 - alpha) * nobs
             l1 = float(lam_val) * alpha * nobs
             return _irls_fit(arrays, y, wts, offset, beta_init,
                              jnp.float32(l2), jnp.float32(l1),
                              jnp.float32(self.params.get("beta_epsilon", 1e-4)),
                              expand=dinfo.expand, famname=fam, linkname=linkname,
-                             max_iter=max_iter,
+                             max_iter=max_iter if max_it is None else int(max_it),
                              var_power=float(self.params["tweedie_variance_power"]),
                              link_power=model.link_power,
                              with_intercept=bool(self.params.get("intercept", True)),
@@ -802,7 +806,21 @@ class GLM(ModelBuilder):
             beta, prev_dev, chosen = b0, np.inf, path[0]
             fitted = 0
             null_dev_est = None
-            for lv in path:
+            start_i = 0
+            rs = self._take_resume_state("glm_lambda_path")
+            if rs is not None:
+                # durable-progress fast-forward: warm-start beta and the
+                # stall-stop bookkeeping at the saved path position (the
+                # path itself re-derives deterministically from the data)
+                beta = jnp.asarray(rs["beta"])
+                prev_dev = float(rs["prev_dev"])
+                chosen = float(rs["chosen"])
+                fitted = int(rs["fitted"])
+                null_dev_est = rs.get("null_dev_est")
+                start_i = int(rs["next_index"])
+            jp_every = self._job_ckpt_every()
+            for li in range(start_i, len(path)):
+                lv = path[li]
                 beta_new, iters, dev = fit_one(lv, beta)
                 fitted += 1
                 dev = float(dev)
@@ -816,14 +834,49 @@ class GLM(ModelBuilder):
                         and dev > prev_dev * (1 - 1e-4)):
                     break  # improvement stalled: keep previous lambda's fit
                 beta, prev_dev, chosen = beta_new, dev, lv
+                if jp_every and (li + 1) % jp_every == 0:
+                    self._tick_job_progress(li + 1, lambda: {
+                        "phase": "glm_lambda_path",
+                        "beta": np.asarray(beta),
+                        "prev_dev": float(prev_dev),
+                        "chosen": float(chosen), "fitted": fitted,
+                        "null_dev_est": null_dev_est,
+                        "next_index": li + 1})
                 if self._out_of_time():
                     break  # wall budget: keep the path fit so far
             dev = prev_dev
             model.iterations = fitted
             self.params["lambda_"] = float(chosen)
         else:
-            beta, iters, dev = fit_one(lam, b0)
-            model.iterations = int(iters)
+            jp_every = self._job_ckpt_every()
+            rs = self._take_resume_state("glm_irls")
+            if jp_every > 0 or rs is not None:
+                # chunked IRLS: warm-started segments of jp_every Newton
+                # steps with durable beta between them — a resumed dispatch
+                # continues the same trajectory from the last chunk instead
+                # of refitting from zero
+                beta, it_done, dev = b0, 0, 0.0
+                if rs is not None:
+                    beta = jnp.asarray(rs["beta"])
+                    it_done = int(rs["iters_done"])
+                    dev = float(rs.get("dev", 0.0))
+                chunk = jp_every if jp_every > 0 else max_iter
+                while it_done < max_iter:
+                    step = min(chunk, max_iter - it_done)
+                    beta, its, dev = fit_one(lam, beta, max_it=step)
+                    it_done += int(its)
+                    self._tick_job_progress(it_done, lambda: {
+                        "phase": "glm_irls", "beta": np.asarray(beta),
+                        "iters_done": it_done, "dev": float(dev)})
+                    if int(its) < step:
+                        break            # converged inside the chunk
+                    if self._out_of_time():
+                        break
+                iters = it_done
+                model.iterations = int(iters)
+            else:
+                beta, iters, dev = fit_one(lam, b0)
+                model.iterations = int(iters)
 
         model.beta = beta
         model.residual_deviance = float(dev)

@@ -99,6 +99,10 @@ class KMeans(ModelBuilder):
     algo_name = "kmeans"
     model_class = KMeansModel
     supervised = False
+    # crash-survivable builds: Lloyd runs in chunks with durable centers
+    # between them when job progress is enabled (the default single
+    # compiled while_loop is untouched otherwise)
+    supports_iteration_resume = True
 
     @classmethod
     def default_params(cls):
@@ -131,9 +135,42 @@ class KMeans(ModelBuilder):
         Xf = jax.jit(di.expand)(*arrays)
         w = (jnp.arange(Xf.shape[0]) < n).astype(jnp.float32)
 
+        jp_every = self._job_ckpt_every()
+        rs = self._take_resume_state("kmeans_lloyd")
         if p.get("estimate_k"):
             k, centers = self._estimate_k(Xf, w, seed, max_iter,
                                           int(p.get("max_k", 100)))
+        elif jp_every > 0 or rs is not None:
+            # chunked Lloyd with durable centers between chunks: a resumed
+            # dispatch continues from the saved centers instead of
+            # re-seeding. Stopping mirrors _lloyd's relative-improvement
+            # rule at chunk granularity.
+            if rs is not None:
+                centers = jnp.asarray(rs["centers"])
+                it_done = int(rs["iters_done"])
+                prev_wss = rs.get("wss")
+            else:
+                centers = _init_centers(Xf, w, int(p["k"]),
+                                        p.get("init", "Furthest"),
+                                        seed, di, p.get("user_points"))
+                it_done, prev_wss = 0, None
+            k = int(centers.shape[0])
+            chunk = jp_every if jp_every > 0 else max_iter
+            while it_done < max_iter:
+                step = min(chunk, max_iter - it_done)
+                centers, wss = _lloyd(Xf, w, centers, step)
+                it_done += step
+                wss = float(wss)
+                self._tick_job_progress(it_done, lambda: {
+                    "phase": "kmeans_lloyd",
+                    "centers": np.asarray(centers),
+                    "iters_done": it_done, "wss": wss})
+                if prev_wss is not None and \
+                        (prev_wss - wss) <= 1e-6 * max(prev_wss, 1e-12):
+                    break
+                prev_wss = wss
+                if self._out_of_time():
+                    break
         else:
             centers = _init_centers(Xf, w, int(p["k"]), p.get("init", "Furthest"),
                                     seed, di, p.get("user_points"))

@@ -50,6 +50,12 @@ class ModelBuilder:
         self.params.update({k: v for k, v in params.items() if v is not None})
         self.job: Optional[Job] = None
         self.model: Optional[Model] = None
+        # crash-survivable training: the externally-visible Job durable
+        # progress is persisted against (set by the REST handler / recovery
+        # watchdog — None keeps library-mode training cost-free), and the
+        # restored loop state a resumed dispatch fast-forwards from
+        self._progress_job: Optional[Job] = None
+        self._resume_state: Optional[dict] = None
 
     # -- param surface ----------------------------------------------------
     @classmethod
@@ -128,19 +134,25 @@ class ModelBuilder:
         # fit loops poll _out_of_time() and keep the model built so far
         mrt = float(self.params.get("max_runtime_secs") or 0.0)
         self._deadline = (t0 + mrt) if mrt > 0 else None
-        self.job.status = Job.RUNNING
-        self.job.start_time = t0
+        # locked transitions: the cloud supervisor can fail() this job from
+        # another thread at any instant — status check+set must be atomic
+        # or a dead cloud's job reports DONE (the fail()/completion race)
+        if not self.job.begin():
+            raise RuntimeError(
+                f"Job {self.job.key} was failed before training started:\n"
+                f"{self.job.exception}")
         try:
             model = self._train_impl(train, valid)
         except Exception:
-            self.job.status = Job.FAILED
             import traceback
 
-            self.job.exception = traceback.format_exc()
+            self.job.fail_local(traceback.format_exc())
             raise
-        self.job.status = Job.DONE
-        self.job.progress = 1.0
-        self.job.end_time = time.time()
+        if self.job.complete():
+            # only a completion that WON the verdict supersedes the durable
+            # progress — when an external FAILED landed first, the progress
+            # file is exactly what the watchdog needs to resume the job
+            self._clear_job_progress()
         model._output.run_time_ms = int((time.time() - t0) * 1000)
         self.model = model
         return model
@@ -149,6 +161,84 @@ class ModelBuilder:
     # builders that implement training continuation set this True; everyone
     # else must REJECT the param rather than silently train from scratch
     supports_checkpoint = False
+    # builders whose fit loops persist durable per-iteration progress and
+    # can fast-forward from it (_tick_job_progress / _take_resume_state)
+    supports_iteration_resume = False
+
+    # -- durable job progress (crash-survivable training) -----------------
+    def _job_ckpt_every(self) -> int:
+        """Chunk/persist interval in completed iterations; 0 when the env
+        knob is unset or this builder cannot resume. Derived from the ENV
+        + capability ONLY — the value shapes the fit loop itself (chunked
+        IRLS / Lloyd), and every process of a multi-process cloud must
+        walk identical device program sequences whether or not it is the
+        one persisting (followers replaying a broadcast train carry no
+        ``_progress_job``). Whether a tick actually SAVES is decided in
+        ``_tick_job_progress``."""
+        if not self.supports_iteration_resume:
+            return 0
+        from h2o3_tpu.parallel import ckpt
+
+        return max(ckpt.job_ckpt_iters(), 0)
+
+    def _tick_job_progress(self, done: int, state_fn) -> None:
+        """Called by iterative fit loops after `done` completed iterations;
+        every ``H2O_TPU_JOB_CKPT_ITERS`` it persists ``state_fn()`` through
+        the job-progress store. Saves happen only on the dispatching
+        process (the one holding the REST-visible job) — everyone else
+        pays a couple of int compares. Best-effort by contract: a failed
+        write logs and training continues (durability must never fail the
+        build)."""
+        every = self._job_ckpt_every()
+        if every <= 0 or done <= 0 or done % every != 0:
+            return
+        job = self._progress_job
+        if job is None or not getattr(job, "resume_spec", None):
+            return
+        if done == getattr(self, "_jp_last", 0):
+            return
+        from h2o3_tpu.parallel import ckpt
+
+        try:
+            ckpt.save_job_progress(str(self._progress_job.key), done,
+                                   self._progress_job.resume_spec, state_fn())
+            self._jp_last = done
+        except Exception as e:   # noqa: BLE001 — best-effort by contract
+            from h2o3_tpu.utils.log import get_logger
+
+            get_logger().warning(
+                "job %s: progress persist at iteration %d failed "
+                "(training continues): %s", self._progress_job.key, done, e)
+
+    def _clear_job_progress(self) -> None:
+        """A completed build supersedes its partial progress — GC it.
+        Checked+deleted under the REST job's status lock: the supervisor's
+        external FAILED targets the REST-visible job (a different object
+        from the builder's internal one), and if that verdict already
+        landed, the progress file IS the watchdog's resume input."""
+        from h2o3_tpu.core.job import Job
+        from h2o3_tpu.parallel import ckpt
+
+        job = self._progress_job
+        if job is None or not getattr(job, "resume_spec", None):
+            return
+        try:
+            with job._status_lock:
+                if job.status == Job.FAILED and job.failed_externally:
+                    return
+                ckpt.delete_job_progress(str(job.key))
+        except Exception:   # noqa: BLE001 — GC stays best-effort
+            pass
+
+    def _take_resume_state(self, phase: str) -> Optional[dict]:
+        """Hand the restored loop state to the fit loop that saved it (the
+        `phase` tag guards against an algo/loop mismatch after a param
+        drift) — consumed once, so CV submodels never see it."""
+        rs = self._resume_state
+        if isinstance(rs, dict) and rs.get("phase") == phase:
+            self._resume_state = None
+            return rs
+        return None
 
     def _train_impl(self, train: Frame, valid: Optional[Frame]) -> Model:
         nfolds = int(self.params.get("nfolds") or 0)

@@ -158,12 +158,12 @@ class DRF(SharedTree):
         # the running validation SUM. OOB accumulators restart at zero (the
         # per-tree bagging masks are not part of the model artifact), so
         # post-resume OOB training metrics cover the NEW trees only.
-        t_start = self._ckpt_start(ntrees)
+        t_base = self._ckpt_start(ntrees)
         if vs is None:
             v_sum = None
-        elif t_start:
+        elif t_base:
             v_sum = (self._ckpt.forest.predict_binned(vs["binned"])
-                     .astype(jnp.float32) * t_start)
+                     .astype(jnp.float32) * t_base)
         else:
             v_sum = jnp.zeros(vs["binned"].shape[0], jnp.float32)
         # OOB accumulation: sum of oob predictions and counts per row
@@ -177,6 +177,25 @@ class DRF(SharedTree):
         root_key = jax.random.PRNGKey(self._seed())
         packs, leaf_means, leaf_wys = [], [], []
         mask = None
+        t_start = t_base
+        rs = self._take_resume_state("drf_single")
+        if rs is not None:
+            # durable-progress fast-forward: exact loop state incl. the OOB
+            # accumulators and the host RNG stream feeding the per-node
+            # mtries masks — the continued run is bitwise-identical
+            t_start = int(rs["t_done"])
+            oob_sum = jnp.asarray(rs["oob_sum"])
+            oob_cnt = jnp.asarray(rs["oob_cnt"])
+            if v_sum is not None and rs.get("v_sum") is not None:
+                v_sum = jnp.asarray(rs["v_sum"])
+            stop_metric = [v for v in rs["stop_metric"]]
+            history = [dict(h) for h in rs["history"]]
+            packs = [np.asarray(pk) for pk in rs["packs"]]
+            leaf_means = [jnp.asarray(v) for v in rs["leaf_means"]]
+            leaf_wys = [jnp.asarray(v) for v in rs["leaf_wys"]]
+            if rs.get("rng_state") is not None:
+                rng.bit_generator.state = rs["rng_state"]
+        jp_every = self._job_ckpt_every()
         for t in range(t_start, ntrees):
             mask, w_t = pre(w, root_key, np.int32(t), sample_rate) \
                 if sampling else (None, w)
@@ -224,12 +243,25 @@ class DRF(SharedTree):
                 break
             if self.job:
                 self.job.update(progress=(t + 1) / ntrees, msg=f"tree {t + 1}")
+            if jp_every and (t + 1) % jp_every == 0:
+                done = t + 1
+                self._tick_job_progress(done, lambda: {
+                    "phase": "drf_single", "t_done": done,
+                    "oob_sum": np.asarray(oob_sum),
+                    "oob_cnt": np.asarray(oob_cnt),
+                    "v_sum": None if v_sum is None else np.asarray(v_sum),
+                    "stop_metric": list(stop_metric),
+                    "history": [dict(h) for h in history],
+                    "packs": [np.asarray(pk) for pk in packs],
+                    "leaf_means": [np.asarray(v) for v in leaf_means],
+                    "leaf_wys": [np.asarray(v) for v in leaf_wys],
+                    "rng_state": rng.bit_generator.state})
 
         # one batched fetch; scale leaves by the ACTUAL tree count (early
         # stopping may truncate) so the summed traversal averages correctly
         from h2o3_tpu.models.tree.device_tree import assemble_trees
 
-        total = t_start + len(packs)
+        total = t_base + len(packs)
         trees = assemble_trees(packs, leaf_means, leaf_wys, spec, max_depth,
                                scale=1.0 / total)
         varimp = self._ckpt_varimp0()
@@ -239,10 +271,10 @@ class DRF(SharedTree):
         self._finalize_varimp(model, varimp)
         forest = CompressedForest.from_host_trees(
             trees, spec, max_depth=max_depth, init_f=0.0, nclasses=1)
-        if t_start:
-            # rescale: prev leaves are /t_start, target is /total
+        if t_base:
+            # rescale: prev leaves are /t_base, target is /total
             forest = CompressedForest.concat(self._ckpt.forest, forest,
-                                             scale_a=t_start / total)
+                                             scale_a=t_base / total)
         f = jnp.where(oob_cnt > 0, oob_sum / jnp.maximum(oob_cnt, 1.0), 0.0)
         self._oob_raw = None
         if float(jnp.max(oob_cnt)) > 0:
@@ -274,10 +306,24 @@ class DRF(SharedTree):
         min_rows = float(self.params["min_rows"])
         msi = float(self.params["min_split_improvement"])
         tree_class = []
-        t_start = self._ckpt_start(ntrees, per_iter=K)
+        t_base = self._ckpt_start(ntrees, per_iter=K)
         oob_sum = jnp.zeros((N, K), jnp.float32)
         oob_cnt = jnp.zeros(N, jnp.float32)
         packs, leaf_means, leaf_wys = [], [], []
+        t_start = t_base
+        rs = self._take_resume_state("drf_multi")
+        if rs is not None:
+            # durable-progress fast-forward (same contract as drf_single)
+            t_start = int(rs["t_done"])
+            oob_sum = jnp.asarray(rs["oob_sum"])
+            oob_cnt = jnp.asarray(rs["oob_cnt"])
+            tree_class = list(rs["tree_class"])
+            packs = [np.asarray(pk) for pk in rs["packs"]]
+            leaf_means = [jnp.asarray(v) for v in rs["leaf_means"]]
+            leaf_wys = [jnp.asarray(v) for v in rs["leaf_wys"]]
+            if rs.get("rng_state") is not None:
+                rng.bit_generator.state = rs["rng_state"]
+        jp_every = self._job_ckpt_every()
         for t in range(t_start, ntrees):
             mask, w_t = self._sample_rows(rng, N, w)
             for k in range(K):
@@ -305,9 +351,20 @@ class DRF(SharedTree):
                 break
             if self.job:
                 self.job.update(progress=(t + 1) / ntrees, msg=f"iter {t + 1}")
+            if jp_every and (t + 1) % jp_every == 0:
+                done = t + 1
+                self._tick_job_progress(done, lambda: {
+                    "phase": "drf_multi", "t_done": done,
+                    "oob_sum": np.asarray(oob_sum),
+                    "oob_cnt": np.asarray(oob_cnt),
+                    "tree_class": list(tree_class),
+                    "packs": [np.asarray(pk) for pk in packs],
+                    "leaf_means": [np.asarray(v) for v in leaf_means],
+                    "leaf_wys": [np.asarray(v) for v in leaf_wys],
+                    "rng_state": rng.bit_generator.state})
         from h2o3_tpu.models.tree.device_tree import assemble_trees
 
-        total = t_start + len(packs) // K
+        total = t_base + len(packs) // K
         trees = assemble_trees(packs, leaf_means, leaf_wys, spec, max_depth,
                                scale=1.0 / total)
         varimp = self._ckpt_varimp0()
@@ -317,9 +374,9 @@ class DRF(SharedTree):
         forest = CompressedForest.from_host_trees(
             trees, spec, tree_class=tree_class, max_depth=max_depth,
             nclasses=K)
-        if t_start:
+        if t_base:
             forest = CompressedForest.concat(self._ckpt.forest, forest,
-                                             scale_a=t_start / total)
+                                             scale_a=t_base / total)
         self._oob_raw = None
         if float(jnp.max(oob_cnt)) > 0:
             p = jnp.clip(oob_sum / jnp.maximum(oob_cnt, 1.0)[:, None], 0.0, 1.0)

@@ -53,6 +53,7 @@ class IsolationForest(SharedTree):
     algo_name = "isolationforest"
     model_class = IsolationForestModel
     supports_checkpoint = False      # reference IF has no _checkpoint path
+    supports_iteration_resume = False
     _intrain_valid = False   # overrides the fit loops; OOB/in-sample stopping
     supervised = False
 

@@ -221,6 +221,10 @@ class SharedTree(ModelBuilder):
 
     model_class = SharedTreeModel
     supports_checkpoint = True
+    # crash-survivable builds: the fit loops persist durable per-tree
+    # progress (margins, packed tables, RNG stream) and fast-forward from
+    # it bitwise-identically (model_builder._tick_job_progress)
+    supports_iteration_resume = True
     # GBM consumes the in-training validation state; DRF/IF override the fit
     # loops without reading it (DRF's stopping metric is OOB, reference
     # doOOBScoring), so they skip building it
@@ -420,8 +424,8 @@ class SharedTree(ModelBuilder):
                                                       stash_packed)
 
         N = binned.shape[0]
-        t_start = self._ckpt_start(ntrees)
-        if t_start:
+        t_base = self._ckpt_start(ntrees)   # trees already in a user
+        if t_base:                          # checkpoint model (concat below)
             # resume: margins restart from the checkpoint forest's predictions
             pf = self._ckpt.forest
             init_f = pf.init_f
@@ -447,7 +451,7 @@ class SharedTree(ModelBuilder):
         vs = self._vstate
         if vs is None:
             f_valid = None
-        elif t_start:
+        elif t_base:
             f_valid = self._ckpt.forest.predict_binned(vs["binned"]) + vs["offset"]
         else:
             f_valid = init_f + vs["offset"]
@@ -459,6 +463,25 @@ class SharedTree(ModelBuilder):
 
         root_key = jax.random.PRNGKey(self._seed())
         packs, leaf_vals, leaf_wys = [], [], []
+        t_start = t_base
+        rs = self._take_resume_state("tree_single")
+        if rs is not None:
+            # durable-progress fast-forward: restore the EXACT loop state
+            # (margins, per-tree tables, host RNG stream) so the continued
+            # run is bitwise-identical to an uninterrupted one
+            t_start = int(rs["t_done"])
+            init_f = float(rs["init_f"])
+            f = jnp.asarray(rs["f"])
+            if f_valid is not None and rs.get("f_valid") is not None:
+                f_valid = jnp.asarray(rs["f_valid"])
+            stop_metric = [float(v) for v in rs["stop_metric"]]
+            history = [dict(h) for h in rs["history"]]
+            packs = [np.asarray(pk) for pk in rs["packs"]]
+            leaf_vals = [jnp.asarray(v) for v in rs["leaf_vals"]]
+            leaf_wys = [jnp.asarray(v) for v in rs["leaf_wys"]]
+            if rs.get("rng_state") is not None:
+                rng.bit_generator.state = rs["rng_state"]
+        jp_every = self._job_ckpt_every()
         from h2o3_tpu.core.failure import faultpoint
 
         for t in range(t_start, ntrees):
@@ -497,6 +520,20 @@ class SharedTree(ModelBuilder):
                 break
             if self.job:
                 self.job.update(progress=(t + 1) / ntrees, msg=f"tree {t + 1}")
+            if jp_every and (t + 1) % jp_every == 0:
+                done = t + 1
+                self._tick_job_progress(done, lambda: {
+                    "phase": "tree_single", "t_done": done,
+                    "init_f": float(init_f),
+                    "f": np.asarray(f),
+                    "f_valid": (None if f_valid is None
+                                else np.asarray(f_valid)),
+                    "stop_metric": list(stop_metric),
+                    "history": [dict(h) for h in history],
+                    "packs": [np.asarray(pk) for pk in packs],
+                    "leaf_vals": [np.asarray(v) for v in leaf_vals],
+                    "leaf_wys": [np.asarray(v) for v in leaf_wys],
+                    "rng_state": rng.bit_generator.state})
 
         # ONE batched fetch for every tree's tables + leaf values
         from h2o3_tpu.models.tree.device_tree import assemble_trees
@@ -509,7 +546,7 @@ class SharedTree(ModelBuilder):
         self._finalize_varimp(model, varimp)
         forest = CompressedForest.from_host_trees(
             trees, spec, max_depth=max_depth, init_f=init_f, nclasses=1)
-        if t_start:
+        if t_base:
             forest = CompressedForest.concat(self._ckpt.forest, forest)
         return forest, f
 
@@ -525,9 +562,9 @@ class SharedTree(ModelBuilder):
 
         N = binned.shape[0]
         yi = y.astype(jnp.int32)
-        t_start = self._ckpt_start(ntrees, per_iter=K)
+        t_base = self._ckpt_start(ntrees, per_iter=K)
         vs = self._vstate
-        if t_start:
+        if t_base:
             pf = self._ckpt.forest
             init = np.asarray(pf.init_class, np.float32)
             f = pf.predict_binned(binned).astype(jnp.float32)
@@ -584,6 +621,24 @@ class SharedTree(ModelBuilder):
         root_key = jax.random.PRNGKey(self._seed())
         sample_rate = float(self.params.get("sample_rate", 1.0) or 1.0)
         packs, leaf_vals, leaf_wys = [], [], []
+        t_start = t_base
+        rs = self._take_resume_state("tree_multi")
+        if rs is not None:
+            # durable-progress fast-forward (same contract as tree_single)
+            t_start = int(rs["t_done"])
+            init = np.asarray(rs["init"], np.float32)
+            f = jnp.asarray(rs["f"])
+            if f_valid is not None and rs.get("f_valid") is not None:
+                f_valid = jnp.asarray(rs["f_valid"])
+            stop_metric = [float(v) for v in rs["stop_metric"]]
+            history = [dict(h) for h in rs["history"]]
+            tree_class = list(rs["tree_class"])
+            packs = [np.asarray(pk) for pk in rs["packs"]]
+            leaf_vals = [jnp.asarray(v) for v in rs["leaf_vals"]]
+            leaf_wys = [jnp.asarray(v) for v in rs["leaf_wys"]]
+            if rs.get("rng_state") is not None:
+                rng.bit_generator.state = rs["rng_state"]
+        jp_every = self._job_ckpt_every()
         for t in range(t_start, ntrees):
             feat_mask_fn = self._feat_mask_fn(rng, spec)
             masks = build_feat_masks(max_depth, feat_mask_fn, spec.F, maxB)
@@ -629,6 +684,21 @@ class SharedTree(ModelBuilder):
                 break
             if self.job:
                 self.job.update(progress=(t + 1) / ntrees, msg=f"iter {t + 1}")
+            if jp_every and (t + 1) % jp_every == 0:
+                done = t + 1
+                self._tick_job_progress(done, lambda: {
+                    "phase": "tree_multi", "t_done": done,
+                    "init": np.asarray(init),
+                    "f": np.asarray(f),
+                    "f_valid": (None if f_valid is None
+                                else np.asarray(f_valid)),
+                    "stop_metric": list(stop_metric),
+                    "history": [dict(h) for h in history],
+                    "tree_class": list(tree_class),
+                    "packs": [np.asarray(pk) for pk in packs],
+                    "leaf_vals": [np.asarray(v) for v in leaf_vals],
+                    "leaf_wys": [np.asarray(v) for v in leaf_wys],
+                    "rng_state": rng.bit_generator.state})
 
         from h2o3_tpu.models.tree.device_tree import assemble_trees
 
@@ -642,7 +712,7 @@ class SharedTree(ModelBuilder):
             trees, spec, tree_class=tree_class, max_depth=max_depth,
             init_f=0.0, nclasses=K)
         forest.init_class = init          # added per-class at scoring
-        if t_start:
+        if t_base:
             forest = CompressedForest.concat(self._ckpt.forest, forest)
         return forest, f
 

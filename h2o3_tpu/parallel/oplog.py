@@ -121,11 +121,22 @@ REST_SERVING = False
 # multi-process ops (locally OR broadcast) until it rejoins as a follower
 _DEMOTED = False
 
+# set when THIS process's replay loop died on a replay crash: the recovery
+# watchdog reads it to nudge the failed follower through rejoin() without
+# an operator; rejoin() clears it
+_REPLAY_CRASHED = False
+
 
 def demoted() -> bool:
     """True when this process lost coordination to a newer epoch and has
     not yet rejoined as a follower (see maybe_demote)."""
     return _DEMOTED
+
+
+def replay_crashed() -> bool:
+    """True when this process's follower replay loop crashed and it has
+    not yet rejoined (the watchdog's auto-rejoin trigger)."""
+    return _REPLAY_CRASHED
 
 
 def _in_op() -> bool:
@@ -157,6 +168,8 @@ def reset(next_seq: int = 0) -> None:
     """Reset the coordinator-side protocol state (sequence counter,
     turnstile, abandoned slots). Test/bootstrap/standby-takeover use."""
     global _SEQ, _NEXT_EXEC, _EXECUTING, _GEN, _HEAD_IDLE_SINCE
+    global _REPLAY_CRASHED
+    _REPLAY_CRASHED = False
     with _EXEC_COND:
         _SEQ = next_seq
         _NEXT_EXEC = next_seq
@@ -628,8 +641,27 @@ def _apply(kind: str, p: Dict[str, Any]) -> None:
         valid = DKV.get(p["validation_frame"]) if p.get("validation_frame") \
             else None
         y = p.get("y")
-        model = cls(**params).train(y=y, training_frame=train,
-                                    validation_frame=valid)
+        builder = cls(**params)
+        if p.get("resume_job"):
+            # resumed dispatch: every process fast-forwards from the SAME
+            # durable progress file (shared checkpoint dir), so the device
+            # program sequence lines up with the coordinator's continuation.
+            # A process that CANNOT read it must fail the replay loudly —
+            # silently training from iteration 0 while the coordinator
+            # fast-forwards desynchronizes the per-iteration collectives
+            # with no error record naming the real cause.
+            from h2o3_tpu.parallel import ckpt
+
+            data = ckpt.load_job_progress(p["resume_job"])
+            if data is None:
+                raise RuntimeError(
+                    f"resumed train for job {p['resume_job']}: durable "
+                    f"progress is not readable on this process — "
+                    f"H2O_TPU_OPLOG_CKPT_DIR must be shared storage for "
+                    f"cross-host job resume")
+            builder._resume_state = data.get("state")
+        model = builder.train(y=y, training_frame=train,
+                              validation_frame=valid)
         if p.get("model_id"):
             from h2o3_tpu.core.dkv import Key
 
@@ -771,7 +803,10 @@ def follower_loop(idle_timeout_s: float = 120.0,
         except Exception:
             # surface the replay failure to the cloud BEFORE dying: the
             # coordinator (and operators reading /3/Cloud health) see the
-            # error instead of a bare collective hang
+            # error instead of a bare collective hang. The crash flag lets
+            # this process's recovery watchdog auto-rejoin.
+            global _REPLAY_CRASHED
+            _REPLAY_CRASHED = True
             _record_error(i, op["kind"], traceback.format_exc())
             raise
         _ack(i, op.get("op_id"))
@@ -838,7 +873,7 @@ def rejoin() -> int:
     catch-up the demotion flag and the supervisor's demotion hold are
     cleared — this is exactly the "rejoin() as a follower" remediation
     the demotion error advertises."""
-    global _DEMOTED
+    global _DEMOTED, _REPLAY_CRASHED
     import jax
 
     from h2o3_tpu.parallel import ckpt
@@ -886,7 +921,8 @@ def rejoin() -> int:
         if s < cursor:
             D.kv_delete(f"{_PREFIX}/error/{s}")
     _write_rejoin(proc, inc, "caught_up", cursor)
-    if _DEMOTED:
+    _REPLAY_CRASHED = False          # readmitted: the crashed loop's state
+    if _DEMOTED:                     # was rebuilt from ckpt + suffix
         # caught up as a follower of the new epoch: the demotion did its
         # job. Clear the flag and lift the supervisor's infinite demotion
         # hold so liveness evidence can recover the health state.

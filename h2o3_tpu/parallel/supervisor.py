@@ -319,12 +319,18 @@ def evaluate() -> str:
 
 
 class Supervisor:
-    """Background evaluator (coordinator-side HeartBeatThread analog)."""
+    """Background evaluator (coordinator-side HeartBeatThread analog).
+
+    Owns the autonomous recovery watchdog (parallel/watchdog.py) when
+    ``H2O_TPU_AUTO_RECOVER`` is on: supervision detects the failures, the
+    watchdog's daemon thread performs the recoveries — elections, rejoins,
+    durable-job resumes — with no operator in the loop."""
 
     def __init__(self, interval: Optional[float] = None):
         self.interval = interval_s() if interval is None else float(interval)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self.watchdog = None
 
     def start(self) -> "Supervisor":
         def run():
@@ -341,7 +347,18 @@ class Supervisor:
         self._thread = threading.Thread(target=run, daemon=True,
                                         name="h2o3-supervisor")
         self._thread.start()
+        from h2o3_tpu.parallel import watchdog as _wd
+
+        # at most ONE watchdog per process: a standby whose own watchdog
+        # just won the election re-enters here via start_server — stacking
+        # a second ticker would double every recovery scan and corrupt the
+        # module-level counters
+        if _wd.enabled() and not _wd.status().get("running"):
+            self.watchdog = _wd.Watchdog().start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        if self.watchdog is not None:
+            self.watchdog.stop()
+            self.watchdog = None

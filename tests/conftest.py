@@ -84,7 +84,8 @@ _SMOKE_MODULES = {"test_core", "test_glm", "test_rapids", "test_java_mojo",
 # file order is kept within each cost class.
 _HEAVY_MODULES = [
     # many passing tests per second of training — earliest of the tail
-    "test_trees", "test_checkpoint", "test_genmodel", "test_mojo",
+    "test_job_resume", "test_trees", "test_checkpoint", "test_genmodel",
+    "test_mojo",
     "test_mojo_families", "test_explain", "test_ensemble",
     "test_survival_gam_rulefit", "test_grid",
     # long single fits / many submodels

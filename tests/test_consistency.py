@@ -8,6 +8,9 @@
    stay in sync with the custom markers actually used under ``tests/``:
    a marker used but not declared breaks ``--strict-markers`` runs, a
    marker declared but never used is dead registry weight.
+3. Every ``H2O_TPU_*`` env knob the framework reads must appear in
+   README.md — an undocumented knob is an operator trap (the recovery
+   runbook promises the full surface).
 
 Pure text scans — no jax, no devices, milliseconds.
 """
@@ -69,6 +72,21 @@ def _used_markers():
     for _p, text in _py_sources(TESTS):
         used |= set(re.findall(r"pytest\.mark\.(\w+)", text))
     return used - _BUILTIN_MARKS
+
+
+def test_env_knobs_documented_in_readme():
+    """Every H2O_TPU_* env var read anywhere in h2o3_tpu/ must be named in
+    README.md (env tables / runbook). New knobs ship with their docs."""
+    used = set()
+    for _p, text in _py_sources(SRC):
+        used |= set(re.findall(r"\bH2O_TPU_[A-Z0-9_]+\b", text))
+    readme = (ROOT / "README.md").read_text(encoding="utf-8")
+    documented = set(re.findall(r"\bH2O_TPU_[A-Z0-9_]+\b", readme))
+    missing = used - documented
+    assert not missing, (
+        f"env knob(s) {sorted(missing)} are read in h2o3_tpu/ but not "
+        "documented in README.md — add them to the env table (operators "
+        "discover knobs there, not by grepping the source)")
 
 
 def test_pyproject_markers_match_test_usage():

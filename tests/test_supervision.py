@@ -47,6 +47,9 @@ def mem_cloud(monkeypatch):
         # seq and the chaos fault injections hit the op they target (the
         # async path has its own dedicated test)
         monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_ASYNC", "0")
+        # these tests drive every transition BY HAND — the autonomous
+        # watchdog would race them (its own tests re-enable it)
+        monkeypatch.setenv("H2O_TPU_AUTO_RECOVER", "0")
         failure.set_incarnation(0)
         D.reset_leadership()
         oplog._DEMOTED = False
@@ -953,9 +956,9 @@ class TestCheckpointCompaction:
         assert len(slots) <= 2 * 8, sorted(slots)
         assert len(acks) <= 2 * 8, sorted(acks)
         assert ckpt.latest_seq() is not None and ckpt.latest_seq() >= 32
-        # checkpoint records themselves are pruned (keep 2)
+        # checkpoint records themselves are GCd (H2O_TPU_OPLOG_CKPT_KEEP)
         assert len([k for k in mem_cloud
-                    if k.startswith("oplog/ckpt/")]) <= 2
+                    if k.startswith("oplog/ckpt/")]) <= ckpt.keep_ckpts()
         assert supervisor.evaluate() != supervisor.FAILED
         oplog.publish("shutdown", {})
         t.join(timeout=15)
@@ -1388,6 +1391,7 @@ def standby_cloud(monkeypatch):
         monkeypatch.setenv("H2O_TPU_OP_ACK_TIMEOUT_S", "30")
         monkeypatch.setenv("H2O_TPU_OPLOG_CHECKPOINT_OPS", "0")
         monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_ASYNC", "0")
+        monkeypatch.setenv("H2O_TPU_AUTO_RECOVER", "0")
         failure.set_incarnation(0)
         D.write_epoch_record(0, 1)
         D.set_leader(1, 0)
@@ -1623,6 +1627,543 @@ class TestSatelliteFixes:
         monkeypatch.setattr(D, "kv_get", counting_get)
         assert DKV.fetch_remote("nope") is None
         assert calls["n"] == 1
+
+
+# ---------------------------------------------------------------------------
+# autonomous recovery watchdog (ISSUE 5): one recovery action per tick,
+# zero operator intervention — plus the Job.fail() race fix and the
+# checkpoint-dir GC satellites
+# ---------------------------------------------------------------------------
+
+from h2o3_tpu.parallel import watchdog  # noqa: E402
+
+
+class _Killed(Exception):
+    """Stands in for the coordinator process dying mid-train."""
+
+
+class TestWatchdogTicks:
+    def test_disabled_by_env_takes_no_action(self, mem_cloud):
+        """mem_cloud pins H2O_TPU_AUTO_RECOVER=0 (manual drills): the
+        watchdog must observe it and do nothing."""
+        watchdog.reset()
+        wd = watchdog.Watchdog(interval=3600, follow=False)
+        assert wd.tick() == "disabled"
+        st = watchdog.status()
+        assert st["enabled"] is False and st["ticks"] == 0
+
+    def test_follower_stands_by_while_leader_beats(self, standby_cloud,
+                                                   monkeypatch):
+        monkeypatch.setenv("H2O_TPU_AUTO_RECOVER", "1")
+        monkeypatch.setenv("H2O_TPU_ELECTION_GRACE_S", "60")
+        watchdog.reset()
+        standby_cloud["h2o3/heartbeat/1"] = json.dumps({"ts": time.time(),
+                                                        "proc": 1})
+        failure.heartbeat()
+        wd = watchdog.Watchdog(interval=3600, follow=False)
+        assert wd.tick() == "follower (leader alive)"
+        assert not D.is_coordinator()
+        assert watchdog.status()["elections"] == 0
+
+    def test_demoted_ex_coordinator_auto_rejoins(self, mem_cloud,
+                                                 monkeypatch, tmp_path):
+        """A demoted ex-coordinator no longer waits for an operator's
+        rejoin(): the next watchdog tick readmits it as a follower."""
+        monkeypatch.setenv("H2O_TPU_AUTO_RECOVER", "1")
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        watchdog.reset()
+        oplog._DEMOTED = True
+        wd = watchdog.Watchdog(interval=3600, follow=False)
+        tag = wd.tick()
+        assert tag.startswith("rejoined (demoted")
+        assert not oplog.demoted()
+        assert failure.incarnation() == 1
+        assert oplog.rejoin_records()[0]["phase"] == "caught_up"
+        assert watchdog.status()["rejoins"] == 1
+
+    def test_crashed_follower_auto_rejoins(self, standby_cloud,
+                                           monkeypatch, tmp_path):
+        """A follower whose replay loop crashed is nudged through the
+        existing rejoin path instead of staying dead."""
+        monkeypatch.setenv("H2O_TPU_AUTO_RECOVER", "1")
+        monkeypatch.setenv("H2O_TPU_ELECTION_GRACE_S", "60")
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        watchdog.reset()
+        standby_cloud["h2o3/heartbeat/1"] = json.dumps({"ts": time.time(),
+                                                        "proc": 1})
+        failure.heartbeat()
+        oplog._REPLAY_CRASHED = True
+        wd = watchdog.Watchdog(interval=3600, follow=False)
+        assert wd.tick() == "rejoined (crashed follower)"
+        assert not oplog.replay_crashed()
+        assert failure.incarnation() == 1
+
+    def test_no_leader_heartbeat_is_not_silence_during_boot(
+            self, standby_cloud, monkeypatch):
+        """A follower's watchdog can start before the coordinator's first
+        beat lands in the KV: the missing row must not count as
+        grace-elapsed silence, or every cloud boot risks a spurious
+        takeover."""
+        monkeypatch.setenv("H2O_TPU_AUTO_RECOVER", "1")
+        monkeypatch.setenv("H2O_TPU_ELECTION_GRACE_S", "60")
+        watchdog.reset()
+        failure.heartbeat()               # we beat; the leader has no row
+        wd = watchdog.Watchdog(interval=3600, follow=False)
+        assert wd.tick() == "follower (no leader evidence yet)"
+        assert not D.is_coordinator()
+        assert watchdog.status()["elections"] == 0
+
+    def test_tick_never_raises(self, standby_cloud, monkeypatch):
+        """A transient KV fault inside a tick must not kill recovery for
+        good: the error is recorded and the next tick retries."""
+        monkeypatch.setenv("H2O_TPU_AUTO_RECOVER", "1")
+        watchdog.reset()
+        monkeypatch.setattr(oplog, "maybe_demote", lambda: 1 / 0)
+        wd = watchdog.Watchdog(interval=3600, follow=False)
+        assert wd.tick() == "error"
+        assert "ZeroDivisionError" in watchdog.status()["last_error"]
+
+    def test_resume_skips_exhausted_and_non_external_jobs(
+            self, mem_cloud, monkeypatch, tmp_path):
+        """A job that keeps dying is parked after MAX_ATTEMPTS dispatches,
+        and a job the WORKER crashed (not the cloud) is never resurrected
+        — only externally-failed jobs with durable progress come back."""
+        from h2o3_tpu.core.dkv import DKV
+        from h2o3_tpu.core.job import Job
+
+        monkeypatch.setenv("H2O_TPU_AUTO_RECOVER", "1")
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        watchdog.reset()
+        exhausted = Job(description="poisoned")
+        exhausted.fail("cloud FAILED")
+        exhausted.attempt = watchdog.MAX_ATTEMPTS
+        exhausted.resume_spec = {"algo": "gbm", "params": {},
+                                 "training_frame": "nope", "y": "y"}
+        ckpt.save_job_progress(str(exhausted.key), 4,
+                               exhausted.resume_spec, {"phase": "x"})
+        local_crash = Job(description="worker bug")
+        local_crash.begin()
+        local_crash.fail_local("trainer raised")   # NOT failed_externally
+        ckpt.save_job_progress(str(local_crash.key), 2,
+                               {"algo": "gbm", "params": {},
+                                "training_frame": "nope", "y": "y"},
+                               {"phase": "x"})
+        try:
+            assert watchdog.resume_failed_jobs() == []
+            assert exhausted.status == Job.FAILED
+            assert local_crash.status == Job.FAILED
+            # both records were GCd: the parked job is dead for good, the
+            # worker-crashed one is the client's to resubmit — neither may
+            # leak its (potentially huge) progress file forever
+            assert ckpt.load_job_progress(str(exhausted.key)) is None
+            assert ckpt.load_job_progress(str(local_crash.key)) is None
+            assert ckpt.job_progress_records() == []
+        finally:
+            for j in (exhausted, local_crash):
+                ckpt.delete_job_progress(str(j.key))
+                DKV.remove(str(j.key))
+
+
+class TestJobCheckpointSurvival:
+    def test_unpickled_inflight_job_fails_externally(self):
+        """A job restored from a control-plane checkpoint has no worker
+        thread by construction: restoring it still-RUNNING would park it
+        in that state forever (the watchdog rightly leaves RUNNING jobs
+        alone). It must come back FAILED+failed_externally — i.e. a
+        resume candidate."""
+        import pickle as _pickle
+
+        from h2o3_tpu.core.dkv import DKV
+        from h2o3_tpu.core.job import Job
+
+        jobs = []
+        for st in (Job.CREATED, Job.RUNNING, Job.RESUMING):
+            job = Job(description=f"inflight {st}")
+            jobs.append(job)
+            job.status = st
+            back = _pickle.loads(_pickle.dumps(job))
+            assert back.status == Job.FAILED and back.failed_externally, st
+            assert "in flight" in back.exception
+        done = Job(description="done")
+        jobs.append(done)
+        done.begin()
+        done.complete()
+        back = _pickle.loads(_pickle.dumps(done))
+        assert back.status == Job.DONE and not back.failed_externally
+        for j in jobs:
+            DKV.remove(str(j.key))
+
+
+class TestJobFailRace:
+    """Satellite: fail() and the worker's own completion interleave — the
+    status lock must make the verdict single-writer."""
+
+    def _job(self):
+        from h2o3_tpu.core.job import Job
+
+        return Job(description="race probe")
+
+    def _drop(self, *jobs):
+        from h2o3_tpu.core.dkv import DKV
+
+        for j in jobs:
+            DKV.remove(str(j.key))
+
+    def test_external_fail_beats_completion(self):
+        from h2o3_tpu.core.job import Job
+
+        job = self._job()
+        try:
+            assert job.begin()
+            job.fail("cloud FAILED under the build")
+            assert not job.complete()           # verdict kept
+            assert job.status == Job.FAILED and job.failed_externally
+            assert "cloud FAILED" in job.exception
+        finally:
+            self._drop(job)
+
+    def test_completion_beats_late_external_fail(self):
+        from h2o3_tpu.core.job import Job
+
+        job = self._job()
+        try:
+            assert job.begin()
+            assert job.complete()
+            job.fail("too late")                # no-op once terminal
+            assert job.status == Job.DONE
+            assert not job.failed_externally and job.exception is None
+        finally:
+            self._drop(job)
+
+    def test_begin_refused_after_external_fail(self):
+        job = self._job()
+        try:
+            job.fail("dead before the worker started")
+            assert not job.begin()              # don't run on a dead cloud
+        finally:
+            self._drop(job)
+
+    def test_concurrent_fail_and_complete_single_verdict(self):
+        """Race the two writers for real: whatever the interleaving, the
+        final state is exactly one of the two consistent verdicts — never
+        DONE-with-external-failure or FAILED-without-the-flag."""
+        from h2o3_tpu.core.job import Job
+
+        jobs = []
+        for _ in range(50):
+            job = self._job()
+            jobs.append(job)
+            assert job.begin()
+            barrier = threading.Barrier(2)
+
+            def failer():
+                barrier.wait()
+                job.fail("external")
+
+            def completer():
+                barrier.wait()
+                job.complete()
+
+            ts = [threading.Thread(target=failer),
+                  threading.Thread(target=completer)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            ok_done = job.status == Job.DONE and not job.failed_externally \
+                and job.exception is None
+            ok_failed = job.status == Job.FAILED and job.failed_externally \
+                and job.exception == "external"
+            assert ok_done or ok_failed, (job.status, job.failed_externally)
+        self._drop(*jobs)
+
+    def test_stale_dispatch_thread_cannot_clobber_resumed_job(self):
+        """A worker wedged in a dead collective outlives the external
+        FAILED and the watchdog's restart: when it finally unwinds, its
+        late exception (or result) must not touch the resumed dispatch —
+        the generation guard in Job.start keeps verdicts single-writer
+        across dispatches too."""
+        from h2o3_tpu.core.job import Job
+
+        job = self._job()
+        try:
+            wedge = threading.Event()
+
+            def wedged(j):
+                wedge.wait(10)               # "stuck in a dead collective"
+                raise RuntimeError("late abort from the old dispatch")
+
+            job.start(wedged, background=True)
+            t1 = job._thread
+            job.fail("cloud FAILED")         # supervisor's verdict
+            assert job.restart(resumed_from_iteration=2)
+            go = threading.Event()
+
+            def fresh(j):
+                go.wait(10)
+                return "model"
+
+            job.start(fresh, background=True)
+            wedge.set()                      # stale thread unwinds NOW
+            t1.join(timeout=5)
+            assert job.status == Job.RUNNING  # untouched by the old thread
+            go.set()
+            deadline = time.time() + 5
+            while job.status == Job.RUNNING and time.time() < deadline:
+                time.sleep(0.01)
+            assert job.status == Job.DONE and job.attempt == 2
+            assert job.result == "model"
+        finally:
+            self._drop(job)
+
+    def test_restart_has_a_single_winner(self):
+        """Two recovery passes racing restart() on one job must produce
+        exactly one RESUMING dispatch."""
+        from h2o3_tpu.core.job import Job
+
+        job = self._job()
+        try:
+            job.begin()
+            job.fail("cloud FAILED")
+            barrier = threading.Barrier(2)
+            wins = []
+
+            def racer():
+                barrier.wait()
+                wins.append(job.restart(resumed_from_iteration=4))
+
+            ts = [threading.Thread(target=racer) for _ in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert sorted(wins) == [False, True]
+            assert job.status == Job.RESUMING
+            assert job.attempt == 2
+            assert job.resumed_from_iteration == 4
+        finally:
+            self._drop(job)
+
+
+class TestCheckpointGC:
+    def test_keep_knob_bounds_snapshots(self, mem_cloud, monkeypatch,
+                                        tmp_path):
+        """Only the newest H2O_TPU_OPLOG_CKPT_KEEP snapshots survive a
+        newer fully-acked checkpoint — KV records AND files."""
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_KEEP", "3")
+        for s in range(6):
+            ckpt.write_checkpoint(s)
+        assert [s for s, _ in ckpt.records()] == [3, 4, 5]
+        names = sorted(p.name for p in tmp_path.glob("ckpt_*.pkl"))
+        assert names == [f"ckpt_{s:012d}.pkl" for s in (3, 4, 5)]
+
+    def test_keep_zero_disables_gc(self, mem_cloud, monkeypatch, tmp_path):
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_KEEP", "0")
+        for s in range(4):
+            ckpt.write_checkpoint(s)
+        assert [s for s, _ in ckpt.records()] == [0, 1, 2, 3]
+
+    def test_mid_restore_snapshot_is_pinned(self, mem_cloud, monkeypatch,
+                                            tmp_path):
+        """GC must not delete the snapshot a rejoining follower is
+        mid-restore on: its standing rejoin record (phase 'replaying')
+        names the restore cursor. Once the rejoin completes, the next
+        checkpoint sweeps it."""
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_KEEP", "1")
+        ckpt.write_checkpoint(0)
+        # proc 1 starts restoring from ckpt 0 (cursor == its next_seq)
+        mem_cloud["oplog/rejoin/1"] = json.dumps(
+            {"proc": 1, "inc": 1, "phase": "replaying", "seq": 1,
+             "ts": time.time()})
+        for s in (1, 2):
+            ckpt.write_checkpoint(s)
+        assert [s for s, _ in ckpt.records()] == [0, 2]   # 0 pinned, 1 GCd
+        assert (tmp_path / "ckpt_000000000000.pkl").exists()
+        assert not (tmp_path / "ckpt_000000000001.pkl").exists()
+        # the rejoin completes: the pin lifts at the next checkpoint
+        mem_cloud["oplog/rejoin/1"] = json.dumps(
+            {"proc": 1, "inc": 1, "phase": "caught_up", "seq": 1,
+             "ts": time.time()})
+        ckpt.write_checkpoint(3)
+        assert [s for s, _ in ckpt.records()] == [3]
+        assert sorted(tmp_path.glob("ckpt_0*.pkl"))[-1].name \
+            == "ckpt_000000000003.pkl"
+
+
+class TestAutonomousArc:
+    def test_kill_elect_rejoin_resume_bitwise_over_rest(
+            self, cl, standby_cloud, monkeypatch, tmp_path):
+        """Acceptance (ISSUE 5): the coordinator is killed mid-GBM-train;
+        with NO manual assume_coordination()/rejoin() calls the watchdog
+        elects this standby (REST re-binds), the restarted ex-coordinator
+        rejoins as a follower, the interrupted job resumes from its last
+        durable iteration under its ORIGINAL key, and the resumed model's
+        REST predictions are bitwise-identical to the uninterrupted
+        baseline's."""
+        import numpy as np
+
+        from h2o3_tpu import scoring
+        from h2o3_tpu.api import server as api_server
+        from h2o3_tpu.core.dkv import DKV
+        from h2o3_tpu.core.frame import Column, Frame
+        from h2o3_tpu.core.job import Job
+        from h2o3_tpu.models.model_builder import ModelBuilder
+        from h2o3_tpu.models.tree.gbm import GBM
+
+        monkeypatch.setenv("H2O_TPU_AUTO_RECOVER", "1")
+        monkeypatch.setenv("H2O_TPU_ELECTION_GRACE_S", "0.2")
+        monkeypatch.setenv("H2O_TPU_HEARTBEAT_STALE_S", "60")
+        monkeypatch.setenv("H2O_TPU_SUPERVISE_INTERVAL_S", "3600")
+        monkeypatch.setenv("H2O_TPU_JOB_CKPT_ITERS", "2")
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        monkeypatch.setenv("H2O_TPU_OP_ACK_TIMEOUT_S", "15")
+        watchdog.reset()
+
+        rng = np.random.default_rng(17)
+        n = 320
+        fr = Frame()
+        x1, x2 = rng.standard_normal(n), rng.standard_normal(n)
+        fr.add("x1", Column.from_numpy(x1))
+        fr.add("x2", Column.from_numpy(x2))
+        fr.add("y", Column.from_numpy(
+            np.where(x1 - 0.5 * x2 > 0, "Y", "N"), ctype="enum"))
+        score = Frame()
+        score.add("x1", Column.from_numpy(rng.standard_normal(64)))
+        score.add("x2", Column.from_numpy(rng.standard_normal(64)))
+        DKV.put(str(fr.key), fr)
+        DKV.put(str(score.key), score)
+        params = dict(ntrees=8, max_depth=3, seed=11)
+        baseline = GBM(**params).train(y="y", training_frame=fr)
+
+        # -- the doomed coordinator's build: durable progress, then death
+        job = Job(description="GBM Model Build")
+        job.resume_spec = {"algo": "gbm", "params": dict(params),
+                           "training_frame": str(fr.key), "y": "y",
+                           "model_id": "resumed_model",
+                           "description": job.description}
+        doomed = GBM(**params)
+        doomed._progress_job = job
+        orig_tick = ModelBuilder._tick_job_progress
+
+        def tick_boom(self, done, fn):
+            orig_tick(self, done, fn)
+            if done >= 4:
+                raise _Killed()
+
+        monkeypatch.setattr(ModelBuilder, "_tick_job_progress", tick_boom)
+        with pytest.raises(_Killed):
+            doomed.train(y="y", training_frame=fr)
+        monkeypatch.setattr(ModelBuilder, "_tick_job_progress", orig_tick)
+        assert ckpt.load_job_progress(str(job.key))["iteration"] == 4
+        # the Job object lived on the dead coordinator: this standby has
+        # only the durable progress record (+ file) to work from
+        DKV.remove(str(job.key))
+        if doomed.job is not None:
+            DKV.remove(str(doomed.job.key))
+
+        # the coordinator goes silent past the election grace
+        standby_cloud["h2o3/heartbeat/1"] = json.dumps(
+            {"ts": time.time() - 999, "proc": 1})
+        failure.heartbeat()
+
+        # stand in for the rejoined ex-coordinator's replay duty: ack every
+        # broadcast op at its post-restart incarnation
+        stop_acks = threading.Event()
+
+        def acker():
+            while not stop_acks.is_set():
+                for k in list(standby_cloud.keys()):
+                    m = re.fullmatch(r"oplog/(\d+)", k)
+                    if not m:
+                        continue
+                    ak = f"oplog/ack/{m.group(1)}/1"
+                    if ak in standby_cloud:
+                        continue
+                    try:
+                        rec = json.loads(standby_cloud[k])
+                    except (ValueError, TypeError):
+                        continue
+                    standby_cloud[ak] = json.dumps(
+                        {"proc": 1, "ts": time.time(),
+                         "op_id": rec.get("op_id"), "inc": 1})
+                time.sleep(0.005)
+
+        ack_thread = threading.Thread(target=acker, daemon=True)
+        ack_thread.start()
+
+        srv_box = {}
+
+        def elect():
+            srv_box["srv"] = api_server.assume_coordination(port=0)
+
+        wd = watchdog.Watchdog(interval=0.05, elect=elect, follow=False)
+        t0 = time.monotonic()
+        wd.start()
+        try:
+            deadline = time.monotonic() + 15
+            while not D.is_coordinator() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert D.is_coordinator() and D.epoch() == 1
+            assert time.monotonic() - t0 < 10     # election fired promptly
+            assert watchdog.status()["elections"] >= 1
+            assert "srv" in srv_box               # REST re-bound by the wd
+            # the ex-coordinator restarts and rejoins as a follower:
+            # fresh beat + readmission record at incarnation 1
+            standby_cloud["h2o3/heartbeat/1"] = json.dumps(
+                {"ts": time.time(), "proc": 1, "inc": 1})
+            standby_cloud["oplog/rejoin/1"] = json.dumps(
+                {"proc": 1, "inc": 1, "phase": "caught_up", "seq": 0,
+                 "ts": time.time()})
+            base = f"http://127.0.0.1:{srv_box['srv'].port}"
+            jk = urllib.request.quote(str(job.key), safe="")
+            j = None
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                failure.heartbeat()
+                try:
+                    got = _get(base, f"/3/Jobs/{jk}")["jobs"]
+                except urllib.error.HTTPError:
+                    got = []                      # not recreated yet
+                j = got[0] if got else None
+                if j is not None and j["status"] == "DONE":
+                    break
+                time.sleep(0.05)
+            assert j is not None and j["status"] == "DONE", j
+            assert j["attempt"] == 2              # original + one resume
+            assert j["resumed_from_iteration"] == 4
+            st = _get(base, "/3/CloudStatus")
+            assert st["state"] == supervisor.HEALTHY
+            assert st["watchdog"]["jobs_resumed"] >= 1
+            assert st["epoch"] == 1 and st["leader"] == 0
+            # bitwise: score baseline and resumed model through the SAME
+            # REST path and compare the prediction frames
+            for mid, dest in ((str(baseline.key), "pred_base"),
+                              ("resumed_model", "pred_resumed")):
+                _post(base, f"/3/Predictions/models/"
+                      f"{urllib.request.quote(mid, safe='')}/frames/"
+                      f"{urllib.request.quote(str(score.key), safe='')}",
+                      {"predictions_frame": dest})
+            pb, pr = DKV.get("pred_base"), DKV.get("pred_resumed")
+            assert pb is not None and pr is not None
+            assert pb.names == pr.names
+            for c in pb.names:
+                assert np.array_equal(np.asarray(pb.col(c).data),
+                                      np.asarray(pr.col(c).data)), c
+        finally:
+            wd.stop()
+            stop_acks.set()
+            ack_thread.join(timeout=5)
+            srv = srv_box.get("srv")
+            if srv is not None:
+                srv.stop()
+            scoring.purge()
+            for k in ("pred_base", "pred_resumed", "resumed_model",
+                      str(job.key), str(fr.key), str(score.key),
+                      str(baseline.key)):
+                DKV.remove(k)
 
 
 # ---------------------------------------------------------------------------
