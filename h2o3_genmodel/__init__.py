@@ -14,8 +14,15 @@ Usage:
 CLI (hex/genmodel/tools/PredictCsv.java analog):
     python -m h2o3_genmodel.predict_csv --mojo model.zip \
         --input in.csv --output out.csv
+
+AOT artifacts (the serving-tier lineage; needs jax at score time):
+    scorer = gm.load_artifact("model_artifact/")   # AOT executable + HLO
+    tbl = scorer.score(cols)
+    python -m h2o3_genmodel.aot_predict --artifact model_artifact/ \
+        --input in.csv --output out.csv
 """
 
+from h2o3_genmodel.aot import AotScorer, load_artifact
 from h2o3_genmodel.easy import (AnomalyPrediction, BinomialPrediction,
                                 ClusteringPrediction, EasyPredictor,
                                 MultinomialPrediction, RegressionPrediction,
@@ -24,4 +31,5 @@ from h2o3_genmodel.easy import (AnomalyPrediction, BinomialPrediction,
 __version__ = "1.0.0"
 __all__ = ["load_mojo", "EasyPredictor", "BinomialPrediction",
            "MultinomialPrediction", "RegressionPrediction",
-           "ClusteringPrediction", "AnomalyPrediction", "__version__"]
+           "ClusteringPrediction", "AnomalyPrediction",
+           "load_artifact", "AotScorer", "__version__"]

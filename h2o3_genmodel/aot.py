@@ -1,0 +1,345 @@
+"""Standalone AOT-artifact scoring runtime (the genmodel side).
+
+Loads an artifact directory exported by ``h2o3_tpu.artifact`` and scores
+CSV / column input **without importing the training stack**: the only
+dependencies are numpy, the standard library, and jax (to execute the
+shipped program). Mirrors the MOJO runtime's charter (reader.py/easy.py)
+for the AOT lineage.
+
+Scoring path, in fallback order per row bucket:
+
+1. deserialize the shipped AOT executable (``exec_b{N}.bin``) when its
+   backend fingerprint matches this process — zero compilation, the
+   cold-start-optimal path;
+2. compile the shipped StableHLO text (``hlo_b{N}.mlir``) through the
+   local XLA client — one compile of the *identical* program the exporter
+   lowered, so predictions stay bitwise-identical to in-process serving.
+
+Executable blobs pass through a restricted unpickler (bytes + jax
+PyTreeDefs only) and every payload file is sha256-gated by the manifest
+before any of its bytes are interpreted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import pickle
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_FORMAT = "h2o3-tpu-aot-artifact"
+_FORMAT_VERSION = 1
+_BLOB_VERSION = 1
+
+
+class ArtifactError(ValueError):
+    """Malformed / tampered / incompatible artifact."""
+
+
+# ---------------------------------------------------------------------------
+# manifest + payload reading (standalone twin of h2o3_tpu.artifact.manifest;
+# tests/test_consistency.py pins the two formats together)
+# ---------------------------------------------------------------------------
+
+def _read_manifest(art_dir: str) -> Dict[str, Any]:
+    path = os.path.join(art_dir, "manifest.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            m = json.load(f)
+    except (OSError, ValueError) as e:
+        raise ArtifactError(f"no readable manifest in {art_dir!r}: {e}") \
+            from None
+    if not isinstance(m, dict) or m.get("format") != _FORMAT:
+        raise ArtifactError(f"not an {_FORMAT} artifact")
+    ver = m.get("format_version")
+    if not isinstance(ver, int) or not 1 <= ver <= _FORMAT_VERSION:
+        raise ArtifactError(
+            f"artifact format_version {ver!r} unsupported by this runtime "
+            f"(supports 1..{_FORMAT_VERSION})")
+    for key in ("model_category", "names", "files", "buckets", "post",
+                "max_depth", "nclasses", "init_f", "model_checksum"):
+        if key not in m:
+            raise ArtifactError(f"manifest missing required key {key!r}")
+    return m
+
+
+def _read_payload(art_dir: str, entry: Dict[str, Any]) -> bytes:
+    name = str(entry.get("name") or "")
+    if not name or os.path.basename(name) != name or name.startswith("."):
+        raise ArtifactError(f"illegal payload file name {name!r}")
+    try:
+        with open(os.path.join(art_dir, name), "rb") as f:
+            data = f.read()
+    except OSError as e:
+        raise ArtifactError(f"payload {name!r} unreadable: {e}") from None
+    if hashlib.sha256(data).hexdigest() != entry.get("sha256"):
+        raise ArtifactError(f"payload {name!r} checksum mismatch — "
+                            "artifact is corrupt or was tampered with")
+    return data
+
+
+class _ExecBlobUnpickler(pickle.Unpickler):
+    _PREFIXES = ("jax.", "jaxlib.", "numpy.")
+    _MODULES = {"jax", "jaxlib", "numpy"}
+
+    def find_class(self, module, name):
+        if module in self._MODULES or \
+                any(module.startswith(p) for p in self._PREFIXES):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"executable blob references disallowed type {module}.{name}")
+
+
+def _backend_fingerprint() -> str:
+    import jax
+
+    d = jax.devices()[0]
+    return ";".join(["jax=" + jax.__version__,
+                     "platform=" + str(d.platform),
+                     "kind=" + str(getattr(d, "device_kind", "?")),
+                     "devices=1"])
+
+
+# ---------------------------------------------------------------------------
+# the scorer
+# ---------------------------------------------------------------------------
+
+class AotScorer:
+    """One loaded artifact: packed constants on device + one executable
+    per row bucket, resolved lazily (deserialize -> StableHLO compile)."""
+
+    def __init__(self, art_dir: str):
+        self.dir = str(art_dir)
+        m = _read_manifest(self.dir)
+        self.manifest = m
+        self.names: List[str] = list(m["names"])
+        self.category: str = str(m["model_category"])
+        self.response_domain: List[str] = list(m.get("response_domain")
+                                               or [])
+        self.default_threshold = float(m.get("default_threshold", 0.5))
+        self.post: Dict[str, Any] = dict(m["post"])
+        self.buckets: List[int] = sorted(int(b) for b in m["buckets"])
+        self.nclasses = int(m["nclasses"])
+        self.per_class = bool(m.get("per_class_trees"))
+
+        with np.load(io.BytesIO(_read_payload(self.dir,
+                                              m["files"]["forest"])),
+                     allow_pickle=False) as z:
+            arrays = {k: np.asarray(z[k]) for k in z.files}
+        self._arrays = arrays
+        F = len(self.names)
+        if int(arrays["spec_is_cat"].shape[0]) != F:
+            raise ArtifactError("packed spec width disagrees with manifest "
+                                "names")
+        self.is_cat = arrays["spec_is_cat"].astype(bool)
+        self.domains: Dict[str, List[str]] = {
+            k: list(v) for k, v in (m.get("domains") or {}).items()}
+        # device-side constants are materialized on first use (load() stays
+        # import-cheap for cold-start measurement)
+        self._dev: Optional[tuple] = None
+        self._exec: Dict[int, Any] = {}
+        self.loaded_from: Dict[int, str] = {}     # bucket -> "exec"|"hlo"
+
+    # -- device constants -------------------------------------------------
+    def _device_args(self) -> tuple:
+        if self._dev is not None:
+            return self._dev
+        import jax.numpy as jnp
+
+        a = self._arrays
+        F = len(self.names)
+        lens = [int(v) for v in a["spec_edges_len"].reshape(-1)]
+        emax = max(lens, default=0) or 1
+        ep = np.full((F, emax), np.inf, np.float32)
+        flat, pos = a["spec_edges_flat"], 0
+        for i, ln in enumerate(lens):
+            ep[i, :ln] = np.asarray(flat[pos: pos + ln], np.float32)
+            pos += ln
+        init = (np.asarray(a["init_class"], np.float32)
+                if "init_class" in a
+                else np.float32(self.manifest["init_f"]))
+        self._dev = (jnp.asarray(ep), jnp.asarray(self.is_cat),
+                     jnp.asarray(init),
+                     jnp.asarray(a["feat"]), jnp.asarray(a["thresh_bin"]),
+                     jnp.asarray(a["na_left"].astype(bool)),
+                     jnp.asarray(a["left"]), jnp.asarray(a["right"]),
+                     jnp.asarray(a["leaf_val"].astype(np.float32)),
+                     jnp.asarray(a["cat_split"]),
+                     jnp.asarray(a["cat_table"].astype(bool)),
+                     jnp.asarray(a["tree_class"]),
+                     jnp.asarray(a["na_bins"]))
+        return self._dev
+
+    # -- executables ------------------------------------------------------
+    def _executable(self, bucket: int):
+        exe = self._exec.get(bucket)
+        if exe is not None:
+            return exe
+        m = self.manifest
+        fp = _backend_fingerprint()
+        for e in m.get("executables", []):
+            if int(e.get("bucket", -1)) != bucket or e.get("backend") != fp:
+                continue
+            blob = _read_payload(self.dir, e)
+            try:
+                d = _ExecBlobUnpickler(io.BytesIO(blob)).load()
+                if not isinstance(d, dict) or d.get("v") != _BLOB_VERSION:
+                    raise ArtifactError("unsupported executable blob "
+                                        "version")
+                from jax.experimental import serialize_executable as se
+
+                loaded = se.deserialize_and_load(d["payload"], d["in_tree"],
+                                                 d["out_tree"])
+            except pickle.UnpicklingError:
+                raise            # tampered blob: refuse, never fall back
+            except Exception:    # noqa: BLE001 — backend can't load: HLO
+                break
+            self._exec[bucket] = ("loaded", loaded)
+            self.loaded_from[bucket] = "exec"
+            return self._exec[bucket]
+        for e in m.get("stablehlo", []):
+            if int(e.get("bucket", -1)) != bucket:
+                continue
+            kept = e.get("kept_args")
+            if kept is None:
+                raise ArtifactError(
+                    f"bucket {bucket}: no loadable executable for this "
+                    "backend and the StableHLO entry carries no argument "
+                    "mapping — re-export the artifact on a current "
+                    "framework build")
+            import jax
+
+            text = _read_payload(self.dir, e).decode("utf-8")
+            raw = jax.devices()[0].client.compile(text)
+            self._exec[bucket] = ("raw", raw, [int(i) for i in kept])
+            self.loaded_from[bucket] = "hlo"
+            return self._exec[bucket]
+        raise ArtifactError(f"artifact has no program for bucket {bucket}")
+
+    def _run(self, bucket: int, X_pad: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        got = self._executable(bucket)
+        args = (jnp.asarray(X_pad),) + self._device_args()
+        if got[0] == "loaded":
+            return np.asarray(got[1](*args))
+        _kind, exe, kept = got
+        # jit pruned unused Python-level args from the XLA signature; the
+        # raw-client execute path must bind only the kept ones, in order
+        outs = exe.execute([args[i] for i in kept])
+        return np.asarray(outs[0])
+
+    # -- feature packing --------------------------------------------------
+    def pack_features(self, cols: Dict[str, Any]) -> np.ndarray:
+        """(n, F) float32 matrix in training-column order: numerics as
+        floats (unparseable/missing -> NaN), categoricals as training-
+        domain codes (unseen/missing -> -1, which bins to the NA bin) —
+        the same convention ScoringSession._features feeds the program."""
+        n = 0
+        for v in cols.values():
+            n = max(n, len(np.asarray(v, dtype=object).reshape(-1)))
+        X = np.empty((n, len(self.names)), np.float32)
+        for i, name in enumerate(self.names):
+            dom = self.domains.get(name)
+            raw = cols.get(name)
+            if raw is None:
+                X[:, i] = -1.0 if dom is not None else np.nan
+                continue
+            vals = np.asarray(raw, dtype=object).reshape(-1)
+            if dom is not None:
+                lut = {str(lvl): k for k, lvl in enumerate(dom)}
+                X[:, i] = [lut.get(str(v).strip(), -1)
+                           if v is not None and str(v).strip() != ""
+                           else -1 for v in vals]
+            else:
+                def as_float(v):
+                    try:
+                        return float(v)
+                    except (TypeError, ValueError):
+                        return np.nan
+                X[:, i] = [as_float(v) for v in vals]
+        return X
+
+    # -- scoring ----------------------------------------------------------
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def margins(self, X: np.ndarray) -> np.ndarray:
+        """(n,) or (n, K) float32 margins — bitwise-identical to the
+        server's fused bucketed program (it IS the server's program)."""
+        X = np.asarray(X, np.float32)
+        n = X.shape[0]
+        maxb = self.buckets[-1]
+        outs: List[np.ndarray] = []
+        pos = 0
+        while pos < n:
+            chunk = X[pos: pos + maxb]
+            m = chunk.shape[0]
+            bucket = self._bucket_for(m)
+            buf = np.zeros((bucket, X.shape[1]), np.float32)
+            buf[:m] = chunk
+            outs.append(self._run(bucket, buf)[:m])
+            pos += m
+        if not outs:
+            K = (self.nclasses
+                 if (self.nclasses > 2 or self.per_class) else 1)
+            return np.zeros((0,) if K == 1 else (0, K), np.float32)
+        return outs[0] if len(outs) == 1 else np.concatenate(outs)
+
+    def raw_predict(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        """Margins + post-processing with the identical jnp ops the server
+        runs in SharedTreeModel._margin_to_raw."""
+        return self.raw_from_margins(self.margins(X))
+
+    def raw_from_margins(self, margins: np.ndarray
+                         ) -> Dict[str, np.ndarray]:
+        import jax.numpy as jnp
+
+        f = jnp.asarray(margins)
+        kind = self.post.get("kind")
+        if kind == "binomial":
+            p = 1.0 / (1.0 + jnp.exp(-f))
+            return {"probs": np.asarray(jnp.stack([1 - p, p], axis=-1))}
+        if kind == "multinomial":
+            import jax
+
+            return {"probs": np.asarray(jax.nn.softmax(f, axis=-1))}
+        if self.post.get("linkinv") == "exp":
+            return {"value": np.asarray(jnp.exp(f))}
+        return {"value": np.asarray(f)}
+
+    def score(self, cols: Dict[str, Any],
+              raw: Dict[str, np.ndarray] = None) -> Dict[str, np.ndarray]:
+        """Batch scoring: raw columns -> the server predict-frame shape
+        (predict + per-class probability columns). Pass `raw` to label a
+        result already computed via raw_predict/raw_from_margins instead
+        of scoring the columns again."""
+        if raw is None:
+            raw = self.raw_predict(self.pack_features(cols))
+        out: Dict[str, np.ndarray] = {}
+        if "probs" in raw:
+            probs = np.asarray(raw["probs"])
+            dom = self.response_domain or [str(i)
+                                           for i in range(probs.shape[1])]
+            if self.category == "Binomial":
+                label = (probs[:, 1] >= self.default_threshold).astype(int)
+            else:
+                label = probs.argmax(axis=-1)
+            out["predict"] = np.asarray([dom[i] for i in label], object)
+            for k, lvl in enumerate(dom):
+                out[str(lvl)] = probs[:, k]
+        else:
+            out["predict"] = np.asarray(raw["value"])
+        return out
+
+
+def load_artifact(art_dir: str) -> AotScorer:
+    """Load an AOT artifact directory into a standalone scorer."""
+    return AotScorer(art_dir)

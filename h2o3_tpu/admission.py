@@ -1,0 +1,174 @@
+"""Admission control for the serving tier: queue, don't collapse.
+
+Reference framing: the Gemma-on-TPU serving comparison (PAPERS.md) scores
+serving stacks on sustained QPS under overload — the failure mode that
+matters is collapse (every request slow, none finishing), and the fix is
+classic admission control in front of the expensive path.
+
+Per model key, at most ``H2O_TPU_SCORE_MAX_INFLIGHT`` requests run the
+fused predict path concurrently; the next ``H2O_TPU_SCORE_QUEUE_CAP``
+wait in a bounded FIFO (so a burst drains in order instead of thundering);
+anything beyond that is rejected IMMEDIATELY with
+:class:`AdmissionRejected` (HTTP 429 + Retry-After at the REST layer). A
+queued request that cannot start within ``H2O_TPU_SCORE_QUEUE_TIMEOUT_S``
+is failed with 503 + Retry-After rather than holding its socket forever.
+
+``H2O_TPU_SCORE_MAX_INFLIGHT=0`` (the default) disables the gate — the
+library-mode and single-tenant behavior is unchanged unless an operator
+opts the serving tier in.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from contextlib import contextmanager
+from typing import Dict
+
+from h2o3_tpu.parallel import retry
+
+
+def max_inflight() -> int:
+    """Per-model concurrent fused-path requests (env
+    ``H2O_TPU_SCORE_MAX_INFLIGHT``; 0 = unlimited, admission off)."""
+    return max(retry.env_int("H2O_TPU_SCORE_MAX_INFLIGHT", 0), 0)
+
+
+def queue_cap() -> int:
+    """Bounded queue depth per model once the inflight limit is reached
+    (env ``H2O_TPU_SCORE_QUEUE_CAP``, default 64)."""
+    return max(retry.env_int("H2O_TPU_SCORE_QUEUE_CAP", 64), 0)
+
+
+def queue_timeout_s() -> float:
+    """Max seconds a queued request waits for a slot before failing with
+    503 (env ``H2O_TPU_SCORE_QUEUE_TIMEOUT_S``, default 30)."""
+    import os
+
+    try:
+        return max(float(os.environ.get("H2O_TPU_SCORE_QUEUE_TIMEOUT_S",
+                                        "30")), 0.1)
+    except ValueError:
+        return 30.0
+
+
+class AdmissionRejected(Exception):
+    """Request refused/expired by admission control; carries the HTTP
+    status (429 overflow / 503 queue timeout) and a Retry-After hint."""
+
+    def __init__(self, msg: str, status: int = 429,
+                 retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.status = int(status)
+        self.retry_after_s = max(float(retry_after_s), 0.1)
+
+
+class _ModelGate:
+    __slots__ = ("cond", "inflight", "queue")
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.inflight = 0
+        self.queue: collections.deque = collections.deque()   # ticket FIFO
+
+
+class AdmissionController:
+    """Per-model-key gates plus aggregate counters for /3/ScoringMetrics."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._gates: Dict[str, _ModelGate] = {}
+        self.admitted = 0
+        self.queued = 0
+        self.rejected = 0
+        self.timed_out = 0
+
+    def _gate(self, key: str) -> _ModelGate:
+        with self._lock:
+            g = self._gates.get(key)
+            if g is None:
+                g = self._gates[key] = _ModelGate()
+            return g
+
+    def _retry_after(self, g: _ModelGate, limit: int) -> float:
+        """Retry-After heuristic: one batch window per queued request ahead,
+        floored at 1s — cheap, monotone in backlog, never a promise."""
+        from h2o3_tpu.scoring import _window_s
+
+        backlog = len(g.queue) + max(g.inflight - limit + 1, 1)
+        return max(1.0, backlog * max(_window_s(), 0.002))
+
+    @contextmanager
+    def slot(self, model_key: str):
+        limit = max_inflight()
+        if limit <= 0:
+            yield                      # admission disabled: zero overhead
+            return
+        g = self._gate(str(model_key))
+        ticket = object()
+        with g.cond:
+            if g.inflight >= limit:
+                if len(g.queue) >= queue_cap():
+                    with self._lock:
+                        self.rejected += 1
+                    raise AdmissionRejected(
+                        f"model {model_key!r}: {g.inflight} requests in "
+                        f"flight and {len(g.queue)} queued (caps "
+                        f"{limit}/{queue_cap()}) — retry later",
+                        status=429,
+                        retry_after_s=self._retry_after(g, limit))
+                g.queue.append(ticket)
+                with self._lock:
+                    self.queued += 1
+                deadline = queue_timeout_s()
+                import time as _t
+
+                t0 = _t.monotonic()
+                # FIFO: only the queue head may take a freed slot
+                while not (g.inflight < limit and g.queue
+                           and g.queue[0] is ticket):
+                    left = deadline - (_t.monotonic() - t0)
+                    if left <= 0:
+                        g.queue.remove(ticket)
+                        g.cond.notify_all()
+                        with self._lock:
+                            self.timed_out += 1
+                        raise AdmissionRejected(
+                            f"model {model_key!r}: queued request expired "
+                            f"after {deadline:.0f}s without a free slot",
+                            status=503,
+                            retry_after_s=self._retry_after(g, limit))
+                    g.cond.wait(timeout=left)
+                g.queue.popleft()
+            g.inflight += 1
+            with self._lock:
+                self.admitted += 1
+        try:
+            yield
+        finally:
+            with g.cond:
+                g.inflight -= 1
+                g.cond.notify_all()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {"admitted": self.admitted, "queued": self.queued,
+                   "rejected": self.rejected, "timed_out": self.timed_out,
+                   "max_inflight": max_inflight(),
+                   "queue_cap": queue_cap()}
+            gates = list(self._gates.items())
+        out["models"] = {k: {"inflight": g.inflight,
+                             "queue_depth": len(g.queue)}
+                         for k, g in gates
+                         if g.inflight or g.queue}
+        return out
+
+    def reset(self) -> None:
+        """Drop counters + idle gates (tests)."""
+        with self._lock:
+            self.admitted = self.queued = self.rejected = self.timed_out = 0
+            self._gates = {k: g for k, g in self._gates.items()
+                           if g.inflight or g.queue}
+
+
+CONTROLLER = AdmissionController()

@@ -760,13 +760,17 @@ def h_cloud_status(ctx: Ctx):
 def h_scoring_metrics(ctx: Ctx):
     """GET /3/ScoringMetrics — per-model serving fast-path statistics
     (scoring.py ScoringSession): request/batch/row counts, micro-batch
-    coalescing, latency percentiles, traversal compile counts and the
-    active row buckets. The per-dispatch events are also in /3/Timeline
-    under kind='scoring'."""
-    from h2o3_tpu import scoring
+    coalescing, latency percentiles, traversal/fused compile counts and
+    the active row buckets; plus the admission-control counters and the
+    persistent compile-cache stats. The per-dispatch events are also in
+    /3/Timeline under kind='scoring'."""
+    from h2o3_tpu import admission, scoring
+    from h2o3_tpu.artifact import compile_cache
 
     return {"__meta": S.meta("ScoringMetricsV3"),
-            "models": scoring.metrics_snapshot()}
+            "models": scoring.metrics_snapshot(),
+            "admission": admission.CONTROLLER.snapshot(),
+            "compile_cache": compile_cache.stats()}
 
 
 def h_watermeter_cpu(ctx: Ctx):

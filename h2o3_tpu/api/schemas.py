@@ -43,6 +43,16 @@ def key_ref(name: Optional[str], ktype: str = "Key<Frame>") -> Optional[dict]:
             "URL": f"/3/{'Frames' if 'Frame' in ktype else 'Models'}/{name}"}
 
 
+def artifact_v3(info: dict, **extra) -> dict:
+    """AOT-artifact DTO (the /3/Artifacts family): a validated manifest
+    summary — never raw manifest internals — plus route-specific fields
+    (dir, model_id)."""
+    out = {"__meta": meta("ArtifactV3")}
+    out.update(info)
+    out.update(extra)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # TwoDimTable
 # ---------------------------------------------------------------------------

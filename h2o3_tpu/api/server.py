@@ -36,6 +36,7 @@ from urllib.parse import parse_qs, unquote, urlparse
 
 import numpy as np
 
+from h2o3_tpu.admission import AdmissionRejected
 from h2o3_tpu.api import schemas as S
 from h2o3_tpu.core.dkv import DKV, Key
 from h2o3_tpu.core.failure import CloudUnhealthyError
@@ -1437,6 +1438,85 @@ def h_te_transform(ctx: Ctx):
             "name": str(out.key)}
 
 
+# -- AOT scoring artifacts (the MOJO2-for-TPU deployment surface) -----------
+
+def _artifact_summary(info: Dict[str, Any]) -> Dict[str, Any]:
+    return S.artifact_v3(info)
+
+
+def h_artifact_export(ctx: Ctx):
+    """POST /3/Artifacts/models/{model_id} — export a trained forest model
+    as a standalone AOT scoring artifact directory (manifest + packed
+    constants + per-bucket serialized executables + StableHLO fallback).
+    Coordinator-local: lowering runs no collectives, so no oplog op."""
+    from h2o3_tpu import artifact
+
+    m = _model_or_404(ctx.params["model_id"])
+    out_dir = str(ctx.arg("dir", "") or "").strip('"')
+    if not out_dir:
+        raise ApiError("dir required (server-side artifact directory)", 400)
+    raw_buckets = _parse_list(ctx.arg("buckets")) or None
+    try:
+        buckets = [int(b) for b in raw_buckets] if raw_buckets else None
+    except (TypeError, ValueError):
+        raise ApiError(f"buckets must be integers, got {raw_buckets!r}",
+                       400) from None
+    try:
+        artifact.export_model(m, out_dir, buckets=buckets)
+        info = artifact.describe(out_dir)
+    except artifact.ArtifactError as e:
+        raise ApiError(str(e), 400) from None
+    return _artifact_summary(info | {"dir": out_dir,
+                                     "model_id": str(m.key)})
+
+
+def h_artifact_import(ctx: Ctx):
+    """POST /3/Artifacts/import — load an artifact directory into a
+    servable model under `model_id` (defaults to the exported key). On a
+    multi-process cloud the load is mirrored as one oplog op so every
+    process installs the model under the SAME key (the dir rides the
+    shared-filesystem contract like parse sources)."""
+    from h2o3_tpu import artifact
+    from h2o3_tpu.parallel import oplog
+
+    art_dir = str(ctx.arg("dir", "") or "").strip('"')
+    if not art_dir:
+        raise ApiError("dir required (artifact directory to load)", 400)
+    model_id = str(ctx.arg("model_id", "") or "").strip('"') or None
+    try:
+        # FULL load-and-validate (manifest, checksums, packed forest,
+        # algo) BEFORE the broadcast, without installing: a post-broadcast
+        # raise would kill every follower's replay loop, so anything a
+        # replayed load could reject must be rejected as a 400 right here
+        artifact.load_model(art_dir, model_id, install=False)
+    except artifact.ArtifactError as e:
+        raise ApiError(str(e), 400) from None
+    op_seq = oplog.broadcast("artifact_import", {"dir": art_dir,
+                                                 "model_id": model_id})
+    with oplog.turn(op_seq):
+        try:
+            model = artifact.load_model(art_dir, model_id)
+        except artifact.ArtifactError as e:
+            raise ApiError(str(e), 400) from None
+    return _artifact_summary({"dir": art_dir, "model_id": str(model.key),
+                              "algo": model.algo_name})
+
+
+def h_artifact_info(ctx: Ctx):
+    """GET /3/Artifacts?dir=... — validated manifest summary of an
+    artifact directory (no payload loads)."""
+    from h2o3_tpu import artifact
+
+    art_dir = str(ctx.arg("dir", "") or "").strip('"')
+    if not art_dir:
+        raise ApiError("dir required", 400)
+    try:
+        info = artifact.describe(art_dir)
+    except artifact.ArtifactError as e:
+        raise ApiError(str(e), 400) from None
+    return _artifact_summary(info | {"dir": art_dir})
+
+
 # -- metadata (schema introspection, water/api/SchemaServer.java:20) --------
 
 def h_metadata_endpoints(ctx: Ctx):
@@ -1463,7 +1543,7 @@ _SCHEMA_REGISTRY = [
     "ModelMetricsBinomialV3", "ModelMetricsMultinomialV3",
     "ModelMetricsRegressionV3", "ModelMetricsClusteringV3",
     "TwoDimTableV3", "KeyV3", "H2OErrorV3", "H2OModelBuilderErrorV3",
-    "TimelineV3", "LogsV3", "AboutV3",
+    "TimelineV3", "LogsV3", "AboutV3", "ArtifactV3",
 ]
 
 
@@ -1531,6 +1611,12 @@ ROUTES: List[Tuple[str, str, Callable, str]] = [
     ("GET", "/3/Models/{model_id}", h_model_get, "Model details"),
     ("DELETE", "/3/Models/{model_id}", h_model_delete, "Delete a model"),
     ("GET", "/3/Models/{model_id}/mojo", h_model_mojo, "Export MOJO artifact"),
+    ("POST", "/3/Artifacts/models/{model_id}", h_artifact_export,
+     "Export a standalone AOT scoring artifact"),
+    ("POST", "/3/Artifacts/import", h_artifact_import,
+     "Import an AOT artifact as a servable model"),
+    ("GET", "/3/Artifacts", h_artifact_info,
+     "Inspect an AOT artifact directory"),
     ("POST", "/3/Predictions/models/{model_id}/frames/{frame_id}", h_predict_v3,
      "Score a frame (sync)"),
     ("POST", "/4/Predictions/models/{model_id}/frames/{frame_id}", h_predict_v4,
@@ -1681,7 +1767,8 @@ class _Handler(BaseHTTPRequestHandler):
         if self.command != "HEAD":
             self.wfile.write(body)
 
-    def _reply_json(self, obj: Any, code: int = 200):
+    def _reply_json(self, obj: Any, code: int = 200,
+                    headers: Optional[Dict[str, str]] = None):
         body = json.dumps(obj, default=_json_default).encode()
         # bare (UNQUOTED) NaN/Infinity tokens are NOT valid JSON: strict
         # parsers (simplejson>=3.19 as vendored by `requests` — i.e.
@@ -1691,11 +1778,13 @@ class _Handler(BaseHTTPRequestHandler):
         if _BARE_NONFINITE.search(body):
             body = json.dumps(_definite(obj), default=_json_default,
                               allow_nan=False).encode()
-        self._send(code, body, "application/json")
+        self._send(code, body, "application/json", headers)
 
     def _reply_error(self, msg: str, code: int, schema: str = "H2OErrorV3",
-                     stack: Optional[List[str]] = None):
-        self._reply_json(S.error_v3(msg, code, stacktrace=stack, schema=schema), code)
+                     stack: Optional[List[str]] = None,
+                     headers: Optional[Dict[str, str]] = None):
+        self._reply_json(S.error_v3(msg, code, stacktrace=stack,
+                                    schema=schema), code, headers)
 
     # -- auth (reference: hash-file basic auth, water.webserver
     #    BasicAuth/-hash_login; enabled via H2O_TPU_AUTH_FILE) -------------
@@ -1757,6 +1846,15 @@ class _Handler(BaseHTTPRequestHandler):
         except ApiError as e:
             status = e.status
             return self._reply_error(str(e), e.status, e.schema)
+        except AdmissionRejected as e:
+            # serving-tier overload: refuse fast with the standard backoff
+            # hint instead of letting the request pile onto a saturated
+            # model (429 queue overflow / 503 queued-request timeout)
+            status = e.status
+            return self._reply_error(
+                str(e), e.status,
+                headers={"Retry-After":
+                         str(int(math.ceil(e.retry_after_s)))})
         except (CloudUnhealthyError, OplogPublishError,
                 OplogTurnTimeout) as e:
             # supervised degraded-mode fail-fast: the cloud cannot complete

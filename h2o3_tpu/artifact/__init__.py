@@ -1,0 +1,30 @@
+"""h2o3_tpu.artifact — standalone AOT scoring artifacts (MOJO2-for-TPU).
+
+H2O-3's killer deployment feature is the dependency-free MOJO/POJO scoring
+artifact (PAPER.md §2.9). This subsystem is its TPU-native equivalent:
+
+- :mod:`export`        — trained forest model -> self-contained artifact
+  directory: versioned manifest, packed constants (``forest.npz``), and an
+  AOT-compiled fused scoring executable per row bucket (plus StableHLO
+  text as the portable fallback).
+- :mod:`loader`        — artifact dir -> servable in-cluster model
+  (the REST import route), checksum-gated end to end.
+- :mod:`compile_cache` — persistent fused-program compile cache keyed by
+  (model checksum, bucket, backend fingerprint) under
+  ``$H2O_TPU_COMPILE_CACHE_DIR``: a warm server restart compiles zero
+  fused programs.
+- :mod:`manifest` / :mod:`packer` / :mod:`aot` — the shared codecs.
+
+The matching *standalone* runtime lives in :mod:`h2o3_genmodel.aot`: it
+loads an artifact with numpy + jax alone (no h2o3_tpu import, restricted
+unpickler for executable blobs) and scores CSV/ndarray input
+bitwise-identically to in-process serving.
+"""
+
+from h2o3_tpu.artifact.export import export_model, supports_export
+from h2o3_tpu.artifact.loader import describe, load_model
+from h2o3_tpu.artifact.manifest import (FORMAT, FORMAT_VERSION,
+                                        ArtifactError)
+
+__all__ = ["export_model", "supports_export", "load_model", "describe",
+           "ArtifactError", "FORMAT", "FORMAT_VERSION"]

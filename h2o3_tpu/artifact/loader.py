@@ -1,0 +1,136 @@
+"""Server-side artifact import: artifact dir -> servable forest model.
+
+The inverse of export.py for the serving tier: a cloud that receives an
+artifact (shared filesystem / object store via persist/) re-hydrates the
+full SharedTreeModel — forest, BinSpec, distribution, labeling threshold —
+and installs it under a DKV key, after which it serves through the SAME
+fused bucketed fast path as a locally-trained model (and its executables
+land in the warm compile cache on first dispatch).
+
+Every byte read here is checksum-gated by the manifest
+(manifest.read_payload); the npz payload is loaded with
+``allow_pickle=False``. Nothing in an artifact can reach a pickle VM on
+this path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from h2o3_tpu.artifact import manifest, packer
+from h2o3_tpu.artifact.export import FOREST_FILE
+from h2o3_tpu.artifact.manifest import ArtifactError
+
+
+def load_model(art_dir: str, model_id: Optional[str] = None,
+               install: bool = True):
+    """Load the artifact at `art_dir` into a Model (installed under
+    `model_id` or the exported key; `install=False` builds + fully
+    validates without touching the DKV — the REST import route uses it as
+    its pre-broadcast check so a payload-corrupt artifact can never kill
+    follower replay loops). Raises ArtifactError on any corruption,
+    version mismatch, or unsupported algo; nothing is registered in the
+    DKV until validation has completed."""
+    from h2o3_tpu import persist
+    from h2o3_tpu.core.dkv import DKV, Key
+    from h2o3_tpu.models.distribution import get_distribution
+    from h2o3_tpu.models.model import Model, ModelCategory
+    from h2o3_tpu.models.mojo import _model_class, _threshold_metrics
+    from h2o3_tpu.models.tree.binning import BinSpec
+    from h2o3_tpu.models.tree.compressed import CompressedForest
+    from h2o3_tpu.models.tree.shared_tree import SharedTreeModel
+
+    art_dir = persist.resolve(art_dir)
+    m = manifest.read_manifest(art_dir)
+    arrays = packer.load_npz(
+        manifest.read_payload(art_dir, m["files"]["forest"]))
+    try:
+        cls = _model_class(str(m["algo"]))
+    except Exception as e:   # noqa: BLE001 — unknown algo is a user error
+        raise ArtifactError(f"artifact algo {m['algo']!r} is not loadable "
+                            f"here: {e}") from None
+    if not issubclass(cls, SharedTreeModel):
+        raise ArtifactError(
+            f"artifact algo {m['algo']!r} is not a forest model")
+
+    model = cls.__new__(cls)
+    Model.__init__(model, parms={})
+    # Model.__init__ auto-installs under a fresh key: withdraw it NOW so a
+    # validation failure below cannot leak a half-constructed model into
+    # /3/Models (it is re-installed under the final key once valid)
+    DKV.remove(str(model.key))
+    model._distribution = None
+
+    lens = arrays["spec_edges_len"]
+    flat = arrays["spec_edges_flat"]
+    edges, pos = [], 0
+    for ln in lens:
+        edges.append(np.asarray(flat[pos: pos + int(ln)], np.float32))
+        pos += int(ln)
+    spec_names = list(m["names"])
+    if len(spec_names) != int(arrays["spec_is_cat"].shape[0]):
+        raise ArtifactError("manifest names disagree with packed spec width")
+    model.spec = BinSpec(spec_names, arrays["spec_is_cat"].astype(bool),
+                         arrays["spec_nbins"], edges, arrays["spec_cards"])
+    forest = CompressedForest(
+        arrays["feat"], arrays["thresh_bin"], arrays["na_left"].astype(bool),
+        arrays["left"], arrays["right"],
+        arrays["leaf_val"].astype(np.float32), arrays["cat_split"],
+        arrays["cat_table"].astype(bool), arrays["tree_class"],
+        arrays["na_bins"], max_depth=int(m["max_depth"]),
+        init_f=float(m["init_f"]), nclasses=int(m["nclasses"]))
+    if "init_class" in arrays:
+        forest.init_class = np.asarray(arrays["init_class"], np.float32)
+    model.forest = forest
+    if packer.model_checksum(forest, spec=model.spec) != m["model_checksum"]:
+        raise ArtifactError("model checksum mismatch — the packed forest "
+                            "does not match the manifest")
+
+    dist = (m.get("distribution") or {}).get("name")
+    if dist:
+        model._distribution = get_distribution(
+            dist, tweedie_power=float(
+                (m.get("distribution") or {}).get("tweedie_power") or 1.5))
+
+    o = model._output
+    o.names = spec_names
+    o.domains = {k: list(v) for k, v in (m.get("domains") or {}).items()}
+    o.response_name = m.get("response_name")
+    o.response_domain = list(m.get("response_domain") or []) or None
+    o.model_category = str(m["model_category"])
+    if o.model_category == ModelCategory.Binomial:
+        o.training_metrics = _threshold_metrics(
+            float(m["default_threshold"]))
+
+    dest = str(model_id or m.get("model_key")
+               or f"artifact_model_{m['model_checksum'][:12]}")
+    model._key = Key(dest)
+    if install:
+        model.install()
+        from h2o3_tpu.utils import timeline
+
+        timeline.record("artifact", "import", model=dest, dir=art_dir,
+                        n_trees=int(m.get("n_trees", forest.n_trees)))
+    return model
+
+
+def describe(art_dir: str) -> Dict[str, Any]:
+    """Validated manifest summary (REST GET surface) — no payload loads
+    beyond the manifest itself."""
+    from h2o3_tpu import persist
+
+    m = manifest.read_manifest(persist.resolve(art_dir))
+    return {k: m.get(k) for k in (
+        "format", "format_version", "algo", "model_key", "model_category",
+        "model_checksum", "nclasses", "n_trees", "max_depth", "buckets",
+        "default_threshold", "created_ts")} | {
+        "executables": [{"bucket": e.get("bucket"),
+                         "backend": e.get("backend"),
+                         "bytes": e.get("bytes")}
+                        for e in m.get("executables", [])],
+        "stablehlo_buckets": [e.get("bucket")
+                              for e in m.get("stablehlo", [])],
+        "n_features": len(m.get("names") or []),
+    }

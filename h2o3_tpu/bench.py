@@ -191,6 +191,108 @@ def run_recover():
     return dt, "recover_secs_to_healthy"
 
 
+def run_artifact(train_rows: int = 20_000, ntrees: int = 10,
+                 batch_rows: int = 256, sustain_s: float = 3.0):
+    """Serving-tier artifact metrics (ROADMAP item 3 'Done' criterion):
+
+    - ``artifact_cold_start_secs`` — wallclock from python start to the
+      first prediction out of the standalone runner in a FRESH process
+      (import + manifest + executable load + one batch). Printed as an
+      auxiliary H2O3_BENCH line; falls back to an in-process runner load
+      when the child cannot take the accelerator (single-client TPU).
+    - ``artifact_qps`` — sustained request rate through the standalone
+      runner at `batch_rows` rows/request (returned as the stage metric).
+    """
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    import h2o3_tpu
+    from h2o3_tpu import artifact
+    from h2o3_tpu.core.frame import Column, Frame
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    h2o3_tpu.init()
+    rng = np.random.default_rng(5)
+
+    def make(n, with_y):
+        fr = Frame()
+        logit = np.zeros(n)
+        for i in range(6):
+            x = rng.standard_normal(n)
+            logit += x * ((-1) ** i) * 0.5
+            fr.add(f"n{i}", Column.from_numpy(x))
+        codes = rng.integers(0, 4, n)
+        fr.add("c0", Column.from_numpy(
+            np.array(["a", "b", "c", "d"])[codes], ctype="enum"))
+        if with_y:
+            yy = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "Y", "N")
+            fr.add("y", Column.from_numpy(yy, ctype="enum"))
+        return fr
+
+    model = GBM(ntrees=ntrees, max_depth=5, seed=6).train(
+        y="y", training_frame=make(train_rows, True))
+    art_dir = tempfile.mkdtemp(prefix="h2o3_bench_artifact_")
+    artifact.export_model(model, art_dir, buckets=[batch_rows])
+
+    # one CSV batch for the runner
+    csv_path = os.path.join(art_dir, "bench_batch.csv")
+    fr = make(batch_rows, False)
+    cols = [(nm, np.asarray(fr.col(nm).data)[:batch_rows]
+             if not fr.col(nm).is_categorical else
+             np.asarray(fr.col(nm).domain, object)[
+                 np.asarray(fr.col(nm).data)[:batch_rows]])
+            for nm in fr.names]
+    with open(csv_path, "w") as f:
+        f.write(",".join(nm for nm, _ in cols) + "\n")
+        for i in range(batch_rows):
+            f.write(",".join(str(c[i]) for _, c in cols) + "\n")
+
+    child = (
+        "import time; t0=time.perf_counter()\n"
+        "from h2o3_genmodel.aot import load_artifact\n"
+        "from h2o3_genmodel.predict_csv import read_csv_columns\n"
+        f"s = load_artifact({art_dir!r})\n"
+        f"out = s.score(read_csv_columns({csv_path!r}))\n"
+        "print('COLD', time.perf_counter() - t0, flush=True)\n")
+    cold = None
+    try:
+        proc = subprocess.run([sys.executable, "-c", child], timeout=240,
+                              capture_output=True, text=True)
+        for ln in proc.stdout.splitlines():
+            if ln.startswith("COLD "):
+                cold = float(ln.split()[1])
+    except (subprocess.TimeoutExpired, OSError):
+        pass
+    if cold is None:
+        # child could not run (e.g. single-client accelerator held by this
+        # process): time a fresh in-process runner load instead
+        from h2o3_genmodel.aot import load_artifact
+        from h2o3_genmodel.predict_csv import read_csv_columns
+
+        t0 = time.perf_counter()
+        s = load_artifact(art_dir)
+        s.score(read_csv_columns(csv_path))
+        cold = time.perf_counter() - t0
+    print(f"H2O3_BENCH artifact_cold_start_secs {cold}", flush=True)
+
+    from h2o3_genmodel.aot import load_artifact
+    from h2o3_genmodel.predict_csv import read_csv_columns
+
+    s = load_artifact(art_dir)
+    cols_d = read_csv_columns(csv_path)
+    X = s.pack_features(cols_d)
+    s.raw_predict(X)                      # warm (matches flagship convention)
+    t0 = time.perf_counter()
+    reqs = 0
+    while time.perf_counter() - t0 < sustain_s:
+        s.raw_predict(X)
+        reqs += 1
+    dt = time.perf_counter() - t0
+    return reqs / dt, "artifact_qps"
+
+
 def run_glm(n_rows: int = 1_000_000, p: int = 32, iters: int = 20):
     """GLM IRLS secondary metric (matches the repo-root bench_glm shape)."""
     import jax
@@ -250,6 +352,10 @@ if __name__ == "__main__":
         value, metric = run_glm()
     elif mode == "recover":
         value, metric = run_recover()
+    elif mode == "artifact":
+        value, metric = run_artifact(
+            train_rows=int(os.environ.get("H2O3_BENCH_ARTIFACT_TRAIN_ROWS",
+                                          20_000)))
     elif mode == "score":
         value, metric = run_scoring(
             train_rows=int(os.environ.get("H2O3_BENCH_SCORE_TRAIN_ROWS",

@@ -40,3 +40,42 @@ def pcast(x, axes, to="varying"):
     if pv is not None and to == "varying":
         return pv(x, axes)
     return x
+
+
+# ---------------------------------------------------------------------------
+# AOT executable (de)serialization — the artifact/compile-cache substrate.
+# jax.experimental.serialize_executable has moved/changed signature across
+# releases; every artifact/cache call site routes through these three shims
+# so a jax without the API degrades to the StableHLO / recompile fallbacks
+# instead of crashing the exporter or the loader.
+# ---------------------------------------------------------------------------
+
+def serialize_compiled(compiled):
+    """Serialize an AOT-compiled executable (``jit(f).lower(...).compile()``)
+    to ``(payload_bytes, in_tree, out_tree)``, or None when this jax/backend
+    cannot serialize executables (the caller falls back to StableHLO)."""
+    try:
+        from jax.experimental import serialize_executable as se
+
+        return se.serialize(compiled)
+    except Exception:   # noqa: BLE001 — capability probe by contract
+        return None
+
+
+def deserialize_compiled(payload, in_tree, out_tree):
+    """Load a serialized executable back into a callable. Raises when the
+    payload targets a different backend/topology or the API is missing —
+    callers treat any raise as 'unavailable on this target' and fall back."""
+    from jax.experimental import serialize_executable as se
+
+    return se.deserialize_and_load(payload, in_tree, out_tree)
+
+
+def compile_stablehlo(text: str):
+    """Portable lowering fallback: compile StableHLO module text through the
+    local XLA client. Returns an executable whose ``.execute([arrays])``
+    runs the program on the default device — the exact program the exporter
+    lowered, so results stay bitwise-identical to the source process."""
+    import jax
+
+    return jax.devices()[0].client.compile(text)

@@ -190,9 +190,8 @@ class DRF(SharedTree):
                 v_sum = jnp.asarray(rs["v_sum"])
             stop_metric = [v for v in rs["stop_metric"]]
             history = [dict(h) for h in rs["history"]]
-            packs = [np.asarray(pk) for pk in rs["packs"]]
-            leaf_means = [jnp.asarray(v) for v in rs["leaf_means"]]
-            leaf_wys = [jnp.asarray(v) for v in rs["leaf_wys"]]
+            packs, leaf_means, leaf_wys = self._load_tree_progress(
+                rs, vals_key="leaf_means")
             if rs.get("rng_state") is not None:
                 rng.bit_generator.state = rs["rng_state"]
         jp_every = self._job_ckpt_every()
@@ -252,9 +251,7 @@ class DRF(SharedTree):
                     "v_sum": None if v_sum is None else np.asarray(v_sum),
                     "stop_metric": list(stop_metric),
                     "history": [dict(h) for h in history],
-                    "packs": [np.asarray(pk) for pk in packs],
-                    "leaf_means": [np.asarray(v) for v in leaf_means],
-                    "leaf_wys": [np.asarray(v) for v in leaf_wys],
+                    **self._tree_progress_ref(packs, leaf_means, leaf_wys),
                     "rng_state": rng.bit_generator.state})
 
         # one batched fetch; scale leaves by the ACTUAL tree count (early
@@ -318,9 +315,8 @@ class DRF(SharedTree):
             oob_sum = jnp.asarray(rs["oob_sum"])
             oob_cnt = jnp.asarray(rs["oob_cnt"])
             tree_class = list(rs["tree_class"])
-            packs = [np.asarray(pk) for pk in rs["packs"]]
-            leaf_means = [jnp.asarray(v) for v in rs["leaf_means"]]
-            leaf_wys = [jnp.asarray(v) for v in rs["leaf_wys"]]
+            packs, leaf_means, leaf_wys = self._load_tree_progress(
+                rs, vals_key="leaf_means")
             if rs.get("rng_state") is not None:
                 rng.bit_generator.state = rs["rng_state"]
         jp_every = self._job_ckpt_every()
@@ -358,9 +354,7 @@ class DRF(SharedTree):
                     "oob_sum": np.asarray(oob_sum),
                     "oob_cnt": np.asarray(oob_cnt),
                     "tree_class": list(tree_class),
-                    "packs": [np.asarray(pk) for pk in packs],
-                    "leaf_means": [np.asarray(v) for v in leaf_means],
-                    "leaf_wys": [np.asarray(v) for v in leaf_wys],
+                    **self._tree_progress_ref(packs, leaf_means, leaf_wys),
                     "rng_state": rng.bit_generator.state})
         from h2o3_tpu.models.tree.device_tree import assemble_trees
 

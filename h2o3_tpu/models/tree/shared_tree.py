@@ -278,6 +278,52 @@ class SharedTree(ModelBuilder):
                          ln / jnp.maximum(ld + self._leaf_den_offset(), 1e-12),
                          0.0)
 
+    # append-only tree-progress persistence --------------------------------
+    def _tree_progress_ref(self, packs, leaf_vals, leaf_wys) -> Dict:
+        """Durable-progress state for the per-tree tables WITHOUT
+        re-serializing the whole forest: entries grown since the last save
+        are appended as one suffix chunk (parallel/ckpt.py, artifact
+        packed-forest codec) and the state carries only the chunk paths —
+        each checkpoint's tree cost is O(new trees), not O(forest).
+        Called from inside a state_fn, i.e. only when a save is actually
+        happening on the dispatching process."""
+        from h2o3_tpu.parallel import ckpt
+
+        saved = getattr(self, "_jp_entries", 0)
+        chunks = list(getattr(self, "_jp_chunks", []))
+        if len(packs) > saved:
+            path = ckpt.append_job_tree_chunk(
+                str(self._progress_job.key), len(chunks),
+                packs[saved:], leaf_vals[saved:], leaf_wys[saved:])
+            chunks.append(path)
+            self._jp_chunks = chunks
+            self._jp_entries = len(packs)
+        return {"tree_chunks": chunks, "n_tree_entries": len(packs)}
+
+    def _load_tree_progress(self, rs: Dict, vals_key: str = "leaf_vals"):
+        """Re-hydrate (packs, leaf values, leaf w/y) from a resume state —
+        chunked suffix files (current format) or the inline lists older
+        progress files carry. Seeds the appender cursor so a resumed run
+        keeps appending instead of rewriting history."""
+        import jax.numpy as jnp
+
+        if rs.get("tree_chunks") is not None:
+            from h2o3_tpu.parallel import ckpt
+
+            packs, lv, lw = ckpt.load_job_tree_chunks(rs["tree_chunks"])
+            n = int(rs.get("n_tree_entries", len(packs)))
+            if len(packs) != n:
+                raise RuntimeError(
+                    f"tree-progress chunks hold {len(packs)} trees but the "
+                    f"state expects {n} — durable progress is torn")
+            self._jp_chunks = list(rs["tree_chunks"])
+            self._jp_entries = n
+        else:
+            packs, lv, lw = rs["packs"], rs[vals_key], rs["leaf_wys"]
+        return ([np.asarray(p) for p in packs],
+                [jnp.asarray(v) for v in lv],
+                [jnp.asarray(w) for w in lw])
+
     # checkpoint helpers ---------------------------------------------------
     def _ckpt_start(self, ntrees: int, per_iter: int = 1) -> int:
         """Iterations the checkpoint forest already holds (0 when training
@@ -476,9 +522,7 @@ class SharedTree(ModelBuilder):
                 f_valid = jnp.asarray(rs["f_valid"])
             stop_metric = [float(v) for v in rs["stop_metric"]]
             history = [dict(h) for h in rs["history"]]
-            packs = [np.asarray(pk) for pk in rs["packs"]]
-            leaf_vals = [jnp.asarray(v) for v in rs["leaf_vals"]]
-            leaf_wys = [jnp.asarray(v) for v in rs["leaf_wys"]]
+            packs, leaf_vals, leaf_wys = self._load_tree_progress(rs)
             if rs.get("rng_state") is not None:
                 rng.bit_generator.state = rs["rng_state"]
         jp_every = self._job_ckpt_every()
@@ -530,9 +574,7 @@ class SharedTree(ModelBuilder):
                                 else np.asarray(f_valid)),
                     "stop_metric": list(stop_metric),
                     "history": [dict(h) for h in history],
-                    "packs": [np.asarray(pk) for pk in packs],
-                    "leaf_vals": [np.asarray(v) for v in leaf_vals],
-                    "leaf_wys": [np.asarray(v) for v in leaf_wys],
+                    **self._tree_progress_ref(packs, leaf_vals, leaf_wys),
                     "rng_state": rng.bit_generator.state})
 
         # ONE batched fetch for every tree's tables + leaf values
@@ -633,9 +675,7 @@ class SharedTree(ModelBuilder):
             stop_metric = [float(v) for v in rs["stop_metric"]]
             history = [dict(h) for h in rs["history"]]
             tree_class = list(rs["tree_class"])
-            packs = [np.asarray(pk) for pk in rs["packs"]]
-            leaf_vals = [jnp.asarray(v) for v in rs["leaf_vals"]]
-            leaf_wys = [jnp.asarray(v) for v in rs["leaf_wys"]]
+            packs, leaf_vals, leaf_wys = self._load_tree_progress(rs)
             if rs.get("rng_state") is not None:
                 rng.bit_generator.state = rs["rng_state"]
         jp_every = self._job_ckpt_every()
@@ -695,9 +735,7 @@ class SharedTree(ModelBuilder):
                     "stop_metric": list(stop_metric),
                     "history": [dict(h) for h in history],
                     "tree_class": list(tree_class),
-                    "packs": [np.asarray(pk) for pk in packs],
-                    "leaf_vals": [np.asarray(v) for v in leaf_vals],
-                    "leaf_wys": [np.asarray(v) for v in leaf_wys],
+                    **self._tree_progress_ref(packs, leaf_vals, leaf_wys),
                     "rng_state": rng.bit_generator.state})
 
         from h2o3_tpu.models.tree.device_tree import assemble_trees

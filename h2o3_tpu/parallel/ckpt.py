@@ -504,10 +504,73 @@ def load_job_progress(job_key: str) -> Optional[dict]:
 
 def delete_job_progress(job_key: str) -> None:
     """Drop a job's durable progress (called when the job completes — the
-    finished model supersedes the partial state)."""
+    finished model supersedes the partial state), including any
+    append-only tree-progress suffix chunks."""
     D.kv_delete(_JOB_PREFIX + str(job_key))
-    for p in (_job_path(job_key), _job_path(job_key) + ".json"):
+    paths = [_job_path(job_key), _job_path(job_key) + ".json"]
+    safe = re.sub(r"[^\w.-]", "_", str(job_key))
+    try:
+        paths += [os.path.join(ckpt_dir(), n)
+                  for n in os.listdir(ckpt_dir())
+                  if n.startswith(f"jobckpt_{safe}_trees_")
+                  and n.endswith(".npz")]
+    except OSError:
+        pass
+    for p in paths:
         try:
             os.unlink(p)
         except OSError:
             pass
+
+
+# ---------------------------------------------------------------------------
+# append-only tree-progress suffix chunks
+#
+# The tree trainers' loop state is dominated by the per-tree tables (packed
+# nodes, leaf values) — O(forest) and strictly append-only. Before this
+# layer every progress save re-pickled the WHOLE list (the recorded PR-5
+# quadratic cost). Now each save writes ONE npz chunk holding only the
+# trees grown since the previous save (artifact/packer.py codec — the same
+# packed-forest discipline as the AOT artifact), and the main progress
+# pickle carries just the chunk paths. Chunks resolve through persist/ on
+# load like every other checkpoint artifact, so cross-host resume holds.
+# ---------------------------------------------------------------------------
+
+def job_tree_chunk_path(job_key: str, idx: int) -> str:
+    safe = re.sub(r"[^\w.-]", "_", str(job_key))
+    return os.path.join(ckpt_dir(), f"jobckpt_{safe}_trees_{int(idx):06d}.npz")
+
+
+def append_job_tree_chunk(job_key: str, idx: int, packs, leaf_vals,
+                          leaf_wys) -> str:
+    """Atomically write suffix chunk `idx` for `job_key`; returns its
+    path (recorded in the main progress state)."""
+    from h2o3_tpu.artifact import packer
+
+    data = packer.pack_tree_chunk(packs, leaf_vals, leaf_wys)
+    path = job_tree_chunk_path(job_key, idx)
+    tmp = path + ".part"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+    return path
+
+
+def load_job_tree_chunks(paths) -> Tuple[list, list, list]:
+    """Re-assemble the per-tree lists from ordered chunk paths. Raises on
+    a missing/torn chunk — a partial forest must fail the resume loudly
+    (the caller's unreadable-progress handling takes over), never train
+    silently from a truncated tree list."""
+    from h2o3_tpu import persist
+    from h2o3_tpu.artifact import packer
+
+    packs: list = []
+    leaf_vals: list = []
+    leaf_wys: list = []
+    for p in paths:
+        with open(persist.resolve(str(p)), "rb") as f:
+            pk, lv, lw = packer.unpack_tree_chunk(f.read())
+        packs.extend(pk)
+        leaf_vals.extend(lv)
+        leaf_wys.extend(lw)
+    return packs, leaf_vals, leaf_wys

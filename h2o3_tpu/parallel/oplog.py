@@ -28,6 +28,7 @@ a lost op can never leave the follower stalled at a sequence gap.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import threading
@@ -139,6 +140,27 @@ def replay_crashed() -> bool:
     return _REPLAY_CRASHED
 
 
+# recent op arrival times (coordinator: publish; follower: replay) — the
+# signal the watchdog's ADAPTIVE replay idle timeout is derived from: a
+# busy cloud keeps its replay threads patient, an idle one lets them
+# retire quickly instead of pinning a thread for a fixed hour
+_OP_TIMES: "collections.deque[float]" = collections.deque(maxlen=32)
+
+
+def note_op_seen() -> None:
+    _OP_TIMES.append(time.time())
+
+
+def observed_op_gap_s() -> Optional[float]:
+    """Median gap between recently seen ops (seconds); None until at least
+    two ops have been observed this process-lifetime."""
+    ts = list(_OP_TIMES)
+    if len(ts) < 2:
+        return None
+    gaps = sorted(b - a for a, b in zip(ts, ts[1:]))
+    return float(gaps[len(gaps) // 2])
+
+
 def _in_op() -> bool:
     return bool(getattr(_TLS, "in_op", False))
 
@@ -207,6 +229,7 @@ def publish(kind: str, payload: Dict[str, Any]) -> int:
     the follower stalled at a sequence gap forever."""
     global _SEQ
     failure.faultpoint("oplog.publish")
+    note_op_seen()            # adaptive replay-idle signal (traffic clock)
     # _PUB_LOCK spans claim + put: rollback is only sound while no LATER
     # slot has been claimed (a gap would stall the follower forever). The
     # hold is bounded — kv_put absorbs transient transport faults with its
@@ -731,6 +754,14 @@ def _apply(kind: str, p: Dict[str, Any]) -> None:
         model._key = Key(p["model_id"])
         DKV.put(p["model_id"], model)
         return
+    if kind == "artifact_import":
+        # AOT artifact -> servable model, mirrored like "generic": the dir
+        # rides the shared-filesystem contract, every process installs the
+        # model under the SAME key so later predict ops resolve it
+        from h2o3_tpu.artifact import load_model
+
+        load_model(p["dir"], p.get("model_id"))
+        return
     if kind == "grid":
         from h2o3_tpu.core.dkv import DKV
         from h2o3_tpu.grid import H2OGridSearch
@@ -810,6 +841,7 @@ def follower_loop(idle_timeout_s: float = 120.0,
             _record_error(i, op["kind"], traceback.format_exc())
             raise
         _ack(i, op.get("op_id"))
+        note_op_seen()        # adaptive replay-idle signal (traffic clock)
         if on_op is not None:
             on_op(op["kind"], op["payload"])
         applied += 1

@@ -57,11 +57,40 @@ def enabled() -> bool:
     return retry.env_int("H2O_TPU_AUTO_RECOVER", 1) != 0
 
 
+# adaptive replay idle bounds: never retire under traffic jitter, never
+# pin an idle thread for the old fixed hour
+_REPLAY_IDLE_MIN_S = 120.0
+_REPLAY_IDLE_MAX_S = 3600.0
+_REPLAY_IDLE_DEFAULT_S = 900.0
+
+
+def replay_idle_timeout_s() -> float:
+    """Idle timeout for watchdog-spawned replay threads.
+
+    ``H2O_TPU_REPLAY_IDLE_S`` > 0 pins it; otherwise it ADAPTS to observed
+    op traffic (oplog.observed_op_gap_s): 20× the median inter-op gap,
+    clamped to [2 min, 1 h], defaulting to 15 min before any traffic has
+    been seen. Replaces the fixed 3600 s that kept replay threads (and
+    whatever their last replayed op pinned) alive for an hour on an idle
+    cloud while ALSO being too short for genuinely slow op cadences."""
+    pinned = retry.env_int("H2O_TPU_REPLAY_IDLE_S", 0)
+    if pinned > 0:
+        return float(pinned)
+    from h2o3_tpu.parallel import oplog
+
+    gap = oplog.observed_op_gap_s()
+    if gap is None:
+        return _REPLAY_IDLE_DEFAULT_S
+    return float(min(max(20.0 * gap, _REPLAY_IDLE_MIN_S),
+                     _REPLAY_IDLE_MAX_S))
+
+
 def status() -> Dict:
     """Snapshot for GET /3/CloudStatus."""
     with _LOCK:
         out = dict(_STATE)
     out["enabled"] = enabled()
+    out["replay_idle_timeout_s"] = round(replay_idle_timeout_s(), 1)
     return out
 
 
@@ -370,8 +399,8 @@ class Watchdog:
         if t is not None and t.is_alive():
             return
         self._follower_thread = threading.Thread(
-            target=lambda: oplog.follower_loop(idle_timeout_s=3600.0,
-                                               start_seq=cursor),
+            target=lambda: oplog.follower_loop(
+                idle_timeout_s=replay_idle_timeout_s(), start_seq=cursor),
             daemon=True, name="h2o3-watchdog-follower")
         self._follower_thread.start()
 

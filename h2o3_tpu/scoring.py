@@ -161,7 +161,14 @@ class ScoringSession:
         self._fn = _fused_score_fn(self.forest.max_depth,
                                    self.forest.nclasses,
                                    self.forest.per_class_trees)
-        self._traced: set = set()        # buckets compiled so far
+        self._traced: set = set()        # buckets activated so far
+        # AOT executables per (bucket, local): dispatched explicitly so
+        # compilation is observable (fused-compile counter) and cacheable
+        # across server restarts (artifact/compile_cache.py)
+        self._exec: Dict[tuple, Any] = {}
+        self._model_ck: Optional[str] = None
+        self.fused_compiles = 0          # actual XLA compiles this session
+        self.cache_hits = 0              # executables served from disk
         self._local_cache = None         # degraded-mode forest array copies
         self.stats = SessionStats()
 
@@ -204,6 +211,47 @@ class ScoringSession:
                                       for a in self._arrays)
         return self._local_cache
 
+    def _model_checksum(self) -> str:
+        if self._model_ck is None:
+            from h2o3_tpu.artifact import packer
+
+            self._model_ck = packer.model_checksum(self.forest, self.spec)
+        return self._model_ck
+
+    def _executable_for(self, bucket: int, local: bool, call_args: tuple):
+        """AOT executable for one (bucket, placement) — in-memory first,
+        then the persistent compile cache ($H2O_TPU_COMPILE_CACHE_DIR,
+        keyed by model checksum + bucket + backend fingerprint), and only
+        then an actual XLA compile (counted, and stored back for the next
+        process/restart). A warm restart therefore compiles zero fused
+        programs."""
+        key = (bucket, bool(local))
+        exe = self._exec.get(key)
+        if exe is not None:
+            return exe
+        from h2o3_tpu.artifact import compile_cache
+
+        ckey = None
+        if compile_cache.enabled():
+            # checksum + key work only when a persistent tier exists —
+            # with the cache off the first dispatch must not pay a
+            # whole-forest hash for a key nobody will read
+            ckey = compile_cache.cache_key(
+                self._model_checksum(), bucket,
+                variant="local" if local else "mesh")
+            exe = compile_cache.load(ckey)
+        if exe is None:
+            exe = self._fn.lower(*call_args).compile()
+            compile_cache.note_compile()
+            self.fused_compiles += 1
+            if ckey is not None:
+                compile_cache.store(ckey, exe)
+        else:
+            self.cache_hits += 1
+        self._exec[key] = exe
+        self._traced.add(bucket)
+        return exe
+
     def _margin_x(self, X: np.ndarray, local: bool = False) -> np.ndarray:
         """Margins for an (n, F) feature matrix via bucketed fused
         dispatch; returns host (n,) or (n, K) float32, exact per row.
@@ -229,9 +277,9 @@ class ScoringSession:
             buf[:m] = chunk
             xd = jax.device_put(buf) if local else jax.device_put(buf,
                                                                   sharding)
-            out = self._fn(xd, self._edges, self._is_cat, self._init,
-                           *arrays)
-            self._traced.add(bucket)
+            call_args = (xd, self._edges, self._is_cat, self._init) + \
+                tuple(arrays)
+            out = self._executable_for(bucket, local, call_args)(*call_args)
             outs.append(np.asarray(out)[:m])
             pos += m
         if not outs:
@@ -382,7 +430,9 @@ def metrics_snapshot() -> List[Dict[str, Any]]:
     out = []
     for mk, sess in items:
         entry = {"model": mk, "buckets": list(sess.buckets),
-                 "traversal_compiles": sess.traversal_compiles}
+                 "traversal_compiles": sess.traversal_compiles,
+                 "fused_compiles": sess.fused_compiles,
+                 "compile_cache_hits": sess.cache_hits}
         entry.update(sess.stats.snapshot())
         out.append(entry)
     return out
@@ -576,6 +626,13 @@ BATCHER = ScoreBatcher()
 
 def score_request(model, frame, dest: Optional[str] = None,
                   with_metrics: bool = False):
-    """Entry point for the REST layer: coalescing, bucketed, oplog-mirrored
-    scoring of one request. Returns (prediction_frame, metrics_or_None)."""
-    return BATCHER.submit(model, frame, dest, with_metrics)
+    """Entry point for the REST layer: admission-controlled, coalescing,
+    bucketed, oplog-mirrored scoring of one request. Returns
+    (prediction_frame, metrics_or_None). Over the per-model concurrency
+    limit requests queue (bounded); overflow raises AdmissionRejected,
+    which the REST layer maps to 429/503 + Retry-After — heavy traffic
+    degrades by queueing, not collapse."""
+    from h2o3_tpu import admission
+
+    with admission.CONTROLLER.slot(str(model.key)):
+        return BATCHER.submit(model, frame, dest, with_metrics)

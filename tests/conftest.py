@@ -85,7 +85,7 @@ _SMOKE_MODULES = {"test_core", "test_glm", "test_rapids", "test_java_mojo",
 _HEAVY_MODULES = [
     # many passing tests per second of training — earliest of the tail
     "test_job_resume", "test_trees", "test_checkpoint", "test_genmodel",
-    "test_mojo",
+    "test_artifact", "test_mojo",
     "test_mojo_families", "test_explain", "test_ensemble",
     "test_survival_gam_rulefit", "test_grid",
     # long single fits / many submodels

@@ -89,6 +89,47 @@ def test_env_knobs_documented_in_readme():
         "discover knobs there, not by grepping the source)")
 
 
+def test_artifact_loads_are_restricted():
+    """Every artifact/cache file read in ``h2o3_tpu/artifact/`` and
+    ``h2o3_genmodel/`` must go through a restricted unpickler or a
+    schema-validated manifest/npz path: no raw ``pickle.load(s)`` and no
+    ``allow_pickle=True`` — a scoring artifact is untrusted input (it may
+    arrive over shared storage or an upload route), and one raw load is a
+    pickle-RCE door."""
+    roots = [SRC / "artifact", ROOT / "h2o3_genmodel"]
+    offenders = []
+    for root in roots:
+        for p, text in _py_sources(root):
+            rel = p.relative_to(ROOT)
+            for pat, why in (
+                    (r"\bpickle\.loads?\(", "raw pickle.load(s)"),
+                    (r"allow_pickle\s*=\s*True", "np.load(allow_pickle)")):
+                for mm in re.finditer(pat, text):
+                    line = text[: mm.start()].count("\n") + 1
+                    offenders.append(f"{rel}:{line} — {why}")
+    assert not offenders, (
+        "artifact/genmodel load paths must use a restricted Unpickler "
+        "subclass or allow_pickle=False npz/manifest reads; found: "
+        + "; ".join(offenders))
+
+
+def test_genmodel_runner_has_no_training_imports():
+    """The standalone runtimes under ``h2o3_genmodel/`` must stay loadable
+    without the framework: any ``import h2o3_tpu`` there would silently
+    re-couple the dependency-free scoring artifact to the training
+    stack."""
+    offenders = []
+    for p, text in _py_sources(ROOT / "h2o3_genmodel"):
+        for mm in re.finditer(
+                r"^\s*(?:import\s+h2o3_tpu|from\s+h2o3_tpu)", text, re.M):
+            line = text[: mm.start()].count("\n") + 1
+            offenders.append(f"{p.relative_to(ROOT)}:{line}")
+    assert not offenders, (
+        f"h2o3_genmodel imports the training stack at {offenders} — the "
+        "standalone runners must depend on numpy/stdlib (+ jax for AOT) "
+        "only")
+
+
 def test_pyproject_markers_match_test_usage():
     declared = _declared_markers()
     used = _used_markers()
