@@ -761,16 +761,21 @@ def h_scoring_metrics(ctx: Ctx):
     """GET /3/ScoringMetrics — per-model serving fast-path statistics
     (scoring.py ScoringSession): request/batch/row counts, micro-batch
     coalescing, latency percentiles, traversal/fused compile counts and
-    the active row buckets; plus the admission-control counters and the
-    persistent compile-cache stats. The per-dispatch events are also in
+    the active row buckets; plus the admission-control counters, the
+    persistent compile-cache stats, and the per-process sharded data-plane
+    counters (``data_plane.packed_rows`` / ``data_plane.gathered_rows`` —
+    "no coordinator column gather on the fused path" is asserted against
+    gathered_rows staying 0). The per-dispatch events are also in
     /3/Timeline under kind='scoring'."""
     from h2o3_tpu import admission, scoring
     from h2o3_tpu.artifact import compile_cache
+    from h2o3_tpu.core import sharded_frame
 
     return {"__meta": S.meta("ScoringMetricsV3"),
             "models": scoring.metrics_snapshot(),
             "admission": admission.CONTROLLER.snapshot(),
-            "compile_cache": compile_cache.stats()}
+            "compile_cache": compile_cache.stats(),
+            "data_plane": sharded_frame.counters()}
 
 
 def h_watermeter_cpu(ctx: Ctx):

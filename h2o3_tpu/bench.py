@@ -118,6 +118,10 @@ def run_scoring(train_rows: int = 20_000, ntrees: int = 10,
     frames = [make(s, False) for s in sizes]
     for fr in frames:                      # warm every bucket once
         sess.predict(fr)
+    from h2o3_tpu.core import sharded_frame
+    import jax
+
+    sharded_frame.reset_counters()         # scope counters to the timed run
     t0 = time.perf_counter()
     rows = 0
     for _ in range(passes):
@@ -125,6 +129,15 @@ def run_scoring(train_rows: int = 20_000, ntrees: int = 10,
             sess.predict(fr)
             rows += fr.nrows
     dt = time.perf_counter() - t0
+    # sharded-data-plane evidence next to the throughput number: the fused
+    # metric must come from per-process shard packing (gathered_rows == 0
+    # on the sharded path; the /3/ScoringMetrics data_plane block reports
+    # the same counters)
+    dp = sharded_frame.counters()
+    print(f"H2O3_BENCH score_devices {len(jax.devices())}", flush=True)
+    print(f"H2O3_BENCH score_packed_rows {dp['packed_rows']}", flush=True)
+    print(f"H2O3_BENCH score_gathered_rows {dp['gathered_rows']}",
+          flush=True)
     return rows / dt, "score_rows_per_sec"
 
 

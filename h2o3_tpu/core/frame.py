@@ -454,6 +454,17 @@ class Frame(Keyed):
             fr.add(nm, other._cols[n])
         return fr
 
+    # -- sharded data plane -----------------------------------------------
+    def sharded_view(self, names: Optional[Sequence[str]] = None):
+        """Row-sharded data-plane view (core/sharded_frame.ShardedFrame):
+        named row axis + NamedSharding over this frame's device columns,
+        or None when a named column has no device data (strings) or the
+        layouts disagree. The fused scoring and tree-input paths pack
+        through it so full columns are never staged on the coordinator."""
+        from h2o3_tpu.core.sharded_frame import ShardedFrame
+
+        return ShardedFrame.of(self, names)
+
     # -- materialization --------------------------------------------------
     def to_pandas(self):
         import pandas as pd

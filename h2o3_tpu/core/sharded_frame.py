@@ -1,0 +1,264 @@
+"""Sharded data plane: per-process feature packing over addressable shards.
+
+Reference: H2O-3's entire engine is "map/reduce over chunks that live where
+they are" (water/fvec/Chunk.java homing + water/MRTask.java local maps) —
+no node ever pulls another node's chunks to build a task's input. The
+TPU-native analog (ROADMAP open item 1, the recorded blocker of PRs 2/3/4):
+columns are row-sharded jax.Arrays over the mesh's named ``rows`` axis, so
+"chunk locality" is the ``NamedSharding`` rule — and every input-building
+step (serving feature packing, tree-training bin matrices) must consume
+those shards WHERE THEY ARE instead of round-tripping whole columns
+through the coordinator host.
+
+:class:`ShardedFrame` is that contract as a view over ``core/frame.Frame``:
+
+- **named row axis** — ``ROW_AXIS`` ("rows"), the mesh axis every column's
+  ``NamedSharding`` partitions; the same axis the fused scorers
+  ``shard_map`` over (compressed.py ``_fused_score_sharded_fn``, routed
+  through ``compat.shard_map`` for this container's jax).
+- **pack_features** — the serving fast path's (bucket, F) float32 feature
+  matrix built by ONE compiled program whose output keeps the row
+  sharding: each process materializes only its addressable shards
+  (``jit`` + ``out_shardings``; the slice/cast/mask is elementwise over
+  rows, so XLA keeps per-shard work local). Bitwise-identical to the
+  host-packed path's matrix: same casts, same zero pad.
+- **pack_binned** — the tree-training input build: the (N, F) integer bin
+  matrix fused into one program with a ``P('rows', None)`` output, so
+  training input pipelines never stage full columns on the coordinator
+  (previously: eager per-column ops + a re-homing device_put).
+
+Per-process counters make the no-gather property OBSERVABLE
+(``GET /3/ScoringMetrics`` → ``data_plane``): ``packed_rows`` counts rows
+packed shard-locally; ``gathered_rows`` counts rows whose columns WERE
+pulled to this process's host inside the fused scoring / tree input paths
+(the degraded-serving and ragged-layout fallbacks). tests/test_consistency
+asserts ``gathered_rows`` stays 0 on the sharded path.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+ROW_AXIS = "rows"
+
+# -- per-process data-plane counters ----------------------------------------
+
+_LOCK = threading.Lock()
+_PACKED = 0
+_GATHERED = 0
+
+
+def note_packed(n: int) -> None:
+    """Record `n` rows whose task input was built from addressable shards
+    in place (no host round-trip)."""
+    global _PACKED
+    with _LOCK:
+        _PACKED += int(n)
+
+
+def note_gathered(n: int) -> None:
+    """Record `n` rows whose columns were fetched to this process's host
+    inside the fused scoring / tree input path (the exceptional path)."""
+    global _GATHERED
+    with _LOCK:
+        _GATHERED += int(n)
+
+
+def counters() -> dict:
+    with _LOCK:
+        return {"packed_rows": _PACKED, "gathered_rows": _GATHERED}
+
+
+def reset_counters() -> None:
+    global _PACKED, _GATHERED
+    with _LOCK:
+        _PACKED = 0
+        _GATHERED = 0
+
+
+def enabled() -> bool:
+    """Master switch for the sharded data plane (H2O_TPU_SHARDED_PLANE,
+    default on). Off = the legacy host-packed / eager paths, kept for
+    A/B bitwise verification and emergency rollback."""
+    return os.environ.get("H2O_TPU_SHARDED_PLANE", "1").lower() not in (
+        "0", "false", "off")
+
+
+# -- compiled packers (cached per geometry, not per request) ----------------
+
+@functools.lru_cache(maxsize=64)
+def _pack_features_fn(bucket: int, padded: int, dtypes: tuple, mesh):
+    """(pos, n, *cols) -> (bucket, F) float32, row-sharded.
+
+    Matches ScoringSession._features + its zero pad bitwise: values pass
+    through for logical rows [pos, min(pos+bucket, n)) — numerics as-is
+    (NaN = NA, bf16 upcast exactly as numpy's), categorical codes cast to
+    float (NA_CAT stays negative) — and every other row is exactly 0.0.
+    pos/n are traced scalars, so one compile covers every request against
+    this (bucket, layout). `padded`/`dtypes` are cache-key-only: they pin
+    the jit wrapper to one column layout so its trace cache never aliases
+    across layouts."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def pack(pos, n, *cols):
+        idx = pos + jnp.arange(bucket, dtype=jnp.int32)
+        valid = idx < n
+        parts = []
+        for c in cols:
+            x = c.astype(jnp.float32)
+            # pad THEN slice: a tail chunk's [pos, pos+bucket) window may
+            # overrun the padded column, and dynamic_slice would clamp the
+            # start (silently shifting rows); the zero tail keeps the
+            # window in bounds and is masked off below anyway
+            x = jnp.pad(x, (0, bucket))
+            parts.append(jax.lax.dynamic_slice_in_dim(x, pos, bucket))
+        X = jnp.stack(parts, axis=-1)
+        return jnp.where(valid[:, None], X, jnp.float32(0))
+
+    return jax.jit(pack, out_shardings=NamedSharding(mesh, P(ROW_AXIS, None)))
+
+
+@functools.lru_cache(maxsize=64)
+def _pack_binned_fn(padded: int, dtypes: tuple, nbins: tuple, is_cat: tuple,
+                    out_dtype: str, mesh):
+    """(edges, *cols) -> (padded, F) integer bin matrix, row-sharded.
+
+    The fused replacement for BinSpec.bin_columns' eager per-column loop:
+    same bin math (searchsorted side='left' over the real edges — the +inf
+    pad lanes never count — NA/out-of-range to the per-feature NA bin),
+    one XLA program, output sharding P('rows', None) so each process bins
+    only its addressable row shards."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dt = getattr(jnp, out_dtype)
+
+    def pack(edges, *cols):
+        parts = []
+        for i, c in enumerate(cols):
+            na_bin = int(nbins[i]) - 1
+            if is_cat[i]:
+                codes = c.astype(jnp.int32)
+                b = jnp.where((codes < 0) | (codes >= na_bin), na_bin, codes)
+            else:
+                x = c
+                b = jnp.searchsorted(edges[i], x,
+                                     side="left").astype(jnp.int32)
+                b = jnp.where(jnp.isnan(x), na_bin, b)
+            parts.append(b.astype(dt))
+        return jnp.stack(parts, axis=-1)
+
+    return jax.jit(pack, out_shardings=NamedSharding(mesh, P(ROW_AXIS, None)))
+
+
+class ShardedFrame:
+    """Row-sharded data-plane view over a Frame's device columns.
+
+    Build with :meth:`of` (returns None when the view cannot hold: a named
+    column is host-resident (strings), layouts disagree, or the plane is
+    switched off) — callers fall back to their legacy host/eager path and
+    count the rows as ``gathered``."""
+
+    __slots__ = ("frame", "names", "_datas", "_cl", "padded_rows")
+
+    def __init__(self, frame, names: List[str], datas: list, cl,
+                 padded_rows: int):
+        self.frame = frame
+        self.names = names
+        self._datas = datas
+        self._cl = cl
+        self.padded_rows = padded_rows
+
+    @classmethod
+    def of(cls, frame, names: Optional[Sequence[str]] = None
+           ) -> Optional["ShardedFrame"]:
+        if not enabled():
+            return None
+        from h2o3_tpu.core.runtime import cluster
+
+        cl = cluster()
+        use = list(names) if names is not None else list(frame.names)
+        datas, padded = [], None
+        for nm in use:
+            c = frame.col(nm)
+            if c.ctype not in ("real", "int", "enum", "time"):
+                return None            # host-resident (string/uuid) column
+            d = c.data                 # faults evicted columns back in
+            if d is None:
+                return None
+            if padded is None:
+                padded = int(d.shape[0])
+            elif int(d.shape[0]) != padded:
+                return None            # ragged layout: no shared row axis
+            datas.append(d)
+        if padded is None or padded % max(cl.row_shards, 1):
+            return None
+        return cls(frame, use, datas, cl, padded)
+
+    @classmethod
+    def for_key(cls, key, names: Optional[Sequence[str]] = None
+                ) -> Optional["ShardedFrame"]:
+        """DKV-resident variant: resolve `key` through the control plane
+        (local store first, replicated payload second) and wrap it."""
+        from h2o3_tpu.core.dkv import DKV
+
+        fr = DKV.fetch_remote(key)
+        return cls.of(fr, names) if fr is not None else None
+
+    # -- layout -----------------------------------------------------------
+    @property
+    def row_axis(self) -> str:
+        return ROW_AXIS
+
+    @property
+    def mesh(self):
+        return self._cl.mesh
+
+    def row_sharding(self, ncols: bool = False):
+        """The view's NamedSharding: rows over the named axis (optionally
+        with an unsharded trailing column axis)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = P(ROW_AXIS, None) if ncols else P(ROW_AXIS)
+        return NamedSharding(self._cl.mesh, spec)
+
+    # -- packers -----------------------------------------------------------
+    def pack_features(self, pos: int, n: int, bucket: int):
+        """(bucket, F) float32 scoring matrix for logical rows
+        [pos, min(pos+bucket, n)), zero elsewhere — built on device from
+        the columns' addressable shards; the host never sees a column."""
+        import jax.numpy as jnp
+
+        fn = _pack_features_fn(int(bucket), self.padded_rows,
+                               tuple(str(d.dtype) for d in self._datas),
+                               self._cl.mesh)
+        return fn(jnp.int32(pos), jnp.int32(n), *self._datas)
+
+    def pack_binned(self, spec):
+        """(padded_rows, F) integer bin matrix for tree training, fused
+        and row-sharded (see _pack_binned_fn). Counts the frame's logical
+        rows as packed."""
+        import jax.numpy as jnp
+
+        max_bins = int(spec.nbins.max()) if len(spec.nbins) else 1
+        out_dtype = ("uint8" if max_bins <= 256
+                     else "int16" if max_bins <= 32767 else "int32")
+        fn = _pack_binned_fn(self.padded_rows,
+                             tuple(str(d.dtype) for d in self._datas),
+                             tuple(int(b) for b in spec.nbins),
+                             tuple(bool(c) for c in spec.is_cat),
+                             out_dtype, self._cl.mesh)
+        note_packed(int(self.frame.nrows))
+        return fn(jnp.asarray(spec.padded_edges()), *self._datas)
+
+    def __repr__(self) -> str:
+        return (f"<ShardedFrame {getattr(self.frame, 'key', '?')} "
+                f"{self.padded_rows}x{len(self.names)} axis={ROW_AXIS}>")

@@ -93,9 +93,30 @@ class BinSpec:
                 cards.append(0)
         return BinSpec(feature_names, is_cat, B, edges, cards)
 
+    def padded_edges(self) -> np.ndarray:
+        """(F, emax) float32 dense edge table, +inf beyond each feature's
+        real edges — the shared binning operand of the fused scorers
+        (compressed._fused_margins) and the sharded bin pack
+        (sharded_frame._pack_binned_fn); +inf lanes never count, so the
+        padded table bins identically to the ragged per-feature arrays."""
+        emax = max((len(e) for e in self.edges), default=0) or 1
+        ep = np.full((self.F, emax), np.inf, np.float32)
+        for i, e in enumerate(self.edges):
+            ep[i, : len(e)] = e
+        return ep
+
     # -- device binning ----------------------------------------------------
     def bin_columns(self, frame: Frame):
         """-> (N, F) row-sharded bin matrix (within-feature indices).
+
+        Packs through the sharded data plane (core/sharded_frame): ONE
+        fused program whose output carries the named-row-axis sharding, so
+        each process bins only its addressable row shards and tree
+        training never stages full columns on the coordinator (ROADMAP
+        open item 1 — previously eager per-column ops plus a re-homing
+        device_put could materialize coordinator-resident intermediates).
+        Frames the view cannot hold (ragged layouts, plane off) keep the
+        legacy eager path below.
 
         Narrowest integer dtype that fits max(nbins): the bin matrix is the
         biggest operand STREAMED from HBM on every histogram pass of every
@@ -107,7 +128,17 @@ class BinSpec:
         import jax.numpy as jnp
 
         from h2o3_tpu.core.runtime import cluster
+        from h2o3_tpu.core.sharded_frame import ShardedFrame
 
+        sf = ShardedFrame.of(frame, self.names)
+        if sf is not None:
+            return sf.pack_binned(self)
+        # legacy path: eager per-column ops can stage coordinator-resident
+        # intermediates, so the frame's rows count as gathered — the
+        # counter contract has no silent holes on the tree input path
+        from h2o3_tpu.core import sharded_frame as _sfmod
+
+        _sfmod.note_gathered(int(frame.nrows))
         max_bins = int(self.nbins.max()) if len(self.nbins) else 1
         dtype = (jnp.uint8 if max_bins <= 256
                  else jnp.int16 if max_bins <= 32767 else jnp.int32)

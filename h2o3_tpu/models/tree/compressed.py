@@ -259,6 +259,34 @@ def _traverse_fn(max_depth: int, nclasses: int, per_class: bool = False):
     return run
 
 
+def _fused_margins(X, edges, is_cat, init, feat, thresh, na_left, left,
+                   right, leaf_val, cat_split, cat_table, tree_class,
+                   na_bins, max_depth: int, K: int):
+    """Traceable fused bin + traverse + init core: (N, F) raw float32
+    features → (N,) / (N, K) margins. Shared verbatim by the jit serving
+    path (_fused_score_fn) and the shard_map'd sharded-data-plane path
+    (_fused_score_sharded_fn) — every op is row-local, so the two lower to
+    bitwise-identical per-row programs. Binning matches
+    BinSpec.bin_columns bit-for-bit: numeric bin = #edges < x
+    (== searchsorted side='left', padded edge slots are +inf so they never
+    count); categorical bin = code, NA/out-of-range clamped to the
+    feature's NA bin."""
+    import jax.numpy as jnp
+
+    nb = na_bins[None, :]
+    num_b = jnp.sum(edges[None, :, :] < X[:, :, None],
+                    axis=-1).astype(jnp.int32)
+    num_b = jnp.where(jnp.isnan(X), nb, num_b)
+    # categorical: NaN→-1 before the int cast (NaN→int is undefined)
+    codes = jnp.where(jnp.isnan(X), -1.0, X).astype(jnp.int32)
+    cat_b = jnp.where((codes < 0) | (codes >= nb), nb, codes)
+    binned = jnp.where(is_cat[None, :], cat_b, num_b)
+    acc = _forest_margins(binned, feat, thresh, na_left, left, right,
+                          leaf_val, cat_split, cat_table, tree_class,
+                          na_bins, max_depth, K)
+    return acc + init
+
+
 @functools.lru_cache(maxsize=32)
 def _fused_score_fn(max_depth: int, nclasses: int, per_class: bool = False):
     """Serving fast path: binning + traversal + init margin in ONE program.
@@ -266,32 +294,50 @@ def _fused_score_fn(max_depth: int, nclasses: int, per_class: bool = False):
     Takes raw features as a dense (N, F) float32 matrix (categoricals as
     their integer codes, NA as NaN for numerics / negative for cats) plus
     the BinSpec tables, so the per-request host work is a single
-    device_put. Binning matches BinSpec.bin_columns bit-for-bit:
-    numeric bin = #edges < x (== searchsorted side='left'); categorical
-    bin = code, with out-of-range/NA clamped to the feature's NA bin."""
+    device_put."""
     import jax
-    import jax.numpy as jnp
 
     K = nclasses if (nclasses > 2 or per_class) else 1
 
     @jax.jit
     def run(X, edges, is_cat, init, feat, thresh, na_left, left, right,
             leaf_val, cat_split, cat_table, tree_class, na_bins):
-        nb = na_bins[None, :]
-        # numeric: padded edge slots are +inf so they never count
-        num_b = jnp.sum(edges[None, :, :] < X[:, :, None],
-                        axis=-1).astype(jnp.int32)
-        num_b = jnp.where(jnp.isnan(X), nb, num_b)
-        # categorical: NaN→-1 before the int cast (NaN→int is undefined)
-        codes = jnp.where(jnp.isnan(X), -1.0, X).astype(jnp.int32)
-        cat_b = jnp.where((codes < 0) | (codes >= nb), nb, codes)
-        binned = jnp.where(is_cat[None, :], cat_b, num_b)
-        acc = _forest_margins(binned, feat, thresh, na_left, left, right,
-                              leaf_val, cat_split, cat_table, tree_class,
-                              na_bins, max_depth, K)
-        return acc + init
+        return _fused_margins(X, edges, is_cat, init, feat, thresh,
+                              na_left, left, right, leaf_val, cat_split,
+                              cat_table, tree_class, na_bins, max_depth, K)
 
     return run
+
+
+@functools.lru_cache(maxsize=32)
+def _fused_score_sharded_fn(max_depth: int, nclasses: int, per_class: bool,
+                            mesh):
+    """Sharded-data-plane serving path: the SAME fused core, executed per
+    row shard under shard_map over the named 'rows' axis (via the
+    compat.py shim for this jax). X arrives already row-sharded from
+    ShardedFrame.pack_features; the forest/BinSpec tables are replicated
+    (in_specs P()). Every op is per-row, so there is NO cross-shard
+    communication inside the program — each process scores only its
+    addressable shards, and margins come back row-sharded for the single
+    gather that assembles the prediction frame."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from h2o3_tpu.compat import shard_map as _compat_shard_map
+
+    K = nclasses if (nclasses > 2 or per_class) else 1
+
+    def run(X, edges, is_cat, init, feat, thresh, na_left, left, right,
+            leaf_val, cat_split, cat_table, tree_class, na_bins):
+        return _fused_margins(X, edges, is_cat, init, feat, thresh,
+                              na_left, left, right, leaf_val, cat_split,
+                              cat_table, tree_class, na_bins, max_depth, K)
+
+    in_specs = (P("rows", None),) + (P(),) * 13
+    out_specs = P("rows", None) if K > 1 else P("rows")
+    fn = _compat_shard_map(run, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs)
+    return jax.jit(fn)
 
 
 @functools.lru_cache(maxsize=8)

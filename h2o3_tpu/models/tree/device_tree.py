@@ -422,7 +422,10 @@ def grow_tree_device(binned, w, y, spec, *, max_depth: int, min_rows: float,
     """Grow one tree fully on device — NOTHING is fetched to host.
 
     binned (N, F) integer bin matrix (uint8/int16/int32 per BinSpec.bin_columns)
-    row-sharded; w, y, num, den (N,) device (num/den are
+    row-sharded — since the sharded data plane (PR 7) this block is packed
+    shard-locally by core/sharded_frame, so the training input pipeline
+    never stages full columns on the coordinator; w, y, num, den (N,)
+    device (num/den are
     the GammaPass numerator/denominator rows; default num=w·y, den=w).
     feat_masks: optional per-level (S_d, F) bool arrays, levels
     0..max_depth-1 (mtries / column sampling) — widths per level_widths().
@@ -444,10 +447,18 @@ def grow_tree_device(binned, w, y, spec, *, max_depth: int, min_rows: float,
     has_masks = feat_masks is not None
     from h2o3_tpu.models.tree import pallas_hist
 
+    # lowering decision at the widest matmul-path level of this tree's
+    # program (that level dominates the histogram cost; wider levels use
+    # the scatter path either way): forced by H2O_TPU_PALLAS_HIST=1,
+    # measured once per (F, maxB, S, backend) under =auto
+    cap_v = frontier_cap(F, maxB)
+    widths = level_widths(int(max_depth), cap_v)
+    s_widest = max([wd for wd in widths[: int(max_depth)]
+                    if wd <= MATMUL_S_LIMIT], default=1)
     fn = _grow_fn(int(max_depth), F, maxB, tuple(int(b) for b in spec.nbins),
                   tuple(bool(c) for c in spec.is_cat), float(min_rows),
                   float(min_split_improvement), has_masks, mesh, n_shard, blk,
-                  frontier_cap(F, maxB), use_pallas=pallas_hist.enabled())
+                  cap_v, use_pallas=pallas_hist.use_pallas(F, maxB, s_widest))
     w = w.astype(jnp.float32)
     y = y.astype(jnp.float32)
     if num is None:

@@ -143,12 +143,7 @@ class ScoringSession:
         self.spec = model.spec
         self._cl = cluster()
         self._arrays = self.forest.arrays()          # device-resident
-        F = self.spec.F
-        emax = max((len(e) for e in self.spec.edges), default=0) or 1
-        ep = np.full((F, emax), np.inf, np.float32)
-        for i, e in enumerate(self.spec.edges):
-            ep[i, : len(e)] = e
-        self._edges = jnp.asarray(ep)
+        self._edges = jnp.asarray(self.spec.padded_edges())
         self._is_cat = jnp.asarray(np.asarray(self.spec.is_cat, bool))
         if self.forest.init_class is not None:
             self._init = jnp.asarray(np.asarray(self.forest.init_class,
@@ -161,6 +156,7 @@ class ScoringSession:
         self._fn = _fused_score_fn(self.forest.max_depth,
                                    self.forest.nclasses,
                                    self.forest.per_class_trees)
+        self._fn_sharded = None          # lazy shard_map'd twin (sharded plane)
         self._traced: set = set()        # buckets activated so far
         # AOT executables per (bucket, local): dispatched explicitly so
         # compilation is observable (fused-compile counter) and cacheable
@@ -176,11 +172,28 @@ class ScoringSession:
     def _features(self, adapted, n: int) -> np.ndarray:
         """(n, F) float32 host matrix in training-column order: numerics
         as-is (NaN = NA), categoricals as their (already remapped) integer
-        codes — NA_CAT stays negative and bins to the NA bin."""
+        codes — NA_CAT stays negative and bins to the NA bin.
+
+        This is the HOST-GATHER fallback (degraded-local serving, ragged
+        layouts): every column round-trips through this process's host, so
+        the rows count as ``gathered`` on the data-plane counters. The
+        default serving path packs shard-locally via _sharded_view /
+        _margin_sharded and never lands here."""
+        from h2o3_tpu.core import sharded_frame
+
+        sharded_frame.note_gathered(n)
         X = np.empty((n, self.spec.F), np.float32)
         for i, name in enumerate(self.spec.names):
             X[:, i] = np.asarray(adapted.col(name).data)[:n]
         return X
+
+    def _sharded_view(self, adapted):
+        """ShardedFrame view of an adapted frame over the training feature
+        columns, or None when shard-local packing cannot hold (plane off,
+        host-resident column, ragged layout)."""
+        from h2o3_tpu.core.sharded_frame import ShardedFrame
+
+        return ShardedFrame.of(adapted, self.spec.names)
 
     def _bucket_for(self, m: int) -> int:
         for b in self.buckets:
@@ -218,14 +231,29 @@ class ScoringSession:
             self._model_ck = packer.model_checksum(self.forest, self.spec)
         return self._model_ck
 
-    def _executable_for(self, bucket: int, local: bool, call_args: tuple):
+    def _sharded_score_fn(self):
+        """Lazy shard_map'd twin of the fused program (compressed.py
+        _fused_score_sharded_fn) — same per-row core, margins computed per
+        addressable row shard under the named 'rows' axis."""
+        if self._fn_sharded is None:
+            from h2o3_tpu.models.tree.compressed import \
+                _fused_score_sharded_fn
+
+            self._fn_sharded = _fused_score_sharded_fn(
+                self.forest.max_depth, self.forest.nclasses,
+                self.forest.per_class_trees, self._cl.mesh)
+        return self._fn_sharded
+
+    def _executable_for(self, bucket: int, local: bool, call_args: tuple,
+                        sharded: bool = False):
         """AOT executable for one (bucket, placement) — in-memory first,
         then the persistent compile cache ($H2O_TPU_COMPILE_CACHE_DIR,
-        keyed by model checksum + bucket + backend fingerprint), and only
-        then an actual XLA compile (counted, and stored back for the next
-        process/restart). A warm restart therefore compiles zero fused
-        programs."""
-        key = (bucket, bool(local))
+        keyed by model checksum + bucket + variant + backend fingerprint),
+        and only then an actual XLA compile (counted, and stored back for
+        the next process/restart). A warm restart therefore compiles zero
+        fused programs. `sharded` selects the shard_map'd program family
+        (the sharded data plane's serving path)."""
+        key = (bucket, bool(local), bool(sharded))
         exe = self._exec.get(key)
         if exe is not None:
             return exe
@@ -238,10 +266,12 @@ class ScoringSession:
             # whole-forest hash for a key nobody will read
             ckey = compile_cache.cache_key(
                 self._model_checksum(), bucket,
-                variant="local" if local else "mesh")
+                variant=("local" if local
+                         else "sharded" if sharded else "mesh"))
             exe = compile_cache.load(ckey)
         if exe is None:
-            exe = self._fn.lower(*call_args).compile()
+            fn = self._sharded_score_fn() if sharded else self._fn
+            exe = fn.lower(*call_args).compile()
             compile_cache.note_compile()
             self.fused_compiles += 1
             if ckey is not None:
@@ -289,6 +319,51 @@ class ScoringSession:
             return np.zeros((0,) if K == 1 else (0, K), np.float32)
         return outs[0] if len(outs) == 1 else np.concatenate(outs)
 
+    def _margin_sharded(self, sf, n: int):
+        """Margins for a sharded-eligible adapted frame WITHOUT any host
+        round-trip: per chunk, ShardedFrame.pack_features builds the
+        (bucket, F) matrix from addressable shards and the shard_map'd
+        fused program scores it; the per-chunk row-sharded margins are
+        then assembled into ONE (padded_rows,) / (padded_rows, K) device
+        array (this reshard is the single gather of the serving path —
+        device-to-device, never through the coordinator host).
+
+        Bitwise contract: rows [0, n) equal the host-packed path's
+        margins; rows [n, padded_rows) are exactly 0.0, like
+        _raw_for_slice's pad — so the downstream margin→raw→frame math is
+        byte-identical between the two paths."""
+        import jax.numpy as jnp
+
+        maxb = self.buckets[-1]
+        P_rows = sf.padded_rows
+        outs: List[Any] = []
+        pos = 0
+        while pos < n:
+            m = min(maxb, n - pos)
+            bucket = self._bucket_for(m)
+            Xd = sf.pack_features(pos, n, bucket)
+            call_args = (Xd, self._edges, self._is_cat, self._init) + \
+                tuple(self._arrays)
+            out = self._executable_for(bucket, False, call_args,
+                                       sharded=True)(*call_args)
+            outs.append(out[:m])
+            pos += m
+        K = (self.forest.nclasses if (self.forest.nclasses > 2
+                                      or self.forest.per_class_trees)
+             else 1)
+        if not outs:
+            zero = jnp.zeros((P_rows,) if K == 1 else (P_rows, K),
+                             jnp.float32)
+            return self._cl.reshard_rows(zero)
+        cat = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+        if P_rows > n:
+            pad = ((0, P_rows - n),) + ((0, 0),) * (cat.ndim - 1)
+            cat = jnp.pad(cat, pad)
+        from h2o3_tpu.core import sharded_frame
+
+        sharded_frame.note_packed(n)
+        return self._cl.reshard_rows(cat)
+
     @property
     def traversal_compiles(self) -> int:
         return len(self._traced)
@@ -317,18 +392,38 @@ class ScoringSession:
         with_metrics)]. Returns [(prediction_frame, metrics_or_None)] in
         entry order; prediction frames are installed under dest_key.
 
-        Single-process: one fused bucketed dispatch over the concatenated
-        rows. Multi-process cloud: the entries run through the generic
-        predict path sequentially INSIDE the one op — followers replay the
-        identical program sequence (the fused path's host-side feature
-        packing cannot see non-addressable shards).
+        Default path (sharded data plane, single- AND multi-process):
+        per entry, ShardedFrame packs the feature matrix from this
+        process's addressable row shards, margins run under shard_map over
+        the named 'rows' axis, and one device-side reshard assembles the
+        prediction frame — no column ever stages on the coordinator host.
+        On a multi-process cloud every process executes the identical SPMD
+        program sequence inside the mirrored op (followers replay), so the
+        fused path no longer falls back to the generic predict there.
+        Entries the view cannot hold (host-resident columns, ragged
+        layouts, plane off) take the legacy host-packed dispatch —
+        coalesced into one bucketed program — or, multi-process, the
+        generic predict path.
+
+        Known trade-off: sharded entries dispatch per entry (pack +
+        score + reshard each), where the host path concatenated every
+        entry's rows into one margin dispatch. The per-entry work that
+        dominates small requests (adapt, margin→raw, frame install,
+        metrics) was per-entry on BOTH paths, and the sharded path drops
+        the per-column host round-trips (~60 ms each through the TPU
+        tunnel), but a many-small-entry flush now pays one fused dispatch
+        per entry instead of ~one per bucket chunk — device-side
+        coalescing of eligible entries is a recorded serving follow-up
+        (ROADMAP item 3 remainder).
 
         `local_only=True` is degraded-cloud serving: the followers are
         dead or stale, so no cross-process program may run. The fused
         host-packed path serves from this process alone — local-device
-        dispatch, never the global mesh — when every column is addressable
-        here; non-addressable shards raise CloudUnhealthyError (scoring
-        them NEEDS the dead peer)."""
+        dispatch, never the global mesh (the sharded path IS a mesh
+        program, so it is skipped) — when every column is addressable
+        here; non-addressable shards raise ShardUnavailableError (scoring
+        them NEEDS the dead peer). That raise is the exceptional path:
+        coordinator-addressable sharded frames serve."""
         import jax
 
         t0 = time.perf_counter()
@@ -344,26 +439,42 @@ class ScoringSession:
                             f"cloud degraded and frame {frame.key} has "
                             f"non-coordinator shards (column {nm!r})",
                             owners=_shard_owners(data))
-        if jax.process_count() > 1 and not local_only:
-            results = []
-            for frame, dest, with_metrics in entries:
-                pred = self.model.predict(frame, key=dest)
+        mp = jax.process_count() > 1
+        results: List[Any] = [None] * len(entries)
+        host_entries = []          # (idx, frame, adapted, n, dest, wm)
+        for i, (frame, dest, with_metrics) in enumerate(entries):
+            adapted = self.model.adapt_test(frame)
+            n = frame.nrows
+            sf = None if local_mp else self._sharded_view(adapted)
+            if sf is not None:
+                raw = self.model._margin_to_raw(self._margin_sharded(sf, n))
+                pred = self.model._raw_to_frame(raw, n, key=dest)
                 pred.install()
-                mm = self.model.model_performance(frame) if with_metrics \
+                mm = self.model._make_metrics(frame, raw) if with_metrics \
                     else None
-                results.append((pred, mm))
-            total_rows = sum(frame.nrows for frame, _, _ in entries)
-        else:
-            adapteds = [self.model.adapt_test(frame)
-                        for frame, _, _ in entries]
-            ns = [frame.nrows for frame, _, _ in entries]
+                results[i] = (pred, mm)
+            elif mp and not local_only:
+                # ineligible entry on a multi-process cloud: the generic
+                # path (device-side binning + traversal) keeps the program
+                # sequence mirrored without host packing. Reuse the one
+                # adaptation above — predict()/model_performance() would
+                # each re-adapt the frame (2-3x column transfers per
+                # request, on every process)
+                raw = self.model._predict_raw(adapted)
+                pred = self.model._raw_to_frame(raw, n, key=dest)
+                pred.install()
+                mm = self.model._make_metrics(frame, raw) if with_metrics \
+                    else None
+                results[i] = (pred, mm)
+            else:
+                host_entries.append((i, frame, adapted, n, dest,
+                                     with_metrics))
+        if host_entries:
             X = np.concatenate([self._features(a, n)
-                                for a, n in zip(adapteds, ns)]) \
-                if entries else np.zeros((0, self.spec.F), np.float32)
+                                for _, _, a, n, _, _ in host_entries])
             margins = self._margin_x(X, local=local_mp)
-            results = []
             off = 0
-            for (frame, dest, with_metrics), n in zip(entries, ns):
+            for i, frame, _a, n, dest, with_metrics in host_entries:
                 raw = self._raw_for_slice(margins[off: off + n], n,
                                           local=local_mp)
                 off += n
@@ -371,8 +482,8 @@ class ScoringSession:
                 pred.install()
                 mm = self.model._make_metrics(frame, raw) if with_metrics \
                     else None
-                results.append((pred, mm))
-            total_rows = sum(ns)
+                results[i] = (pred, mm)
+        total_rows = sum(frame.nrows for frame, _, _ in entries)
         ms = (time.perf_counter() - t0) * 1000
         self.stats.record_batch(len(entries), total_rows, ms)
         from h2o3_tpu.utils import timeline

@@ -84,6 +84,9 @@ _SMOKE_MODULES = {"test_core", "test_glm", "test_rapids", "test_java_mojo",
 # file order is kept within each cost class.
 _HEAVY_MODULES = [
     # many passing tests per second of training — earliest of the tail
+    # (test_sharded_frame trains small GBMs, so it rides the head of the
+    # heavy tail: the pure-host cheap modules still bank their dots first)
+    "test_sharded_frame",
     "test_job_resume", "test_trees", "test_checkpoint", "test_genmodel",
     "test_artifact", "test_mojo",
     "test_mojo_families", "test_explain", "test_ensemble",
@@ -96,9 +99,25 @@ _HEAVY_MODULES = [
 ]
 
 
+# individual tests whose cost class differs from their module's: the
+# consistency suite is millisecond text scans EXCEPT its behavioral
+# data-plane guard, which trains a tiny GBM — that one item rides with
+# the sharded suite at the head of the heavy tail instead of dragging
+# compile work into the cheap-first phase
+_HEAVY_ITEMS = {
+    "test_fused_paths_never_gather_columns_to_coordinator":
+        "test_sharded_frame",
+}
+
+
 def pytest_collection_modifyitems(config, items):
     for item in items:
         if item.module.__name__ in _SMOKE_MODULES:
             item.add_marker(pytest.mark.smoke)
     rank = {m: i for i, m in enumerate(_HEAVY_MODULES, start=1)}
-    items.sort(key=lambda item: rank.get(item.module.__name__, 0))
+
+    def key(item):
+        mod = _HEAVY_ITEMS.get(item.name, item.module.__name__)
+        return rank.get(mod, 0)
+
+    items.sort(key=key)

@@ -11,8 +11,14 @@
 3. Every ``H2O_TPU_*`` env knob the framework reads must appear in
    README.md — an undocumented knob is an operator trap (the recovery
    runbook promises the full surface).
+4. Sharded-data-plane invariant (ISSUE 7): no call site under
+   ``h2o3_tpu/`` may fetch a full column to the coordinator host inside
+   the fused scoring or tree input path — asserted behaviorally via the
+   ``gathered_rows`` counter staying 0 through a train + fused-score
+   smoke on the 8-device mesh (the one non-text guard here; it is the
+   counter the issue pins the invariant to).
 
-Pure text scans — no jax, no devices, milliseconds.
+Guards 1–3 are pure text scans — no jax, no devices, milliseconds.
 """
 
 import re
@@ -142,3 +148,47 @@ def test_pyproject_markers_match_test_usage():
     assert not unused, (
         f"marker(s) {sorted(unused)} are declared in pyproject.toml but "
         "never used under tests/ — drop them or mark the tests")
+
+
+def test_fused_paths_never_gather_columns_to_coordinator():
+    """ISSUE-7 guard: the fused scoring path and the tree-training input
+    path must build their inputs from addressable row shards in place.
+    Train a tiny GBM on the virtual 8-device mesh and score it through
+    the fused session: the per-process ``gathered_rows`` counter (the one
+    ``GET /3/ScoringMetrics`` serves under ``data_plane``) must not move,
+    while ``packed_rows`` covers both the training bin pack and the
+    scored request. A regression that re-introduces a coordinator column
+    fetch anywhere under either path trips this immediately."""
+    import numpy as np
+
+    import h2o3_tpu
+    from h2o3_tpu import scoring
+    from h2o3_tpu.core import sharded_frame
+    from h2o3_tpu.core.frame import Column, Frame
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    h2o3_tpu.init()
+    rng = np.random.default_rng(77)
+    n = 512
+    fr = Frame()
+    x = rng.standard_normal(n)
+    fr.add("x1", Column.from_numpy(x))
+    fr.add("g", Column.from_numpy(
+        np.array(["a", "b"])[rng.integers(0, 2, n)], ctype="enum"))
+    fr.add("y", Column.from_numpy(
+        np.where(rng.random(n) < 1 / (1 + np.exp(-x)), "Y", "N"),
+        ctype="enum"))
+    before = sharded_frame.counters()
+    model = GBM(ntrees=2, max_depth=2, seed=7).train(
+        y="y", training_frame=fr)
+    sfr = Frame()
+    sfr.add("x1", Column.from_numpy(rng.standard_normal(100)))
+    sfr.add("g", Column.from_numpy(
+        np.array(["a", "b"])[rng.integers(0, 2, 100)], ctype="enum"))
+    scoring.ScoringSession(model).predict(sfr)
+    after = sharded_frame.counters()
+    assert after["gathered_rows"] == before["gathered_rows"], (
+        "a fused scoring / tree input call site pulled full columns to "
+        "the coordinator host (gathered_rows moved) — the sharded data "
+        "plane contract is broken")
+    assert after["packed_rows"] >= before["packed_rows"] + n + 100
