@@ -79,8 +79,55 @@ def bench_glm(n_rows: int = 1_000_000, p: int = 32, iters: int = 20) -> float:
     return run_glm(n_rows=n_rows, p=p, iters=iters)[0]
 
 
+def _arm_probe_autopsy() -> None:
+    """STDLIB-ONLY flight-dump timer for the probe stage: the probe's
+    failure mode is `import jax` / backend init wedging, so the arming
+    must not touch h2o3_tpu (whose import pulls jax). The dump captures
+    every thread's stack + the newest imported modules — i.e. exactly
+    WHERE the wedge sits — into a flight record the parent folds into
+    the BENCH_STAGE tail."""
+    import threading
+    import traceback
+
+    try:
+        t = float(os.environ.get("H2O3_BENCH_STAGE_TIMEOUT_S") or 0)
+    except ValueError:
+        return
+    if t <= 6:
+        return
+
+    def dump():
+        try:
+            frames = {str(tid): traceback.format_stack(frame)[-8:]
+                      for tid, frame in sys._current_frames().items()}
+            d = os.environ.get("H2O_TPU_OBS_FLIGHT_DIR") or os.path.join(
+                os.environ.get("H2O_TPU_ICE_ROOT", "/tmp/h2o3_tpu"),
+                "flight")
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d, f"flight_{time.strftime('%Y%m%d_%H%M%S')}"
+                   f"_bench_probe_timeout_{os.getpid()}.json")
+            tmp = f"{path}.part"
+            with open(tmp, "w") as f:
+                json.dump({"reason": "bench_probe_timeout",
+                           "ts": time.time(), "pid": os.getpid(),
+                           "thread_stacks": frames,
+                           "modules_tail": list(sys.modules)[-40:]}, f)
+            os.replace(tmp, path)
+            print("H2O3_FLIGHT_JSON " + json.dumps(
+                {"flight_record": path, "timeline_tail": []}),
+                file=sys.stderr, flush=True)
+        except Exception:   # noqa: BLE001 — the autopsy must never be
+            pass            # the thing that kills a healthy probe
+
+    tm = threading.Timer(max(t - 5.0, 1.0), dump)
+    tm.daemon = True
+    tm.start()
+
+
 def bench_probe() -> float:
     """Stage 0: is the accelerator reachable at all? Prints platform info."""
+    _arm_probe_autopsy()       # leave a corpse if the tunnel wedges here
     t0 = time.perf_counter()
     import jax
     import jax.numpy as jnp
@@ -109,27 +156,53 @@ def _parse_result(stdout: str):
     return out or None
 
 
+def _autopsy(stderr) -> dict:
+    """Bench autopsy (ISSUE 8): a dying stage's child arms a timer that
+    dumps a flight record and prints ONE ``H2O3_FLIGHT_JSON {...}`` line
+    to stderr just before the parent's kill lands. Parse it into the
+    BENCH_STAGE tail — the flight-record path plus the last 20 timeline
+    events — so a dark round says WHY the device stage died instead of
+    just "timeout"."""
+    if isinstance(stderr, bytes):
+        stderr = stderr.decode(errors="replace")
+    for ln in reversed((stderr or "").splitlines()):
+        if ln.startswith("H2O3_FLIGHT_JSON "):
+            try:
+                rec = json.loads(ln[len("H2O3_FLIGHT_JSON "):])
+            except ValueError:
+                break
+            return {"flight_record": rec.get("flight_record"),
+                    "timeline_tail": (rec.get("timeline_tail") or [])[-20:]}
+    return {}
+
+
 def _stage(name, cmd, timeout_s, env_extra=None):
     """Run one bench stage in a subprocess with a hard timeout. Returns
     (value, metric) or None on timeout / crash / missing result line.
-    Records the outcome — auxiliary metrics included — to
-    BENCH_STAGES.json either way."""
+    Records the outcome — auxiliary metrics and any flight-record autopsy
+    included — to BENCH_STAGES.json either way."""
     env = dict(os.environ)
     if env_extra:
         env.update(env_extra)
+    # the child arms its own flight-dump timer a few seconds short of this
+    # deadline (h2o3_tpu/bench.py _arm_stage_autopsy) — subprocess.run's
+    # timeout kill is SIGKILL, so the corpse must be written BEFORE it
+    env["H2O3_BENCH_STAGE_TIMEOUT_S"] = str(timeout_s)
     t0 = time.perf_counter()
     try:
         proc = subprocess.run(cmd, capture_output=True, timeout=timeout_s,
                               text=True, cwd=REPO, env=env)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as te:
         _record(name, ok=False, error=f"timeout after {timeout_s}s",
-                secs=round(time.perf_counter() - t0, 1))
+                secs=round(time.perf_counter() - t0, 1),
+                **_autopsy(te.stderr))
         return None
     secs = round(time.perf_counter() - t0, 1)
     got = _parse_result(proc.stdout)
     if got is None:
         _record(name, ok=False, rc=proc.returncode, secs=secs,
-                error=(proc.stderr or "")[-1500:])
+                error=(proc.stderr or "")[-1500:],
+                **_autopsy(proc.stderr))
         return None
     value, metric = got[-1]
     extras = {m: round(v, 3) for v, m in got[:-1]}

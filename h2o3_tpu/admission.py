@@ -123,23 +123,30 @@ class AdmissionController:
                 deadline = queue_timeout_s()
                 import time as _t
 
+                from h2o3_tpu.obs import tracing
+
                 t0 = _t.monotonic()
-                # FIFO: only the queue head may take a freed slot
-                while not (g.inflight < limit and g.queue
-                           and g.queue[0] is ticket):
-                    left = deadline - (_t.monotonic() - t0)
-                    if left <= 0:
-                        g.queue.remove(ticket)
-                        g.cond.notify_all()
-                        with self._lock:
-                            self.timed_out += 1
-                        raise AdmissionRejected(
-                            f"model {model_key!r}: queued request expired "
-                            f"after {deadline:.0f}s without a free slot",
-                            status=503,
-                            retry_after_s=self._retry_after(g, limit))
-                    g.cond.wait(timeout=left)
-                g.queue.popleft()
+                # the admission queue wait lands in the request's span
+                # tree (distinct from the micro-batcher's queue_wait —
+                # this one is the overload gate, that one the coalescing
+                # window); inert without an active trace
+                with tracing.span("admission_wait", model=str(model_key)):
+                    # FIFO: only the queue head may take a freed slot
+                    while not (g.inflight < limit and g.queue
+                               and g.queue[0] is ticket):
+                        left = deadline - (_t.monotonic() - t0)
+                        if left <= 0:
+                            g.queue.remove(ticket)
+                            g.cond.notify_all()
+                            with self._lock:
+                                self.timed_out += 1
+                            raise AdmissionRejected(
+                                f"model {model_key!r}: queued request "
+                                f"expired after {deadline:.0f}s without a "
+                                f"free slot", status=503,
+                                retry_after_s=self._retry_after(g, limit))
+                        g.cond.wait(timeout=left)
+                    g.queue.popleft()
             g.inflight += 1
             with self._lock:
                 self.admitted += 1

@@ -778,6 +778,122 @@ def h_scoring_metrics(ctx: Ctx):
             "data_plane": sharded_frame.counters()}
 
 
+def h_metrics(ctx: Ctx):
+    """GET /3/Metrics — CLUSTER-wide metrics: the coordinator merges its
+    live registry snapshot with every other process's KV-published one
+    (counters/histograms sum; gauges aggregate by their declared agg).
+    Default body is Prometheus text exposition (format 0.0.4) so a stock
+    Prometheus scrape config points straight at this route;
+    ``?format=json`` returns the structured series instead."""
+    from h2o3_tpu.obs import metrics as obs_metrics
+
+    series = obs_metrics.cluster_aggregate()
+    fmt = str(ctx.arg("format", "") or "").lower()
+    if fmt == "json":
+        return {"__meta": S.meta("MetricsV3"), "series": series,
+                "series_count": len(series)}
+    return RawReply(obs_metrics.prometheus_text(series).encode(),
+                    "text/plain; version=0.0.4; charset=utf-8")
+
+
+def h_trace_list(ctx: Ctx):
+    """GET /3/Trace — newest trace ids with root span names."""
+    from h2o3_tpu.obs import tracing
+
+    n = int(ctx.arg("count", 50) or 50)
+    return {"__meta": S.meta("TraceV3"),
+            "traces": tracing.recent_traces(max(min(n, 500), 1))}
+
+
+def h_trace_get(ctx: Ctx):
+    """GET /3/Trace/{trace_id} — one request's span tree: local spans plus
+    any follower-side replay/ack spans published through the cloud KV."""
+    from h2o3_tpu.obs import tracing
+
+    tid = ctx.params["trace_id"]
+    spans = tracing.get_trace(tid)
+    if not spans:
+        raise ApiError(f"trace {tid!r} not found (bounded store — it may "
+                       "have been evicted)", 404)
+    return S.trace_v3(tid, spans, tracing.span_tree(spans))
+
+
+def h_flight_list(ctx: Ctx):
+    """GET /3/FlightRecords — newest-first postmortem records under the
+    flight dir ($H2O_TPU_OBS_FLIGHT_DIR)."""
+    from h2o3_tpu.obs import flight
+
+    return S.flight_records_v3(flight.list_records())
+
+
+def h_flight_get(ctx: Ctx):
+    """GET /3/FlightRecords/{name} — one record's raw JSON (the name
+    pattern check is the path-traversal gate)."""
+    from h2o3_tpu.obs import flight
+
+    data = flight.read_record(ctx.params["name"])
+    if data is None:
+        raise ApiError(f"flight record {ctx.params['name']!r} not found",
+                       404)
+    return RawReply(data, "application/json")
+
+
+# XLA profiler capture state: one capture at a time per process
+# (jax.profiler itself enforces this; the lock keeps our answer coherent)
+_PROFILER_LOCK = threading.Lock()
+_PROFILER = {"dir": None, "t0": None}
+
+
+def h_profiler_start(ctx: Ctx):
+    """POST /3/Profiler/start — begin an XLA profiler capture
+    (``jax.profiler.start_trace`` through compat.py). The resulting trace
+    dir is viewable with xprof/tensorboard. 409 when already capturing."""
+    from h2o3_tpu import compat
+    from h2o3_tpu.utils import timeline
+
+    log_dir = str(ctx.arg("dir", "") or "").strip('"')
+    if not log_dir:
+        ice = os.environ.get("H2O_TPU_ICE_ROOT", "/tmp/h2o3_tpu")
+        log_dir = os.path.join(ice, "profiler",
+                               time.strftime("%Y%m%d_%H%M%S"))
+    with _PROFILER_LOCK:
+        if _PROFILER["dir"] is not None:
+            raise ApiError(f"a profiler capture is already running "
+                           f"(dir {_PROFILER['dir']!r}) — stop it first",
+                           409)
+        try:
+            compat.profiler_start(log_dir)
+        except Exception as e:   # noqa: BLE001 — backend refusal -> 400
+            raise ApiError(f"profiler start failed: {e}", 400) from None
+        _PROFILER["dir"] = log_dir
+        _PROFILER["t0"] = time.perf_counter()
+    timeline.record("profiler", "start", dir=log_dir)
+    return {"__meta": S.meta("ProfilerV3"), "status": "capturing",
+            "dir": log_dir}
+
+
+def h_profiler_stop(ctx: Ctx):
+    """POST /3/Profiler/stop — end the capture; returns the trace dir and
+    capture duration. 400 when nothing is capturing."""
+    from h2o3_tpu import compat
+    from h2o3_tpu.utils import timeline
+
+    with _PROFILER_LOCK:
+        if _PROFILER["dir"] is None:
+            raise ApiError("no profiler capture is running", 400)
+        log_dir, t0 = _PROFILER["dir"], _PROFILER["t0"]
+        try:
+            compat.profiler_stop()
+        except Exception as e:   # noqa: BLE001
+            raise ApiError(f"profiler stop failed: {e}", 400) from None
+        finally:
+            _PROFILER["dir"] = _PROFILER["t0"] = None
+    ms = (time.perf_counter() - t0) * 1000
+    timeline.record("profiler", "stop", ms=ms, dir=log_dir)
+    return {"__meta": S.meta("ProfilerV3"), "status": "stopped",
+            "dir": log_dir, "captured_ms": round(ms, 3)}
+
+
 def h_watermeter_cpu(ctx: Ctx):
     """GET /3/WaterMeterCpuTicks/{nodeidx} — per-node CPU ticks
     (water/util/WaterMeterCpuTicks); /proc-based on linux."""
@@ -1250,6 +1366,18 @@ EXTRA_ROUTES = [
      "Supervised cloud health state machine"),
     ("GET", "/3/ScoringMetrics", h_scoring_metrics,
      "Serving fast-path scoring metrics"),
+    ("GET", "/3/Metrics", h_metrics,
+     "Cluster-wide metrics (Prometheus text / JSON)"),
+    ("GET", "/3/Trace", h_trace_list, "Recent trace ids"),
+    ("GET", "/3/Trace/{trace_id}", h_trace_get, "One request's span tree"),
+    ("GET", "/3/FlightRecords", h_flight_list,
+     "List flight-recorder postmortems"),
+    ("GET", "/3/FlightRecords/{name}", h_flight_get,
+     "Fetch one flight record"),
+    ("POST", "/3/Profiler/start", h_profiler_start,
+     "Start an XLA profiler capture"),
+    ("POST", "/3/Profiler/stop", h_profiler_stop,
+     "Stop the XLA profiler capture"),
     ("GET", "/3/WaterMeterCpuTicks/{nodeidx}", h_watermeter_cpu,
      "CPU tick counters"),
     ("GET", "/3/WaterMeterIo", h_watermeter_io, "IO counters"),

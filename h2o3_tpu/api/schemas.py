@@ -43,6 +43,19 @@ def key_ref(name: Optional[str], ktype: str = "Key<Frame>") -> Optional[dict]:
             "URL": f"/3/{'Frames' if 'Frame' in ktype else 'Models'}/{name}"}
 
 
+def trace_v3(trace_id: str, spans: List[dict], tree: List[dict]) -> dict:
+    """One trace's span tree (GET /3/Trace/{id}): the flat start-ordered
+    span list plus the parent-nested tree — clients graph either."""
+    return {"__meta": meta("TraceV3"), "trace_id": trace_id,
+            "span_count": len(spans), "spans": spans, "tree": tree}
+
+
+def flight_records_v3(records: List[dict]) -> dict:
+    """Flight-record listing (GET /3/FlightRecords)."""
+    return {"__meta": meta("FlightRecordsV3"), "records": records,
+            "count": len(records)}
+
+
 def artifact_v3(info: dict, **extra) -> dict:
     """AOT-artifact DTO (the /3/Artifacts family): a validated manifest
     summary — never raw manifest internals — plus route-specific fields

@@ -21,6 +21,7 @@ Contract notes (verified against h2o-py):
 
 from __future__ import annotations
 
+import contextlib
 import io
 import json
 import math
@@ -38,6 +39,8 @@ import numpy as np
 
 from h2o3_tpu.admission import AdmissionRejected
 from h2o3_tpu.api import schemas as S
+from h2o3_tpu.obs import metrics as obs_metrics
+from h2o3_tpu.obs import tracing
 from h2o3_tpu.core.dkv import DKV, Key
 from h2o3_tpu.core.failure import CloudUnhealthyError
 from h2o3_tpu.core.frame import Frame
@@ -1544,6 +1547,7 @@ _SCHEMA_REGISTRY = [
     "ModelMetricsRegressionV3", "ModelMetricsClusteringV3",
     "TwoDimTableV3", "KeyV3", "H2OErrorV3", "H2OModelBuilderErrorV3",
     "TimelineV3", "LogsV3", "AboutV3", "ArtifactV3",
+    "MetricsV3", "TraceV3", "FlightRecordsV3", "ProfilerV3",
 ]
 
 
@@ -1761,6 +1765,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        tid = tracing.current_trace_id()
+        if tid:
+            # hand the client its span tree's address (GET /3/Trace/{id})
+            self.send_header("X-H2O3-Trace-Id", tid)
         for k, v in (extra or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -1818,10 +1826,38 @@ class _Handler(BaseHTTPRequestHandler):
             hashlib.sha256(pw.encode()).hexdigest(), want)
 
     # -- dispatch ---------------------------------------------------------
+
+    # routes that poll/scrape (metrics scrapers, job pollers, the
+    # observability surfaces themselves): tracing them would evict the
+    # interesting traces from the bounded store
+    _UNTRACED = ("/3/Metrics", "/3/Trace", "/3/FlightRecords", "/3/Ping",
+                 "/3/Timeline", "/3/Jobs", "/3/CloudStatus")
+
     def _handle(self):
         t0 = time.time()
-        status = 200
         u = urlparse(self.path)
+        traced = not any(u.path.startswith(p) for p in self._UNTRACED)
+        span_cm = (tracing.root_span("ingress", method=self.command,
+                                     path=u.path)
+                   if traced else contextlib.nullcontext())
+        try:
+            with span_cm:
+                try:
+                    return self._dispatch(u)
+                finally:
+                    if traced:
+                        span_cm.set(status=self._last_status)
+        finally:
+            dt = time.time() - t0
+            _timeline_record(self.command, u.path, self._last_status, dt * 1000)
+            obs_metrics.inc("h2o3_rest_requests_total",
+                            status=f"{self._last_status // 100}xx")
+            obs_metrics.observe("h2o3_rest_request_seconds", dt)
+
+    _last_status = 200
+
+    def _dispatch(self, u):
+        status = 200
         try:
             # the body must ALWAYS be drained FIRST — before auth/route
             # early returns: h2o-py sends form bodies on GET too (e.g. GET
@@ -1883,7 +1919,7 @@ class _Handler(BaseHTTPRequestHandler):
                 f"{type(e).__name__}: {e}", 500,
                 stack=traceback.format_exc().splitlines()[-12:])
         finally:
-            _timeline_record(self.command, u.path, status, (time.time() - t0) * 1000)
+            self._last_status = status
 
     do_GET = do_POST = do_DELETE = do_PUT = do_HEAD = _handle
 
@@ -1990,10 +2026,14 @@ def start_server(port: int = 54321, auth_file: Optional[str] = None,
                  host: Optional[str] = None,
                  ssl_certfile: Optional[str] = None,
                  ssl_keyfile: Optional[str] = None) -> ApiServer:
+    from h2o3_tpu.obs import flight
     from h2o3_tpu.parallel import distributed as D
     from h2o3_tpu.parallel import oplog
 
     oplog.REST_SERVING = True     # handler-thread collectives need op turns
+    # fatal-signal flight hooks: an externally killed server leaves a
+    # postmortem (H2O_TPU_OBS_SIGNALS=0 disables; no-op off-main-thread)
+    flight.install_signal_hooks()
     srv = ApiServer(port, auth_file=auth_file, host=host,
                     ssl_certfile=ssl_certfile,
                     ssl_keyfile=ssl_keyfile).start()

@@ -27,7 +27,7 @@ from typing import Any, Optional
 
 _LOCK = threading.Lock()
 _STATS = {"compiles": 0, "disk_hits": 0, "disk_misses": 0, "stores": 0,
-          "load_failures": 0}
+          "load_failures": 0, "compile_ms_total": 0.0}
 
 
 def cache_dir() -> Optional[str]:
@@ -111,10 +111,13 @@ def store(key: str, compiled) -> bool:
     return True
 
 
-def note_compile() -> None:
-    """Record one actual fused-program XLA compilation."""
+def note_compile(ms: float = 0.0) -> None:
+    """Record one actual fused-program XLA compilation (and, when the
+    caller timed it, the wall milliseconds it cost — the compile-seconds
+    series on /3/Metrics that makes cold-start spikes visible)."""
     with _LOCK:
         _STATS["compiles"] += 1
+        _STATS["compile_ms_total"] += float(ms)
 
 
 def fused_compile_count() -> int:

@@ -13,6 +13,47 @@ import time
 import numpy as np
 
 
+def arm_stage_autopsy() -> bool:
+    """Bench autopsy (ISSUE 8): when the parent bench driver set
+    ``H2O3_BENCH_STAGE_TIMEOUT_S``, arm a daemon timer that — a few
+    seconds before the parent's SIGKILL lands — dumps a flight record
+    (timeline ring + metrics snapshot) and prints one
+    ``H2O3_FLIGHT_JSON {...}`` line to stderr. The parent folds the
+    record path + the last 20 timeline events into the stage's
+    BENCH_STAGE JSON tail, so a timed-out device stage finally says WHERE
+    it died (ROADMAP open item 2's missing evidence). Returns True when a
+    timer was armed."""
+    import json as _json
+    import os as _os
+    import sys as _sys
+    import threading as _th
+
+    try:
+        t = float(_os.environ.get("H2O3_BENCH_STAGE_TIMEOUT_S") or 0)
+    except ValueError:
+        return False
+    if t <= 6:
+        return False
+
+    def dump():
+        try:
+            from h2o3_tpu.obs import flight as _fl
+            from h2o3_tpu.utils import timeline as _tl
+
+            path = _fl.record_flight("bench_stage_timeout",
+                                     extra={"stage_timeout_s": t})
+            print("H2O3_FLIGHT_JSON " + _json.dumps(
+                {"flight_record": path, "timeline_tail": _tl.events(20)},
+                default=str), file=_sys.stderr, flush=True)
+        except Exception:   # noqa: BLE001 — the autopsy must never be the
+            pass            # thing that kills a healthy stage
+
+    tm = _th.Timer(max(t - 5.0, 1.0), dump)
+    tm.daemon = True
+    tm.start()
+    return True
+
+
 def run_flagship(n_rows: int = 1_000_000, n_num: int = 8, n_cat: int = 2,
                  ntrees: int = 20, max_depth: int = 5):
     import h2o3_tpu
@@ -346,6 +387,7 @@ if __name__ == "__main__":
     # secondary metric runs as its OWN watchdog stage (H2O3_BENCH_ONLY=…)
     import os
 
+    arm_stage_autopsy()      # dying stages leave a flight record to read
     mode = os.environ.get("H2O3_BENCH_ONLY", "")
     if mode == "profile":
         # one profile artifact per round (VERDICT r4 item 3): an XLA trace

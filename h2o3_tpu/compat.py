@@ -71,6 +71,24 @@ def deserialize_compiled(payload, in_tree, out_tree):
     return se.deserialize_and_load(payload, in_tree, out_tree)
 
 
+def profiler_start(log_dir: str) -> None:
+    """``jax.profiler.start_trace`` across jax releases (the API predates
+    0.4 but its kwargs have shifted): positional log_dir only, which every
+    supported release accepts. Raises when a capture is already running —
+    the REST layer maps that to a clean 409."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+
+
+def profiler_stop() -> None:
+    """``jax.profiler.stop_trace`` — raises when no capture is running
+    (mapped to a clean 400 at the REST layer)."""
+    import jax
+
+    jax.profiler.stop_trace()
+
+
 def compile_stablehlo(text: str):
     """Portable lowering fallback: compile StableHLO module text through the
     local XLA client. Returns an executable whose ``.execute([arrays])``

@@ -237,16 +237,25 @@ class ShardedFrame:
         the columns' addressable shards; the host never sees a column."""
         import jax.numpy as jnp
 
+        from h2o3_tpu.obs import tracing
+
         fn = _pack_features_fn(int(bucket), self.padded_rows,
                                tuple(str(d.dtype) for d in self._datas),
                                self._cl.mesh)
-        return fn(jnp.int32(pos), jnp.int32(n), *self._datas)
+        # host-side dispatch wall time only — the packed matrix stays
+        # device-resident and no sync is added (span is inert without an
+        # active trace)
+        with tracing.span("pack", bucket=int(bucket), rows=int(n),
+                          path="sharded"):
+            return fn(jnp.int32(pos), jnp.int32(n), *self._datas)
 
     def pack_binned(self, spec):
         """(padded_rows, F) integer bin matrix for tree training, fused
         and row-sharded (see _pack_binned_fn). Counts the frame's logical
         rows as packed."""
         import jax.numpy as jnp
+
+        from h2o3_tpu.obs import tracing
 
         max_bins = int(spec.nbins.max()) if len(spec.nbins) else 1
         out_dtype = ("uint8" if max_bins <= 256
@@ -257,7 +266,9 @@ class ShardedFrame:
                              tuple(bool(c) for c in spec.is_cat),
                              out_dtype, self._cl.mesh)
         note_packed(int(self.frame.nrows))
-        return fn(jnp.asarray(spec.padded_edges()), *self._datas)
+        with tracing.span("pack", rows=int(self.frame.nrows),
+                          path="binned"):
+            return fn(jnp.asarray(spec.padded_edges()), *self._datas)
 
     def __repr__(self) -> str:
         return (f"<ShardedFrame {getattr(self.frame, 'key', '?')} "

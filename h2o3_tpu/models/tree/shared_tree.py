@@ -528,8 +528,13 @@ class SharedTree(ModelBuilder):
         jp_every = self._job_ckpt_every()
         from h2o3_tpu.core.failure import faultpoint
 
+        from h2o3_tpu.obs import metrics as obs_metrics
+        from h2o3_tpu.utils import timeline
+
+        profile = timeline.profiling_enabled()
         for t in range(t_start, ntrees):
             faultpoint("tree.fit_tree")     # chaos hook (core/failure.py)
+            t_tree0 = time.perf_counter()
             z, w_t, num_r, den_r, _mask = pre(y, f, w, root_key,
                                               np.int32(t), sample_rate)
             feat_mask_fn = self._feat_mask_fn(rng, spec)
@@ -539,6 +544,15 @@ class SharedTree(ModelBuilder):
                 min_split_improvement=msi, num=num_r, den=den_r,
                 feat_masks=masks)
             gamma, f = post(leaf4, row_leaf, f, self._tree_lr(t))
+            obs_metrics.inc("h2o3_tree_trees_built_total")
+            if profile:
+                # per-tree device wall time: the sync is the documented
+                # H2O_TPU_PROFILE trade-off (never paid by default — the
+                # async dispatch pipeline stays sync-free otherwise)
+                f.block_until_ready()
+                timeline.record("tree", f"tree_{t}",
+                                ms=(time.perf_counter() - t_tree0) * 1000,
+                                depth=max_depth, rows=N)
             packs.append(stash_packed(packed, max_depth))
             leaf_vals.append(gamma)
             leaf_wys.append(leaf4[:, :2])
@@ -679,7 +693,12 @@ class SharedTree(ModelBuilder):
             if rs.get("rng_state") is not None:
                 rng.bit_generator.state = rs["rng_state"]
         jp_every = self._job_ckpt_every()
+        from h2o3_tpu.obs import metrics as obs_metrics
+        from h2o3_tpu.utils import timeline
+
+        profile = timeline.profiling_enabled()
         for t in range(t_start, ntrees):
+            t_tree0 = time.perf_counter()
             feat_mask_fn = self._feat_mask_fn(rng, spec)
             masks = build_feat_masks(max_depth, feat_mask_fn, spec.F, maxB)
             for k in range(K):
@@ -698,10 +717,17 @@ class SharedTree(ModelBuilder):
                 leaf_vals.append(gamma)
                 leaf_wys.append(leaf4[:, :2])
                 tree_class.append(k)
+                obs_metrics.inc("h2o3_tree_trees_built_total")
                 if f_valid is not None:
                     f_valid = f_valid.at[:, k].add(
                         apply_packed(vs["binned"], packed, gamma,
                                      max_depth, maxB))
+            if profile:
+                # same H2O_TPU_PROFILE-only sync as the single-class loop
+                f.block_until_ready()
+                timeline.record("tree", f"iter_{t}",
+                                ms=(time.perf_counter() - t_tree0) * 1000,
+                                depth=max_depth, classes=K)
             if self._should_score(t, ntrees):
                 ll = float(jnp.sum(-w * jnp.log(jnp.maximum(
                     jax.nn.softmax(f, axis=-1)[jnp.arange(N), yi], 1e-15))) /

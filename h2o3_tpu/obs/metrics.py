@@ -1,0 +1,578 @@
+"""Process-wide metrics registry + cluster-wide aggregation.
+
+Reference: H2O-3's WaterMeter family (water/util/WaterMeterCpuTicks etc.)
+exposes per-node counters over REST; the Gemma-on-TPU serving comparison
+(PAPERS.md) makes the case that serving-tier decisions stand or fall on
+these series. This module gives the reproduction one registry every
+subsystem's ad-hoc counters re-register onto, and one cluster-wide
+``GET /3/Metrics`` the coordinator serves in both Prometheus text
+exposition (``text/plain; version=0.0.4``) and JSON.
+
+Design:
+
+- **One registration site.** Every metric is registered exactly once, in
+  :func:`_install_default_metrics` below — names must match
+  ``^h2o3_[a-z0-9_]+$`` (tests/test_consistency.py guards both
+  properties). Producers either increment by name (:func:`inc`,
+  :func:`observe`) or are read at snapshot time through a collector
+  callback (the existing counters in scoring.py, admission.py,
+  artifact/compile_cache.py, core/sharded_frame.py, parallel/oplog.py
+  stay the source of truth; the callbacks lazily import them so this
+  module never pulls the heavy stack at import).
+- **Bounded label sets.** A metric stores at most ``_LABEL_CAP`` distinct
+  label-value tuples; overflow lands on a single ``{"overflow": "true"}``
+  sample so a cardinality bug degrades one series, not the scrape.
+- **Cluster aggregation through the KV.** Every process publishes its
+  snapshot under ``obs/metrics/{proc}`` (follower replay loop + watchdog
+  ticks keep it fresh, throttled by ``H2O_TPU_OBS_PUBLISH_S``); the
+  coordinator merges its own LIVE snapshot with the other processes'
+  published ones — counters and histograms sum, gauges aggregate by
+  their declared ``agg`` ("sum" default, "max" for e.g. uptime).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+NAME_RE = re.compile(r"^h2o3_[a-z0-9_]+$")
+
+_LABEL_CAP = 32           # distinct label tuples per metric
+_OVERFLOW_LABELS = (("overflow", "true"),)
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                    1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def _publish_interval_s() -> float:
+    try:
+        return max(float(os.environ.get("H2O_TPU_OBS_PUBLISH_S", "2")), 0.0)
+    except ValueError:
+        return 2.0
+
+
+class Metric:
+    """One registered series: a direct counter/gauge (incremented /set by
+    name), a histogram, or a callback-collected series whose values are
+    read from their owning module at snapshot time."""
+
+    __slots__ = ("name", "mtype", "help", "agg", "labels", "buckets",
+                 "_values", "_hist", "_fn", "_lock")
+
+    def __init__(self, name: str, mtype: str, help_: str, agg: str = "sum",
+                 fn: Optional[Callable] = None,
+                 buckets: Tuple[float, ...] = _DEFAULT_BUCKETS):
+        if not NAME_RE.match(name):
+            raise ValueError(f"metric name {name!r} must match "
+                             f"{NAME_RE.pattern}")
+        self.name = name
+        self.mtype = mtype           # counter | gauge | histogram
+        self.help = help_
+        self.agg = agg               # gauges: sum | max
+        self.buckets = tuple(sorted(buckets))
+        self._values: Dict[tuple, float] = {}
+        self._hist: Dict[tuple, List] = {}   # labels -> [counts..., sum, n]
+        self._fn = fn
+        self._lock = threading.Lock()
+
+    def _label_key(self, labels: Dict[str, str], store) -> tuple:
+        key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        if key not in store and len(store) >= _LABEL_CAP:
+            return _OVERFLOW_LABELS
+        return key
+
+    def inc(self, n: float = 1.0, **labels) -> None:
+        with self._lock:
+            key = self._label_key(labels, self._values)
+            self._values[key] = self._values.get(key, 0.0) + float(n)
+
+    def set(self, v: float, **labels) -> None:
+        with self._lock:
+            key = self._label_key(labels, self._values)
+            self._values[key] = float(v)
+
+    def observe(self, v: float, **labels) -> None:
+        v = float(v)
+        with self._lock:
+            key = self._label_key(labels, self._hist)
+            h = self._hist.get(key)
+            if h is None:
+                h = self._hist[key] = [0] * len(self.buckets) + [0.0, 0]
+            for i, le in enumerate(self.buckets):
+                if v <= le:
+                    h[i] += 1
+            h[-2] += v
+            h[-1] += 1
+
+    def snapshot(self) -> dict:
+        out = {"name": self.name, "type": self.mtype, "help": self.help,
+               "agg": self.agg}
+        if self.mtype == "histogram":
+            with self._lock:
+                out["buckets"] = list(self.buckets)
+                out["samples"] = [
+                    {"labels": dict(k), "bucket_counts": list(h[:-2]),
+                     "sum": h[-2], "count": h[-1]}
+                    for k, h in self._hist.items()]
+            return out
+        samples: List[dict] = []
+        if self._fn is not None:
+            try:
+                got = self._fn()
+            except Exception:   # noqa: BLE001 — one broken collector must
+                got = None      # never break the whole scrape
+            if isinstance(got, dict):
+                samples = [{"labels": dict(k) if isinstance(k, tuple) else {},
+                            "value": float(v)} for k, v in got.items()]
+            elif got is not None:
+                samples = [{"labels": {}, "value": float(got)}]
+        else:
+            with self._lock:
+                samples = [{"labels": dict(k), "value": v}
+                           for k, v in self._values.items()]
+            if not samples and self.mtype in ("counter", "gauge"):
+                samples = [{"labels": {}, "value": 0.0}]
+        out["samples"] = samples
+        return out
+
+
+class Registry:
+    """Named metrics; registering the same name twice raises (the
+    consistency suite additionally guards the source for drift)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, Metric] = {}
+
+    def _register(self, m: Metric) -> Metric:
+        with self._lock:
+            if m.name in self._metrics:
+                raise ValueError(f"metric {m.name!r} is already registered")
+            self._metrics[m.name] = m
+        return m
+
+    def counter(self, name: str, help_: str) -> Metric:
+        return self._register(Metric(name, "counter", help_))
+
+    def gauge(self, name: str, help_: str, agg: str = "sum") -> Metric:
+        return self._register(Metric(name, "gauge", help_, agg=agg))
+
+    def histogram(self, name: str, help_: str,
+                  buckets: Tuple[float, ...] = _DEFAULT_BUCKETS) -> Metric:
+        return self._register(Metric(name, "histogram", help_,
+                                     buckets=buckets))
+
+    def counter_fn(self, name: str, help_: str, fn: Callable) -> Metric:
+        return self._register(Metric(name, "counter", help_, fn=fn))
+
+    def gauge_fn(self, name: str, help_: str, fn: Callable,
+                 agg: str = "sum") -> Metric:
+        return self._register(Metric(name, "gauge", help_, agg=agg, fn=fn))
+
+    def get(self, name: str) -> Optional[Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            ms = list(self._metrics.values())
+        return [m.snapshot() for m in ms]
+
+
+REGISTRY = Registry()
+
+
+# -- producer-facing helpers (never raise: observability must not take the
+#    serving path down) ------------------------------------------------------
+
+def inc(name: str, n: float = 1.0, **labels) -> None:
+    m = REGISTRY.get(name)
+    if m is not None:
+        m.inc(n, **labels)
+
+
+def set_gauge(name: str, v: float, **labels) -> None:
+    m = REGISTRY.get(name)
+    if m is not None:
+        m.set(v, **labels)
+
+
+def observe(name: str, v: float, **labels) -> None:
+    m = REGISTRY.get(name)
+    if m is not None:
+        m.observe(v, **labels)
+
+
+# ---------------------------------------------------------------------------
+# cluster aggregation (per-process snapshots through the cloud KV)
+# ---------------------------------------------------------------------------
+
+_KV_PREFIX = "obs/metrics/"
+_PUB_LOCK = threading.Lock()
+_LAST_PUBLISH = 0.0
+
+
+def _proc_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:   # noqa: BLE001 — pre-init / wedged backend
+        return 0
+
+
+def publish_snapshot(proc: Optional[int] = None) -> bool:
+    """Publish this process's snapshot under ``obs/metrics/{proc}`` (the
+    coordinator merges them into the cluster view). False when there is no
+    cloud KV to publish into."""
+    from h2o3_tpu.parallel import distributed as D
+
+    p = _proc_index() if proc is None else int(proc)
+    try:
+        return D.kv_put(_KV_PREFIX + str(p),
+                        json.dumps({"proc": p, "ts": time.time(),
+                                    "metrics": REGISTRY.snapshot()}))
+    except Exception:   # noqa: BLE001 — best-effort by contract
+        return False
+
+
+def maybe_publish() -> None:
+    """Throttled publish (``H2O_TPU_OBS_PUBLISH_S`` between writes) —
+    called from the hot-ish paths that keep follower snapshots fresh
+    (op replay, watchdog ticks)."""
+    global _LAST_PUBLISH
+    now = time.monotonic()
+    with _PUB_LOCK:
+        if now - _LAST_PUBLISH < _publish_interval_s():
+            return
+        _LAST_PUBLISH = now
+    publish_snapshot()
+
+
+def cluster_snapshots() -> List[dict]:
+    """This process's LIVE snapshot + every OTHER process's KV-published
+    one, as [{proc, ts, metrics}]."""
+    from h2o3_tpu.parallel import distributed as D
+
+    me = _proc_index()
+    out = [{"proc": me, "ts": time.time(), "metrics": REGISTRY.snapshot()}]
+    for _k, v in D.kv_dir(_KV_PREFIX):
+        try:
+            rec = json.loads(v)
+        except (ValueError, TypeError):
+            continue
+        if not isinstance(rec, dict) or rec.get("proc") == me:
+            continue
+        out.append(rec)
+    return out
+
+
+def aggregate(snaps: List[dict]) -> List[dict]:
+    """Merge per-process snapshots into cluster series: counters and
+    histograms sum; gauges follow their declared agg (sum/max)."""
+    merged: Dict[str, dict] = {}
+    for snap in snaps:
+        for m in snap.get("metrics", []):
+            name = m.get("name")
+            if not name:
+                continue
+            agg = merged.get(name)
+            if agg is None:
+                agg = merged[name] = {"name": name, "type": m.get("type"),
+                                      "help": m.get("help", ""),
+                                      "agg": m.get("agg", "sum"),
+                                      "buckets": m.get("buckets"),
+                                      "_samples": {}}
+            for s in m.get("samples", []):
+                key = tuple(sorted((str(k), str(v))
+                            for k, v in (s.get("labels") or {}).items()))
+                cur = agg["_samples"].get(key)
+                if agg["type"] == "histogram":
+                    if cur is None:
+                        agg["_samples"][key] = {
+                            "labels": dict(key),
+                            "bucket_counts": list(s.get("bucket_counts", [])),
+                            "sum": float(s.get("sum", 0.0)),
+                            "count": int(s.get("count", 0))}
+                    else:
+                        bc = s.get("bucket_counts", [])
+                        cur["bucket_counts"] = [
+                            a + b for a, b in zip(cur["bucket_counts"], bc)
+                        ] if cur["bucket_counts"] else list(bc)
+                        cur["sum"] += float(s.get("sum", 0.0))
+                        cur["count"] += int(s.get("count", 0))
+                else:
+                    v = float(s.get("value", 0.0))
+                    if cur is None:
+                        agg["_samples"][key] = {"labels": dict(key),
+                                                "value": v}
+                    elif agg["type"] == "gauge" and agg["agg"] == "max":
+                        cur["value"] = max(cur["value"], v)
+                    else:
+                        cur["value"] += v
+    out = []
+    for name in sorted(merged):
+        m = merged[name]
+        m["samples"] = list(m.pop("_samples").values())
+        out.append(m)
+    return out
+
+
+def cluster_aggregate() -> List[dict]:
+    return aggregate(cluster_snapshots())
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (format 0.0.4)
+# ---------------------------------------------------------------------------
+
+def _esc_label(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace("\n",
+                                                                   r"\n")
+
+
+def _label_str(labels: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{k}="{_esc_label(v)}"' for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def prometheus_text(series: List[dict]) -> str:
+    lines: List[str] = []
+    for m in series:
+        name, mtype = m["name"], m.get("type", "gauge")
+        lines.append(f"# HELP {name} {m.get('help', '')}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for s in m.get("samples", []):
+            labels = s.get("labels") or {}
+            if mtype == "histogram":
+                for le, c in zip(m.get("buckets") or [],
+                                 s.get("bucket_counts", [])):
+                    # bucket counts are already cumulative
+                    le_lab = 'le="%s"' % le
+                    lines.append(f"{name}_bucket"
+                                 f"{_label_str(labels, le_lab)} {_fmt(c)}")
+                inf_lab = 'le="+Inf"'
+                lines.append(f"{name}_bucket{_label_str(labels, inf_lab)} "
+                             f"{_fmt(s.get('count', 0))}")
+                lines.append(f"{name}_sum{_label_str(labels)} "
+                             f"{_fmt(s.get('sum', 0.0))}")
+                lines.append(f"{name}_count{_label_str(labels)} "
+                             f"{_fmt(s.get('count', 0))}")
+            else:
+                lines.append(f"{name}{_label_str(labels)} "
+                             f"{_fmt(s.get('value', 0.0))}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# default metric set — THE single registration site (consistency-guarded):
+# the ad-hoc counters that predate this registry (scoring, admission,
+# compile cache, data plane, oplog, supervisor, watchdog) re-register here
+# as collector callbacks; their modules stay the source of truth and are
+# imported lazily at snapshot time.
+# ---------------------------------------------------------------------------
+
+_START_TS = time.time()
+
+
+def _scoring_field(field: str) -> float:
+    from h2o3_tpu import scoring
+
+    return float(sum(e.get(field, 0) for e in scoring.metrics_snapshot()))
+
+
+def _install_default_metrics() -> None:
+    r = REGISTRY
+
+    # -- direct counters/histograms (incremented by name at the source) --
+    r.counter("h2o3_rest_requests_total",
+              "REST requests served, by status class")
+    r.histogram("h2o3_rest_request_seconds",
+                "REST request wall time (seconds)")
+    r.counter("h2o3_trace_spans_total", "trace spans recorded")
+    r.counter("h2o3_flight_records_total", "flight records written")
+    r.counter("h2o3_oplog_ops_published_total",
+              "oplog ops published by this coordinator")
+    r.counter("h2o3_oplog_ops_replayed_total",
+              "oplog ops replayed by this follower")
+    r.counter("h2o3_oplog_errors_total",
+              "follower-side oplog error records written")
+    r.counter("h2o3_oplog_rejoins_total", "successful rejoin() readmissions")
+    r.counter("h2o3_cloud_transitions_total",
+              "cloud health state transitions, by target state")
+    r.counter("h2o3_tree_trees_built_total",
+              "trees built across all forest trainers")
+    r.counter("h2o3_log_messages_total",
+              "framework log records, by level (warning and up)")
+
+    # -- collector-backed series (existing ad-hoc counters re-registered) --
+    def _dp(field):
+        def fn():
+            from h2o3_tpu.core import sharded_frame
+
+            return float(sharded_frame.counters()[field])
+        return fn
+
+    r.counter_fn("h2o3_data_plane_packed_rows_total",
+                 "rows packed shard-locally (no host round-trip)",
+                 _dp("packed_rows"))
+    r.counter_fn("h2o3_data_plane_gathered_rows_total",
+                 "rows whose columns were gathered to this host "
+                 "(exceptional path)", _dp("gathered_rows"))
+
+    r.counter_fn("h2o3_scoring_requests_total",
+                 "fused-path scoring requests",
+                 lambda: _scoring_field("requests"))
+    r.counter_fn("h2o3_scoring_batches_total",
+                 "coalesced scoring batches dispatched",
+                 lambda: _scoring_field("batches"))
+    r.counter_fn("h2o3_scoring_rows_total", "rows scored on the fused path",
+                 lambda: _scoring_field("rows"))
+    r.counter_fn("h2o3_scoring_fused_compiles_total",
+                 "fused traversal XLA compiles across live sessions",
+                 lambda: _scoring_field("fused_compiles"))
+    r.counter_fn("h2o3_scoring_compile_cache_hits_total",
+                 "fused executables served from the persistent cache",
+                 lambda: _scoring_field("compile_cache_hits"))
+
+    def _adm(field):
+        def fn():
+            from h2o3_tpu import admission
+
+            return float(admission.CONTROLLER.snapshot()[field])
+        return fn
+
+    r.counter_fn("h2o3_admission_admitted_total",
+                 "requests admitted to the fused path", _adm("admitted"))
+    r.counter_fn("h2o3_admission_queued_total",
+                 "requests that waited in the admission queue",
+                 _adm("queued"))
+    r.counter_fn("h2o3_admission_rejected_total",
+                 "requests rejected 429 at the admission gate",
+                 _adm("rejected"))
+    r.counter_fn("h2o3_admission_timed_out_total",
+                 "queued requests expired 503 before a slot freed",
+                 _adm("timed_out"))
+
+    def _cc(field):
+        def fn():
+            from h2o3_tpu.artifact import compile_cache
+
+            return float(compile_cache.stats()[field])
+        return fn
+
+    r.counter_fn("h2o3_compile_cache_compiles_total",
+                 "actual fused-program XLA compilations", _cc("compiles"))
+
+    def _compile_secs():
+        from h2o3_tpu.artifact import compile_cache
+
+        return float(compile_cache.stats()["compile_ms_total"]) / 1000.0
+
+    r.counter_fn("h2o3_compile_cache_compile_seconds_total",
+                 "wall seconds spent in fused-program XLA compilation",
+                 _compile_secs)
+    r.counter_fn("h2o3_compile_cache_disk_hits_total",
+                 "persistent compile-cache hits", _cc("disk_hits"))
+    r.counter_fn("h2o3_compile_cache_disk_misses_total",
+                 "persistent compile-cache misses", _cc("disk_misses"))
+    r.counter_fn("h2o3_compile_cache_stores_total",
+                 "executables stored to the persistent cache", _cc("stores"))
+
+    def _wd(field):
+        def fn():
+            from h2o3_tpu.parallel import watchdog
+
+            return float(watchdog.status().get(field, 0))
+        return fn
+
+    r.counter_fn("h2o3_watchdog_ticks_total", "recovery watchdog ticks",
+                 _wd("ticks"))
+    r.counter_fn("h2o3_watchdog_elections_total",
+                 "standby elections won by this process", _wd("elections"))
+    r.counter_fn("h2o3_watchdog_rejoins_total",
+                 "watchdog-driven rejoins", _wd("rejoins"))
+    r.counter_fn("h2o3_watchdog_jobs_resumed_total",
+                 "externally-failed jobs re-dispatched from durable "
+                 "progress", _wd("jobs_resumed"))
+
+    def _cloud_state():
+        from h2o3_tpu.parallel import supervisor
+
+        order = {supervisor.HEALTHY: 0, supervisor.DEGRADED: 1,
+                 supervisor.RECOVERING: 2, supervisor.FAILED: 3}
+        return float(order.get(supervisor.state(), -1))
+
+    r.gauge_fn("h2o3_cloud_state",
+               "health state (0 HEALTHY, 1 DEGRADED, 2 RECOVERING, "
+               "3 FAILED)", _cloud_state, agg="max")
+
+    def _oplog_seq():
+        from h2o3_tpu.parallel import oplog
+
+        return float(oplog.current_seq())
+
+    r.gauge_fn("h2o3_oplog_current_seq",
+               "next oplog sequence to be claimed", _oplog_seq, agg="max")
+
+    def _timeline_events():
+        from h2o3_tpu.utils import timeline
+
+        return float(len(timeline.events()))
+
+    r.gauge_fn("h2o3_timeline_events", "events in the timeline ring",
+               _timeline_events, agg="max")
+    r.gauge_fn("h2o3_process_uptime_seconds",
+               "seconds since this process registered its metrics",
+               lambda: time.time() - _START_TS, agg="max")
+
+    def _devices():
+        # only consult jax when a backend is ALREADY initialized: this
+        # collector runs inside flight-recorder dumps, whose primary
+        # scenario is a process wedged in backend init — calling
+        # local_devices() there would hang the dump, not raise
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return 0.0
+        try:
+            from jax._src import xla_bridge as xb
+
+            if not getattr(xb, "_backends", None):
+                return 0.0
+            return float(len(jax.local_devices()))
+        except Exception:   # noqa: BLE001 — private-API drift / wedged
+            return 0.0
+
+    r.gauge_fn("h2o3_local_device_count",
+               "accelerator devices addressable by this process", _devices)
+
+
+_install_default_metrics()
+
+
+def reset_for_tests() -> None:
+    """Zero every direct counter/histogram (collector-backed series follow
+    their sources). Tests only."""
+    for name in REGISTRY.names():
+        m = REGISTRY.get(name)
+        with m._lock:
+            if m._fn is None:
+                m._values.clear()
+            m._hist.clear()

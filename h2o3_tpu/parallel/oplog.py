@@ -236,27 +236,36 @@ def publish(kind: str, payload: Dict[str, Any]) -> int:
     # own small backoff budget; a put that still fails is a HARD loss that
     # rolls back and raises (callers that must survive it, e.g. the
     # scoring micro-batcher, retry the whole publish for a fresh slot).
+    from h2o3_tpu.obs import metrics as obs_metrics
+    from h2o3_tpu.obs import tracing
+
     with _PUB_LOCK:
         seq = _SEQ
         _SEQ += 1
         op_id = uuid.uuid4().hex[:16]
         ok, cause = False, None
-        try:
-            failure.faultpoint("oplog.kv_put")
-            ok = D.kv_put(f"{_PREFIX}/{seq}",
-                          json.dumps({"kind": kind, "payload": payload,
-                                      "op_id": op_id}))
-        except Exception as e:   # noqa: BLE001 — converted below
-            cause = e
-        if not ok:
-            _SEQ = seq           # gapless rollback: next publish reuses it
-            raise OplogPublishError(
-                f"failed to publish oplog op {seq} ({kind}): "
-                f"{cause or 'kv_put did not land'}") from cause
+        # the op record carries the REST ingress trace context so the
+        # follower's replay + ack land in the SAME span tree as the
+        # coordinator's handler (publish -> replay -> ack, one trace)
+        with tracing.span("oplog.publish", kind=kind, seq=seq) as psp:
+            try:
+                failure.faultpoint("oplog.kv_put")
+                op_rec = {"kind": kind, "payload": payload, "op_id": op_id}
+                if psp:
+                    op_rec["trace"] = psp.ctx()
+                ok = D.kv_put(f"{_PREFIX}/{seq}", json.dumps(op_rec))
+            except Exception as e:   # noqa: BLE001 — converted below
+                cause = e
+            if not ok:
+                _SEQ = seq       # gapless rollback: next publish reuses it
+                raise OplogPublishError(
+                    f"failed to publish oplog op {seq} ({kind}): "
+                    f"{cause or 'kv_put did not land'}") from cause
         _OP_IDS[seq] = op_id     # reclaim overwrites: acks match THIS op
         if len(_OP_IDS) > _OP_IDS_CAP:
             for old in sorted(_OP_IDS)[: len(_OP_IDS) - _OP_IDS_CAP]:
                 del _OP_IDS[old]
+    obs_metrics.inc("h2o3_oplog_ops_published_total")
     return seq
 
 
@@ -620,6 +629,9 @@ def _record_error(seq: int, kind: str, trace: str, fatal: bool = True) -> None:
     itself did NOT diverge (e.g. a lost ack write) — the supervisor
     degrades instead of sticky-FAILing. A loss of the error record itself
     is logged loudly: there is no further channel left."""
+    from h2o3_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.inc("h2o3_oplog_errors_total")
     if not D.kv_put(f"{_PREFIX}/error/{seq}",
                     json.dumps({"kind": kind, "trace": trace[-4000:],
                                 "fatal": bool(fatal)})):
@@ -828,6 +840,14 @@ def follower_loop(idle_timeout_s: float = 120.0,
         if op["kind"] == "shutdown":
             _ack(i, op.get("op_id"))
             return applied
+        from h2o3_tpu.obs import metrics as obs_metrics
+        from h2o3_tpu.obs import tracing
+
+        # the op's trace context (minted at the coordinator's REST
+        # ingress) parents this replay — and the ack nests under the
+        # replay — so /3/Trace/{id} shows publish -> replay -> ack
+        tctx = op.get("trace")
+        t_replay0 = time.time() * 1000.0
         try:
             failure.faultpoint("oplog.replay")
             _apply(op["kind"], op["payload"])
@@ -839,8 +859,25 @@ def follower_loop(idle_timeout_s: float = 120.0,
             global _REPLAY_CRASHED
             _REPLAY_CRASHED = True
             _record_error(i, op["kind"], traceback.format_exc())
+            tracing.record_span("oplog.replay", tctx, t_replay0,
+                                publish=True, status="error",
+                                kind=op["kind"], seq=i)
             raise
+        t_ack0 = time.time() * 1000.0
         _ack(i, op.get("op_id"))
+        # span KV writes happen AFTER the ack landed: tracing must never
+        # add latency to the coordinator's wait_acks path
+        rsp = tracing.record_span("oplog.replay", tctx, t_replay0, t_ack0,
+                                  publish=True, kind=op["kind"], seq=i)
+        tracing.record_span(
+            "oplog.ack",
+            {"trace_id": tctx["trace_id"],
+             "span_id": rsp["span_id"]} if rsp else None,
+            t_ack0, publish=True, seq=i)
+        obs_metrics.inc("h2o3_oplog_ops_replayed_total")
+        # keep this follower's published metrics snapshot fresh for the
+        # coordinator's cluster-wide /3/Metrics (throttled)
+        obs_metrics.maybe_publish()
         note_op_seen()        # adaptive replay-idle signal (traffic clock)
         if on_op is not None:
             on_op(op["kind"], op["payload"])
@@ -962,8 +999,10 @@ def rejoin() -> int:
         from h2o3_tpu.parallel import supervisor
 
         supervisor.release_hold()
+    from h2o3_tpu.obs import metrics as obs_metrics
     from h2o3_tpu.utils import timeline
 
+    obs_metrics.inc("h2o3_oplog_rejoins_total")
     timeline.record("cloud", "rejoin", proc=proc, inc=inc,
                     caught_up_seq=cursor)
     return cursor

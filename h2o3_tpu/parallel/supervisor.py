@@ -101,6 +101,7 @@ def _transition(new: str, reason: str, remote_trace: str = "") -> bool:
                              "reason": reason})
         if len(_TRANSITIONS) > _TRANSITIONS_MAX:
             del _TRANSITIONS[: len(_TRANSITIONS) - _TRANSITIONS_MAX]
+    from h2o3_tpu.obs import metrics as obs_metrics
     from h2o3_tpu.utils import timeline
     from h2o3_tpu.utils.log import get_logger
 
@@ -108,6 +109,7 @@ def _transition(new: str, reason: str, remote_trace: str = "") -> bool:
     (log.error if new == FAILED else log.warning)(
         "cloud %s -> %s: %s", cur, new, reason)
     timeline.record("cloud", f"{cur}->{new}", reason=reason)
+    obs_metrics.inc("h2o3_cloud_transitions_total", to=new)
     return True
 
 
@@ -177,6 +179,14 @@ def fail(reason: str, remote_trace: str = "") -> None:
         if not _transition(FAILED, reason, remote_trace):
             return
         _STATE["incs_at_failure"] = incs
+    # a FAILED cloud is exactly the moment evidence starts evaporating
+    # (jobs get failed, clients give up): dump the flight record NOW so
+    # the postmortem has the timeline/spans/metrics as they stood
+    from h2o3_tpu.obs import flight
+
+    flight.record_flight("cloud_failed",
+                         extra={"reason": reason,
+                                "remote_trace": remote_trace[-2000:]})
     _fail_running_jobs(reason, remote_trace)
 
 

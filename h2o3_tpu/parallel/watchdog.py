@@ -356,6 +356,14 @@ class Watchdog:
         with _LOCK:
             _STATE["ticks"] += 1
             _STATE["last_tick"] = time.time()
+        # follower-side freshness for the coordinator's cluster-wide
+        # /3/Metrics rides the watchdog tick (throttled; best-effort)
+        try:
+            from h2o3_tpu.obs import metrics as _om
+
+            _om.maybe_publish()
+        except Exception:   # noqa: BLE001 — observability never blocks
+            pass            # recovery
         try:
             if D.process_count() > 1:
                 oplog.maybe_demote()
@@ -372,6 +380,10 @@ class Watchdog:
             if st == supervisor.HEALTHY or D.process_count() <= 1:
                 got = resume_failed_jobs()
                 if got:
+                    from h2o3_tpu.obs import flight
+
+                    flight.record_flight("watchdog_job_resume",
+                                         extra={"jobs": got})
                     return _note(f"resumed jobs {got}",
                                  jobs_resumed=len(got))
             return _note("idle")
@@ -386,8 +398,13 @@ class Watchdog:
         cursor = D.rejoin()
         if self.follow:
             self._spawn_follower(cursor)
+        from h2o3_tpu.obs import flight
         from h2o3_tpu.utils.log import get_logger
 
+        # every autonomous recovery action leaves a flight record: the
+        # state that FORCED the action is the postmortem evidence
+        flight.record_flight("watchdog_rejoin",
+                             extra={"why": why, "caught_up_seq": cursor})
         get_logger().warning("watchdog: auto-rejoined as follower (%s), "
                              "caught up to seq %d", why, cursor)
         return _note(f"rejoined ({why})", rejoins=1)
@@ -426,8 +443,12 @@ class Watchdog:
             elect()
         except oplog.ElectionLost as e:
             return _note(f"standing by ({e})")
+        from h2o3_tpu.obs import flight
         from h2o3_tpu.utils.log import get_logger
 
+        flight.record_flight("watchdog_election",
+                             extra={"epoch": D.epoch(),
+                                    "old_leader": rec["leader"]})
         get_logger().warning("watchdog: won the standby election "
                              "(epoch %d)", D.epoch())
         return _note("elected", elections=1)

@@ -38,6 +38,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from h2o3_tpu.obs import tracing
+
 _DEFAULT_BUCKETS = (256, 1024, 4096, 16384)
 
 
@@ -182,9 +184,10 @@ class ScoringSession:
         from h2o3_tpu.core import sharded_frame
 
         sharded_frame.note_gathered(n)
-        X = np.empty((n, self.spec.F), np.float32)
-        for i, name in enumerate(self.spec.names):
-            X[:, i] = np.asarray(adapted.col(name).data)[:n]
+        with tracing.span("pack", rows=n, path="host"):
+            X = np.empty((n, self.spec.F), np.float32)
+            for i, name in enumerate(self.spec.names):
+                X[:, i] = np.asarray(adapted.col(name).data)[:n]
         return X
 
     def _sharded_view(self, adapted):
@@ -271,8 +274,9 @@ class ScoringSession:
             exe = compile_cache.load(ckey)
         if exe is None:
             fn = self._sharded_score_fn() if sharded else self._fn
+            t0 = time.perf_counter()
             exe = fn.lower(*call_args).compile()
-            compile_cache.note_compile()
+            compile_cache.note_compile((time.perf_counter() - t0) * 1000)
             self.fused_compiles += 1
             if ckey is not None:
                 compile_cache.store(ckey, exe)
@@ -309,8 +313,13 @@ class ScoringSession:
                                                                   sharding)
             call_args = (xd, self._edges, self._is_cat, self._init) + \
                 tuple(arrays)
-            out = self._executable_for(bucket, local, call_args)(*call_args)
-            outs.append(np.asarray(out)[:m])
+            exe = self._executable_for(bucket, local, call_args)
+            with tracing.span("dispatch", bucket=bucket, rows=m,
+                              path="host"):
+                out = exe(*call_args)
+            with tracing.span("fetch", rows=m, path="host"):
+                got = np.asarray(out)[:m]   # the one blocking transfer
+            outs.append(got)
             pos += m
         if not outs:
             K = (self.forest.nclasses if (self.forest.nclasses > 2
@@ -344,8 +353,14 @@ class ScoringSession:
             Xd = sf.pack_features(pos, n, bucket)
             call_args = (Xd, self._edges, self._is_cat, self._init) + \
                 tuple(self._arrays)
-            out = self._executable_for(bucket, False, call_args,
-                                       sharded=True)(*call_args)
+            exe = self._executable_for(bucket, False, call_args,
+                                       sharded=True)
+            # host-side dispatch wall time only — the program is async and
+            # NO block_until_ready is added here (the fused-path counters
+            # assert the path is unchanged when profiling is off)
+            with tracing.span("dispatch", bucket=bucket, rows=m,
+                              path="sharded"):
+                out = exe(*call_args)
             outs.append(out[:m])
             pos += m
         K = (self.forest.nclasses if (self.forest.nclasses > 2
@@ -448,10 +463,15 @@ class ScoringSession:
             sf = None if local_mp else self._sharded_view(adapted)
             if sf is not None:
                 raw = self.model._margin_to_raw(self._margin_sharded(sf, n))
-                pred = self.model._raw_to_frame(raw, n, key=dest)
-                pred.install()
-                mm = self.model._make_metrics(frame, raw) if with_metrics \
-                    else None
+                # result assembly is where this path first blocks on the
+                # device (frame install / metrics read host values) — the
+                # "fetch" phase of the request's span tree. No sync is
+                # ADDED: these calls block with or without tracing.
+                with tracing.span("fetch", rows=n, path="sharded"):
+                    pred = self.model._raw_to_frame(raw, n, key=dest)
+                    pred.install()
+                    mm = self.model._make_metrics(frame, raw) \
+                        if with_metrics else None
                 results[i] = (pred, mm)
             elif mp and not local_only:
                 # ineligible entry on a multi-process cloud: the generic
@@ -555,7 +575,7 @@ def metrics_snapshot() -> List[Dict[str, Any]]:
 
 class _Pending:
     __slots__ = ("frame", "dest", "with_metrics", "event", "pred", "mm",
-                 "error", "promoted")
+                 "error", "promoted", "trace_ctx", "enq_ms")
 
     def __init__(self, frame, dest, with_metrics):
         self.frame = frame
@@ -566,6 +586,11 @@ class _Pending:
         self.mm = None
         self.error: Optional[BaseException] = None
         self.promoted = False      # woken to take over flush leadership
+        # submitter's trace context + enqueue wall time: the flush leader
+        # (a different thread) records each request's queue-wait span into
+        # ITS trace, and adopts the lead context for the batch phases
+        self.trace_ctx = tracing.context()
+        self.enq_ms = time.time() * 1000.0
 
 
 def execute_batch(model, entries: List[Tuple[Any, Optional[str], bool]],
@@ -676,6 +701,14 @@ class ScoreBatcher:
     def _flush(model, batch: List[_Pending]) -> None:
         from h2o3_tpu.parallel import oplog, retry, supervisor
 
+        # queue-wait: submit -> flush start, one span per request in that
+        # request's OWN trace; the batch's shared phases (publish, pack,
+        # dispatch, fetch) then run under the lead (oldest) context
+        now_ms = time.time() * 1000.0
+        for e in batch:
+            tracing.record_span("queue_wait", e.trace_ctx, e.enq_ms, now_ms,
+                                batched_with=len(batch) - 1)
+        lead_ctx = next((e.trace_ctx for e in batch if e.trace_ctx), None)
         try:
             # broadcast ONE op for the whole batch; followers replay it
             # once. Existence/compat validation already happened
@@ -686,42 +719,44 @@ class ScoreBatcher:
             # rolled its sequence slot back, so the re-claim is gapless);
             # on a DEGRADED/FAILED cloud scoring skips the broadcast and
             # serves coordinator-locally — the one surface that stays up.
-            local_only = (oplog.active()
-                          and supervisor.state() != supervisor.HEALTHY)
-            op_seq = None
-            if not local_only:
-                from h2o3_tpu.core.failure import CloudUnhealthyError
+            with tracing.activate(lead_ctx):
+                local_only = (oplog.active()
+                              and supervisor.state() != supervisor.HEALTHY)
+                op_seq = None
+                if not local_only:
+                    from h2o3_tpu.core.failure import CloudUnhealthyError
 
-                try:
-                    op_seq = retry.retry_call(
-                        oplog.broadcast, "score_batch", {
-                            "model": str(model.key),
-                            "requests": [{"frame": str(e.frame.key),
-                                          "destination_frame": e.dest,
-                                          "with_metrics":
-                                          bool(e.with_metrics)}
-                                         for e in batch]},
-                        retry_on=(oplog.OplogPublishError,),
-                        describe="score_batch broadcast")
-                except CloudUnhealthyError:
-                    # the cloud degraded between the state snapshot and
-                    # the broadcast's own fail-fast check: scoring is the
-                    # surface that keeps serving — fall back to local
-                    local_only = True
-            if local_only:
-                # local serving installs prediction frames only in the
-                # COORDINATOR's DKV (no oplog record): follower key state
-                # is now behind, so the degraded verdict must never
-                # auto-recover — only a cloud restart re-syncs
-                supervisor.degrade(
-                    "coordinator-local scoring served while degraded: "
-                    "follower DKV state is behind; restart the cloud to "
-                    "re-sync", hold_s=float("inf"))
-            with oplog.turn(op_seq):
-                results = execute_batch(
-                    model, [(e.frame, e.dest, e.with_metrics)
-                            for e in batch],
-                    local_only=local_only)
+                    try:
+                        op_seq = retry.retry_call(
+                            oplog.broadcast, "score_batch", {
+                                "model": str(model.key),
+                                "requests": [{"frame": str(e.frame.key),
+                                              "destination_frame": e.dest,
+                                              "with_metrics":
+                                              bool(e.with_metrics)}
+                                             for e in batch]},
+                            retry_on=(oplog.OplogPublishError,),
+                            describe="score_batch broadcast")
+                    except CloudUnhealthyError:
+                        # the cloud degraded between the state snapshot and
+                        # the broadcast's own fail-fast check: scoring is
+                        # the surface that keeps serving — fall back to
+                        # local
+                        local_only = True
+                if local_only:
+                    # local serving installs prediction frames only in the
+                    # COORDINATOR's DKV (no oplog record): follower key
+                    # state is now behind, so the degraded verdict must
+                    # never auto-recover — only a cloud restart re-syncs
+                    supervisor.degrade(
+                        "coordinator-local scoring served while degraded: "
+                        "follower DKV state is behind; restart the cloud "
+                        "to re-sync", hold_s=float("inf"))
+                with oplog.turn(op_seq):
+                    results = execute_batch(
+                        model, [(e.frame, e.dest, e.with_metrics)
+                                for e in batch],
+                        local_only=local_only)
             for e, (pred, mm) in zip(batch, results):
                 e.pred, e.mm = pred, mm
         except BaseException as ex:   # noqa: BLE001 — propagate per-request

@@ -10,6 +10,20 @@ PROGRESS = True
 _LOGGER = None
 
 
+class _MetricsHandler(logging.Handler):
+    """Warning-and-up log records become the ``h2o3_log_messages_total``
+    series on /3/Metrics — an error-rate alarm needs no log scraping."""
+
+    def emit(self, record):
+        try:
+            from h2o3_tpu.obs import metrics
+
+            metrics.inc("h2o3_log_messages_total",
+                        level=record.levelname.lower())
+        except Exception:   # noqa: BLE001 — counting must never re-log
+            pass
+
+
 def get_logger() -> logging.Logger:
     global _LOGGER
     if _LOGGER is None:
@@ -18,6 +32,9 @@ def get_logger() -> logging.Logger:
         h = logging.StreamHandler(sys.stderr)
         h.setFormatter(logging.Formatter("%(asctime)s %(levelname).1s h2o3_tpu: %(message)s"))
         lg.addHandler(h)
+        mh = _MetricsHandler()
+        mh.setLevel(logging.WARNING)
+        lg.addHandler(mh)
         try:
             ice = os.environ.get("H2O_TPU_ICE_ROOT", "/tmp/h2o3_tpu")
             os.makedirs(ice, exist_ok=True)

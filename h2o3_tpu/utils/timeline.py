@@ -23,13 +23,39 @@ from typing import Dict, List, Optional
 _RING: collections.deque = collections.deque(maxlen=4096)
 _LOCK = threading.Lock()
 
+# the closed enumeration of event kinds h2o3_tpu/ may record: free-form
+# kind drift makes the ring un-queryable (and un-documentable), so
+# tests/test_consistency.py pins every record()/task() call-site literal
+# to this set (mirroring the faultpoint-name guard). "rest" is emitted by
+# the API layer's request ring merge, not by record().
+KINDS = frozenset({
+    "artifact",         # AOT artifact export/import
+    "cloud",            # supervision/election/rejoin/demotion events
+    "flight",           # flight-recorder dumps (obs/flight.py)
+    "job",              # durable job-progress saves
+    "oplog",            # control-plane checkpoints
+    "pallas_auto",      # pallas-vs-XLA microbenchmark verdicts
+    "profiler",         # /3/Profiler start/stop captures
+    "rest",             # REST request ring (api/server.py merge)
+    "scoring",          # fused serving dispatches
+    "self_benchmark",   # mesh boot probes
+    "task_profile",     # opt-in per-task phase timings (H2O_TPU_PROFILE)
+    "tree",             # per-tree / per-level trainer timings
+    "xla_trace",        # XLA profiler captures
+})
+
+_RESERVED = ("time_ms", "kind", "what", "ms")
+
 
 def record(kind: str, what: str, ms: Optional[float] = None, **meta) -> None:
     ev = {"time_ms": int(time.time() * 1000), "kind": kind, "what": what}
     if ms is not None:
         ev["ms"] = round(float(ms), 3)
-    if meta:
-        ev.update(meta)
+    # reserved keys win: caller meta must not clobber the event's identity
+    # fields (a meta dict splatted with e.g. time_ms used to silently
+    # overwrite the timestamp) — colliding meta lands under a meta_ prefix
+    for k, v in meta.items():
+        ev[f"meta_{k}" if k in _RESERVED else k] = v
     with _LOCK:
         _RING.append(ev)
 

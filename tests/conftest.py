@@ -103,7 +103,10 @@ _HEAVY_MODULES = [
 # consistency suite is millisecond text scans EXCEPT its behavioral
 # data-plane guard, which trains a tiny GBM — that one item rides with
 # the sharded suite at the head of the heavy tail instead of dragging
-# compile work into the cheap-first phase
+# compile work into the cheap-first phase.
+# (test_obs deliberately stays OUT of _HEAVY_MODULES: the observability
+# suite trains nothing — its one forest-backed assertion lives in
+# test_sharded_frame's REST test — so it banks dots in the cheap phase.)
 _HEAVY_ITEMS = {
     "test_fused_paths_never_gather_columns_to_coordinator":
         "test_sharded_frame",

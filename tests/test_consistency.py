@@ -11,14 +11,21 @@
 3. Every ``H2O_TPU_*`` env knob the framework reads must appear in
    README.md — an undocumented knob is an operator trap (the recovery
    runbook promises the full surface).
-4. Sharded-data-plane invariant (ISSUE 7): no call site under
+4. Metric-name registry guard (ISSUE 8): every metric registered in
+   ``h2o3_tpu/`` exactly once, names matching ``^h2o3_[a-z0-9_]+$``, and
+   the live registry agreeing with the source scan.
+5. Timeline-kind enumeration guard (ISSUE 8): no free-form
+   ``record(kind=...)`` drift — every recorded kind is declared in
+   ``utils/timeline.py KINDS`` and no declared kind is dead.
+6. Sharded-data-plane invariant (ISSUE 7): no call site under
    ``h2o3_tpu/`` may fetch a full column to the coordinator host inside
    the fused scoring or tree input path — asserted behaviorally via the
    ``gathered_rows`` counter staying 0 through a train + fused-score
    smoke on the 8-device mesh (the one non-text guard here; it is the
    counter the issue pins the invariant to).
 
-Guards 1–3 are pure text scans — no jax, no devices, milliseconds.
+All but #6 are pure text scans (plus cheap imports) — no devices,
+milliseconds.
 """
 
 import re
@@ -148,6 +155,71 @@ def test_pyproject_markers_match_test_usage():
     assert not unused, (
         f"marker(s) {sorted(unused)} are declared in pyproject.toml but "
         "never used under tests/ — drop them or mark the tests")
+
+
+def test_metric_names_registered_exactly_once():
+    """ISSUE-8 guard (mirrors the faultpoint-name guard): every metric
+    registration in h2o3_tpu/ uses a ``^h2o3_[a-z0-9_]+$`` name and no
+    name is registered twice — a duplicate would raise at import in
+    production, and a malformed name breaks Prometheus scrapes. All
+    registrations live in obs/metrics.py's single install site by
+    design; this guard pins that discipline against drift."""
+    import collections
+
+    pat = re.compile(
+        r"\br\.(?:counter|gauge|histogram)(?:_fn)?\(\s*['\"]([^'\"]+)['\"]")
+    names = collections.Counter()
+    for p, text in _py_sources(SRC):
+        for name in pat.findall(text):
+            names[name] += 1
+    assert names, "no metric registrations found under h2o3_tpu/"
+    bad = [n for n in names if not re.match(r"^h2o3_[a-z0-9_]+$", n)]
+    assert not bad, (f"metric name(s) {sorted(bad)} do not match "
+                     "^h2o3_[a-z0-9_]+$ — Prometheus scrapes reject them")
+    dup = sorted(n for n, c in names.items() if c > 1)
+    assert not dup, (f"metric name(s) {dup} are registered more than once "
+                     "— the registry raises on the second registration")
+    assert len(names) >= 20, (
+        f"only {len(names)} metrics registered — the cluster /3/Metrics "
+        "surface promises >= 20 series")
+    # behavioral half: the live registry agrees with the text scan
+    from h2o3_tpu.obs import metrics as obs_metrics
+
+    live = set(obs_metrics.REGISTRY.names())
+    missing = set(names) - live
+    assert not missing, (
+        f"metric(s) {sorted(missing)} are registered in source but absent "
+        "from the live registry (conditional registration?)")
+
+
+def test_timeline_kinds_are_enumerated():
+    """ISSUE-8 guard: every ``timeline.record(kind, ...)`` /
+    ``timeline.task(kind, ...)`` call-site literal under h2o3_tpu/ must be
+    in ``timeline.KINDS`` (free-form kind drift makes the ring
+    un-queryable), and no declared kind may be dead — mirroring the
+    marker-registry guard. 'rest' is emitted by the API layer's request
+    ring merge rather than record(), so it is exempt from the usage
+    half."""
+    from h2o3_tpu.utils import timeline
+
+    used = set()
+    call_pat = re.compile(
+        r"\btimeline\.(?:record|task)\(\s*['\"]([^'\"]+)['\"]")
+    # timeline.py's own internal record() calls (module-local, unprefixed)
+    bare_pat = re.compile(r"(?<![\w.])record\(\s*['\"]([^'\"]+)['\"]")
+    for p, text in _py_sources(SRC):
+        used |= set(call_pat.findall(text))
+        if p.name == "timeline.py":
+            used |= set(bare_pat.findall(text))
+    unknown = used - timeline.KINDS
+    assert not unknown, (
+        f"timeline kind(s) {sorted(unknown)} are recorded in h2o3_tpu/ "
+        "but not declared in utils/timeline.py KINDS — add them there "
+        "(the enumeration is the ring's query surface)")
+    dead = timeline.KINDS - used - {"rest"}
+    assert not dead, (
+        f"timeline kind(s) {sorted(dead)} are declared in KINDS but never "
+        "recorded anywhere under h2o3_tpu/ — drop them or record them")
 
 
 def test_fused_paths_never_gather_columns_to_coordinator():

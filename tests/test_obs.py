@@ -1,0 +1,336 @@
+"""Observability plane (ISSUE 8): metrics registry + cluster aggregation,
+trace-span propagation across the oplog, flight recorder, profiler REST.
+
+Cheap tier by design (the satellite pins this suite to conftest's
+cheap-first phase): no model training here — the fused-scoring span-tree
+and /3/Metrics-over-REST assertions that need a trained forest ride
+tests/test_sharded_frame.py's existing REST test (same heavy-tail slot).
+Cross-process behavior is driven on the supervision tier's mem_cloud
+harness (dict KV + monkeypatched 2-process topology): deterministic, no
+gloo."""
+
+import json
+import re
+
+import pytest
+
+from h2o3_tpu.core import failure
+from h2o3_tpu.obs import flight, metrics, tracing
+from h2o3_tpu.parallel import distributed as D
+from h2o3_tpu.parallel import oplog, supervisor
+from h2o3_tpu.utils import timeline
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture()
+def mem_cloud(monkeypatch):
+    """Simulated 2-process cloud (the test_supervision harness shape)."""
+    with D.memory_kv() as kv:
+        monkeypatch.setattr(D, "process_count", lambda: 2)
+        monkeypatch.setattr(D, "is_coordinator", lambda: True)
+        monkeypatch.setenv("H2O_TPU_RETRY_BASE_MS", "1")
+        monkeypatch.setenv("H2O_TPU_OP_ACK_TIMEOUT_S", "30")
+        monkeypatch.setenv("H2O_TPU_OPLOG_CHECKPOINT_OPS", "0")
+        monkeypatch.setenv("H2O_TPU_AUTO_RECOVER", "0")
+        failure.set_incarnation(0)
+        D.reset_leadership()
+        oplog._DEMOTED = False
+        oplog.reset()
+        supervisor.reset()
+        yield kv
+    failure.set_incarnation(0)
+    D.reset_leadership()
+    oplog._DEMOTED = False
+    oplog.reset()
+    supervisor.reset()
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z0-9_]+=\"[^\"]*\""
+    r"(,[a-zA-Z0-9_]+=\"[^\"]*\")*\})? -?\S+$")
+
+
+class TestRegistry:
+    def test_names_and_duplicate_registration(self):
+        with pytest.raises(ValueError):
+            metrics.Registry().counter("Bad-Name", "x")
+        r = metrics.Registry()
+        r.counter("h2o3_t_dup", "x")
+        with pytest.raises(ValueError):
+            r.counter("h2o3_t_dup", "again")
+
+    def test_inc_observe_and_unknown_names_never_raise(self):
+        metrics.inc("h2o3_rest_requests_total", status="2xx")
+        metrics.observe("h2o3_rest_request_seconds", 0.01)
+        metrics.inc("h2o3_no_such_metric")         # silently dropped
+        snap = {m["name"]: m for m in metrics.REGISTRY.snapshot()}
+        vals = {tuple(sorted(s["labels"].items())): s["value"]
+                for s in snap["h2o3_rest_requests_total"]["samples"]}
+        assert vals[(("status", "2xx"),)] >= 1
+        h = snap["h2o3_rest_request_seconds"]["samples"][0]
+        assert h["count"] >= 1 and h["sum"] > 0
+
+    def test_label_cardinality_bounded(self):
+        r = metrics.Registry()
+        m = r.counter("h2o3_t_cardinality", "x")
+        for i in range(200):
+            m.inc(model=f"m{i}")
+        assert len(m._values) <= metrics._LABEL_CAP + 1
+        snap = m.snapshot()
+        overflow = [s for s in snap["samples"]
+                    if s["labels"].get("overflow") == "true"]
+        assert overflow and overflow[0]["value"] > 0
+
+    def test_default_registry_has_twenty_plus_series(self):
+        assert len(metrics.REGISTRY.names()) >= 20
+        for name in metrics.REGISTRY.names():
+            assert metrics.NAME_RE.match(name), name
+
+    def test_prometheus_text_is_valid_exposition(self):
+        text = metrics.prometheus_text(
+            metrics.aggregate([{"metrics": metrics.REGISTRY.snapshot()}]))
+        names = set()
+        for ln in text.splitlines():
+            if not ln.strip():
+                continue
+            if ln.startswith("#"):
+                assert ln.startswith("# HELP ") or ln.startswith("# TYPE ")
+                continue
+            assert _PROM_LINE.match(ln), ln
+            names.add(re.split(r"[{ ]", ln, 1)[0])
+        assert len(names) >= 20
+
+    def test_broken_collector_degrades_one_series_not_the_scrape(self):
+        r = metrics.Registry()
+        r.counter_fn("h2o3_t_broken", "x",
+                     lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+        r.counter("h2o3_t_fine", "x").inc()
+        snap = {m["name"]: m for m in r.snapshot()}
+        assert snap["h2o3_t_broken"]["samples"] == []
+        assert snap["h2o3_t_fine"]["samples"][0]["value"] == 1
+
+
+class TestClusterAggregation:
+    def test_kv_published_snapshots_sum_with_live(self, mem_cloud):
+        """The coordinator's cluster view = its LIVE registry + every
+        other process's KV-published snapshot; counters sum (the
+        per-process data_plane counters are the satellite's example)."""
+        from h2o3_tpu.core import sharded_frame
+
+        def dp_packed(series):
+            m = next(s for s in series
+                     if s["name"] == "h2o3_data_plane_packed_rows_total")
+            return sum(s["value"] for s in m["samples"])
+
+        live0 = dp_packed(metrics.aggregate(
+            [{"metrics": metrics.REGISTRY.snapshot()}]))
+        # "process 1" publishes its snapshot (same registry — what matters
+        # is that the coordinator merges the KV row it did NOT serve live)
+        sharded_frame.note_packed(70)
+        assert metrics.publish_snapshot(proc=1)
+        sharded_frame.note_packed(30)         # coordinator-local growth
+        total = dp_packed(metrics.cluster_aggregate())
+        assert total == pytest.approx((live0 + 70) + (live0 + 100))
+
+    def test_own_kv_row_is_not_double_counted(self, mem_cloud):
+        metrics.publish_snapshot()            # proc 0 == this process
+        series = metrics.cluster_aggregate()
+        m = next(s for s in series
+                 if s["name"] == "h2o3_process_uptime_seconds")
+        assert len(m["samples"]) == 1         # live snapshot only
+
+
+# ---------------------------------------------------------------------------
+# trace spans: publish -> replay -> ack in ONE tree across the oplog
+# ---------------------------------------------------------------------------
+
+class TestSpanPropagation:
+    def test_span_is_noop_without_active_trace(self):
+        before = len(tracing.recent_traces(500))
+        with tracing.span("pack") as sp:
+            assert not sp and tracing.context() is None
+        assert len(tracing.recent_traces(500)) == before
+
+    def test_mirrored_op_yields_one_span_tree(self, mem_cloud):
+        """A mirrored op on the mem_cloud: the coordinator publishes under
+        an ingress trace, the follower replays + acks — and all of it
+        lands in ONE tree (ingress -> oplog.publish -> oplog.replay ->
+        oplog.ack), the replay/ack spans having crossed the KV."""
+        with tracing.root_span("ingress", path="/test") as root:
+            tid = root.span["trace_id"]
+            seq = oplog.publish("noop", {})
+        oplog.publish("shutdown", {})
+        oplog.follower_loop(idle_timeout_s=5.0)
+        spans = tracing.get_trace(tid)
+        by_name = {s["name"]: s for s in spans}
+        assert {"ingress", "oplog.publish", "oplog.replay",
+                "oplog.ack"} <= set(by_name)
+        pub, rep, ack = (by_name["oplog.publish"], by_name["oplog.replay"],
+                         by_name["oplog.ack"])
+        assert pub["parent_id"] == by_name["ingress"]["span_id"]
+        assert rep["parent_id"] == pub["span_id"]
+        assert ack["parent_id"] == rep["span_id"]
+        assert rep["attrs"]["seq"] == seq
+        # the follower-side spans crossed the KV (remote_span publishes)
+        assert any(k.startswith(f"obs/span/{tid}/") for k in mem_cloud)
+        # and the tree nests accordingly
+        tree = tracing.span_tree(spans)
+        assert tree[0]["name"] == "ingress"
+        assert tree[0]["children"][0]["name"] == "oplog.publish"
+        assert tree[0]["children"][0]["children"][0]["name"] == \
+            "oplog.replay"
+
+    def test_untraced_op_record_carries_no_trace(self, mem_cloud):
+        oplog.publish("noop", {})
+        rec = json.loads(mem_cloud["oplog/0"])
+        assert "trace" not in rec
+
+    def test_store_is_bounded(self, monkeypatch):
+        monkeypatch.setenv("H2O_TPU_OBS_TRACE_CAP", "4")
+        tracing.clear()
+        tids = []
+        for i in range(8):
+            with tracing.root_span(f"t{i}") as r:
+                tids.append(r.span["trace_id"])
+        alive = [t for t in tids
+                 if tracing.get_trace(t, include_remote=False)]
+        assert len(alive) <= 4 and tids[-1] in alive
+
+
+# ---------------------------------------------------------------------------
+# timeline satellite: reserved keys win over caller meta
+# ---------------------------------------------------------------------------
+
+class TestTimelineReservedKeys:
+    def test_meta_cannot_clobber_reserved_keys(self):
+        timeline.clear()
+        timeline.record("scoring", "w", ms=1.0, **{"time_ms": -5,
+                                                   "rows": 3})
+        ev = timeline.events()[-1]
+        assert ev["time_ms"] > 0            # real timestamp intact
+        assert ev["meta_time_ms"] == -5     # caller meta kept, prefixed
+        assert ev["rows"] == 3              # non-colliding meta unprefixed
+        assert ev["ms"] == 1.0
+
+    def test_kind_enumeration_is_exported(self):
+        assert "scoring" in timeline.KINDS and "flight" in timeline.KINDS
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_record_roundtrip_and_gc(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("H2O_TPU_OBS_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("H2O_TPU_OBS_FLIGHT_KEEP", "3")
+        timeline.record("scoring", "evidence", rows=1)
+        paths = [flight.record_flight(f"unit_reason_{i}", extra={"i": i})
+                 for i in range(5)]
+        assert all(paths)
+        recs = flight.list_records()
+        assert len(recs) == 3               # GC kept the newest 3
+        body = json.loads(flight.read_record(recs[0]["name"]))
+        assert body["reason"].startswith("unit_reason")
+        assert any(e.get("what") == "evidence" for e in body["timeline"])
+        assert isinstance(body["metrics"], list) and body["metrics"]
+
+    def test_unsafe_names_refused(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("H2O_TPU_OBS_FLIGHT_DIR", str(tmp_path))
+        assert flight.read_record("../../../etc/passwd") is None
+        assert flight.read_record("nope.json") is None
+
+    def test_forced_watchdog_recovery_leaves_a_record(
+            self, mem_cloud, tmp_path, monkeypatch):
+        """ISSUE 8 acceptance: a forced watchdog recovery action produces
+        a flight record, and it is listed. Same drill as the bench
+        `recover` stage: dead recorded leader, this process's watchdog
+        wins the election."""
+        import time as _t
+
+        from h2o3_tpu.parallel import watchdog
+
+        monkeypatch.setenv("H2O_TPU_OBS_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("H2O_TPU_AUTO_RECOVER", "1")
+        monkeypatch.setenv("H2O_TPU_ELECTION_GRACE_S", "0.1")
+        monkeypatch.setenv("H2O_TPU_HEARTBEAT_STALE_S", "0.5")
+        monkeypatch.setattr(D, "is_coordinator",
+                            lambda: D.leader() == 0 and D.epoch() > 0)
+        D.write_epoch_record(0, 1)          # process 1 led ...
+        D.set_leader(1, 0)                  # ... and is long dead
+        mem_cloud["h2o3/heartbeat/1"] = json.dumps(
+            {"ts": _t.time() - 999, "proc": 1})
+        failure.heartbeat()
+        watchdog.reset()
+        wd = watchdog.Watchdog(interval=3600, follow=False)
+        tag = wd.tick()
+        assert tag == "elected", tag
+        recs = flight.list_records()
+        assert recs and recs[0]["reason"] == "watchdog_election"
+
+
+# ---------------------------------------------------------------------------
+# /3/Metrics + /3/Profiler over the wire (single-process server)
+# ---------------------------------------------------------------------------
+
+class TestObsRest:
+    def test_metrics_trace_list_and_profiler_routes(self, cl, tmp_path):
+        import urllib.request
+
+        from h2o3_tpu.api.server import start_server
+
+        srv = start_server(port=0)
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with urllib.request.urlopen(base + "/3/Metrics",
+                                        timeout=30) as r:
+                assert r.headers["Content-Type"].startswith("text/plain")
+                text = r.read().decode()
+            series = {ln.split("{")[0].split(" ")[0]
+                      for ln in text.splitlines()
+                      if ln.strip() and not ln.startswith("#")}
+            assert len(series) >= 20
+            with urllib.request.urlopen(base + "/3/Metrics?format=json",
+                                        timeout=30) as r:
+                mj = json.loads(r.read())
+            assert mj["__meta"]["schema_name"] == "MetricsV3"
+            assert mj["series_count"] >= 20
+            with urllib.request.urlopen(base + "/3/Trace", timeout=30) as r:
+                assert "traces" in json.loads(r.read())
+            # profiler start -> stop writes an XLA trace dir
+            pdir = str(tmp_path / "prof")
+            req = urllib.request.Request(
+                base + "/3/Profiler/start",
+                data=json.dumps({"dir": pdir}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert json.loads(r.read())["status"] == "capturing"
+            # double-start refused with 409 while capturing
+            import urllib.error
+
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    base + "/3/Profiler/start", data=b"", method="POST"),
+                    timeout=30)
+            assert ei.value.code == 409
+            req = urllib.request.Request(base + "/3/Profiler/stop",
+                                         data=b"", method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                out = json.loads(r.read())
+            assert out["status"] == "stopped" and out["captured_ms"] >= 0
+            import os
+
+            assert os.path.isdir(pdir) and os.listdir(pdir)
+            # stop with nothing running is a clean 400
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    base + "/3/Profiler/stop", data=b"", method="POST"),
+                    timeout=30)
+            assert ei.value.code == 400
+        finally:
+            srv.stop()

@@ -355,10 +355,21 @@ class TestScoringMetricsRest:
         """GET /3/ScoringMetrics carries the per-process data_plane block;
         after a REST-scored sharded request, gathered_rows has not moved
         and packed_rows covers the scored frame (the issue's counter
-        assertion, over the real wire)."""
+        assertion, over the real wire).
+
+        ISSUE-8 extension, same request: (a) the response's trace id
+        resolves on GET /3/Trace/{id} to the COMPLETE fused-path span
+        tree — ingress -> queue_wait -> pack -> dispatch -> fetch — and
+        the unchanged gathered_rows / fused-compile counters are the
+        proof that tracing added no device sync or path change; (b)
+        GET /3/Metrics serves the cluster-aggregated
+        h2o3_data_plane_* series in Prometheus text exposition with the
+        same values the data_plane block reports."""
         import json
+        import re
         import urllib.request
 
+        from h2o3_tpu import scoring
         from h2o3_tpu.api.server import start_server
         from h2o3_tpu.core import sharded_frame
 
@@ -368,12 +379,17 @@ class TestScoringMetricsRest:
         srv = start_server(port=0)
         try:
             base = f"http://127.0.0.1:{srv.port}"
+            # warm the session so the traced request compiles nothing (the
+            # no-new-compiles assertion below needs a warm bucket)
+            scoring.session_for(gbm).predict(fr)
+            compiles0 = scoring.session_for(gbm).fused_compiles
             before = sharded_frame.counters()
             req = urllib.request.Request(
                 base + f"/3/Predictions/models/{gbm.key}/frames/"
                 f"{fr.key}?predictions_frame=sharded_metrics_pred",
                 data=b"", method="POST")
             with urllib.request.urlopen(req, timeout=120) as r:
+                trace_id = r.headers.get("X-H2O3-Trace-Id")
                 json.loads(r.read())
             with urllib.request.urlopen(base + "/3/ScoringMetrics",
                                         timeout=30) as r:
@@ -381,6 +397,36 @@ class TestScoringMetricsRest:
             dp = sm["data_plane"]
             assert dp["gathered_rows"] == before["gathered_rows"]
             assert dp["packed_rows"] >= before["packed_rows"] + fr.nrows
+            # -- span tree (ISSUE 8 acceptance): complete fused-path
+            #    phases, and zero new fused compiles / gathers while
+            #    traced (tracing must not change the dispatch path)
+            assert trace_id
+            assert scoring.session_for(gbm).fused_compiles == compiles0
+            with urllib.request.urlopen(base + f"/3/Trace/{trace_id}",
+                                        timeout=30) as r:
+                tr = json.loads(r.read())
+            names = {s["name"] for s in tr["spans"]}
+            assert {"ingress", "queue_wait", "pack", "dispatch",
+                    "fetch"} <= names, names
+            roots = tr["tree"]
+            assert roots[0]["name"] == "ingress"
+            child_names = {c["name"] for c in roots[0]["children"]}
+            assert {"queue_wait", "pack", "dispatch",
+                    "fetch"} <= child_names
+            # -- cluster /3/Metrics agrees with the data_plane block
+            with urllib.request.urlopen(base + "/3/Metrics",
+                                        timeout=30) as r:
+                text = r.read().decode()
+            m = re.search(r"^h2o3_data_plane_packed_rows_total (\S+)$",
+                          text, re.M)
+            assert m and float(m.group(1)) == dp["packed_rows"]
+            m = re.search(r"^h2o3_data_plane_gathered_rows_total (\S+)$",
+                          text, re.M)
+            assert m and float(m.group(1)) == dp["gathered_rows"]
+            series = {ln.split("{")[0].split(" ")[0]
+                      for ln in text.splitlines()
+                      if ln.strip() and not ln.startswith("#")}
+            assert len(series) >= 20
         finally:
             srv.stop()
             fr.delete()
