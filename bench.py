@@ -267,6 +267,7 @@ def main():
                 break
         if got is not None:
             for sname, env in (("score", {"H2O3_BENCH_ONLY": "score"}),
+                               ("rapids", {"H2O3_BENCH_ONLY": "rapids"}),
                                ("artifact", {"H2O3_BENCH_ONLY": "artifact"}),
                                ("drf-deep", {"H2O3_BENCH_ONLY": "drf"}),
                                ("pallas", {"H2O3_BENCH_ONLY": "pallas"}),
@@ -309,6 +310,23 @@ def main():
                 got = score
         else:
             _record("cpu-score", ok=False, error="skipped: deadline")
+        if remaining() > 140:
+            # rapids data-plane metric: fused-vs-eager statement engine —
+            # pure CPU-measurable, so the trajectory gains a data-plane
+            # number even while the device tree stage is dark
+            rap = _stage("cpu-rapids", [py, "-m", "h2o3_tpu.bench"], 130,
+                         env_extra={"PALLAS_AXON_POOL_IPS": "",
+                                    "JAX_PLATFORMS": "cpu",
+                                    "XLA_FLAGS":
+                                    (os.environ.get("XLA_FLAGS", "") +
+                                     " --xla_force_host_platform_"
+                                     "device_count=8"),
+                                    "H2O3_BENCH_ONLY": "rapids",
+                                    "H2O3_BENCH_RAPIDS_ROWS": "2000000"})
+            if got is None:
+                got = rap
+        else:
+            _record("cpu-rapids", ok=False, error="skipped: deadline")
         if remaining() > 170:
             # serving-tier artifact metrics land even on a dead tunnel
             _stage("cpu-artifact", [py, "-m", "h2o3_tpu.bench"], 160,

@@ -765,17 +765,21 @@ def h_scoring_metrics(ctx: Ctx):
     persistent compile-cache stats, and the per-process sharded data-plane
     counters (``data_plane.packed_rows`` / ``data_plane.gathered_rows`` —
     "no coordinator column gather on the fused path" is asserted against
-    gathered_rows staying 0). The per-dispatch events are also in
-    /3/Timeline under kind='scoring'."""
+    gathered_rows staying 0), and the Rapids statement-fusion block
+    (``rapids``: statements, fused programs/compiles/cache hits, barrier
+    fallbacks, host-materialized cells). The per-dispatch events are also
+    in /3/Timeline under kind='scoring'."""
     from h2o3_tpu import admission, scoring
     from h2o3_tpu.artifact import compile_cache
     from h2o3_tpu.core import sharded_frame
+    from h2o3_tpu.rapids import fusion
 
     return {"__meta": S.meta("ScoringMetricsV3"),
             "models": scoring.metrics_snapshot(),
             "admission": admission.CONTROLLER.snapshot(),
             "compile_cache": compile_cache.stats(),
-            "data_plane": sharded_frame.counters()}
+            "data_plane": sharded_frame.counters(),
+            "rapids": fusion.stats()}
 
 
 def h_metrics(ctx: Ctx):

@@ -494,12 +494,18 @@ def h_rapids(ctx: Ctx):
     ast = ctx.arg("ast", "")
     sid = str(ctx.arg("session_id", "default"))
     sess = _SESSIONS.setdefault(sid, Session(sid))
+    from h2o3_tpu.obs import metrics as obs_metrics
     from h2o3_tpu.parallel import oplog
 
     # munging runs device programs too: replay the same AST cloud-wide
     op_seq = oplog.broadcast("rapids", {"ast": str(ast), "session_id": sid})
+    t0 = time.perf_counter()
     with oplog.turn(op_seq):
+        # exec_rapids emits parse/plan/execute/fused_dispatch child spans
+        # on the ingress trace (wall-clock only — no device syncs added)
         val = exec_rapids(ast, sess)
+    obs_metrics.observe("h2o3_rapids_statement_seconds",
+                        time.perf_counter() - t0)
     out: Dict[str, Any] = {"__meta": S.meta("RapidsFrameV3", "RapidsFrameV3")}
     if isinstance(val, Frame):
         if DKV.get(str(val.key)) is None:

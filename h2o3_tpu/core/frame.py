@@ -21,6 +21,7 @@ TPU-native design (SURVEY.md §7):
 
 from __future__ import annotations
 
+import itertools
 import math
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
@@ -38,6 +39,10 @@ T_UUID = "uuid"
 T_BAD = "bad"
 
 NA_CAT = np.int32(-1)
+
+# monotonically increasing Column identity tokens (Column.token); CPython's
+# GIL makes next() atomic, so no lock is needed
+_COLUMN_TOKENS = itertools.count(1)
 
 
 def _code_dtype(n_levels: int):
@@ -92,7 +97,7 @@ class Column:
     """
 
     __slots__ = ("_data", "_evicted", "_loader", "_touch", "ctype", "domain",
-                 "host_data", "nrows", "_rollups", "_chunks")
+                 "host_data", "nrows", "_rollups", "_chunks", "_token")
 
     def __init__(self, data, ctype: str, nrows: int,
                  domain: Optional[List[str]] = None,
@@ -106,6 +111,9 @@ class Column:
         self.host_data = host_data
         self.nrows = int(nrows)
         self._rollups = None
+        # minted eagerly: a lazy check-then-set would race under the
+        # threaded REST server and hand two threads different tokens
+        self._token = next(_COLUMN_TOKENS)
 
     # -- HBM residency (water/Cleaner.java analog: cold columns swap to
     #    host RAM; access faults them back in) ----------------------------
@@ -243,6 +251,15 @@ class Column:
     def from_device(data, ctype: str, nrows: int,
                     domain: Optional[List[str]] = None) -> "Column":
         return Column(data, ctype, nrows, domain=domain)
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def token(self) -> int:
+        """Process-unique stable identity for this Column. Unlike ``id()``
+        it is never reused after GC, so it is safe as a dictionary key
+        that may outlive the object (Rapids Session refcounts, fusion
+        leaf dedup)."""
+        return self._token
 
     # -- introspection ----------------------------------------------------
     @property

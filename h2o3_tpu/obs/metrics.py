@@ -450,6 +450,38 @@ def _install_default_metrics() -> None:
                  "fused executables served from the persistent cache",
                  lambda: _scoring_field("compile_cache_hits"))
 
+    def _rapids(field):
+        def fn():
+            from h2o3_tpu.rapids import fusion
+
+            return float(fusion.counters()[field])
+        return fn
+
+    r.counter_fn("h2o3_rapids_statements_total",
+                 "rapids statements executed", _rapids("statements"))
+    r.counter_fn("h2o3_rapids_fused_statements_total",
+                 "statements that ran at least one fused program",
+                 _rapids("fused_statements"))
+    r.counter_fn("h2o3_rapids_fused_programs_total",
+                 "fused rapids program executions", _rapids("fused_programs"))
+    r.counter_fn("h2o3_rapids_fused_programs_compiled_total",
+                 "fused rapids programs actually XLA-compiled",
+                 _rapids("fused_programs_compiled"))
+    r.counter_fn("h2o3_rapids_compile_cache_hits_total",
+                 "fused rapids programs served warm (signature or disk "
+                 "tier)", _rapids("compile_cache_hits"))
+    r.counter_fn("h2o3_rapids_barrier_fallbacks_total",
+                 "host-fallback prim executions (the exceptional path)",
+                 _rapids("barrier_fallbacks"))
+    r.counter_fn("h2o3_rapids_host_materialized_cells_total",
+                 "cells staged on host by host-fallback prims",
+                 _rapids("host_materialized_cells"))
+    r.counter_fn("h2o3_rapids_fused_rows_total",
+                 "logical rows through fused rapids programs",
+                 _rapids("fused_rows"))
+    r.histogram("h2o3_rapids_statement_seconds",
+                "rapids statement wall time over POST /99/Rapids (seconds)")
+
     def _adm(field):
         def fn():
             from h2o3_tpu import admission

@@ -26,9 +26,15 @@ def _as_f32(col: Column):
     return col.data
 
 
-@jax.jit
-def _cat_to_f32(d):
+def cat_to_f32_expr(d):
+    """Traceable enum-code -> f32 view (NA code -1 -> NaN). The ONE
+    definition both the eager jit below and the rapids fusion emitter
+    trace through — sharing it is what makes fused statements bitwise
+    identical to the eager evaluator by construction."""
     return jnp.where(d >= 0, d.astype(jnp.float32), jnp.nan)
+
+
+_cat_to_f32 = jax.jit(cat_to_f32_expr)
 
 
 def _trigamma(x):
@@ -73,16 +79,22 @@ _UNOPS = {
 }
 
 
+def binop_expr(op: str, a, b):
+    """Traceable binary op with H2O NA semantics: arithmetic lets NaN
+    propagate; comparisons force NA rows to NA. Shared by the eager
+    `binop` jit and the rapids fusion emitter (bitwise parity)."""
+    if op in _CMPOPS:
+        na = jnp.isnan(a) | jnp.isnan(b)
+        return jnp.where(na, jnp.nan,
+                         _CMPOPS[op](a, b).astype(jnp.float32))
+    return _BINOPS[op](a, b).astype(jnp.float32)
+
+
 @functools.lru_cache(maxsize=128)
 def _jit_binop(op: str, cmp: bool):
-    fn = _CMPOPS[op] if cmp else _BINOPS[op]
-
     @jax.jit
     def run(a, b):
-        if cmp:
-            na = jnp.isnan(a) | jnp.isnan(b)
-            return jnp.where(na, jnp.nan, fn(a, b).astype(jnp.float32))
-        return fn(a, b).astype(jnp.float32)
+        return binop_expr(op, a, b)
 
     return run
 
@@ -99,13 +111,16 @@ def binop(op: str, left, right) -> Column:
     return Column.from_device(out, T_NUM, ref.nrows)
 
 
+def unop_expr(op: str, a):
+    """Traceable unary op (shared eager/fused definition)."""
+    return _UNOPS[op](a).astype(jnp.float32)
+
+
 @functools.lru_cache(maxsize=128)
 def _jit_unop(op: str):
-    fn = _UNOPS[op]
-
     @jax.jit
     def run(a):
-        return fn(a).astype(jnp.float32)
+        return unop_expr(op, a)
 
     return run
 
@@ -115,10 +130,45 @@ def unop(op: str, col: Column) -> Column:
     return Column.from_device(out, T_NUM, col.nrows)
 
 
-@jax.jit
-def _ifelse(c, a, b):
+def ifelse_expr(c, a, b):
+    """Traceable (ifelse cond yes no): NA cond -> NA (shared eager/fused)."""
     na = jnp.isnan(c)
     return jnp.where(na, jnp.nan, jnp.where(c != 0, a, b))
+
+
+def logical_expr(op: str, a, b):
+    """Traceable `&`/`|` with H2O three-valued-logic NA semantics
+    (0 & NA = 0, 1 | NA = 1; else NA poisons). Shared by the eager
+    evaluator's logical prims and the fusion emitter."""
+    if op == "&":
+        return jnp.where((a == 0) | (b == 0), 0.0,
+                         jnp.where(jnp.isnan(a) | jnp.isnan(b), jnp.nan,
+                                   1.0))
+    return jnp.where((a != 0) & ~jnp.isnan(a) | ((b != 0) & ~jnp.isnan(b)),
+                     1.0,
+                     jnp.where(jnp.isnan(a) | jnp.isnan(b), jnp.nan, 0.0))
+
+
+def isna_expr(a):
+    """Traceable is.na over an f32 view (shared eager/fused). Emitted as
+    a select rather than convert(pred): XLA's algebraic simplifier
+    rewrites multiply(convert(pred), x) -> select(pred, x, 0), which
+    silently drops NaN propagation through 0*NaN when the mask and the
+    multiply land in ONE fused program — the select form pins IEEE
+    semantics in both evaluation modes."""
+    return jnp.where(jnp.isnan(a), jnp.float32(1.0), jnp.float32(0.0))
+
+
+_ifelse = jax.jit(ifelse_expr)
+
+
+@functools.lru_cache(maxsize=8)
+def _jit_logical(op: str):
+    @jax.jit
+    def run(a, b):
+        return logical_expr(op, a, b)
+
+    return run
 
 
 def ifelse(cond: Column, yes, no) -> Column:
@@ -127,9 +177,7 @@ def ifelse(cond: Column, yes, no) -> Column:
     return Column.from_device(_ifelse(_as_f32(cond), a, b), T_NUM, cond.nrows)
 
 
-@jax.jit
-def _isna(d):
-    return jnp.isnan(d).astype(jnp.float32)
+_isna = jax.jit(isna_expr)
 
 
 def is_na(col: Column) -> Column:

@@ -34,15 +34,23 @@ def _selection_order(mask):
     return order, jnp.sum(keep)
 
 
-@functools.lru_cache(maxsize=32)
-def _gather_fn(is_cat: bool, out_len: int):
+@functools.lru_cache(maxsize=64)
+def _gather_many_fn(is_cat: tuple, dtypes: tuple, out_len: int):
+    """ONE program gathering every device column of a frame through the
+    shared permutation: the row-filter/slice/take analog of the fused
+    statement engine — previously each column paid its own dispatch.
+    Per column: take through order[:out_len], then re-sentinel the rows
+    beyond the kept count k (NA_CAT for enum codes, NaN for numerics) so
+    the pad tail keeps the Column NA contract; `dtypes` is
+    cache-key-only (pins the trace to one column layout)."""
     @jax.jit
-    def run(data, order, k):
-        g = jnp.take(data, order[:out_len], axis=0)
+    def run(order, k, *datas):
         idx = jnp.arange(out_len)
-        if is_cat:
-            return jnp.where(idx < k, g, NA_CAT)
-        return jnp.where(idx < k, g, jnp.nan)
+        outs = []
+        for cat, d in zip(is_cat, datas):
+            g = jnp.take(d, order[:out_len], axis=0)
+            outs.append(jnp.where(idx < k, g, NA_CAT if cat else jnp.nan))
+        return tuple(outs)
 
     return run
 
@@ -50,6 +58,15 @@ def _gather_fn(is_cat: bool, out_len: int):
 def _apply_order(frame: Frame, order, k: int, key: Optional[str] = None) -> Frame:
     cl = _cluster()
     out_len = min(cl.pad_rows(k), int(order.shape[0]))
+    dev: dict = {}
+    dev_cols = [(name, frame.col(name)) for name in frame.names
+                if frame.col(name).data is not None]
+    if dev_cols:
+        fn = _gather_many_fn(
+            tuple(c.ctype == T_CAT for _, c in dev_cols),
+            tuple(str(c.data.dtype) for _, c in dev_cols), out_len)
+        gathered = fn(order, jnp.int32(k), *[c.data for _, c in dev_cols])
+        dev = {name: g for (name, _), g in zip(dev_cols, gathered)}
     out = Frame(key=key)
     for name in frame.names:
         c = frame.col(name)
@@ -58,8 +75,7 @@ def _apply_order(frame: Frame, order, k: int, key: Optional[str] = None) -> Fram
             host = host[host < c.nrows]
             out.add(name, Column(None, c.ctype, k, host_data=c.host_data[host]))
             continue
-        g = _gather_fn(c.ctype == T_CAT, out_len)(c.data, order, jnp.int32(k))
-        g = cl.reshard_rows(g)
+        g = cl.reshard_rows(dev[name])
         out.add(name, Column(g, c.ctype, k, domain=c.domain))
     return out
 
@@ -86,7 +102,8 @@ def slice_rows(frame: Frame, start: int, stop: int, key: Optional[str] = None) -
 
 
 def take_rows(frame: Frame, rows: np.ndarray, key: Optional[str] = None) -> Frame:
-    """Gather arbitrary row indices (host-provided)."""
+    """Gather arbitrary row indices (host-provided). Device columns ride
+    the same one-program fused gather as _apply_order."""
     cl = _cluster()
     rows = np.asarray(rows, np.int64)
     k = len(rows)
@@ -94,14 +111,23 @@ def take_rows(frame: Frame, rows: np.ndarray, key: Optional[str] = None) -> Fram
     order = np.zeros(max(out_len, k), np.int32)
     order[:k] = rows
     order_dev = jnp.asarray(order[:out_len])
+    dev: dict = {}
+    dev_cols = [(name, frame.col(name)) for name in frame.names
+                if frame.col(name).data is not None]
+    if dev_cols:
+        fn = _gather_many_fn(
+            tuple(c.ctype == T_CAT for _, c in dev_cols),
+            tuple(str(c.data.dtype) for _, c in dev_cols), out_len)
+        gathered = fn(order_dev, jnp.int32(k),
+                      *[c.data for _, c in dev_cols])
+        dev = {name: g for (name, _), g in zip(dev_cols, gathered)}
     out = Frame(key=key)
     for name in frame.names:
         c = frame.col(name)
         if c.data is None:
             out.add(name, Column(None, c.ctype, k, host_data=c.host_data[rows]))
             continue
-        g = _gather_fn(c.ctype == T_CAT, out_len)(c.data, order_dev, jnp.int32(k))
-        g = cl.reshard_rows(g)
+        g = cl.reshard_rows(dev[name])
         out.add(name, Column(g, c.ctype, k, domain=c.domain))
     return out
 

@@ -22,10 +22,19 @@ from h2o3_tpu.core.frame import Column, Frame, T_CAT, T_NUM
 
 
 def _codes_and_levels(frame: Frame, by: Sequence[str]) -> Tuple[jnp.ndarray, List[np.ndarray], int]:
-    """Flatten the by-columns into one int32 code per row (-1 where any NA)."""
+    """Flatten the by-columns into one int32 code per row (-1 where any NA).
+
+    Enum by-columns stay on device end-to-end (codes + host-side domain),
+    so enum-keyed group-by consumes the columns' row shards where they
+    are — the ShardedFrame contract. Numeric by-columns still factorize
+    on host (np.unique needs the values) and are counted ``gathered`` —
+    the demoted path the data-plane counters make observable."""
+    from h2o3_tpu.core import sharded_frame
+
     sizes = []
     code_arrays = []
     levels = []
+    gathered = False
     for name in by:
         c = frame.col(name)
         if c.is_categorical:
@@ -34,12 +43,16 @@ def _codes_and_levels(frame: Frame, by: Sequence[str]) -> Tuple[jnp.ndarray, Lis
             levels.append(np.asarray(c.domain, dtype=object))
         else:
             vals = c.to_numpy()
+            sharded_frame.note_gathered(c.nrows)
+            gathered = True
             uniq, codes = np.unique(vals[~np.isnan(vals)], return_inverse=True)
             full = np.full(c.padded_rows, -1, np.int32)
             full[: c.nrows][~np.isnan(vals)] = codes.astype(np.int32)
             code_arrays.append(jnp.asarray(full))
             sizes.append(max(len(uniq), 1))
             levels.append(uniq)
+    if not gathered:
+        sharded_frame.note_packed(frame.nrows)
     # pack in int32 regardless of code width — narrow (int8/int16) cat codes
     # would overflow the product key for multi-column groups
     flat = jnp.zeros(code_arrays[0].shape, jnp.int32)

@@ -33,8 +33,14 @@ from h2o3_tpu.ops.filters import take_rows
 
 def _key_arrays(left: Frame, right: Frame, bx: Sequence[str],
                 by: Sequence[str]):
-    """Per key column: (left f32-exact array, right array) with NAs as NaN
-    and categorical codes remapped onto a shared union domain."""
+    """Per key column: (left, right) DEVICE arrays with NAs as NaN and
+    categorical codes remapped onto a shared union domain. The arrays are
+    the columns' own row-sharded (padded) buffers — the join consumes
+    shard-local blocks in place instead of round-tripping key columns
+    through the coordinator host; _rank_fn slices the logical rows inside
+    the compiled program. Only the O(|domain|) union map is host work."""
+    import jax.numpy as jnp
+
     pairs = []
     for ln, rn in zip(bx, by):
         lc, rc = left.col(ln), right.col(rn)
@@ -53,15 +59,17 @@ def _key_arrays(left: Frame, right: Frame, bx: Sequence[str],
                     pos[v] = nxt
                     nxt += 1
                 rmap_l.append(pos[v])
-            lmap = np.arange(max(len(ld), 1), dtype=np.float64)
-            rmap = np.asarray(rmap_l or [0], np.float64)
-            lcodes = np.asarray(lc.to_numpy())
-            rcodes = np.asarray(rc.to_numpy())
-            la = np.where(lcodes >= 0, lmap[np.maximum(lcodes, 0)], np.nan)
-            ra = np.where(rcodes >= 0, rmap[np.maximum(rcodes, 0)], np.nan)
+            rmap = jnp.asarray(np.asarray(rmap_l or [0], np.float32))
+            lcodes = lc.data
+            rcodes = rc.data
+            # left map is the identity over its own domain
+            la = jnp.where(lcodes >= 0, lcodes.astype(jnp.float32), jnp.nan)
+            ra = jnp.where(rcodes >= 0,
+                           jnp.take(rmap,
+                                    jnp.maximum(rcodes, 0).astype(jnp.int32)),
+                           jnp.nan)
         else:
-            la = np.asarray(lc.to_numpy(), np.float64)
-            ra = np.asarray(rc.to_numpy(), np.float64)
+            la, ra = lc.data, rc.data            # padded f32, NaN = NA/pad
         pairs.append((la, ra))
     return pairs
 
@@ -95,8 +103,11 @@ def _rank_fn(nl: int, nr: int, k: int):
         combined = None
         na = jnp.zeros(n, bool)
         for j in range(k):
-            v = jnp.concatenate([cols[2 * j], cols[2 * j + 1]]).astype(
-                jnp.float32)
+            # key buffers arrive PADDED (the columns' own row-sharded
+            # layout); the logical-row slice happens here, inside the
+            # compiled program, so no host staging is ever needed
+            v = jnp.concatenate([cols[2 * j][:nl],
+                                 cols[2 * j + 1][:nr]]).astype(jnp.float32)
             na = na | jnp.isnan(v)
             rank = dense_rank(v)
             combined = rank if combined is None else fold(combined, rank)
@@ -166,7 +177,13 @@ def _device_pairs(pairs, nl: int, nr: int, all_x: bool, all_y: bool):
 
 def _host_pairs(left: Frame, right: Frame, bx, by, all_x, all_y):
     """Hash join over host key tuples — string keys / mixed types. NA keys
-    (None or NaN components) match NOTHING, like the device path."""
+    (None or NaN components) match NOTHING, like the device path. This is
+    the demoted host path: the key columns are staged on the coordinator,
+    so the rows are counted ``gathered`` on the data-plane counters."""
+    from h2o3_tpu.core import sharded_frame
+
+    sharded_frame.note_gathered(left.nrows + right.nrows)
+
     def tuples(frame, names):
         cols = []
         for n in names:
@@ -215,6 +232,9 @@ def merge(left: Frame, right: Frame, all_x=False, all_y=False,
 
     pairs = _key_arrays(left, right, bx, by)
     if pairs is not None:
+        from h2o3_tpu.core import sharded_frame
+
+        sharded_frame.note_packed(left.nrows + right.nrows)
         lrows, rrows = _device_pairs(pairs, left.nrows, right.nrows,
                                      all_x, all_y)
     else:

@@ -17,7 +17,7 @@ from h2o3_tpu.core.frame import Column, Frame, T_CAT, T_NUM, T_STR
 from h2o3_tpu.ops import elementwise as E
 from h2o3_tpu.ops import filters as FL
 from h2o3_tpu.rapids.parser import (Id, Lambda, NumList, Span, StrLit,
-                                    StrList, parse)
+                                    StrList, parse, parse_cached)
 
 
 class Session:
@@ -33,11 +33,14 @@ class Session:
     def __init__(self, session_id: str = "default"):
         self.id = session_id
         self.temps: Dict[str, Frame] = {}
-        self.refcnt: Dict[int, int] = {}     # id(Column) -> temp refs
+        # keyed by Column.token, NOT id(): id() values are reused after GC,
+        # so an id-keyed map can credit a brand-new Column with a dead
+        # Column's leftover refcount and corrupt the rm/end bookkeeping
+        self.refcnt: Dict[int, int] = {}     # Column.token -> temp refs
 
     def _track(self, fr: Frame, delta: int):
         for c in fr.columns:
-            cid = id(c)
+            cid = c.token
             n = self.refcnt.get(cid, 0) + delta
             if n <= 0:
                 self.refcnt.pop(cid, None)
@@ -57,7 +60,7 @@ class Session:
         return out
 
     def column_refs(self, col: Column) -> int:
-        return self.refcnt.get(id(col), 0)
+        return self.refcnt.get(col.token, 0)
 
     def remove(self, key: str):
         old = self.temps.pop(key, None)
@@ -745,12 +748,9 @@ def _logical(op):
 
         a = E._as_f32(lc) if isinstance(lc, Column) else jnp.float32(lc)
         b = E._as_f32(rc) if isinstance(rc, Column) else jnp.float32(rc)
-        if op == "&":
-            v = jnp.where((a == 0) | (b == 0), 0.0,
-                          jnp.where(jnp.isnan(a) | jnp.isnan(b), jnp.nan, 1.0))
-        else:
-            v = jnp.where((a != 0) & ~jnp.isnan(a) | ((b != 0) & ~jnp.isnan(b)), 1.0,
-                          jnp.where(jnp.isnan(a) | jnp.isnan(b), jnp.nan, 0.0))
+        # the same traceable expression the fusion emitter composes
+        # (ops/elementwise.logical_expr) — one definition, bitwise parity
+        v = E._jit_logical(op)(a, b)
         ref = lc if isinstance(lc, Column) else rc
         return _colfr(Column.from_device(v, T_NUM, ref.nrows), op)
     return impl
@@ -830,7 +830,16 @@ def _eval(ast, env: Env):
             fn = PRIMS.get(name)
             if fn is None:
                 raise ValueError(f"unknown rapids primitive {name!r}")
+            if name in _fusion.ROOT_OPS:
+                # offer the MAXIMAL fusible subtree rooted here to the
+                # fusion engine: one XLA program instead of one dispatch
+                # (and one Column materialization) per prim
+                got = _fusion.try_execute(ast, env)
+                if got is not _fusion._MISS:
+                    return got
             args = [_eval(a, env) for a in ast[1:]]
+            if _fusion.PRIM_FUSION.get(name) == _fusion.HOST:
+                _fusion.note_host_fallback()   # the exceptional path
             return fn(env, *args)
         if isinstance(head, Lambda):
             args = [_eval(a, env) for a in ast[1:]]
@@ -858,16 +867,32 @@ def _eval_lambda(env: Env, lam, args):
 
 
 def exec_rapids(expr: str, session: Optional[Session] = None):
-    """Parse + evaluate one Rapids expression (water/rapids/Rapids.exec)."""
+    """Parse + evaluate one Rapids statement (water/rapids/Rapids.exec).
+
+    Fusible chains execute as single XLA programs (rapids/fusion.py);
+    parse/plan/execute child spans land on the active trace (inert when
+    no trace is active — wall-clock only, no device syncs)."""
+    from h2o3_tpu.obs import tracing
+
     session = session or Session()
     env = Env(session)
-    ast = parse(expr)
-    # StrLit/list at top level (e.g. "frame_id") → lookup
-    if isinstance(ast, StrLit):
-        return env.lookup(ast.s)
-    return _eval(ast, env)
+    _fusion.note_statement()
+    progs_before = _fusion.counters()["fused_programs"]
+    with tracing.span("parse", chars=len(expr)):
+        ast = parse_cached(expr)
+    try:
+        # StrLit/list at top level (e.g. "frame_id") → lookup
+        if isinstance(ast, StrLit):
+            return env.lookup(ast.s)
+        with tracing.span("execute"):
+            return _eval(ast, env)
+    finally:
+        _fusion.note_statement_result(progs_before)
 
 
 # extended prim suites register themselves on import (advmath/time/string/
 # search/mungers/matrix/repeaters/timeseries — water/rapids/ast/prims/*)
 from h2o3_tpu.rapids import prims_ext as _prims_ext  # noqa: E402,F401
+# the statement fusion engine (classification registry + planner); imported
+# after the registries are complete so its guard surface sees every prim
+from h2o3_tpu.rapids import fusion as _fusion  # noqa: E402

@@ -1,0 +1,810 @@
+"""Rapids statement fusion engine: one XLA program per statement.
+
+Reference: water/rapids executes each prim as its own MRTask pass over the
+chunks; the first jax_graft port kept that shape — one (or a few) XLA
+dispatches per prim with a Column materialized between every step, and a
+host sync wherever a scalar crossed a prim boundary. This module is the
+PR-2-style "compile-once fast path" applied to the whole expression
+engine (ROADMAP open item 4a):
+
+- **Classification.** Every registered prim carries one of three
+  fusibility classes (closed enumeration, guarded by
+  tests/test_consistency.py): ``fusible`` prims can appear INSIDE one
+  fused XLA program (elementwise arithmetic/comparison/logic, unary
+  math, ifelse, is.na, column selection); ``barrier`` prims are
+  device-executed but bound a fused region with their own program
+  (group-by, merge, sort, quantile, cumulative ops, the reducers and
+  the ``rows`` filter — both consume fused chains as input, structural
+  munging); ``host`` prims materialize data on the host and are the
+  EXCEPTIONAL path — each execution increments the
+  ``barrier_fallbacks`` counter.
+- **Planning.** The evaluator offers every fusible application node to
+  :func:`try_execute` before falling back to eager evaluation. The
+  planner walks the subtree, binds Column leaves (dtype-checked,
+  dedup'd by ``Column.token``, all sharing one padded row layout) and
+  scalar constants, and renders a structure-only signature — constants
+  are traced arguments, so repeated client statements that differ only
+  in literals share one compiled program. A successful plan covers the
+  MAXIMAL fusible subtree; barrier/host ancestors simply consume its
+  result, so chains fuse without any special casing per prim. The one
+  carve-out from "one program per statement" is bitwise soundness:
+  edges the compiler is known to rewrite across (mul feeding +/- —
+  FMA contraction; division/power chains — algebraic reassociation)
+  become sub-program boundaries (:func:`_split_rewrite_edges`), each
+  segment cached and shared like any other program.
+- **Compilation.** Programs are AOT-compiled (``lower().compile()``)
+  once per signature × column dtypes × padded-rows bucket and held in
+  an in-process cache; the PR-6 persistent compile cache
+  (``$H2O_TPU_COMPILE_CACHE_DIR``, artifact/compile_cache.py) serializes
+  them across processes and restarts, so a warm server compiles ZERO
+  fused programs for statement shapes it has seen before
+  (counter-asserted by the fusion test suite).
+- **Sharded execution.** Leaves are the columns' row-sharded device
+  buffers consumed where they are; the program's output sharding is
+  pinned to ``P('rows')`` over the mesh's named row axis
+  (core/sharded_frame.ROW_AXIS), so fused statements never stage a
+  column on the coordinator — ``gathered_rows`` stays 0 and the rows
+  are counted ``packed`` on the same data-plane counters PR 7
+  introduced. The eager evaluator remains as the degraded/ragged
+  fallback, exactly as the host-packed scorer did.
+
+The emitter composes the SAME traceable expressions the eager jits wrap
+(ops/elementwise binop_expr/unop_expr/ifelse_expr/logical_expr/
+isna_expr/cat_to_f32_expr), which is what makes fused output bitwise
+identical to the eager evaluator by construction: identical per-element
+op DAG, identical f32 casts at every node boundary — XLA fusion removes
+the intermediate materializations, not the rounding steps.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from h2o3_tpu.core.frame import (Column, Frame, T_CAT, T_INT, T_NUM,
+                                 T_TIME)
+from h2o3_tpu.ops import elementwise as E
+from h2o3_tpu.rapids.parser import (Id, Lambda, NumList, Span, StrLit,
+                                    StrList)
+
+# ---------------------------------------------------------------------------
+# fusibility classes — closed enumeration (consistency-suite guarded)
+# ---------------------------------------------------------------------------
+
+FUSIBLE = "fusible"
+BARRIER = "barrier"
+HOST = "host"
+FUSION_CLASSES = frozenset({FUSIBLE, BARRIER, HOST})
+
+# canonical op aliases (h2o-py emits both spellings)
+_OP_ALIAS = {"%%": "%", "%/%": "intDiv", "&&": "&", "||": "|"}
+
+_BIN_NAMES = {"+", "-", "*", "/", "^", "%", "intDiv", "%%", "%/%",
+              "==", "!=", "<", "<=", ">", ">="}
+_LOGICAL_NAMES = {"&", "&&", "|", "||"}
+
+# fusible = can appear INSIDE one fused program. Reducers and the `rows`
+# filter are NOT here: they CONSUME a fused chain but always execute as
+# their own program (the rollup reduction / the selection gather), which
+# is exactly the barrier definition.
+_FUSIBLE_NAMES = (_BIN_NAMES | _LOGICAL_NAMES | set(E._UNOPS)
+                  | {"ifelse", "is.na", "cols", "cols_py"})
+
+# device-executed (or pure-metadata) prims that bound a fused region
+_BARRIER_NAMES = {
+    ",", ":=", "GB", "append", "assign", "cbind", "colnames=",
+    "columnsByType", "cor", "cummax", "cummin", "cumprod", "cumsum",
+    "distance", "filterNACols", "getTimeZone", "h2o.fillna", "h2o.impute",
+    "any.factor", "any.na", "is.character", "is.factor", "is.numeric",
+    "kurtosis", "median", "merge", "model.reset.threshold", "na.omit",
+    "ncol", "nlevels", "none", "nrow", "prod", "prod.na", "quantile",
+    "rbind", "rename", "rm", "rows", "scale", "setDomain", "setTimeZone",
+    "setproperty", "skewness", "sort", "sumNA", "sumaxis", "table",
+    "tmp=", "unique", "which.max", "which.min", "x",
+    "mean", "sum", "min", "max", "sd", "var", "all", "any", "naCnt",
+    "nacnt",
+}
+
+# host-materializing prims — the exceptional path (barrier_fallbacks)
+_HOST_NAMES = {
+    "apply", "as.Date", "as.character", "as.factor", "as.numeric",
+    "ascharacter", "asfactor", "asnumeric", "countmatches", "cut", "day",
+    "dayOfWeek", "ddply", "difflag1", "dropdup", "entropy", "flatten",
+    "getrow", "grep", "grouped_permute", "h2o.mad",
+    "h2o.random_stratified_split", "h2o.runif", "h2o.splitframe", "hist",
+    "hour", "isax", "kfold_column", "levels", "listTimeZones", "ls",
+    "lstrip", "mad", "match", "maxNA", "melt", "millis", "minNA",
+    "minute", "mktime", "mode", "modulo_kfold_column", "moment", "month",
+    "nchar", "num_valid_substrings", "perfectAUC", "pivot",
+    "rank_within_groupby", "relevel", "rep_len", "replaceall",
+    "replacefirst", "rstrip", "second", "segment_models_as_frame", "seq",
+    "seq_len",
+    "setLevel", "signif", "strDistance", "stratified_kfold_column",
+    "strlen", "strsplit", "substring", "t", "tf-idf", "tokenize",
+    "tolower", "topn", "toupper", "trim", "week", "which", "year",
+}
+
+PRIM_FUSION: Dict[str, str] = {}
+for _n in _FUSIBLE_NAMES:
+    PRIM_FUSION[_n] = FUSIBLE
+for _n in _BARRIER_NAMES:
+    PRIM_FUSION[_n] = BARRIER
+for _n in _HOST_NAMES:
+    PRIM_FUSION[_n] = HOST
+
+
+def classify(name: str) -> Optional[str]:
+    """Fusibility class of a registered prim (None for unknown names —
+    the consistency guard refuses unclassified prims at test time)."""
+    return PRIM_FUSION.get(name)
+
+
+# compute roots the evaluator offers to try_execute (a subset of the
+# fusible class: prims the emitter can be the ROOT of a fused program for)
+ROOT_OPS = (_BIN_NAMES | _LOGICAL_NAMES | set(E._UNOPS)
+            | {"ifelse", "is.na"})
+
+
+# ---------------------------------------------------------------------------
+# counters (surfaced as h2o3_rapids_* on /3/Metrics and under the
+# ScoringMetrics `rapids` block)
+# ---------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+_COUNTS = {
+    "statements": 0,              # exec_rapids calls
+    "fused_statements": 0,        # statements that ran >= 1 fused program
+    "fused_programs": 0,          # fused program executions
+    "fused_programs_compiled": 0,  # actual XLA compiles
+    "compile_cache_hits": 0,      # warm reuse (in-memory sig or disk tier)
+    "barrier_fallbacks": 0,       # host-class prim executions
+    "host_materialized_cells": 0,  # cells staged on host by host prims
+    "fused_rows": 0,              # logical rows through fused programs
+}
+
+
+def _bump(key: str, n: int = 1) -> None:
+    with _LOCK:
+        _COUNTS[key] += int(n)
+
+
+def note_statement() -> None:
+    _bump("statements")
+
+
+def note_host_fallback() -> None:
+    _bump("barrier_fallbacks")
+
+
+def note_host_cells(cells: int) -> None:
+    _bump("host_materialized_cells", cells)
+
+
+def counters() -> dict:
+    with _LOCK:
+        return dict(_COUNTS)
+
+
+def reset_counters() -> None:
+    with _LOCK:
+        for k in _COUNTS:
+            _COUNTS[k] = 0
+
+
+# ---------------------------------------------------------------------------
+# enable / force switches
+# ---------------------------------------------------------------------------
+
+_FORCE: Optional[bool] = None
+
+
+def enabled() -> bool:
+    """Master switch (H2O_TPU_RAPIDS_FUSION, default on). Off = the eager
+    op-at-a-time evaluator everywhere, kept for A/B bitwise verification
+    and emergency rollback — the same demotion contract as
+    H2O_TPU_SHARDED_PLANE."""
+    if _FORCE is not None:
+        return _FORCE
+    return os.environ.get("H2O_TPU_RAPIDS_FUSION", "1").lower() not in (
+        "0", "false", "off")
+
+
+class force:
+    """Context manager pinning fusion on/off regardless of the env knob
+    (bench A/B runs and the equivalence suite)."""
+
+    def __init__(self, on: bool):
+        self._on = bool(on)
+        self._prev: Optional[bool] = None
+
+    def __enter__(self):
+        global _FORCE
+        self._prev = _FORCE
+        _FORCE = self._on
+        return self
+
+    def __exit__(self, *exc):
+        global _FORCE
+        _FORCE = self._prev
+        return False
+
+
+# ---------------------------------------------------------------------------
+# planning
+# ---------------------------------------------------------------------------
+
+class _NotFusible(Exception):
+    """Internal: this subtree cannot enter a fused program."""
+
+
+_LEAF_CTYPES = (T_NUM, T_INT, T_CAT, T_TIME)
+
+
+class Plan:
+    """A fused column program: expression tree over Column leaves and
+    scalar constants, plus the layout facts the cache key needs."""
+
+    __slots__ = ("root", "leaves", "consts", "leaf_ctypes", "leaf_dtypes",
+                 "padded", "nrows", "n_ops", "out_name", "signature")
+
+    def __init__(self):
+        self.root = None
+        self.leaves: List[Column] = []
+        self.consts: List[float] = []
+        self.leaf_ctypes: List[str] = []
+        self.leaf_dtypes: List[str] = []
+        self.padded: Optional[int] = None
+        self.nrows: Optional[int] = None
+        self.n_ops = 0
+        self.out_name = "C1"
+        self.signature = ""
+
+
+class _Planner:
+    def __init__(self, env):
+        self.env = env
+        self.plan = Plan()
+        self._leaf_ix: Dict[int, int] = {}     # Column.token -> leaf index
+
+    # -- leaves ------------------------------------------------------------
+    def _leaf(self, col: Column) -> tuple:
+        if col.ctype not in _LEAF_CTYPES:
+            raise _NotFusible
+        d = col.data                      # faults evicted columns back in
+        if d is None:
+            raise _NotFusible             # host-resident (string) column
+        p = self.plan
+        padded = int(d.shape[0])
+        if p.padded is None:
+            p.padded, p.nrows = padded, col.nrows
+        elif p.padded != padded or p.nrows != col.nrows:
+            raise _NotFusible             # ragged layout: eager fallback
+        ix = self._leaf_ix.get(col.token)
+        if ix is None:
+            ix = len(p.leaves)
+            self._leaf_ix[col.token] = ix
+            p.leaves.append(col)
+            p.leaf_ctypes.append(col.ctype)
+            p.leaf_dtypes.append(str(d.dtype))
+        return ("L", ix)
+
+    def _const(self, v: float) -> tuple:
+        p = self.plan
+        p.consts.append(float(v))
+        return ("K", len(p.consts) - 1)
+
+    def _resolve_frame(self, a) -> Frame:
+        if isinstance(a, Id):
+            try:
+                v = self.env.lookup(a.name)
+            except KeyError:
+                raise _NotFusible
+            if isinstance(v, Frame):
+                return v
+        raise _NotFusible
+
+    def _leaf_from_cols(self, ast) -> tuple:
+        if len(ast) != 3:
+            raise _NotFusible
+        fr = self._resolve_frame(ast[1])
+        sel = ast[2]
+        if isinstance(sel, StrLit):
+            name = sel.s
+        elif isinstance(sel, StrList) and len(sel) == 1:
+            name = sel[0]
+        elif (isinstance(sel, NumList) and len(sel) == 1
+              and not isinstance(sel[0], Span)):
+            i = int(sel[0])
+            if not 0 <= i < fr.ncols:
+                raise _NotFusible
+            name = fr.names[i]
+        elif isinstance(sel, (int, float)) and not isinstance(sel, bool):
+            i = int(sel)
+            if not 0 <= i < fr.ncols:
+                raise _NotFusible
+            name = fr.names[i]
+        else:
+            raise _NotFusible
+        if name not in fr:
+            raise _NotFusible
+        return self._leaf(fr.col(name))
+
+    # -- recursive build ---------------------------------------------------
+    def build(self, ast) -> Tuple[tuple, bool]:
+        """-> (node, is_column). Mirrors the eager evaluator's value
+        rules so fused and eager agree on which shapes are legal; any
+        shape the eager path would reject raises _NotFusible and the
+        eager path reports the error."""
+        if isinstance(ast, bool):
+            raise _NotFusible
+        if isinstance(ast, (int, float)):
+            return self._const(float(ast)), False
+        if isinstance(ast, Id):
+            try:
+                v = self.env.lookup(ast.name)
+            except KeyError:
+                raise _NotFusible
+            if isinstance(v, Frame):
+                if v.ncols != 1:
+                    raise _NotFusible
+                return self._leaf(v.col(0)), True
+            if isinstance(v, Column):
+                return self._leaf(v), True
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                return self._const(float(v)), False
+            raise _NotFusible
+        if not isinstance(ast, list) or not ast or \
+                not isinstance(ast[0], Id):
+            raise _NotFusible
+        name = ast[0].name
+        p = self.plan
+        if name in ("cols", "cols_py"):
+            return self._leaf_from_cols(ast), True
+        if name in _BIN_NAMES:
+            if len(ast) != 3:
+                raise _NotFusible
+            l, lcol = self.build(ast[1])
+            r, rcol = self.build(ast[2])
+            p.n_ops += 1
+            return ("bin", _OP_ALIAS.get(name, name), l, r), lcol or rcol
+        if name in _LOGICAL_NAMES:
+            if len(ast) != 3:
+                raise _NotFusible
+            l, lcol = self.build(ast[1])
+            r, rcol = self.build(ast[2])
+            if not (lcol or rcol):
+                raise _NotFusible         # eager needs a Column ref
+            p.n_ops += 1
+            return ("log", _OP_ALIAS.get(name, name), l, r), True
+        if name in E._UNOPS:
+            if len(ast) != 2:
+                raise _NotFusible
+            x, xcol = self.build(ast[1])
+            if not xcol:
+                raise _NotFusible         # eager _one_col would raise
+            p.n_ops += 1
+            return ("un", name, x), True
+        if name == "ifelse":
+            if len(ast) != 4:
+                raise _NotFusible
+            c, ccol = self.build(ast[1])
+            if not ccol:
+                raise _NotFusible
+            a, _ = self.build(ast[2])
+            b, _ = self.build(ast[3])
+            p.n_ops += 1
+            return ("ifelse", c, a, b), True
+        if name == "is.na":
+            if len(ast) != 2:
+                raise _NotFusible
+            x, xcol = self.build(ast[1])
+            if not xcol:
+                raise _NotFusible
+            p.n_ops += 1
+            return ("isna", x), True
+        raise _NotFusible
+
+
+def _render(node) -> str:
+    k = node[0]
+    if k in ("L", "K"):
+        return f"{k}{node[1]}"
+    if k in ("bin", "log", "un"):
+        return "(" + node[1] + " " + " ".join(
+            _render(c) for c in node[2:]) + ")"
+    return "(" + k + " " + " ".join(_render(c) for c in node[1:]) + ")"
+
+
+def _out_name(node) -> str:
+    """Output column name, matching the eager prims' _colfr naming."""
+    k = node[0]
+    if k in ("bin", "log", "un"):
+        return node[1]
+    if k == "isna":
+        return "isNA"
+    return "C1"                            # ifelse
+
+
+def plan_expr(ast, env) -> Optional[Plan]:
+    """Plan `ast` as a fused program (plus FMA-boundary sub-programs);
+    None when not fusible."""
+    pl = _Planner(env)
+    try:
+        root, is_col = pl.build(ast)
+    except _NotFusible:
+        return None
+    p = pl.plan
+    if not is_col or p.padded is None or p.n_ops == 0:
+        return None
+    p.root = root
+    p.out_name = _out_name(root)
+    _split_rewrite_edges(p)
+    _finish_signature(p)
+    return p
+
+
+def _plan_is_scalar(plan: Plan) -> bool:
+    """True when the program's output is rank-0 (a const-only subtree:
+    no transitive Column leaf)."""
+    return all(isinstance(l, Plan) and _plan_is_scalar(l)
+               for l in plan.leaves)
+
+
+# ---------------------------------------------------------------------------
+# compilation — in-memory signature cache + PR-6 persistent tier
+# ---------------------------------------------------------------------------
+
+class _Program:
+    __slots__ = ("exe", "jfn")
+
+    def __init__(self, exe, jfn):
+        self.exe = exe
+        self.jfn = jfn
+
+
+_PROGRAMS: Dict[str, _Program] = {}
+_PROG_LOCK = threading.Lock()
+_PROG_CAP = 256
+
+
+def clear_programs() -> None:
+    """Drop the in-process program cache (tests simulate a cold restart
+    against the persistent tier this way)."""
+    with _PROG_LOCK:
+        _PROGRAMS.clear()
+
+
+# ops XLA rewrites ACROSS when composed in one program, diverging from
+# per-op f32 rounding: division/power/remainder chains get reassociated
+# by the algebraic simplifier ((a/b)/c -> a/(b*c), a/exp(b) -> a*exp(-b),
+# ...), so such a node always runs as its own segment with compute
+# operands materialized
+_BOUNDARY_OPS = frozenset({"/", "^", "%", "intDiv"})
+
+
+def _is_compute(node) -> bool:
+    return node[0] not in ("L", "K")
+
+
+def _is_boundary(node) -> bool:
+    return node[0] == "bin" and node[1] in _BOUNDARY_OPS
+
+
+def _split_rewrite_edges(plan: Plan) -> None:
+    """Rewrite the plan so no edge the backend is known to rewrite
+    unsoundly (w.r.t. per-op f32 rounding) stays inside one program:
+
+    - a multiply feeding +/- would be contracted into an FMA by codegen
+      (the product skips its rounding step);
+    - division/power/remainder nodes get algebraically reassociated with
+      their neighbors by the HLO simplifier.
+
+    Each such producer becomes its own sub-program whose materialized
+    output re-enters the parent as a leaf — a program boundary is the
+    one construct the compiler cannot rewrite across (everything cheaper
+    — optimization_barrier, bitcast round-trips, output pinning,
+    reduce_precision — is simplified away or contracted through before
+    codegen; verified empirically). The common long chains of
+    add/sub/mul/cmp/ifelse/mask/unary ops stay in one program.
+    Sub-programs are full Plans: cached by their own signature, split
+    recursively, shared across statements."""
+
+    def walk(node):
+        k = node[0]
+        if k in ("L", "K"):
+            return node
+        if k == "bin":
+            op = node[1]
+            l = walk(node[2])
+            r = walk(node[3])
+            if op in _BOUNDARY_OPS:
+                # a boundary node's compute operands arrive materialized
+                l = extract(l) if _is_compute(l) else l
+                r = extract(r) if _is_compute(r) else r
+            else:
+                if op in ("+", "-"):
+                    if l[0] == "bin" and l[1] == "*":
+                        l = extract(l)
+                    if r[0] == "bin" and r[1] == "*":
+                        r = extract(r)
+                l = extract(l) if _is_boundary(l) else l
+                r = extract(r) if _is_boundary(r) else r
+            return ("bin", op, l, r)
+        kids = [c if isinstance(c, str) else walk(c) for c in node[1:]]
+        kids = [c if isinstance(c, str) or not _is_boundary(c)
+                else extract(c) for c in kids]
+        return (k, *kids)
+
+    def extract(node):
+        sub = Plan()
+        sub.padded, sub.nrows = plan.padded, plan.nrows
+        remap_l: Dict[int, int] = {}
+        remap_k: Dict[int, int] = {}
+
+        def rebind(n):
+            if n[0] == "L":
+                ix = remap_l.get(n[1])
+                if ix is None:
+                    ix = remap_l[n[1]] = len(sub.leaves)
+                    sub.leaves.append(plan.leaves[n[1]])
+                    sub.leaf_ctypes.append(plan.leaf_ctypes[n[1]])
+                    sub.leaf_dtypes.append(plan.leaf_dtypes[n[1]])
+                return ("L", ix)
+            if n[0] == "K":
+                ix = remap_k.get(n[1])
+                if ix is None:
+                    ix = remap_k[n[1]] = len(sub.consts)
+                    sub.consts.append(plan.consts[n[1]])
+                return ("K", ix)
+            return (n[0], *[c if isinstance(c, str) else rebind(c)
+                            for c in n[1:]])
+
+        sub.root = rebind(node)
+        sub.n_ops = _count_ops(sub.root)
+        _split_rewrite_edges(sub)
+        _finish_signature(sub)
+        ix = len(plan.leaves)
+        plan.leaves.append(sub)
+        plan.leaf_ctypes.append(T_NUM)
+        plan.leaf_dtypes.append("float32")
+        return ("L", ix)
+
+    plan.root = walk(plan.root)
+    _compact_leaves(plan)
+
+
+def _count_ops(node) -> int:
+    if node[0] in ("L", "K"):
+        return 0
+    return 1 + sum(_count_ops(c) for c in node[1:]
+                   if not isinstance(c, str))
+
+
+def _compact_leaves(plan: Plan) -> None:
+    """Drop leaves/consts the (possibly rewritten) tree no longer
+    references and renumber the survivors in first-use order."""
+    used_l: Dict[int, int] = {}
+    used_k: Dict[int, int] = {}
+
+    def renum(n):
+        if n[0] == "L":
+            ix = used_l.setdefault(n[1], len(used_l))
+            return ("L", ix)
+        if n[0] == "K":
+            ix = used_k.setdefault(n[1], len(used_k))
+            return ("K", ix)
+        return (n[0], *[c if isinstance(c, str) else renum(c)
+                        for c in n[1:]])
+
+    plan.root = renum(plan.root)
+    plan.leaves = [plan.leaves[i] for i in used_l]
+    plan.leaf_ctypes = [plan.leaf_ctypes[i] for i in used_l]
+    plan.leaf_dtypes = [plan.leaf_dtypes[i] for i in used_l]
+    plan.consts = [plan.consts[i] for i in used_k]
+
+
+def _leaf_sig(plan: Plan, i: int) -> str:
+    leaf = plan.leaves[i]
+    if isinstance(leaf, Plan):
+        return "P{" + leaf.signature + "}"
+    return f"{plan.leaf_ctypes[i]}/{plan.leaf_dtypes[i]}"
+
+
+def _finish_signature(plan: Plan) -> None:
+    plan.signature = (_render(plan.root)
+                      + "|" + ",".join(_leaf_sig(plan, i)
+                                       for i in range(len(plan.leaves)))
+                      + f"|k{len(plan.consts)}|r{plan.padded}")
+
+
+def _constrain_rows(v, mesh):
+    """Pin the root output to the named row sharding from INSIDE the
+    traced program (works identically for jit dispatch and the AOT
+    lower/compile path, and leaves the pinned aux outputs — which may be
+    rank-0 scalar subtrees — unconstrained)."""
+    try:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from h2o3_tpu.core.sharded_frame import ROW_AXIS
+
+        return jax.lax.with_sharding_constraint(
+            v, NamedSharding(mesh, P(ROW_AXIS)))
+    except Exception:   # noqa: BLE001 — constraint is an optimization
+        return v
+
+
+def _emit(plan: Plan, mesh):
+    """Build the traceable python callable for this plan. Leaves convert
+    through the SAME expressions the eager jits trace (elementwise
+    *_expr), giving bitwise parity with op-at-a-time evaluation."""
+    n_leaf = len(plan.leaves)
+    ctypes = list(plan.leaf_ctypes)
+    root = plan.root
+    scalar_out = _plan_is_scalar(plan)
+
+    def f(*args):
+        def ev(node):
+            k = node[0]
+            if k == "L":
+                d = args[node[1]]
+                return (E.cat_to_f32_expr(d) if ctypes[node[1]] == T_CAT
+                        else d)
+            if k == "K":
+                return args[n_leaf + node[1]]
+            if k == "bin":
+                return E.binop_expr(node[1], ev(node[2]), ev(node[3]))
+            if k == "log":
+                return E.logical_expr(node[1], ev(node[2]), ev(node[3]))
+            if k == "un":
+                return E.unop_expr(node[1], ev(node[2]))
+            if k == "ifelse":
+                return E.ifelse_expr(ev(node[1]), ev(node[2]), ev(node[3]))
+            if k == "isna":
+                return E.isna_expr(ev(node[1]))
+            raise AssertionError(f"bad fused node {k!r}")
+
+        r = ev(root)
+        return r if scalar_out else _constrain_rows(r, mesh)
+
+    return f
+
+
+def _mesh():
+    from h2o3_tpu.core.runtime import cluster
+
+    return cluster().mesh
+
+
+def _program_for(plan: Plan) -> _Program:
+    sig = plan.signature
+    with _PROG_LOCK:
+        prog = _PROGRAMS.get(sig)
+    if prog is not None:
+        _bump("compile_cache_hits")
+        return prog
+
+    import jax
+
+    from h2o3_tpu.artifact import compile_cache
+
+    mesh = _mesh()
+    jfn = jax.jit(_emit(plan, mesh))
+
+    ckey = None
+    exe = None
+    if compile_cache.enabled():
+        sig_hash = hashlib.sha256(sig.encode()).hexdigest()
+        ckey = compile_cache.cache_key(sig_hash, plan.padded,
+                                       variant="rapids")
+        exe = compile_cache.load(ckey)
+        if exe is not None:
+            _bump("compile_cache_hits")
+    if exe is None:
+        structs = []
+        for i, leaf in enumerate(plan.leaves):
+            if isinstance(leaf, Plan) and _plan_is_scalar(leaf):
+                structs.append(jax.ShapeDtypeStruct((), np.float32))
+            else:
+                structs.append(jax.ShapeDtypeStruct(
+                    (plan.padded,), np.dtype(plan.leaf_dtypes[i])))
+        structs += [jax.ShapeDtypeStruct((), np.float32)] * len(plan.consts)
+        t0 = time.perf_counter()
+        exe = jfn.lower(*structs).compile()
+        compile_cache.note_compile((time.perf_counter() - t0) * 1000)
+        _bump("fused_programs_compiled")
+        if ckey is not None:
+            compile_cache.store(ckey, exe)
+    prog = _Program(exe, jfn)
+    with _PROG_LOCK:
+        if len(_PROGRAMS) >= _PROG_CAP:
+            _PROGRAMS.pop(next(iter(_PROGRAMS)))
+        _PROGRAMS[sig] = prog
+    return prog
+
+
+# ---------------------------------------------------------------------------
+# execution
+# ---------------------------------------------------------------------------
+
+def _run_program(plan: Plan):
+    """Dispatch one program, resolving sub-program leaves first (each is
+    its own compiled program; outputs stay device-resident between
+    segments)."""
+    import jax.numpy as jnp
+
+    prog = _program_for(plan)
+    args = [(_run_program(leaf) if isinstance(leaf, Plan) else leaf.data)
+            for leaf in plan.leaves]
+    args += [jnp.float32(v) for v in plan.consts]
+    try:
+        out = prog.exe(*args)
+    except Exception:   # noqa: BLE001 — AOT layout/placement mismatch
+        out = prog.jfn(*args)
+    _bump("fused_programs")
+    return out
+
+
+def execute_plan(plan: Plan) -> Column:
+    """Run one fused statement plan over its row-sharded leaves; the
+    result stays a device column (no host round-trip, rows counted
+    packed)."""
+    from h2o3_tpu.core import sharded_frame
+    from h2o3_tpu.obs import tracing
+
+    # host-side dispatch wall time only — the fused result stays
+    # device-resident, so tracing adds no device sync
+    with tracing.span("fused_dispatch", ops=plan.n_ops,
+                      rows=int(plan.nrows), leaves=len(plan.leaves)):
+        out = _run_program(plan)
+    _bump("fused_rows", int(plan.nrows))
+    sharded_frame.note_packed(int(plan.nrows))
+    return Column.from_device(out, T_NUM, plan.nrows)
+
+
+_MISS = object()
+
+
+def try_execute(ast, env):
+    """Offer an application node to the fusion engine. Returns the fused
+    result Frame, or the _MISS sentinel when the subtree is not fusible
+    (the caller falls back to the eager evaluator). Planning only reads
+    the environment (Id lookups are pure), so a miss has no side
+    effects."""
+    if not enabled():
+        return _MISS
+    from h2o3_tpu.obs import tracing
+
+    try:
+        with tracing.span("plan", prim=ast[0].name):
+            plan = plan_expr(ast, env)
+        if plan is None:
+            return _MISS
+        col = execute_plan(plan)
+    except Exception:   # noqa: BLE001 — never take a statement down for a
+        return _MISS    # fusion bug; the eager path is the contract
+    fr = Frame()
+    fr.add(plan.out_name, col)
+    return fr
+
+
+def note_statement_result(fused_programs_before: int) -> None:
+    """Statement epilogue: mark the statement fused when at least one
+    fused program ran during it."""
+    with _LOCK:
+        if _COUNTS["fused_programs"] > fused_programs_before:
+            _COUNTS["fused_statements"] += 1
+
+
+def stats() -> dict:
+    """Counters + cache occupancy (the /3/ScoringMetrics `rapids` block)."""
+    out = counters()
+    with _PROG_LOCK:
+        out["cached_programs"] = len(_PROGRAMS)
+    out["enabled"] = enabled()
+    return out

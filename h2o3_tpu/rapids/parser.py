@@ -11,6 +11,7 @@ float, str wrapped in StrLit, Id for identifiers.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Any, List
 
@@ -166,3 +167,13 @@ def parse(s: str):
     if r.peek() != "":
         raise ValueError(f"trailing input: {r.s[r.i:]!r}")
     return ast
+
+
+@functools.lru_cache(maxsize=1024)
+def parse_cached(s: str):
+    """Memoized :func:`parse` for the statement hot path: h2o-py clients
+    re-send the same AST strings constantly (every frame refresh), and the
+    evaluator treats parsed ASTs as read-only, so caching by the exact
+    expression string is safe. Parse errors are not cached (lru_cache
+    does not memoize raises)."""
+    return parse(s)

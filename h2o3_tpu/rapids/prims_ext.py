@@ -45,6 +45,13 @@ def _num_matrix(fr: Frame) -> np.ndarray:
             f"({fr.nrows} rows × {len(fr.names)} cols = {cells} cells > "
             f"cap {_HOST_MATRIX_MAX_CELLS}); subset the frame first or "
             f"raise H2O_TPU_HOST_MATRIX_CELLS")
+    # the exceptional host path: make its cost observable on
+    # h2o3_rapids_host_materialized_cells_total / the data-plane counters
+    from h2o3_tpu.core import sharded_frame
+    from h2o3_tpu.rapids import fusion
+
+    fusion.note_host_cells(cells)
+    sharded_frame.note_gathered(fr.nrows)
     return np.column_stack([np.asarray(fr.col(n).to_numpy(), np.float64)
                             for n in fr.names])
 

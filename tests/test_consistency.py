@@ -222,6 +222,35 @@ def test_timeline_kinds_are_enumerated():
         "recorded anywhere under h2o3_tpu/ — drop them or record them")
 
 
+def test_rapids_prims_declare_fusibility_class():
+    """ISSUE-10 guard (mirrors the timeline-KINDS guard): every registered
+    Rapids prim must carry exactly one fusibility class from the closed
+    enumeration {fusible, barrier, host} in rapids/fusion.PRIM_FUSION —
+    a new prim without a declaration would silently land as an un-fused
+    barrier the planner (and the barrier_fallbacks metric) cannot see.
+    Dead classifications (names no prim registers) are drift too."""
+    from h2o3_tpu.rapids import fusion
+    from h2o3_tpu.rapids.eval import PRIMS
+
+    registered = set(PRIMS)
+    classified = set(fusion.PRIM_FUSION)
+    missing = registered - classified
+    assert not missing, (
+        f"rapids prim(s) {sorted(missing)} are registered but declare no "
+        "fusibility class — add them to rapids/fusion.py (fusible / "
+        "barrier / host); unclassified prims can't be planned or counted")
+    dead = classified - registered
+    assert not dead, (
+        f"fusibility class entries {sorted(dead)} name prims that are no "
+        "longer registered — drop them from rapids/fusion.py")
+    bad = {n: c for n, c in fusion.PRIM_FUSION.items()
+           if c not in fusion.FUSION_CLASSES}
+    assert not bad, f"fusibility classes outside the enumeration: {bad}"
+    # the planner's root set must be a subset of the fusible class
+    assert fusion.ROOT_OPS <= {n for n, c in fusion.PRIM_FUSION.items()
+                               if c == fusion.FUSIBLE}
+
+
 def test_fused_paths_never_gather_columns_to_coordinator():
     """ISSUE-7 guard: the fused scoring path and the tree-training input
     path must build their inputs from addressable row shards in place.

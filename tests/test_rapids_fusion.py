@@ -1,0 +1,418 @@
+"""Rapids statement-fusion suite (ISSUE 10).
+
+Covers: (1) the fused-vs-eager bitwise-equivalence property over
+randomized AST chains (elementwise/filter/reduce/ifelse compositions,
+NA paths — the fused path must be indistinguishable from op-at-a-time
+evaluation); (2) the compile-cache contract (structure-only signatures,
+zero compiles warm, persistent tier across a simulated restart); (3) the
+sharded-data-plane guard (``gathered_rows == 0`` on fused statements and
+on enum-keyed group-by / device-join inputs, with numeric-key group-by
+and host joins as the counted demoted path); (4) the Session refcount
+token fix; (5) the h2o3_rapids_* observability surface, including the
+traced-statement zero-added-syncs assertion.
+"""
+
+import gc
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Column, Frame, T_CAT
+from h2o3_tpu.rapids import Session, exec_rapids
+from h2o3_tpu.rapids import fusion
+
+pytestmark = pytest.mark.rapids
+
+FR = "fusion_test_fr"
+
+
+@pytest.fixture()
+def sess(cl):
+    s = Session("fusion_t")
+    yield s
+    s.end()
+
+
+@pytest.fixture()
+def fr(cl):
+    rng = np.random.default_rng(11)
+    f = Frame(key=FR)
+    a = rng.standard_normal(40)
+    a[[3, 17, 29]] = np.nan                   # NA paths are first-class
+    f.add("a", Column.from_numpy(a))
+    f.add("b", Column.from_numpy(rng.standard_normal(40)))
+    c = rng.uniform(-2.0, 2.0, 40)
+    c[7] = np.nan
+    f.add("c", Column.from_numpy(c))
+    f.add("g", Column.from_numpy(
+        np.asarray(["x", "y", "z", "y"] * 10, object), ctype=T_CAT))
+    f.install()
+    yield f
+    f.delete()
+
+
+def _both(stmt, sess):
+    """Evaluate one statement fused and eager; returns (fused, eager)."""
+    with fusion.force(True):
+        vf = exec_rapids(stmt, sess)
+    with fusion.force(False):
+        ve = exec_rapids(stmt, sess)
+    return vf, ve
+
+
+def _col_equal(vf, ve):
+    af = np.asarray(vf.col(0).to_numpy())
+    ae = np.asarray(ve.col(0).to_numpy())
+    assert af.dtype == ae.dtype
+    assert np.array_equal(af, ae, equal_nan=True), (af, ae)
+    assert vf.names == ve.names
+
+
+# ---------------------------------------------------------------------------
+# randomized equivalence property
+# ---------------------------------------------------------------------------
+
+_BINS = ["+", "-", "*", "/"]
+_CMPS = ["<", ">", "<=", ">=", "==", "!="]
+_UNS = ["abs", "sqrt", "floor", "ceiling", "sign", "exp", "log"]
+
+
+def _gen(rng, depth):
+    """Random fusible expression string (leaves: frame columns incl. the
+    NA-carrying and enum ones, plus literals)."""
+    if depth <= 0:
+        if rng.random() < 0.6:
+            i = int(rng.integers(0, 4))
+            return f"(cols {FR} [{i}])"
+        return f"{rng.uniform(-2, 2):.3f}"
+    roll = rng.random()
+    if roll < 0.35:
+        op = _BINS[rng.integers(0, len(_BINS))]
+        return f"({op} {_gen(rng, depth - 1)} {_gen(rng, depth - 1)})"
+    if roll < 0.5:
+        op = _CMPS[rng.integers(0, len(_CMPS))]
+        return f"({op} {_gen(rng, depth - 1)} {_gen(rng, depth - 1)})"
+    if roll < 0.62:
+        op = "&" if rng.random() < 0.5 else "|"
+        # logical needs a column ref on at least one side
+        return f"({op} (> (cols {FR} [0]) 0) {_gen(rng, depth - 1)})"
+    if roll < 0.78:
+        op = _UNS[rng.integers(0, len(_UNS))]
+        return f"({op} (+ {_gen(rng, depth - 1)} (cols {FR} [1])))"
+    if roll < 0.9:
+        return (f"(ifelse (> (cols {FR} [{int(rng.integers(0, 3))}]) 0) "
+                f"{_gen(rng, depth - 1)} {_gen(rng, depth - 1)})")
+    return f"(is.na (+ (cols {FR} [0]) {_gen(rng, depth - 1)}))"
+
+
+@pytest.mark.parametrize("seed", range(24))
+def test_randomized_chain_equivalence(seed, cl, fr, sess):
+    rng = np.random.default_rng(seed)
+    stmt = _gen(rng, int(rng.integers(2, 5)))
+    while not stmt.startswith("("):           # root must be a compute node
+        stmt = _gen(rng, 3)
+    before = fusion.counters()["fused_programs"]
+    vf, ve = _both(stmt, sess)
+    _col_equal(vf, ve)
+    assert fusion.counters()["fused_programs"] > before, (
+        f"statement {stmt!r} did not take the fused path")
+
+
+def test_reducer_equivalence(cl, fr, sess):
+    for red in ("mean", "sum", "min", "max", "sd", "var", "naCnt",
+                "any", "all"):
+        stmt = f"({red} (* (+ (cols {FR} [0]) (cols {FR} [1])) 0.5))"
+        with fusion.force(True):
+            vf = exec_rapids(stmt, sess)
+        with fusion.force(False):
+            ve = exec_rapids(stmt, sess)
+        assert vf == ve or (vf != vf and ve != ve), (red, vf, ve)
+
+
+def test_rows_filter_equivalence(cl, fr, sess):
+    stmt = (f"(rows {FR} (& (> (+ (cols {FR} [0]) (cols {FR} [1])) 0) "
+            f"(< (cols {FR} [2]) 1)))")
+    vf, ve = _both(stmt, sess)
+    assert vf.nrows == ve.nrows
+    for n in vf.names:
+        cf, ce = vf.col(n), ve.col(n)
+        if cf.is_categorical:
+            assert list(cf.values()) == list(ce.values())
+        else:
+            assert np.array_equal(cf.to_numpy(), ce.to_numpy(),
+                                  equal_nan=True)
+
+
+def test_all_na_and_enum_paths(cl, sess):
+    f = Frame(key="fusion_na_fr")
+    f.add("a", Column.from_numpy(np.full(16, np.nan)))
+    f.add("g", Column.from_numpy(
+        np.asarray(["u", "v"] * 8, object), ctype=T_CAT))
+    f.install()
+    try:
+        for stmt in (
+                "(+ (cols fusion_na_fr [0]) 1)",
+                "(is.na (cols fusion_na_fr [0]))",
+                "(ifelse (is.na (cols fusion_na_fr [0])) "
+                "(cols fusion_na_fr [1]) 0)",
+                "(== (cols fusion_na_fr [1]) 1)",   # enum codes as numerics
+        ):
+            vf, ve = _both(stmt, sess)
+            _col_equal(vf, ve)
+    finally:
+        f.delete()
+
+
+def test_mask_multiply_na_propagation(cl, fr, sess):
+    """0*NaN / 1*NaN must stay NaN through fused mask arithmetic — the
+    XLA simplifier's multiply(convert(pred), x) -> select(pred, x, 0)
+    rewrite would silently drop it inside one program (the reason
+    isna_expr emits a select; this is the regression pin)."""
+    for mask in (f"(is.na (cols {FR} [0]))",
+                 f"(== (cols {FR} [1]) 0)",
+                 f"(& (> (cols {FR} [1]) 0) (< (cols {FR} [1]) 9))"):
+        for stmt in (f"(* {mask} (cols {FR} [2]))",
+                     f"(+ (cols {FR} [0]) (* {mask} (cols {FR} [2])))"):
+            vf, ve = _both(stmt, sess)
+            _col_equal(vf, ve)
+
+
+def test_assigned_statement_fuses(cl, fr, sess):
+    """(tmp= ...) roots fuse their RHS — the evaluator offers the inner
+    compute node, so assignment costs no fusion opportunity."""
+    before = fusion.counters()["fused_programs"]
+    with fusion.force(True):
+        out = exec_rapids(
+            f"(tmp= fusion_assigned (* (+ (cols {FR} [0]) 1) 2))", sess)
+    assert fusion.counters()["fused_programs"] == before + 1
+    with fusion.force(False):
+        ref = exec_rapids(f"(* (+ (cols {FR} [0]) 1) 2)", sess)
+    assert np.array_equal(out.col(0).to_numpy(), ref.col(0).to_numpy(),
+                          equal_nan=True)
+
+
+# ---------------------------------------------------------------------------
+# compile-cache contract
+# ---------------------------------------------------------------------------
+
+def test_signature_cache_shares_programs_across_literals(cl, fr, sess):
+    """Constants are traced arguments: statements that differ only in
+    literals share ONE compiled program (AST shape × dtypes × rows
+    bucket)."""
+    with fusion.force(True):
+        start = fusion.counters()
+        exec_rapids(f"(+ (* (cols {FR} [0]) 3) (cols {FR} [1]))", sess)
+        c0 = fusion.counters()
+        exec_rapids(f"(+ (* (cols {FR} [0]) 99) (cols {FR} [1]))", sess)
+        c1 = fusion.counters()
+    assert c1["fused_programs_compiled"] == c0["fused_programs_compiled"]
+    assert c1["compile_cache_hits"] > c0["compile_cache_hits"]
+    # same segment count both times (the statement splits at the FMA
+    # boundary, so it may be more than one program)
+    assert (c1["fused_programs"] - c0["fused_programs"]
+            == c0["fused_programs"] - start["fused_programs"])
+
+
+def test_persistent_cache_survives_restart(cl, fr, sess, tmp_path,
+                                           monkeypatch):
+    """PR-6 persistent tier: drop the in-memory program cache (simulated
+    process restart) — the statement shape reloads from disk and compiles
+    ZERO programs."""
+    from h2o3_tpu.artifact import compile_cache
+
+    monkeypatch.setenv("H2O_TPU_COMPILE_CACHE_DIR", str(tmp_path))
+    stmt = f"(- (* (cols {FR} [2]) 2) (cols {FR} [1]))"
+    # cold in-memory state: every segment must compile (and store) under
+    # the persistent tier, or the restart below would re-compile segments
+    # that were warmed before the tier existed
+    fusion.clear_programs()
+    with fusion.force(True):
+        exec_rapids(stmt, sess)
+        if not any(p.name.startswith("xc_") for p in tmp_path.iterdir()):
+            pytest.skip("this jax cannot serialize executables")
+        fusion.clear_programs()
+        c0 = fusion.counters()
+        vf = exec_rapids(stmt, sess)
+        c1 = fusion.counters()
+    assert c1["fused_programs_compiled"] == c0["fused_programs_compiled"], \
+        "a warm restart must compile zero fused programs"
+    assert c1["compile_cache_hits"] > c0["compile_cache_hits"]
+    with fusion.force(False):
+        ve = exec_rapids(stmt, sess)
+    _col_equal(vf, ve)
+
+
+# ---------------------------------------------------------------------------
+# sharded data-plane guard
+# ---------------------------------------------------------------------------
+
+class TestShardedGuard:
+    def test_fused_statements_never_gather(self, cl, fr, sess):
+        """The ISSUE acceptance counter: fused statements over sharded
+        frames build everything from the columns' row shards in place —
+        gathered_rows must not move, packed_rows covers the statement."""
+        from h2o3_tpu.core import sharded_frame
+
+        with fusion.force(True):
+            exec_rapids(f"(+ (cols {FR} [0]) 1)", sess)   # warm compile
+            before = sharded_frame.counters()
+            exec_rapids(
+                f"(ifelse (> (cols {FR} [0]) 0) (* (cols {FR} [1]) 2) "
+                f"(- (cols {FR} [2]) 1))", sess)
+            after = sharded_frame.counters()
+        assert after["gathered_rows"] == before["gathered_rows"], (
+            "a fused rapids statement pulled a column to the host")
+        assert after["packed_rows"] >= before["packed_rows"] + fr.nrows
+
+    def test_enum_groupby_input_never_gathers(self, cl, fr, sess):
+        """Enum-keyed group-by consumes device codes + host domains: no
+        column gather (the fused group-by input contract)."""
+        from h2o3_tpu.core import sharded_frame
+
+        before = sharded_frame.counters()
+        exec_rapids(f'(GB {FR} [3] "mean" 0 "all" "nrow" 0 "all")', sess)
+        after = sharded_frame.counters()
+        assert after["gathered_rows"] == before["gathered_rows"]
+        assert after["packed_rows"] >= before["packed_rows"] + fr.nrows
+
+    def test_numeric_groupby_key_is_the_counted_demoted_path(self, cl, fr,
+                                                             sess):
+        from h2o3_tpu.core import sharded_frame
+
+        before = sharded_frame.counters()
+        exec_rapids(f'(GB {FR} [0] "mean" 1 "all")', sess)
+        after = sharded_frame.counters()
+        assert after["gathered_rows"] >= before["gathered_rows"] + fr.nrows
+
+    def test_device_join_inputs_never_gather(self, cl, sess):
+        """Numeric/enum-keyed merge consumes the key columns' own padded
+        device buffers (sliced inside the compiled rank program) — no
+        host staging of key columns."""
+        from h2o3_tpu.core import sharded_frame
+        from h2o3_tpu.ops.merge import merge
+
+        l = Frame(key="fusion_join_l")
+        l.add("k", Column.from_numpy(np.arange(24, dtype=float) % 6))
+        l.add("v", Column.from_numpy(np.arange(24, dtype=float)))
+        r = Frame(key="fusion_join_r")
+        r.add("k", Column.from_numpy(np.arange(6, dtype=float)))
+        r.add("w", Column.from_numpy(np.arange(6, dtype=float) * 10))
+        try:
+            before = sharded_frame.counters()
+            out = merge(l, r)
+            after = sharded_frame.counters()
+            assert after["gathered_rows"] == before["gathered_rows"]
+            assert after["packed_rows"] >= \
+                before["packed_rows"] + l.nrows + r.nrows
+            assert out.nrows == 24
+        finally:
+            l.delete()
+            r.delete()
+
+
+# ---------------------------------------------------------------------------
+# Session refcounts (satellite: stable tokens, not id())
+# ---------------------------------------------------------------------------
+
+class TestSessionTokens:
+    def test_column_refs_by_token(self, cl, fr):
+        s = Session("tok_t")
+        col = fr.col("a")
+        s.assign("t1", fr)
+        s.assign("t2", fr)
+        assert s.column_refs(col) == 2
+        s.remove("t1")
+        assert s.column_refs(col) == 1
+        s.end()
+        assert s.column_refs(col) == 0
+
+    def test_tokens_survive_gc_without_reuse(self, cl):
+        """The id() bug this fix closes: a dead Column's identity must
+        never be claimable by a new Column. Tokens are minted from a
+        process counter, so even an id()-recycled object gets a fresh
+        token and a zero refcount."""
+        s = Session("tok_gc")
+        f = Frame(key="tok_gc_fr")
+        f.add("x", Column.from_numpy(np.arange(8, dtype=float)))
+        tok_old = f.col("x").token
+        s.assign("tmp_gc", f)
+        assert s.refcnt.get(tok_old) == 1
+        s.remove("tmp_gc")
+        f.delete()
+        del f
+        gc.collect()
+        fresh = Column.from_numpy(np.arange(8, dtype=float))
+        assert fresh.token != tok_old
+        assert s.column_refs(fresh) == 0
+        assert fresh.token == fresh.token      # stable once minted
+        s.end()
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+class TestObservability:
+    def test_rapids_metric_series_registered(self, cl):
+        from h2o3_tpu.obs import metrics as obs_metrics
+
+        names = set(obs_metrics.REGISTRY.names())
+        for n in ("h2o3_rapids_statements_total",
+                  "h2o3_rapids_fused_statements_total",
+                  "h2o3_rapids_fused_programs_total",
+                  "h2o3_rapids_fused_programs_compiled_total",
+                  "h2o3_rapids_compile_cache_hits_total",
+                  "h2o3_rapids_barrier_fallbacks_total",
+                  "h2o3_rapids_host_materialized_cells_total",
+                  "h2o3_rapids_fused_rows_total",
+                  "h2o3_rapids_statement_seconds"):
+            assert n in names, n
+
+    def test_host_fallback_prims_are_counted(self, cl, fr, sess):
+        before = fusion.counters()["barrier_fallbacks"]
+        exec_rapids(f"(toupper (cols {FR} [3]))", sess)
+        assert fusion.counters()["barrier_fallbacks"] == before + 1
+
+    def test_host_matrix_cells_are_counted(self, cl, fr, sess):
+        before = fusion.counters()["host_materialized_cells"]
+        exec_rapids(f"(t {FR})", sess)          # transpose host-materializes
+        assert fusion.counters()["host_materialized_cells"] >= \
+            before + fr.nrows * fr.ncols
+
+    def test_traced_statement_spans_and_zero_added_syncs(self, cl, fr,
+                                                         sess):
+        """Parse/plan/execute/fused_dispatch child spans land on the
+        active trace; the proof that tracing changed nothing: zero new
+        fused compiles (warm shape) and zero gathered rows while
+        traced."""
+        from h2o3_tpu.core import sharded_frame
+        from h2o3_tpu.obs import tracing
+
+        stmt = f"(* (+ (cols {FR} [0]) (cols {FR} [1])) 2)"
+        with fusion.force(True):
+            exec_rapids(stmt, sess)              # warm the program
+            compiles0 = fusion.counters()["fused_programs_compiled"]
+            gathered0 = sharded_frame.counters()["gathered_rows"]
+            with tracing.root_span("rapids_test") as root:
+                trace_id = root.ctx()["trace_id"]
+                exec_rapids(stmt, sess)
+        assert fusion.counters()["fused_programs_compiled"] == compiles0
+        assert sharded_frame.counters()["gathered_rows"] == gathered0
+        names = {s["name"] for s in tracing.get_trace(trace_id)}
+        assert {"parse", "plan", "execute", "fused_dispatch"} <= names, \
+            names
+
+    def test_statement_counters_move(self, cl, fr, sess):
+        c0 = fusion.counters()
+        with fusion.force(True):
+            exec_rapids(f"(+ (cols {FR} [0]) (cols {FR} [1]))", sess)
+        c1 = fusion.counters()
+        assert c1["statements"] == c0["statements"] + 1
+        assert c1["fused_statements"] == c0["fused_statements"] + 1
+        assert c1["fused_rows"] >= c0["fused_rows"] + fr.nrows
+
+    def test_disabled_fusion_is_pure_eager(self, cl, fr, sess):
+        c0 = fusion.counters()["fused_programs"]
+        with fusion.force(False):
+            exec_rapids(f"(+ (cols {FR} [0]) 1)", sess)
+        assert fusion.counters()["fused_programs"] == c0
