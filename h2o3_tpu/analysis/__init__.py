@@ -1,0 +1,103 @@
+"""Distributed-invariant static analyzer (ISSUE 11).
+
+H2O-3's engine correctness rests on invariants no compiler checks: every
+process must walk an identical device-program sequence when replaying the
+oplog, locks must nest in one global order, nothing may raw-unpickle
+external bytes, device-only jax APIs must route through ``compat.py``,
+and trace spans must not smuggle device syncs into hot paths. Six review
+rounds across PRs 3-9 re-found violations of exactly these classes by
+hand; this package checks them at the program level, before execution
+("Memory Safe Computations with XLA Compiler" applies the same idea to
+resource safety).
+
+Usage::
+
+    python -m h2o3_tpu.analysis              # all passes, repo root
+    python -m h2o3_tpu.analysis --json       # machine-readable findings
+    python -m h2o3_tpu.analysis --select mirrored,lock-order
+    python -m h2o3_tpu.analysis --update-baseline   # accept benign rest
+
+Exit code 0 = zero non-baselined findings. The baseline
+(``ANALYSIS_BASELINE.json``) may only carry ``sync-hygiene`` /
+``compat-routing`` entries, each with a one-line justification; stale
+entries are findings themselves. Tier-1 wiring: the consistency suite
+runs the full analyzer and asserts a clean exit.
+
+Everything is stdlib-``ast`` based — no new dependencies, no imports of
+the framework's heavy modules, full-repo run well under the 10 s budget.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from h2o3_tpu.analysis.core import (BASELINE_NAME, BASELINEABLE, Context,
+                                    Finding, apply_baseline, load_baseline,
+                                    make_context, save_baseline)
+
+__all__ = ["Finding", "Context", "PASSES", "make_context", "run",
+           "run_repo", "load_baseline", "save_baseline", "apply_baseline",
+           "BASELINE_NAME", "BASELINEABLE"]
+
+
+def _passes() -> Dict[str, object]:
+    from h2o3_tpu.analysis import (passes_locks, passes_mirrored,
+                                   passes_misc, passes_registries)
+
+    return {
+        "mirrored": passes_mirrored.run,
+        "lock-order": passes_locks.run,
+        "serialization": passes_misc.run_serialization,
+        "compat-routing": passes_misc.run_compat,
+        "sync-hygiene": passes_misc.run_sync_hygiene,
+        "faultpoints": passes_registries.run_faultpoints,
+        "metric-registry": passes_registries.run_metric_registry,
+        "timeline-kinds": passes_registries.run_timeline_kinds,
+        "knob-docs": passes_registries.run_knob_docs,
+    }
+
+
+PASSES = _passes()
+
+
+def run(ctx: Context, passes: Optional[List[str]] = None) -> List[Finding]:
+    """Run the selected passes (default: all) over `ctx`, deduplicated
+    and ordered by (file, line, pass)."""
+    selected = list(PASSES) if passes is None else list(passes)
+    unknown = [p for p in selected if p not in PASSES]
+    if unknown:
+        raise ValueError(f"unknown pass(es) {unknown}; "
+                         f"available: {sorted(PASSES)}")
+    findings: List[Finding] = []
+    seen = set()
+    for name in selected:
+        for f in PASSES[name](ctx):
+            key = (f.pass_id, f.file, f.line, f.message)
+            if key not in seen:
+                seen.add(key)
+                findings.append(f)
+    findings.sort(key=lambda f: (f.file, f.line, f.pass_id, f.message))
+    return findings
+
+
+def run_repo(root: Optional[Path] = None,
+             passes: Optional[List[str]] = None,
+             baseline: Optional[Path] = None):
+    """One-call repo run: returns ``(new_findings, baselined, problems)``
+    where `new_findings` must be empty for a clean exit, `baselined` are
+    accepted findings (note attached) and `problems` are baseline-hygiene
+    findings (stale entries, illegal passes, missing notes)."""
+    ctx = make_context(root)
+    findings = run(ctx, passes)
+    bl_path = Path(baseline) if baseline else ctx.root / BASELINE_NAME
+    entries = load_baseline(bl_path)
+    if passes is not None:
+        # a partial run produces findings for the SELECTED passes only —
+        # judging the whole baseline against it would misreport every
+        # unselected pass's entry as stale
+        entries = [e for e in entries if e.get("pass") in passes]
+    covered_before = list(findings)
+    new, problems = apply_baseline(findings, entries)
+    baselined = [f for f in covered_before if f not in new]
+    return new, baselined, problems

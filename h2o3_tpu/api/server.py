@@ -650,6 +650,30 @@ def _pin_seed_and_wire(params: Dict[str, Any]) -> Dict[str, Any]:
     return wire
 
 
+def _clear_wallclock_budget(params: Dict[str, Any], what: str) -> bool:
+    """Zero ``max_runtime_secs`` IN PLACE ahead of an oplog broadcast.
+
+    The budget is wall-clock measured per process (``_out_of_time`` polls
+    ``time.time()`` inside the fit loops): on a mirrored op each process
+    would stop training at a DIFFERENT iteration, desynchronizing the
+    per-iteration device collectives — the mirrored-program invariant the
+    static analyzer pins (``h2o3_tpu/analysis``, mirrored pass). The
+    AutoML handler has cleared it since PR 4; train and grid broadcasts
+    shipped it until the analyzer surfaced the gap. Returns True when a
+    non-zero budget was cleared (callers log the downgrade)."""
+    if float(params.get("max_runtime_secs") or 0.0) <= 0:
+        return False
+    params["max_runtime_secs"] = 0.0
+    import logging
+
+    logging.getLogger("h2o3_tpu").warning(
+        "%s: max_runtime_secs ignored on a multi-process cloud (per-"
+        "process wall clock would desynchronize the mirrored device "
+        "program sequence); bound the build by iterations/trees instead",
+        what)
+    return True
+
+
 def _extract_train_params(cls, body: Dict[str, Any]):
     defaults = cls.default_params()
     params: Dict[str, Any] = {}
@@ -760,6 +784,9 @@ def h_modelbuilder_train(ctx: Ctx):
     op_seq = None
     wire_params = None
     if oplog.active():
+        # cleared on the COORDINATOR'S builder too, not just the wire:
+        # both sides must run the identical un-budgeted fit loop
+        _clear_wallclock_budget(builder.params, f"{algo} train")
         wire_params = _pin_seed_and_wire(builder.params)
         op_seq = oplog.broadcast("train", {
             "algo": algo, "params": wire_params,
@@ -1197,6 +1224,14 @@ def h_grid_build(ctx: Ctx):
             criteria = dict(criteria or {})
             criteria["seed"] = int(uuid.uuid4().int % (2 ** 31))
         parallelism = 1
+        # the walker's wall-clock budget break and each member build's
+        # deadline are per-process time: zero BOTH before the op ships
+        # (local run() and followers then walk the identical combo/model
+        # sequence) — same mirrored-program invariant as train/automl
+        if criteria and float(criteria.get("max_runtime_secs") or 0.0) > 0:
+            criteria = dict(criteria)
+            _clear_wallclock_budget(criteria, f"{algo} grid criteria")
+        _clear_wallclock_budget(params, f"{algo} grid build")
         wire_params = _pin_seed_and_wire(params)
         op_seq = oplog.broadcast("grid", {
             "algo": algo, "params": wire_params, "hyper": hyper,

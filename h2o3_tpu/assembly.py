@@ -192,8 +192,12 @@ class H2OAssembly:
 
     @staticmethod
     def load(path: str) -> "H2OAssembly":
-        import pickle
+        # restricted unpickler: an assembly artifact is untrusted input
+        # like any model artifact — framework types only (ISSUE-11
+        # serialization invariant)
         import struct
+
+        from h2o3_tpu.utils.unpickle import restricted_load
 
         with open(path, "rb") as f:
             if f.read(8) != H2OAssembly._SAVE_MAGIC:
@@ -201,7 +205,7 @@ class H2OAssembly:
             (ver,) = struct.unpack("<H", f.read(2))
             if ver > H2OAssembly._SAVE_VERSION:
                 raise ValueError(f"assembly artifact version {ver} too new")
-            return pickle.load(f)
+            return restricted_load(f, what="assembly artifact")
 
     # -- REST wire format (h2o-py transform_base.to_rest) ----------------
     @staticmethod

@@ -476,10 +476,10 @@ if __name__ == "__main__":
     if mode == "profile":
         # one profile artifact per round (VERDICT r4 item 3): an XLA trace
         # of a short flagship run, viewable with tensorboard/xprof
-        import jax
+        from h2o3_tpu.utils import timeline
 
         pdir = os.environ.get("H2O3_PROFILE_DIR", "profile_out")
-        with jax.profiler.trace(pdir):
+        with timeline.trace(pdir):
             value, metric = run_flagship(n_rows=200_000, ntrees=5)
         metric = "gbm_profiled_rows_per_sec"
         print(f"profile written to {pdir}", flush=True)

@@ -89,6 +89,32 @@ def profiler_stop() -> None:
     jax.profiler.stop_trace()
 
 
+def profiler_annotation(name: str):
+    """``jax.profiler.TraceAnnotation`` — a named region inside a capture
+    (the API spelling has been stable, but it lives on the same
+    version-mobile module as start/stop_trace, so it routes here too)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def pallas_modules():
+    """``(pallas, pallas.tpu)`` — the TPU kernel surface. Pallas is a
+    device-only lowering that has moved within jax.experimental across
+    releases; importing it at call time through this shim keeps CPU-only
+    deployments importable (callers already guard execution behind
+    ``H2O_TPU_PALLAS_HIST`` / interpret mode). The tpu submodule is None
+    when this jax does not ship it — callers fall back to default memory
+    spaces."""
+    from jax.experimental import pallas as pl
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except ImportError:         # pragma: no cover — very old jax
+        pltpu = None
+    return pl, pltpu
+
+
 def compile_stablehlo(text: str):
     """Portable lowering fallback: compile StableHLO module text through the
     local XLA client. Returns an executable whose ``.execute([arrays])``

@@ -162,9 +162,14 @@ class _DKV:
         if raw is None:
             return None
         import base64
-        import pickle
 
-        value = pickle.loads(base64.b64decode(raw))
+        # restricted unpickler: the blob came over the coordination KV —
+        # another process (or whatever reached the KV) wrote it, so it is
+        # untrusted input like any artifact (ISSUE-11 serialization
+        # invariant); framework/numeric types only
+        from h2o3_tpu.utils.unpickle import restricted_loads
+
+        value = restricted_loads(base64.b64decode(raw), what="DKV blob")
         self.put(key, value)       # cache locally, like Value caching
         return value
 
@@ -197,14 +202,16 @@ class _DKV:
 
     def restore_control_plane(self, snap: dict, loads=None) -> List[str]:
         """Install a checkpoint snapshot into this process's store (rejoin
-        / standby takeover). `loads` lets the caller supply a restricted
-        unpickler. Returns the keys restored; per-key failures are skipped
-        (the object rebuilds from the oplog suffix or a re-import)."""
-        import pickle
-
+        / standby takeover). `loads` lets the caller supply its own
+        restricted unpickler; the DEFAULT is the shared restricted loader
+        — a snapshot blob came off shared storage and must never reach a
+        raw unpickler (ISSUE-11 serialization invariant). Returns the
+        keys restored; per-key failures are skipped (the object rebuilds
+        from the oplog suffix or a re-import)."""
         from h2o3_tpu.parallel import distributed as D
+        from h2o3_tpu.utils.unpickle import restricted_loads
 
-        loads = loads or pickle.loads
+        loads = loads or restricted_loads
         restored: List[str] = []
         for k, blob in (snap.get("objects") or {}).items():
             try:

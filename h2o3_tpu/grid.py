@@ -29,7 +29,8 @@ import numpy as np
 from h2o3_tpu.core.dkv import DKV, Keyed
 from h2o3_tpu.core.frame import Frame
 from h2o3_tpu.models.model import Model
-from h2o3_tpu.models.model_builder import BUILDERS, ModelBuilder
+from h2o3_tpu.models.model_builder import (BUILDERS, ModelBuilder,
+                                           random_seed)
 
 _LOWER_IS_BETTER = {"rmse", "mse", "logloss", "mae", "mean_residual_deviance",
                     "mean_per_class_error", "err", "rmsle"}
@@ -98,8 +99,13 @@ class H2OGridSearch(Keyed):
         strategy = (self.search_criteria.get("strategy") or "Cartesian").lower()
         combos = list(itertools.product(*grids))
         if strategy == "randomdiscrete":
+            # wildcard seeds route through the ONE seed-derivation policy
+            # (model_builder.random_seed): the REST grid handler pins the
+            # criteria seed before broadcast, so on a mirrored grid op
+            # every process shuffles the combo walk identically
             seed = int(self.search_criteria.get("seed", -1))
-            rng = np.random.default_rng(seed if seed >= 0 else None)
+            rng = np.random.default_rng(
+                seed if seed >= 0 else random_seed())
             rng.shuffle(combos)
         return keys, combos
 

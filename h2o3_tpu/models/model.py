@@ -357,8 +357,13 @@ class Model(Keyed):
 
     @staticmethod
     def load(path: str) -> "Model":
-        import pickle
+        # restricted unpickler: a model artifact arriving over shared
+        # storage / an upload is untrusted input — framework/numeric
+        # types only, never arbitrary callables (ISSUE-11 serialization
+        # invariant, same contract as oplog checkpoints)
         import struct
+
+        from h2o3_tpu.utils.unpickle import restricted_load
 
         with open(path, "rb") as f:
             head = f.read(8)
@@ -368,12 +373,12 @@ class Model(Keyed):
                     raise ValueError(
                         f"model artifact version {ver} is newer than this "
                         f"build supports ({Model._SAVE_VERSION})")
-                cls, state = pickle.load(f)
+                cls, state = restricted_load(f, what="model artifact")
             else:
                 # pre-versioning artifact (round <= 3 headerless pickle)
                 f.seek(0)
                 try:
-                    cls, state = pickle.load(f)
+                    cls, state = restricted_load(f, what="model artifact")
                 except Exception as e:
                     raise ValueError(
                         f"{path!r} is not an h2o3_tpu model artifact") from e

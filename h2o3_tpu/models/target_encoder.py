@@ -24,7 +24,8 @@ import numpy as np
 
 from h2o3_tpu.core.frame import Column, Frame, T_CAT, T_NUM
 from h2o3_tpu.models.model import Model, ModelCategory
-from h2o3_tpu.models.model_builder import ModelBuilder, register
+from h2o3_tpu.models.model_builder import (ModelBuilder, random_seed,
+                                           register)
 
 
 def _level_sums(codes, y, w, card: int, folds=None, nfolds: int = 0):
@@ -104,8 +105,13 @@ class TargetEncoderModel(Model):
                   else p.get("smoothing", 20.0) or 20.0)
         noise = (float(p.get("noise", 0.01) if noise is None else noise) or 0.0)
         leakage = str(p.get("data_leakage_handling") or "None").lower().replace("_", "")
+        # wildcard seeds route through the ONE seed-derivation policy:
+        # mirrored callers (AutoML preprocessing on a multi-process
+        # cloud) always pass the pinned shared seed, so the noise columns
+        # are identical on every process; random_seed() only fires
+        # library-mode
         seed = int(p.get("seed") or -1)
-        rng = np.random.default_rng(seed if seed >= 0 else None)
+        rng = np.random.default_rng(seed if seed >= 0 else random_seed())
 
         keep_orig = bool(p.get("keep_original_categorical_columns", True))
         out = Frame(key=key)

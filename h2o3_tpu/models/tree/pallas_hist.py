@@ -143,8 +143,10 @@ def _build(n_rows: int, F: int, maxB: int, S: int, blk: int, interpret: bool,
            vma: tuple):
     import jax
     import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+
+    from h2o3_tpu.compat import pallas_modules
+
+    pl, pltpu = pallas_modules()
 
     C = S * 3
     nblk = n_rows // blk

@@ -41,6 +41,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from h2o3_tpu.core import failure
 from h2o3_tpu.parallel import distributed as D
 from h2o3_tpu.parallel import retry
+from h2o3_tpu.utils import unpickle
 
 _CKPT_PREFIX = "oplog/ckpt/"
 
@@ -126,26 +127,14 @@ def wait_idle(timeout_s: float = 30.0) -> bool:
     return True
 
 
-class _CkptUnpickler(pickle.Unpickler):
+class _CkptUnpickler(unpickle.RestrictedUnpickler):
     """Framework/numeric types only — a checkpoint file (possibly fetched
     from shared storage) must not smuggle arbitrary callables, same
-    contract as the binary-artifact loader in api/routes_ext.py."""
+    contract as the binary-artifact loader in api/routes_ext.py. The
+    allowlist lives in utils/unpickle.py (shared with Model.load,
+    assembly load and the DKV blob fetch)."""
 
-    _PREFIXES = ("h2o3_tpu.", "numpy.", "jax.", "jaxlib.", "collections.",
-                 "functools.", "optax.")
-    _MODULES = {"numpy", "jax", "jaxlib", "collections", "functools",
-                "threading", "optax"}
-    _BUILTINS = {"set", "frozenset", "slice", "complex", "range",
-                 "bytearray", "object"}
-
-    def find_class(self, module, name):
-        if module == "builtins" and name in self._BUILTINS:
-            return super().find_class(module, name)
-        if module in self._MODULES or \
-                any(module.startswith(pfx) for pfx in self._PREFIXES):
-            return super().find_class(module, name)
-        raise pickle.UnpicklingError(
-            f"checkpoint references disallowed type {module}.{name}")
+    what = "checkpoint"
 
 
 def _loads(data: bytes) -> Any:

@@ -343,17 +343,24 @@ class Supervisor:
         self.watchdog = None
 
     def start(self) -> "Supervisor":
+        from h2o3_tpu.utils.log import get_logger
+
         def run():
             while not self._stop.wait(self.interval):
                 try:
                     evaluate()
-                except Exception:   # noqa: BLE001 — a transient KV hiccup
-                    pass            # must not kill supervision for good
+                except Exception as e:   # noqa: BLE001 — a transient KV
+                    # hiccup must not kill supervision for good; but a
+                    # PERMANENTLY-failing evaluate dying silently is an
+                    # outage multiplier — leave a trace
+                    get_logger().debug("supervisor tick failed "
+                                       "(will retry): %s", e)
 
         try:
             evaluate()
-        except Exception:   # noqa: BLE001
-            pass
+        except Exception as e:   # noqa: BLE001
+            get_logger().debug("initial supervision pass failed "
+                               "(thread will retry): %s", e)
         self._thread = threading.Thread(target=run, daemon=True,
                                         name="h2o3-supervisor")
         self._thread.start()

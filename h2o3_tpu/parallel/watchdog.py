@@ -243,8 +243,17 @@ def _dispatch_resume(job, spec: dict, data: dict) -> bool:
         return False
     y = spec.get("y")
     dest = spec.get("model_id") or job.dest
+    params = dict(spec.get("params") or {})
+    if oplog.active() and float(params.get("max_runtime_secs") or 0.0) > 0:
+        # re-broadcast resume on a multi-process cloud: the wall-clock
+        # budget is per-process time and would desynchronize the mirrored
+        # fit loops (the train/grid handlers clear it the same way; a
+        # resume whose ORIGINAL submit predates that fix may still carry
+        # one in its durable spec)
+        params["max_runtime_secs"] = 0.0
+        spec = dict(spec, params=params)
     try:
-        builder = cls(**(spec.get("params") or {}))
+        builder = cls(**params)
     except Exception as e:   # noqa: BLE001 — param drift is deterministic:
         # fail_local keeps failed_externally False so the identical doomed
         # rebuild is NOT retried on the next recovery pass
@@ -362,8 +371,11 @@ class Watchdog:
             from h2o3_tpu.obs import metrics as _om
 
             _om.maybe_publish()
-        except Exception:   # noqa: BLE001 — observability never blocks
-            pass            # recovery
+        except Exception as e:   # noqa: BLE001 — observability never
+            # blocks recovery, but its death should not be invisible
+            from h2o3_tpu.utils.log import get_logger
+
+            get_logger().debug("watchdog metrics publish failed: %s", e)
         try:
             if D.process_count() > 1:
                 oplog.maybe_demote()

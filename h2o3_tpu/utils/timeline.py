@@ -109,23 +109,25 @@ class TaskProfile:
 
 @contextlib.contextmanager
 def trace(log_dir: str):
-    """Capture an XLA profiler trace around a code block."""
-    import jax
+    """Capture an XLA profiler trace around a code block (profiler API
+    routed through compat.py — its kwargs have shifted across jax
+    releases)."""
+    from h2o3_tpu import compat
 
-    jax.profiler.start_trace(log_dir)
+    compat.profiler_start(log_dir)
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        compat.profiler_stop()
         record("xla_trace", log_dir, ms=(time.perf_counter() - t0) * 1000)
 
 
 def annotate(name: str):
     """Named region inside a captured trace (TraceAnnotation)."""
-    import jax
+    from h2o3_tpu import compat
 
-    return jax.profiler.TraceAnnotation(name)
+    return compat.profiler_annotation(name)
 
 
 def device_memory() -> List[Dict]:

@@ -1,0 +1,58 @@
+"""Restricted unpickling — the ONE sanctioned deserializer for framework
+bytes that crossed a process/file/KV boundary.
+
+Reference contract: a model artifact, an oplog checkpoint, a KV blob —
+anything a process did not build in its own address space — is untrusted
+input (it may arrive over shared storage, an upload route, or a peer's
+KV write), and one raw ``pickle.load`` is a remote-code-execution door.
+The static analyzer's serialization pass bans raw loads repo-wide; the
+allowed modules (``parallel/ckpt.py``, ``artifact/``) either use this
+unpickler or their own equally-restricted subclass.
+
+``find_class`` admits framework / numeric / container types only —
+never arbitrary callables. The allowlist intentionally mirrors
+``parallel/ckpt.py``'s checkpoint contract so every surface refuses the
+same payloads.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, BinaryIO
+
+_PREFIXES = ("h2o3_tpu.", "numpy.", "jax.", "jaxlib.", "collections.",
+             "functools.", "optax.")
+_MODULES = {"numpy", "jax", "jaxlib", "collections", "functools",
+            "threading", "optax"}
+_BUILTINS = {"set", "frozenset", "slice", "complex", "range", "bytearray",
+             "object"}
+
+
+class RestrictedUnpickler(pickle.Unpickler):
+    """Framework/numeric types only; anything else raises
+    :class:`pickle.UnpicklingError` (refuse, never fall back)."""
+
+    what = "payload"        # subclasses override for error context
+
+    def find_class(self, module, name):
+        if module == "builtins" and name in _BUILTINS:
+            return super().find_class(module, name)
+        if module in _MODULES or \
+                any(module.startswith(pfx) for pfx in _PREFIXES):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"{self.what} references disallowed type {module}.{name} — "
+            f"refusing to unpickle (restricted loader contract)")
+
+
+def restricted_loads(data: bytes, what: str = "payload") -> Any:
+    up = RestrictedUnpickler(io.BytesIO(data))
+    up.what = what
+    return up.load()
+
+
+def restricted_load(fileobj: BinaryIO, what: str = "payload") -> Any:
+    up = RestrictedUnpickler(fileobj)
+    up.what = what
+    return up.load()

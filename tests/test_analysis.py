@@ -1,0 +1,768 @@
+"""Static-analyzer suite (ISSUE 11): per-pass fixtures (one positive +
+one near-miss negative each), baseline mechanics, and the regression
+fixtures for the REAL defects the analyzer surfaced in this repo:
+
+- the REST train and grid handlers broadcast ``max_runtime_secs`` in the
+  op payload — each process measures its own wall clock, so mirrored fit
+  loops would stop at DIFFERENT iterations (desynced device collectives);
+  both handlers now clear it like the AutoML handler always did;
+- ``Model.load`` / ``H2OAssembly.load`` / the DKV blob fetch raw-
+  unpickled external bytes — all three now refuse non-framework types
+  through the shared restricted unpickler (utils/unpickle.py).
+
+Fixture snippets are tiny synthetic projects under tmp_path; the
+analyzer's faultpoint scan excludes this file by registry declaration
+(the snippets deliberately contain armed-looking text).
+"""
+
+import base64
+import json
+import pickle
+import struct
+import textwrap
+import types
+from pathlib import Path
+
+import pytest
+
+from h2o3_tpu import analysis
+from h2o3_tpu.analysis import core as acore
+
+pytestmark = pytest.mark.analysis
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def mini_ctx(tmp_path, files, **reg):
+    """Context over a synthetic project tree with a stand-in registry."""
+    (tmp_path / "h2o3_tpu").mkdir(parents=True, exist_ok=True)
+    (tmp_path / "h2o3_tpu" / "__init__.py").write_text("")
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    defaults = dict(
+        MIRRORED_ROOTS=(), KNOB_HELPERS=frozenset(), GUARDED={},
+        HOST_SIDE_MODULES={}, LOCK_SCOPE=("h2o3_tpu/",), LOCK_ORDER=(),
+        PICKLE_ALLOWED=(), COMPAT_MODULE="h2o3_tpu/compat.py",
+        DEVICE_ONLY_APIS={"jax.experimental.pallas": "tpu-only",
+                          "jax.profiler": "version-mobile"},
+        SWALLOW_SCOPE=(), FAULTPOINT_SCAN_EXCLUDE=())
+    defaults.update(reg)
+    return acore.make_context(tmp_path,
+                              registry=types.SimpleNamespace(**defaults))
+
+
+def run_pass(ctx, name):
+    return analysis.run(ctx, [name])
+
+
+# ---------------------------------------------------------------------------
+# mirrored-program pass
+# ---------------------------------------------------------------------------
+
+class TestMirroredPass:
+    def test_wallclock_in_control_flow_flagged_metadata_not(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/work.py": """
+            import time
+
+            def handler(p):
+                helper()
+                meta()
+
+            def helper():
+                deadline = time.time() + 5
+                while time.time() < deadline:
+                    pass
+
+            def meta():
+                t0 = time.time()
+                return time.time() - t0
+
+            def unreachable():
+                if time.time() > 0:
+                    pass
+        """}, MIRRORED_ROOTS=("h2o3_tpu.work.handler",))
+        got = run_pass(ctx, "mirrored")
+        syms = {f.symbol for f in got}
+        assert any("helper" in s for s in syms), got
+        # near-misses: wall-clock as pure metadata; divergence outside the
+        # reachable closure
+        assert not any("meta" in s for s in syms)
+        assert not any("unreachable" in s for s in syms)
+
+    def test_fresh_prng_flagged_seeded_rng_not(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/work.py": """
+            import numpy as np
+
+            def handler(p):
+                bad = np.random.default_rng()
+                ok = np.random.default_rng(42)
+                import jax
+                k2 = jax.random.split(p["key"])   # functional: key-driven
+                return bad, ok, k2
+        """}, MIRRORED_ROOTS=("h2o3_tpu.work.handler",))
+        got = run_pass(ctx, "mirrored")
+        assert len(got) == 1 and "default_rng" in got[0].message, got
+
+    def test_raw_env_flagged_knob_helper_exempt(self, tmp_path):
+        files = {"h2o3_tpu/work.py": """
+            import os
+
+            def handler(p):
+                if os.environ.get("H2O_TPU_X"):
+                    return 1
+                if knob():
+                    return 2
+
+            def knob():
+                v = os.environ.get("H2O_TPU_X")
+                if v is None:
+                    return 0
+                return int(v)
+        """}
+        ctx = mini_ctx(tmp_path, files,
+                       MIRRORED_ROOTS=("h2o3_tpu.work.handler",),
+                       KNOB_HELPERS=frozenset({"h2o3_tpu.work.knob"}))
+        got = run_pass(ctx, "mirrored")
+        assert len(got) == 1 and "handler" in got[0].symbol, got
+
+    def test_guarded_and_host_side_suppress(self, tmp_path):
+        files = {"h2o3_tpu/work.py": """
+            import time
+            from h2o3_tpu import hostmod
+
+            def handler(p):
+                audited()
+                hostmod.hosty()
+
+            def audited():
+                if time.time() > 1:
+                    pass
+        """, "h2o3_tpu/hostmod.py": """
+            import time
+
+            def hosty():
+                if time.time() > 1:
+                    pass
+        """}
+        ctx = mini_ctx(tmp_path, files,
+                       MIRRORED_ROOTS=("h2o3_tpu.work.handler",),
+                       GUARDED={"h2o3_tpu.work.audited": "audited: safe"},
+                       HOST_SIDE_MODULES={"h2o3_tpu/hostmod.py": "host"})
+        assert run_pass(ctx, "mirrored") == []
+
+
+# ---------------------------------------------------------------------------
+# lock-order pass
+# ---------------------------------------------------------------------------
+
+class TestLockOrderPass:
+    def test_ab_ba_cycle_reported(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/locks.py": """
+            import threading
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def ab():
+                with A:
+                    with B:
+                        pass
+
+            def ba():
+                with B:
+                    with A:
+                        pass
+        """})
+        got = run_pass(ctx, "lock-order")
+        assert any("cycle" in f.message for f in got), got
+
+    def test_consistent_order_clean(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/locks.py": """
+            import threading
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def ab():
+                with A:
+                    with B:
+                        pass
+
+            def ab2():
+                with A:
+                    with B:
+                        pass
+        """})
+        assert run_pass(ctx, "lock-order") == []
+
+    def test_interprocedural_nesting_seen(self, tmp_path):
+        """with A: f() where f takes B, plus the direct B->A nesting,
+        closes the AB/BA cycle through the call graph."""
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/locks.py": """
+            import threading
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def outer():
+                with A:
+                    inner()
+
+            def inner():
+                with B:
+                    pass
+
+            def reversed_path():
+                with B:
+                    with A:
+                        pass
+        """})
+        got = run_pass(ctx, "lock-order")
+        assert any("cycle" in f.message for f in got), got
+
+    def test_declared_order_reversal(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/locks.py": """
+            import threading
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def ba():
+                with B:
+                    with A:
+                        pass
+        """}, LOCK_ORDER=(("locks.A", "locks.B"),))
+        got = run_pass(ctx, "lock-order")
+        assert any("reversed" in f.message for f in got), got
+
+    def test_nonreentrant_self_nesting(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/locks.py": """
+            import threading
+            A = threading.Lock()
+            R = threading.RLock()
+
+            def bad():
+                with A:
+                    with A:
+                        pass
+
+            def fine():
+                with R:
+                    with R:
+                        pass
+        """})
+        got = run_pass(ctx, "lock-order")
+        assert len(got) == 1 and "self-deadlock" in got[0].message, got
+
+
+# ---------------------------------------------------------------------------
+# serialization pass
+# ---------------------------------------------------------------------------
+
+class TestSerializationPass:
+    SRC = {"h2o3_tpu/io2.py": """
+        import pickle
+        import numpy as np
+
+        def bad(f):
+            return pickle.load(f)
+
+        def bad2(path):
+            return np.load(path, allow_pickle=True)
+
+        def fine(path):
+            return np.load(path, allow_pickle=False)
+    """}
+
+    def test_raw_loads_flagged(self, tmp_path):
+        got = run_pass(mini_ctx(tmp_path, self.SRC), "serialization")
+        msgs = " ".join(f.message for f in got)
+        assert len(got) == 2 and "pickle.load" in msgs and \
+            "allow_pickle" in msgs, got
+
+    def test_no_module_escapes_the_raw_load_ban(self, tmp_path):
+        """PICKLE_ALLOWED bounds Unpickler DEFINITIONS — it never exempts
+        a raw load (review finding: an allowlist hole would silently
+        reopen the artifact-ingest pickle door)."""
+        ctx = mini_ctx(tmp_path, self.SRC,
+                       PICKLE_ALLOWED=("h2o3_tpu/io2.py",))
+        got = run_pass(ctx, "serialization")
+        assert any("pickle.load" in f.message for f in got), got
+
+    def test_bare_reference_default_is_flagged(self, tmp_path):
+        """`loads = loads or pickle.loads` — a non-call reference is the
+        same RCE door (review finding: the dkv restore default)."""
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/io3.py": """
+            import pickle
+
+            def restore(blob, loads=None):
+                loads = loads or pickle.loads
+                return loads(blob)
+        """})
+        got = run_pass(ctx, "serialization")
+        assert len(got) == 1 and "pickle.loads" in got[0].message, got
+
+    def test_unpickler_subclass_outside_sanctioned_home(self, tmp_path):
+        files = {"h2o3_tpu/fork.py": """
+            import pickle
+
+            class MyUnpickler(pickle.Unpickler):
+                def find_class(self, module, name):
+                    return super().find_class(module, name)
+        """}
+        got = run_pass(mini_ctx(tmp_path, files), "serialization")
+        assert len(got) == 1 and "Unpickler subclass" in got[0].message
+        ctx = mini_ctx(tmp_path, files,
+                       PICKLE_ALLOWED=("h2o3_tpu/fork.py",))
+        assert run_pass(ctx, "serialization") == []
+
+
+# ---------------------------------------------------------------------------
+# compat-routing pass
+# ---------------------------------------------------------------------------
+
+class TestCompatPass:
+    def test_direct_device_api_flagged(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/kern.py": """
+            import jax
+            from jax.experimental import pallas as pl
+
+            def cap(d):
+                jax.profiler.start_trace(d)
+        """})
+        got = run_pass(ctx, "compat-routing")
+        apis = " ".join(f.message for f in got)
+        assert "pallas" in apis and "jax.profiler" in apis, got
+
+    def test_compat_module_itself_exempt(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/compat.py": """
+            def pallas_modules():
+                from jax.experimental import pallas as pl
+                return pl
+
+            def profiler_start(d):
+                import jax
+                jax.profiler.start_trace(d)
+        """})
+        assert run_pass(ctx, "compat-routing") == []
+
+
+# ---------------------------------------------------------------------------
+# sync-hygiene pass
+# ---------------------------------------------------------------------------
+
+class TestSyncHygienePass:
+    def test_sync_inside_span_flagged_outside_not(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/hot.py": """
+            import numpy as np
+            from h2o3_tpu.obs import tracing
+
+            def instrumented(out):
+                with tracing.span("dispatch"):
+                    got = np.asarray(out)
+                return got
+
+            def plain(out):
+                return np.asarray(out)
+        """})
+        got = run_pass(ctx, "sync-hygiene")
+        assert len(got) == 1 and "numpy.asarray" in got[0].message, got
+
+    def test_block_until_ready_in_span(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/hot.py": """
+            from h2o3_tpu.obs import tracing
+
+            def instrumented(out):
+                with tracing.span("dispatch"):
+                    out.block_until_ready()
+        """})
+        got = run_pass(ctx, "sync-hygiene")
+        assert len(got) == 1 and "block_until_ready" in got[0].message
+
+    def test_swallowed_exception_in_tick_scope(self, tmp_path):
+        files = {"h2o3_tpu/wd.py": """
+            def tick():
+                try:
+                    work()
+                except Exception:
+                    pass
+
+            def logged():
+                try:
+                    work()
+                except Exception as e:
+                    log(e)
+        """}
+        ctx = mini_ctx(tmp_path, files, SWALLOW_SCOPE=("h2o3_tpu/wd.py",))
+        got = run_pass(ctx, "sync-hygiene")
+        assert len(got) == 1 and "swallowed" in got[0].message, got
+        # same file outside the declared scope: clean
+        ctx2 = mini_ctx(tmp_path, files, SWALLOW_SCOPE=())
+        assert run_pass(ctx2, "sync-hygiene") == []
+
+
+# ---------------------------------------------------------------------------
+# registry passes (folded consistency guards)
+# ---------------------------------------------------------------------------
+
+class TestRegistryPasses:
+    def test_faultpoint_drift(self, tmp_path):
+        files = {
+            "h2o3_tpu/faults.py": 'def f():\n    faultpoint("real.point")\n',
+            "tests/test_x.py": 'def test_a():\n    inject("gone.point")\n',
+        }
+        got = run_pass(mini_ctx(tmp_path, files), "faultpoints")
+        assert len(got) == 1 and "gone.point" in got[0].message, got
+        files["h2o3_tpu/faults.py"] = \
+            'def f():\n    faultpoint("gone.point")\n'
+        assert run_pass(mini_ctx(tmp_path, files), "faultpoints") == []
+
+    def test_timeline_kind_drift(self, tmp_path):
+        files = {
+            "h2o3_tpu/utils/timeline.py":
+                'KINDS = frozenset({"alpha"})\n',
+            "h2o3_tpu/user.py":
+                'from h2o3_tpu.utils import timeline\n'
+                'def f():\n    timeline.record("beta", "x")\n',
+        }
+        got = run_pass(mini_ctx(tmp_path, files), "timeline-kinds")
+        msgs = " ".join(f.message for f in got)
+        assert "beta" in msgs and "alpha" in msgs, got   # drift + dead
+        files["h2o3_tpu/user.py"] = (
+            'from h2o3_tpu.utils import timeline\n'
+            'def f():\n    timeline.record("alpha", "x")\n')
+        assert run_pass(mini_ctx(tmp_path, files), "timeline-kinds") == []
+
+    def test_knob_docs(self, tmp_path):
+        files = {"h2o3_tpu/k.py":
+                 'import os\ndef f():\n'
+                 '    return os.environ.get("H2O_TPU_SECRET_KNOB")\n'}
+        got = run_pass(mini_ctx(tmp_path, files), "knob-docs")
+        assert len(got) == 1 and "H2O_TPU_SECRET_KNOB" in got[0].message
+        (tmp_path / "README.md").write_text("docs: H2O_TPU_SECRET_KNOB\n")
+        assert run_pass(mini_ctx(tmp_path, files), "knob-docs") == []
+
+    def test_metric_duplicate_and_bad_name(self, tmp_path):
+        files = {"h2o3_tpu/m.py": """
+            def reg(r):
+                r.counter("h2o3_good_total")
+                r.counter("h2o3_good_total")
+                r.gauge("BadName")
+        """}
+        got = run_pass(mini_ctx(tmp_path, files), "metric-registry")
+        msgs = " ".join(f.message for f in got)
+        assert "registered 2 times" in msgs and "BadName" in msgs, got
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics
+# ---------------------------------------------------------------------------
+
+class TestBaseline:
+    def _one_finding_ctx(self, tmp_path):
+        return mini_ctx(tmp_path, {"h2o3_tpu/hot.py": """
+            import numpy as np
+            from h2o3_tpu.obs import tracing
+
+            def instrumented(out):
+                with tracing.span("d"):
+                    return np.asarray(out)
+        """})
+
+    def test_roundtrip(self, tmp_path):
+        ctx = self._one_finding_ctx(tmp_path)
+        got = run_pass(ctx, "sync-hygiene")
+        assert len(got) == 1
+        bl = tmp_path / "BL.json"
+        analysis.save_baseline(bl, got,
+                               notes={got[0].fingerprint: "audited ok"})
+        entries = analysis.load_baseline(bl)
+        new, problems = analysis.apply_baseline(got, entries)
+        assert new == [] and problems == []
+        assert got[0].note == "audited ok"
+
+    def test_stale_entry_is_a_problem(self, tmp_path):
+        ctx = self._one_finding_ctx(tmp_path)
+        got = run_pass(ctx, "sync-hygiene")
+        entries = [{"fingerprint": "deadbeef0000", "pass": "sync-hygiene",
+                    "file": "gone.py", "note": "was ok"}]
+        new, problems = analysis.apply_baseline(got, entries)
+        assert len(new) == 1                      # finding NOT covered
+        assert len(problems) == 1 and "stale" in problems[0].message
+
+    def test_non_baselineable_pass_rejected(self, tmp_path):
+        f = acore.Finding("mirrored", "x.py", 1, "m", snippet="s")
+        with pytest.raises(ValueError, match="not\\s+baselineable"):
+            analysis.save_baseline(tmp_path / "b.json", [f])
+        _new, problems = analysis.apply_baseline(
+            [], [{"fingerprint": "abc", "pass": "mirrored", "note": "n"}])
+        assert len(problems) == 1 and "mirrored" in problems[0].message
+
+    def test_missing_note_is_a_problem(self):
+        _new, problems = analysis.apply_baseline(
+            [], [{"fingerprint": "abc", "pass": "sync-hygiene",
+                  "note": "TODO: one-line justification"}])
+        assert any("no justification" in p.message for p in problems)
+
+    def test_repo_baseline_has_no_stale_entries_and_notes(self):
+        """The checked-in baseline only references findings that still
+        exist, every entry carries a real note, and only baselineable
+        passes appear (the satellite's no-stale-baseline guard)."""
+        new, baselined, problems = analysis.run_repo(root=REPO)
+        assert problems == [], [p.message for p in problems]
+        for f in baselined:
+            assert f.note and not f.note.startswith("TODO")
+            assert f.pass_id in analysis.BASELINEABLE
+
+
+# ---------------------------------------------------------------------------
+# regression fixtures: the real defects this analyzer surfaced
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def mem_cloud(monkeypatch):
+    """2-process memory-KV cloud (same shape as test_supervision's):
+    oplog.active() becomes True so handler broadcasts really publish."""
+    from h2o3_tpu.core import failure
+    from h2o3_tpu.parallel import distributed as D
+    from h2o3_tpu.parallel import oplog, supervisor
+
+    with D.memory_kv() as kv:
+        monkeypatch.setattr(D, "process_count", lambda: 2)
+        monkeypatch.setattr(D, "is_coordinator", lambda: True)
+        monkeypatch.setenv("H2O_TPU_RETRY_BASE_MS", "1")
+        monkeypatch.setenv("H2O_TPU_OP_ACK_TIMEOUT_S", "1")
+        monkeypatch.setenv("H2O_TPU_OPLOG_CHECKPOINT_OPS", "0")
+        monkeypatch.setenv("H2O_TPU_AUTO_RECOVER", "0")
+        failure.set_incarnation(0)
+        D.reset_leadership()
+        oplog._DEMOTED = False
+        oplog.reset()
+        supervisor.reset()
+        yield kv
+    failure.set_incarnation(0)
+    D.reset_leadership()
+    oplog._DEMOTED = False
+    oplog.reset()
+    supervisor.reset()
+
+
+def _tiny_frame(cl, key="analysis_train_frame"):
+    import numpy as np
+
+    from h2o3_tpu.core.frame import Column, Frame
+
+    rng = np.random.default_rng(5)
+    fr = Frame(key=key)
+    fr.add("x1", Column.from_numpy(rng.standard_normal(40)))
+    fr.add("y", Column.from_numpy(
+        np.array(["a", "b"])[rng.integers(0, 2, 40)], ctype="enum"))
+    fr.install()
+    return fr
+
+
+class TestRealDefectRegressions:
+    """REAL defects surfaced by the mirrored pass (time.time() control
+    flow in `_out_of_time` / the grid budget loop, reachable from the
+    broadcast-train root): train and grid broadcasts shipped a per-
+    process wall-clock budget. The handlers must zero it before the op
+    ships — exactly what the AutoML handler has always done."""
+
+    def test_train_broadcast_clears_wallclock_budget(self, cl, mem_cloud,
+                                                     monkeypatch):
+        from h2o3_tpu.api import server as srv
+        from h2o3_tpu.core.dkv import DKV
+        from h2o3_tpu.core.job import Job
+
+        fr = _tiny_frame(cl)
+        # broadcast happens synchronously in the handler; the training
+        # job itself is irrelevant here — don't start its thread
+        monkeypatch.setattr(Job, "start",
+                            lambda self, fn, background=True: self)
+        try:
+            srv.h_modelbuilder_train(srv.Ctx(
+                {"algo": "gbm"}, {},
+                {"training_frame": str(fr.key), "response_column": "y",
+                 "ntrees": 1, "max_depth": 2, "seed": -1,
+                 "max_runtime_secs": 30.0}, None))
+            op = json.loads(mem_cloud["oplog/0"])
+            assert op["kind"] == "train"
+            wire = op["payload"]["params"]
+            assert float(wire["max_runtime_secs"]) == 0.0, (
+                "train broadcast still ships a per-process wall-clock "
+                "budget — mirrored fit loops would stop at different "
+                "iterations")
+            assert int(wire["seed"]) >= 0      # wildcard seed pinned too
+        finally:
+            DKV.remove(str(fr.key))
+
+    def test_grid_broadcast_clears_wallclock_budget(self, cl, mem_cloud,
+                                                    monkeypatch):
+        from h2o3_tpu.api import server as srv
+        from h2o3_tpu.core.dkv import DKV
+        from h2o3_tpu.core.job import Job
+
+        fr = _tiny_frame(cl, key="analysis_grid_frame")
+        monkeypatch.setattr(Job, "start",
+                            lambda self, fn, background=True: self)
+        try:
+            srv.h_grid_build(srv.Ctx(
+                {"algo": "gbm"}, {},
+                {"training_frame": str(fr.key), "response_column": "y",
+                 "hyper_parameters": {"max_depth": [2, 3]},
+                 "search_criteria": {"strategy": "RandomDiscrete",
+                                     "max_models": 2,
+                                     "max_runtime_secs": 60.0},
+                 "ntrees": 1, "max_runtime_secs": 30.0}, None))
+            op = json.loads(mem_cloud["oplog/0"])
+            assert op["kind"] == "grid"
+            assert float(op["payload"]["params"]["max_runtime_secs"]) == 0.0
+            crit = op["payload"]["criteria"]
+            assert float(crit["max_runtime_secs"]) == 0.0, (
+                "grid broadcast still ships the walker's wall-clock "
+                "budget — processes would walk different combo prefixes")
+            assert int(crit["seed"]) >= 0      # RandomDiscrete seed pinned
+        finally:
+            DKV.remove(str(fr.key))
+
+
+class _Evil:
+    def __reduce__(self):
+        return (eval, ("1+1",))
+
+
+class TestRestrictedUnpicklerRegressions:
+    """Serialization-pass defects fixed in this PR: every external-bytes
+    load refuses non-framework types instead of executing them."""
+
+    def test_restricted_loads_refuses_callables_allows_framework(self):
+        import numpy as np
+
+        from h2o3_tpu.core.dkv import Key
+        from h2o3_tpu.utils.unpickle import restricted_loads
+
+        with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+            restricted_loads(pickle.dumps(_Evil()))
+        ok = restricted_loads(pickle.dumps(
+            {"a": np.arange(3), "k": Key("x"), "s": {1, 2}}))
+        assert list(ok["a"]) == [0, 1, 2] and str(ok["k"]) == "x"
+
+    def test_model_load_refuses_malicious_artifact(self, tmp_path):
+        from h2o3_tpu.models.model import Model
+
+        p = tmp_path / "evil_model.bin"
+        with open(p, "wb") as f:
+            f.write(Model._SAVE_MAGIC)
+            f.write(struct.pack("<H", Model._SAVE_VERSION))
+            f.write(pickle.dumps(_Evil()))
+        with pytest.raises(Exception, match="disallowed"):
+            Model.load(str(p))
+
+    def test_assembly_load_refuses_malicious_artifact(self, tmp_path):
+        from h2o3_tpu.assembly import H2OAssembly
+
+        p = tmp_path / "evil_assembly.bin"
+        with open(p, "wb") as f:
+            f.write(H2OAssembly._SAVE_MAGIC)
+            f.write(struct.pack("<H", H2OAssembly._SAVE_VERSION))
+            f.write(pickle.dumps(_Evil()))
+        with pytest.raises(Exception, match="disallowed"):
+            H2OAssembly.load(str(p))
+
+    def test_dkv_blob_fetch_refuses_malicious_payload(self, mem_cloud):
+        from h2o3_tpu.core.dkv import DKV
+        from h2o3_tpu.parallel import distributed as D
+
+        D.kv_put(DKV._BLOB_PREFIX + "evil_key",
+                 base64.b64encode(pickle.dumps(_Evil())).decode())
+        with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+            DKV.fetch_remote("evil_key")
+
+
+# ---------------------------------------------------------------------------
+# CLI + whole-repo invariants
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def test_json_output_and_exit_codes(self, tmp_path, capsys):
+        from h2o3_tpu.analysis.__main__ import main
+
+        # a dirty mini repo exits 1 with machine-readable findings
+        mini_ctx(tmp_path, {"h2o3_tpu/io2.py":
+                            "import pickle\n\n"
+                            "def bad(f):\n    return pickle.load(f)\n"})
+        rc = main([str(tmp_path), "--json", "--select", "serialization"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1 and len(out["findings"]) == 1
+        assert out["findings"][0]["pass"] == "serialization"
+
+    def test_list_passes(self, capsys):
+        from h2o3_tpu.analysis.__main__ import main
+
+        assert main(["--list"]) == 0
+        listed = set(capsys.readouterr().out.split())
+        assert {"mirrored", "lock-order", "serialization",
+                "compat-routing", "sync-hygiene"} <= listed
+
+    def test_unknown_pass_is_usage_error(self, tmp_path):
+        from h2o3_tpu.analysis.__main__ import main
+
+        mini_ctx(tmp_path, {})
+        assert main([str(tmp_path), "--select", "nope"]) == 2
+
+    def test_partial_update_preserves_unselected_entries(self, tmp_path):
+        """Review finding: `--select X --update-baseline` must not delete
+        the audited entries of unselected passes, and a partial run must
+        not misreport them as stale."""
+        from h2o3_tpu.analysis.__main__ import main
+
+        mini_ctx(tmp_path, {"h2o3_tpu/hot.py": """
+            import numpy as np
+            from h2o3_tpu.obs import tracing
+
+            def instrumented(out):
+                with tracing.span("d"):
+                    return np.asarray(out)
+        """})
+        bl = tmp_path / "BL.json"
+        bl.write_text(json.dumps({"version": 1, "entries": [
+            {"fingerprint": "aaaaaaaaaaaa", "pass": "compat-routing",
+             "file": "x.py", "note": "audited compat leftover"}]}))
+        # partial serialization-only run: the compat entry is untouched
+        # and NOT reported stale
+        rc = main([str(tmp_path), "--select", "serialization",
+                   "--baseline", str(bl), "--update-baseline"])
+        assert rc == 0
+        entries = analysis.load_baseline(bl)
+        assert any(e["fingerprint"] == "aaaaaaaaaaaa" for e in entries), \
+            "partial --update-baseline dropped an unselected pass's entry"
+
+
+class TestRegistrySelfChecks:
+    """Review finding: an unresolvable registry qualname must be a
+    finding, not a silent green no-op (the renamed-faultpoint failure
+    mode applied to the analyzer's own registry)."""
+
+    def test_unresolvable_mirrored_root_is_a_finding(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/work.py": "def f():\n  pass\n"},
+                       MIRRORED_ROOTS=("h2o3_tpu.work.renamed_away",))
+        got = run_pass(ctx, "mirrored")
+        assert len(got) == 1 and "MIRRORED_ROOTS" in got[0].message, got
+
+    def test_stale_guarded_and_helper_entries_flagged(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/work.py": "def f():\n  pass\n"},
+                       MIRRORED_ROOTS=("h2o3_tpu.work.f",),
+                       GUARDED={"h2o3_tpu.work.gone": "stale audit"},
+                       KNOB_HELPERS=frozenset({"h2o3_tpu.work.gone2"}))
+        msgs = " ".join(f.message for f in run_pass(ctx, "mirrored"))
+        assert "GUARDED" in msgs and "KNOB_HELPERS" in msgs
+
+    def test_stale_swallow_scope_flagged(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/work.py": "def f():\n  pass\n"},
+                       SWALLOW_SCOPE=("h2o3_tpu/renamed_watchdog.py",))
+        got = run_pass(ctx, "sync-hygiene")
+        assert len(got) == 1 and "SWALLOW_SCOPE" in got[0].message, got
+
+    def test_stale_lock_scope_flagged(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/work.py": "def f():\n  pass\n"},
+                       LOCK_SCOPE=("h2o3_tpu/gone_dir/",))
+        got = run_pass(ctx, "lock-order")
+        assert len(got) == 1 and "LOCK_SCOPE" in got[0].message, got
