@@ -79,13 +79,47 @@ def bench_glm(n_rows: int = 1_000_000, p: int = 32, iters: int = 20) -> float:
     return run_glm(n_rows=n_rows, p=p, iters=iters)[0]
 
 
+# keep in sync with h2o3_tpu/obs/phases.py DEADLINE_EXIT_RC — this file
+# must stay importable without h2o3_tpu (whose import pulls jax)
+PHASE_DEADLINE_RC = 97
+
+
+def _phase_deadline(name: str) -> float:
+    """Stdlib parse of the H2O_TPU_PHASE_DEADLINE_S map (one number for
+    every phase, or name=secs pairs) — the probe child must read it
+    before anything heavier than os.environ exists."""
+    raw = os.environ.get("H2O_TPU_PHASE_DEADLINE_S", "").strip()
+    if not raw:
+        return 0.0
+    if "=" not in raw:
+        try:
+            return max(float(raw), 0.0)
+        except ValueError:
+            return 0.0
+    for part in raw.replace(";", ",").split(","):
+        k, _, v = part.partition("=")
+        if k.strip() == name:
+            try:
+                return max(float(v), 0.0)
+            except ValueError:
+                return 0.0
+    return 0.0
+
+
 def _arm_probe_autopsy() -> None:
-    """STDLIB-ONLY flight-dump timer for the probe stage: the probe's
+    """STDLIB-ONLY flight-dump timers for the probe stage: the probe's
     failure mode is `import jax` / backend init wedging, so the arming
-    must not touch h2o3_tpu (whose import pulls jax). The dump captures
-    every thread's stack + the newest imported modules — i.e. exactly
-    WHERE the wedge sits — into a flight record the parent folds into
-    the BENCH_STAGE tail."""
+    must not touch h2o3_tpu (whose import pulls jax). Two timers:
+
+    - the classic stage autopsy a few seconds short of the parent's
+      SIGKILL — thread stacks + newest imported modules, i.e. exactly
+      WHERE the wedge sits;
+    - the PHASE deadline (ISSUE 12): the whole probe IS backend_init, so
+      at ``H2O_TPU_PHASE_DEADLINE_S``'s backend_init deadline the child
+      dumps a corpse NAMING the phase and — under
+      ``H2O_TPU_PHASE_DEADLINE_EXIT=1`` — exits with
+      ``PHASE_DEADLINE_RC`` so the parent hands the saved budget to the
+      CPU chain instead of waiting out the stage timeout."""
     import threading
     import traceback
 
@@ -96,7 +130,7 @@ def _arm_probe_autopsy() -> None:
     if t <= 6:
         return
 
-    def dump():
+    def dump(reason="bench_probe_timeout", phase=None, hard_exit=False):
         try:
             frames = {str(tid): traceback.format_stack(frame)[-8:]
                       for tid, frame in sys._current_frames().items()}
@@ -106,23 +140,42 @@ def _arm_probe_autopsy() -> None:
             os.makedirs(d, exist_ok=True)
             path = os.path.join(
                 d, f"flight_{time.strftime('%Y%m%d_%H%M%S')}"
-                   f"_bench_probe_timeout_{os.getpid()}.json")
+                   f"_{reason}_{os.getpid()}.json")
             tmp = f"{path}.part"
             with open(tmp, "w") as f:
-                json.dump({"reason": "bench_probe_timeout",
+                json.dump({"reason": reason,
                            "ts": time.time(), "pid": os.getpid(),
+                           **({"phase": phase} if phase else {}),
                            "thread_stacks": frames,
                            "modules_tail": list(sys.modules)[-40:]}, f)
             os.replace(tmp, path)
             print("H2O3_FLIGHT_JSON " + json.dumps(
-                {"flight_record": path, "timeline_tail": []}),
+                {"flight_record": path, "timeline_tail": [],
+                 **({"phase": phase} if phase else {})}),
                 file=sys.stderr, flush=True)
         except Exception:   # noqa: BLE001 — the autopsy must never be
             pass            # the thing that kills a healthy probe
+        if hard_exit:
+            try:
+                sys.stderr.flush()
+            except Exception:   # noqa: BLE001
+                pass
+            os._exit(PHASE_DEADLINE_RC)
 
     tm = threading.Timer(max(t - 5.0, 1.0), dump)
     tm.daemon = True
     tm.start()
+    dl = _phase_deadline("backend_init")
+    if 0 < dl < t:
+        exit_fast = os.environ.get(
+            "H2O_TPU_PHASE_DEADLINE_EXIT", "").lower() in ("1", "true",
+                                                           "on")
+        pm = threading.Timer(
+            dl, dump, kwargs={"reason": "phase_deadline_backend_init",
+                              "phase": "backend_init",
+                              "hard_exit": exit_fast})
+        pm.daemon = True
+        pm.start()
 
 
 def bench_probe() -> float:
@@ -171,8 +224,16 @@ def _autopsy(stderr) -> dict:
                 rec = json.loads(ln[len("H2O3_FLIGHT_JSON "):])
             except ValueError:
                 break
-            return {"flight_record": rec.get("flight_record"),
-                    "timeline_tail": (rec.get("timeline_tail") or [])[-20:]}
+            out = {"flight_record": rec.get("flight_record"),
+                   "timeline_tail": (rec.get("timeline_tail") or [])[-20:]}
+            # ISSUE 12: the corpse names the lifecycle phase that never
+            # completed + the durations of the ones that did — fold them
+            # into the BENCH_STAGE record next to the timeline tail
+            if rec.get("phase"):
+                out["phase"] = rec["phase"]
+            if rec.get("phase_report"):
+                out["phase_report"] = rec["phase_report"]
+            return out
     return {}
 
 
@@ -188,6 +249,15 @@ def _stage(name, cmd, timeout_s, env_extra=None):
     # deadline (h2o3_tpu/bench.py _arm_stage_autopsy) — subprocess.run's
     # timeout kill is SIGKILL, so the corpse must be written BEFORE it
     env["H2O3_BENCH_STAGE_TIMEOUT_S"] = str(timeout_s)
+    # ISSUE 12: deadline-supervised lifecycle phases in every child. A
+    # wedged backend init / first tiny compile (the r03-r05 wedge) now
+    # dumps a flight record naming the phase and EXITS fast with
+    # PHASE_DEADLINE_RC instead of burning the whole stage budget — the
+    # parent's chain then reaches the CPU fallback with budget to spare
+    env.setdefault("H2O_TPU_PHASE_DEADLINE_S",
+                   "backend_init=45,device_discovery=20,mesh_init=20,"
+                   "first_compile=90,compile_cache_load=60")
+    env.setdefault("H2O_TPU_PHASE_DEADLINE_EXIT", "1")
     t0 = time.perf_counter()
     try:
         proc = subprocess.run(cmd, capture_output=True, timeout=timeout_s,
@@ -200,9 +270,12 @@ def _stage(name, cmd, timeout_s, env_extra=None):
     secs = round(time.perf_counter() - t0, 1)
     got = _parse_result(proc.stdout)
     if got is None:
+        err = (f"phase deadline expired (rc {PHASE_DEADLINE_RC}): wedged "
+               f"init phase, fell back to the CPU chain fast"
+               if proc.returncode == PHASE_DEADLINE_RC
+               else (proc.stderr or "")[-1500:])
         _record(name, ok=False, rc=proc.returncode, secs=secs,
-                error=(proc.stderr or "")[-1500:],
-                **_autopsy(proc.stderr))
+                error=err, **_autopsy(proc.stderr))
         return None
     value, metric = got[-1]
     extras = {m: round(v, 3) for v, m in got[:-1]}
@@ -230,7 +303,12 @@ def main():
     cache = {"JAX_COMPILATION_CACHE_DIR":
              os.environ.get("JAX_COMPILATION_CACHE_DIR",
                             os.path.join(REPO, ".jax_cache"))}
-    probe = _stage("probe", [py, "-c", _PROBE_SNIPPET], 10)
+    # the 10 s probe gets a tighter backend_init deadline than the
+    # default map: a wedged import jax leaves a corpse naming the phase
+    # (and exits with PHASE_DEADLINE_RC) ~3 s before the SIGKILL would land
+    probe = _stage("probe", [py, "-c", _PROBE_SNIPPET], 10,
+                   env_extra={"H2O_TPU_PHASE_DEADLINE_S": "backend_init=7",
+                              "H2O_TPU_PHASE_DEADLINE_EXIT": "1"})
     got = None
     unit = "rows/sec/chip"
     if probe is None and remaining() > 500:
@@ -238,9 +316,15 @@ def main():
         # saved 110 s on the smallest shrunken flagship size anyway — a
         # slow first device init looks identical to a dead tunnel inside
         # 10 s, and this is the only way a device metric can still land
+        # laxer init deadlines than the default map: this shot EXISTS for
+        # the slow-but-healthy first device init the 10 s probe cannot
+        # distinguish from a dead tunnel
         got = _stage("measure-50k-blind", [py, "-m", "h2o3_tpu.bench"], 240,
                      env_extra={"H2O3_BENCH_ROWS": "50000",
-                                "H2O3_BENCH_TREES": "5", **cache})
+                                "H2O3_BENCH_TREES": "5",
+                                "H2O_TPU_PHASE_DEADLINE_S":
+                                "backend_init=150,first_compile=60",
+                                **cache})
     if probe is not None:
         # tunnel is up: compile-only stage first, then the measured run.
         # The measure stage AUTO-SHRINKS on failure/timeout (1M -> 200k ->

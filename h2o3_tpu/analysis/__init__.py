@@ -50,6 +50,7 @@ def _passes() -> Dict[str, object]:
         "lock-order": passes_locks.run,
         "serialization": passes_misc.run_serialization,
         "compat-routing": passes_misc.run_compat,
+        "compile-ledger": passes_misc.run_compile_ledger,
         "sync-hygiene": passes_misc.run_sync_hygiene,
         "faultpoints": passes_registries.run_faultpoints,
         "metric-registry": passes_registries.run_metric_registry,

@@ -139,6 +139,91 @@ def run_compat(ctx: Context) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# compile-ledger
+# ---------------------------------------------------------------------------
+
+def run_compile_ledger(ctx: Context) -> List[Finding]:
+    """Every XLA compile must route through ``obs/compiles.py`` (the
+    ledger chokepoint, ``registry.COMPILE_LEDGER_MODULES``): a direct
+    ``.lower(...).compile(`` — chained or via a name bound from a
+    ``.lower(...)`` call — a direct ``compile_stablehlo`` call, or a
+    direct ``note_compile`` call elsewhere is an unrecorded compile that
+    silently under-counts /3/Runtime and the compile-seconds series."""
+    allowed = set(ctx.reg("COMPILE_LEDGER_MODULES",
+                          ("h2o3_tpu/obs/compiles.py",)))
+    compat = ctx.reg("COMPAT_MODULE", "h2o3_tpu/compat.py")
+    findings: List[Finding] = []
+    for mod in ctx.project.modules.values():
+        if mod.rel in allowed or mod.rel.startswith("h2o3_genmodel/"):
+            # the genmodel runners are framework-free by contract (they
+            # execute the exporter's exact program through the raw XLA
+            # client); the ledger lives with the framework
+            continue
+        # names (incl. dotted attribute targets like `self._lowered`)
+        # bound from a `.lower(...)` call anywhere in the module — the
+        # two-step spelling: lowered = fn.lower(...); lowered.compile()
+        lowered_names = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)) and \
+                    isinstance(getattr(node, "value", None), ast.Call) and \
+                    isinstance(node.value.func, ast.Attribute) and \
+                    node.value.func.attr == "lower":
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    d = _dotted(t)
+                    if d:
+                        lowered_names.add(d)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "compile":
+                direct = (isinstance(fn.value, ast.Call)
+                          and isinstance(fn.value.func, ast.Attribute)
+                          and fn.value.func.attr == "lower")
+                via_name = (_dotted(fn.value) or "") in lowered_names
+                if direct or via_name:
+                    findings.append(ctx.finding(
+                        "compile-ledger", mod, node,
+                        "direct `.lower(...).compile(` — every XLA "
+                        "compile must route through obs/compiles.py "
+                        "(compile_jit/compile_lowered) so it lands a "
+                        "ledger row on /3/Runtime", symbol=mod.rel))
+            name = _dotted(fn)
+            if name and name.split(".")[-1] == "compile_stablehlo" and \
+                    mod.rel != compat:
+                # the blessed wrapper IS the remediation — a call whose
+                # base resolves to the ledger module must not be flagged
+                norm = _normalize(name, mod.imports) or name
+                via_ledger = (norm.startswith("h2o3_tpu.obs.compiles.")
+                              or name.split(".")[-2:-1] == ["compiles"])
+                if not via_ledger:
+                    findings.append(ctx.finding(
+                        "compile-ledger", mod, node,
+                        "direct `compile_stablehlo` call — route through "
+                        "obs/compiles.py compile_stablehlo(family, text) "
+                        "so the compile is ledger-recorded",
+                        symbol=mod.rel))
+            if name and name.split(".")[-1] == "note_compile":
+                findings.append(ctx.finding(
+                    "compile-ledger", mod, node,
+                    "direct `note_compile` call — the ledger is the one "
+                    "writer of the fused-compile counter (it times the "
+                    "compile itself, so compile_ms_total cannot drift "
+                    "from the per-program rows)", symbol=mod.rel))
+    # registry self-check: a renamed chokepoint must not turn this pass
+    # into a green no-op
+    for rel in allowed:
+        if not any(m.rel == rel for m in ctx.project.modules.values()):
+            findings.append(Finding(
+                "compile-ledger", "h2o3_tpu/analysis/registry.py", 0,
+                f"COMPILE_LEDGER_MODULES entry `{rel}` matches no module "
+                f"— stale registry path; fix it", symbol=rel, snippet=rel))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # sync-hygiene
 # ---------------------------------------------------------------------------
 

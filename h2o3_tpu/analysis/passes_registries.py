@@ -141,6 +141,52 @@ def run_timeline_kinds(ctx: Context) -> List[Finding]:
             "timeline-kinds", "h2o3_tpu/utils/timeline.py", 0,
             f"timeline kind `{k}` is declared in KINDS but never "
             f"recorded — drop it or record it", symbol=k, snippet=k))
+    findings.extend(_phase_name_findings(ctx))
+    return findings
+
+
+def _declared_phases(ctx: Context) -> set:
+    mod = ctx.project.modules.get("h2o3_tpu.obs.phases")
+    if mod is None:
+        return set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "PHASES":
+            return {n.value for n in ast.walk(node.value)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)}
+    return set()
+
+
+def _phase_name_findings(ctx: Context) -> List[Finding]:
+    """The lifecycle-phase enumeration half of the timeline-kinds guard
+    (ISSUE 12): every phase literal passed to obs.phases ``enter`` must
+    be declared in ``obs/phases.py PHASES``, and every declared phase
+    must be entered somewhere — a dead phase name makes /3/Runtime's
+    table lie."""
+    declared = _declared_phases(ctx)
+    enter_pat = re.compile(r"\bphases\.enter\(\s*['\"]([^'\"]+)['\"]")
+    used = {}
+    for mod in _src_texts(ctx):
+        for m in enter_pat.finditer(mod.text):
+            used.setdefault(m.group(1), mod.rel)
+    if not declared and not used:
+        # synthetic fixture projects without a phase tracker have
+        # nothing to guard; a real repo that renamed obs/phases.py but
+        # kept enter() calls still gets findings below
+        return []
+    findings = [Finding(
+        "timeline-kinds", rel, 0,
+        f"lifecycle phase `{p}` is entered but not declared in "
+        f"obs/phases.py PHASES (closed enumeration)", symbol=p, snippet=p)
+        for p, rel in sorted(used.items()) if p not in declared]
+    for p in sorted(declared - set(used)):
+        findings.append(Finding(
+            "timeline-kinds", "h2o3_tpu/obs/phases.py", 0,
+            f"lifecycle phase `{p}` is declared in PHASES but never "
+            f"entered — drop it or wrap its boot step", symbol=p,
+            snippet=p))
     return findings
 
 

@@ -174,6 +174,18 @@ DEVICE_ONLY_APIS = {
 COMPAT_MODULE = "h2o3_tpu/compat.py"
 
 # ---------------------------------------------------------------------------
+# compile-ledger pass (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+# the ONE chokepoint allowed to run `.lower(...).compile(` /
+# `compat.compile_stablehlo` / `compile_cache.note_compile` — every XLA
+# compile must land a ledger row (family, signature, duration, cache
+# disposition, HBM estimate) or /3/Runtime and the compile-seconds
+# series silently under-count. h2o3_genmodel/ is exempt like the compat
+# pass: the standalone runners are framework-free by contract.
+COMPILE_LEDGER_MODULES = ("h2o3_tpu/obs/compiles.py",)
+
+# ---------------------------------------------------------------------------
 # sync-hygiene pass
 # ---------------------------------------------------------------------------
 

@@ -794,10 +794,57 @@ def h_metrics(ctx: Ctx):
     series = obs_metrics.cluster_aggregate()
     fmt = str(ctx.arg("format", "") or "").lower()
     if fmt == "json":
+        # JSON consumers get computed p50/p95/p99 per histogram sample
+        # (the Prometheus text path keeps raw cumulative buckets — that
+        # is its contract; histogram_quantile runs server-side there)
+        for m in series:
+            if m.get("type") != "histogram":
+                continue
+            for s in m.get("samples", []):
+                s["quantiles"] = obs_metrics.histogram_quantiles(
+                    m.get("buckets") or [], s.get("bucket_counts") or [],
+                    int(s.get("count", 0)))
         return {"__meta": S.meta("MetricsV3"), "series": series,
                 "series_count": len(series)}
     return RawReply(obs_metrics.prometheus_text(series).encode(),
                     "text/plain; version=0.0.4; charset=utf-8")
+
+
+def h_runtime(ctx: Ctx):
+    """GET /3/Runtime — the engine's lifecycle + compile story in one
+    page (ISSUE 12): this process's phase history (``backend_init`` …
+    ``server_start``, each with wall ms, status and any deadline
+    expiry), the cluster-wide compile-ledger table per program family
+    (compiles / memory hits / disk hits / total+max ms), and the
+    slowest-N compiled programs with signature hash, device kind and HBM
+    estimate. Every process contributes its KV-published runtime
+    snapshot (same throttle as the /3/Metrics publish). The response
+    carries ``X-H2O3-Trace-Id`` like every traced route."""
+    from h2o3_tpu.obs import compiles, phases
+
+    try:
+        n = int(ctx.arg("slowest", 10) or 10)
+    except (TypeError, ValueError):
+        n = 10
+    n = max(min(n, 100), 1)
+    snaps = compiles.cluster_runtime(slowest_n=n)
+    families = compiles.merge_family_tables(
+        [(s.get("compiles") or {}).get("families") or {} for s in snaps])
+    slowest = sorted(
+        (r for s in snaps
+         for r in (s.get("compiles") or {}).get("slowest") or []),
+        key=lambda r: float(r.get("ms") or 0.0), reverse=True)[:n]
+    return {"__meta": S.meta("RuntimeV3"),
+            "phases": phases.history(),
+            "phase_report": phases.phase_report(),
+            "wedged_phase": phases.wedged_phase(),
+            "compile_families": families,
+            "slowest_compiles": slowest,
+            "processes": [{"proc": s.get("proc"), "ts": s.get("ts"),
+                           "phase_report": s.get("phase_report"),
+                           "rows_recorded":
+                           (s.get("compiles") or {}).get("rows_recorded")}
+                          for s in snaps]}
 
 
 def h_trace_list(ctx: Ctx):
@@ -1372,6 +1419,8 @@ EXTRA_ROUTES = [
      "Serving fast-path scoring metrics"),
     ("GET", "/3/Metrics", h_metrics,
      "Cluster-wide metrics (Prometheus text / JSON)"),
+    ("GET", "/3/Runtime", h_runtime,
+     "Lifecycle phase history + cluster compile ledger"),
     ("GET", "/3/Trace", h_trace_list, "Recent trace ids"),
     ("GET", "/3/Trace/{trace_id}", h_trace_get, "One request's span tree"),
     ("GET", "/3/FlightRecords", h_flight_list,

@@ -2067,7 +2067,7 @@ def start_server(port: int = 54321, auth_file: Optional[str] = None,
                  host: Optional[str] = None,
                  ssl_certfile: Optional[str] = None,
                  ssl_keyfile: Optional[str] = None) -> ApiServer:
-    from h2o3_tpu.obs import flight
+    from h2o3_tpu.obs import flight, phases
     from h2o3_tpu.parallel import distributed as D
     from h2o3_tpu.parallel import oplog
 
@@ -2075,34 +2075,39 @@ def start_server(port: int = 54321, auth_file: Optional[str] = None,
     # fatal-signal flight hooks: an externally killed server leaves a
     # postmortem (H2O_TPU_OBS_SIGNALS=0 disables; no-op off-main-thread)
     flight.install_signal_hooks()
-    srv = ApiServer(port, auth_file=auth_file, host=host,
-                    ssl_certfile=ssl_certfile,
-                    ssl_keyfile=ssl_keyfile).start()
-    if D.process_count() > 1:
-        # multi-process cloud: the coordinator beats + supervises without
-        # manual wiring, so /3/Cloud liveness and the /3/CloudStatus state
-        # machine are live for every REST-served cloud (stopped by stop())
-        from h2o3_tpu.core.failure import HeartbeatThread
-        from h2o3_tpu.parallel import supervisor as _sup
+    # the whole bring-up (HTTP bind + supervision wiring) is one
+    # deadline-supervisable lifecycle phase on /3/Runtime's history
+    with phases.enter("server_start", port=port):
+        srv = ApiServer(port, auth_file=auth_file, host=host,
+                        ssl_certfile=ssl_certfile,
+                        ssl_keyfile=ssl_keyfile).start()
+        if D.process_count() > 1:
+            # multi-process cloud: the coordinator beats + supervises
+            # without manual wiring, so /3/Cloud liveness and the
+            # /3/CloudStatus state machine are live for every REST-served
+            # cloud (stopped by stop())
+            from h2o3_tpu.core.failure import HeartbeatThread
+            from h2o3_tpu.parallel import supervisor as _sup
 
-        # a RE-started cloud begins from evidence, not from the previous
-        # incarnation's sticky verdict: reset, then let Supervisor.start's
-        # synchronous first evaluate() re-derive FAILED from any error
-        # keys still in the coordination KV
-        _sup.reset()
-        # core.runtime's cluster boot already runs a beater on every
-        # process of a REAL multi-process cloud — only start our own when
-        # none is running (REST served without a booted Runtime); the
-        # runtime's beater outlives stop() on purpose: the process is
-        # still a live cloud member after its HTTP server closes
-        import sys as _sys
+            # a RE-started cloud begins from evidence, not from the
+            # previous incarnation's sticky verdict: reset, then let
+            # Supervisor.start's synchronous first evaluate() re-derive
+            # FAILED from any error keys still in the coordination KV
+            _sup.reset()
+            # core.runtime's cluster boot already runs a beater on every
+            # process of a REAL multi-process cloud — only start our own
+            # when none is running (REST served without a booted
+            # Runtime); the runtime's beater outlives stop() on purpose:
+            # the process is still a live cloud member after its HTTP
+            # server closes
+            import sys as _sys
 
-        _rt = _sys.modules.get("h2o3_tpu.core.runtime")
-        _cl = getattr(_rt, "_CLUSTER", None) if _rt else None
-        if getattr(_cl, "_heartbeat", None) is None:
-            srv.heartbeat_thread = HeartbeatThread().start()
-        srv.supervisor = _sup.Supervisor().start()
-    return srv
+            _rt = _sys.modules.get("h2o3_tpu.core.runtime")
+            _cl = getattr(_rt, "_CLUSTER", None) if _rt else None
+            if getattr(_cl, "_heartbeat", None) is None:
+                srv.heartbeat_thread = HeartbeatThread().start()
+            srv.supervisor = _sup.Supervisor().start()
+        return srv
 
 
 def assume_coordination(port: int = 54321, caught_up_seq=None,

@@ -147,9 +147,17 @@ def compile_bucket(bucket: int, meta: Dict[str, Any], edges, is_cat, init,
                    forest_args) -> Tuple[Any, Optional[bytes], str, Any]:
     """AOT-compile one bucket; returns (compiled, blob_or_None, stablehlo
     text, kept_arg_indices_or_None)."""
+    from h2o3_tpu.obs import compiles
+
     lowered = lower_bucket(bucket, meta, edges, is_cat, init, forest_args)
     text = lowered.as_text()
-    compiled = lowered.compile()
+    # ledger chokepoint (family "artifact"): the exporter's per-bucket
+    # compile cost lands on /3/Runtime next to the serving compiles
+    compiled = compiles.compile_lowered(
+        "artifact", lowered,
+        signature=("artifact", int(bucket), int(meta.get("max_depth", 0)),
+                   int(meta.get("nclasses", 0))),
+        program=f"artifact_bucket_{int(bucket)}")
     nargs = 4 + len(forest_args)
     return (compiled, serialize_exec_blob(compiled), text,
             kept_arg_indices(compiled, text, nargs))

@@ -62,9 +62,20 @@ def _path(key: str) -> Optional[str]:
     return os.path.join(d, f"xc_{key}.bin")
 
 
+_PHASE_LOAD_SEEN = False
+
+
 def load(key: str) -> Optional[Any]:
     """Loaded executable for `key`, or None (disabled / miss / unloadable
-    blob — the caller compiles)."""
+    blob — the caller compiles). The FIRST executable deserialize of the
+    process runs inside the ``compile_cache_load`` lifecycle phase: it
+    talks to the backend, so a wedged tunnel wedges HERE at warm-start —
+    the phase tracker's deadline and timeline event make that visible
+    instead of silent. Later serving-time loads skip the phase so they
+    cannot flood the bounded phase history (the boot records must
+    survive a long-lived server)."""
+    global _PHASE_LOAD_SEEN
+
     path = _path(key)
     if path is None:
         return None
@@ -78,7 +89,14 @@ def load(key: str) -> Optional[Any]:
     try:
         from h2o3_tpu.artifact import aot
 
-        exe = aot.load_exec_blob(blob)
+        if not _PHASE_LOAD_SEEN:
+            _PHASE_LOAD_SEEN = True
+            from h2o3_tpu.obs import phases
+
+            with phases.enter("compile_cache_load", key=key[:16]):
+                exe = aot.load_exec_blob(blob)
+        else:
+            exe = aot.load_exec_blob(blob)
     except Exception:   # noqa: BLE001 — any unloadable blob = miss
         with _LOCK:
             _STATS["load_failures"] += 1
@@ -112,9 +130,12 @@ def store(key: str, compiled) -> bool:
 
 
 def note_compile(ms: float = 0.0) -> None:
-    """Record one actual fused-program XLA compilation (and, when the
-    caller timed it, the wall milliseconds it cost — the compile-seconds
-    series on /3/Metrics that makes cold-start spikes visible)."""
+    """Record one actual fused-program XLA compilation. Since the compile
+    ledger landed, ``obs/compiles.py`` is the ONLY caller (enforced by
+    the `compile-ledger` analysis pass): the ledger times the compile
+    itself and feeds this counter the SAME milliseconds it recorded in
+    the per-program row, so ``compile_ms_total`` can never drift from
+    the ledger (it used to be caller-self-reported)."""
     with _LOCK:
         _STATS["compiles"] += 1
         _STATS["compile_ms_total"] += float(ms)

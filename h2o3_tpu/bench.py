@@ -38,12 +38,19 @@ def arm_stage_autopsy() -> bool:
     def dump():
         try:
             from h2o3_tpu.obs import flight as _fl
+            from h2o3_tpu.obs import phases as _ph
             from h2o3_tpu.utils import timeline as _tl
 
-            path = _fl.record_flight("bench_stage_timeout",
-                                     extra={"stage_timeout_s": t})
+            report = _ph.phase_report()
+            wedged = _ph.wedged_phase()
+            path = _fl.record_flight(
+                "bench_stage_timeout",
+                extra={"stage_timeout_s": t, "phase_report": report,
+                       "wedged_phase": wedged})
             print("H2O3_FLIGHT_JSON " + _json.dumps(
-                {"flight_record": path, "timeline_tail": _tl.events(20)},
+                {"flight_record": path, "timeline_tail": _tl.events(20),
+                 "phase_report": report,
+                 **({"phase": wedged} if wedged else {})},
                 default=str), file=_sys.stderr, flush=True)
         except Exception:   # noqa: BLE001 — the autopsy must never be the
             pass            # thing that kills a healthy stage
@@ -514,4 +521,14 @@ if __name__ == "__main__":
         value, metric = run_flagship(
             n_rows=int(os.environ.get("H2O3_BENCH_ROWS", 1_000_000)),
             ntrees=int(os.environ.get("H2O3_BENCH_TREES", 20)))
+    # the lifecycle phase report rides along as aux lines (the ISSUE-12
+    # acceptance evidence: backend_init .. first_compile durations next
+    # to the stage's primary metric, mirrored on GET /3/Runtime)
+    try:
+        from h2o3_tpu.obs import phases as _phases
+
+        for _name, _ms in _phases.phase_report().items():
+            print(f"H2O3_BENCH phase_{_name}_ms {_ms}", flush=True)
+    except Exception:   # noqa: BLE001 — reporting must not fail a stage
+        pass
     print(f"H2O3_BENCH {metric} {value}", flush=True)

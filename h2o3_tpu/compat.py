@@ -115,6 +115,37 @@ def pallas_modules():
     return pl, pltpu
 
 
+def memory_analysis(compiled):
+    """Byte-level memory estimate of an AOT-compiled executable
+    (``compiled.memory_analysis()`` — the API and its field names are
+    version-mobile, and some backends return None). Normalized to
+    ``{argument_bytes, output_bytes, temp_bytes, generated_code_bytes}``
+    (missing fields omitted), or None when this jax/backend cannot say —
+    the compile ledger records it as the program's HBM estimate
+    ("Memory Safe Computations with XLA Compiler", PAPERS.md)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:   # noqa: BLE001 — capability probe by contract
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for key, attrs in (
+            ("argument_bytes", ("argument_size_in_bytes",)),
+            ("output_bytes", ("output_size_in_bytes",)),
+            ("temp_bytes", ("temp_size_in_bytes",)),
+            ("generated_code_bytes", ("generated_code_size_in_bytes",))):
+        for a in attrs:
+            v = getattr(ma, a, None)
+            if v is not None:
+                try:
+                    out[key] = int(v)
+                except (TypeError, ValueError):
+                    pass
+                break
+    return out or None
+
+
 def compile_stablehlo(text: str):
     """Portable lowering fallback: compile StableHLO module text through the
     local XLA client. Returns an executable whose ``.execute([arrays])``

@@ -20,9 +20,18 @@ at the one controller. This package is that layer for the TPU cloud:
   open spans + a metrics snapshot persist atomically to
   ``$H2O_TPU_ICE_ROOT/flight/`` (``GET /3/FlightRecords``), so a dark
   bench round leaves a corpse to autopsy instead of a bare timeout.
+- :mod:`h2o3_tpu.obs.phases` — the runtime lifecycle phase tracker
+  (ISSUE 12): ``backend_init`` … ``server_start`` as deadline-supervised
+  timeline phases; a wedged phase dumps a flight record naming itself
+  and, in bench/probe contexts, hands the budget to the CPU chain fast.
+- :mod:`h2o3_tpu.obs.compiles` — the cluster-wide compile ledger: the
+  ONE chokepoint every XLA compile routes through (family, signature,
+  duration, cache disposition, HBM estimate), served on
+  ``GET /3/Runtime`` and folded into ``/3/Metrics``.
 
 Import cost: this package pulls in only the stdlib — jax and the heavy
 framework modules load lazily inside callbacks, so the flight recorder
 stays usable from a process whose accelerator tunnel is wedged."""
 
-from h2o3_tpu.obs import flight, metrics, tracing  # noqa: F401
+from h2o3_tpu.obs import (compiles, flight, metrics,  # noqa: F401
+                          phases, tracing)

@@ -247,7 +247,8 @@ def publish_snapshot(proc: Optional[int] = None) -> bool:
 def maybe_publish() -> None:
     """Throttled publish (``H2O_TPU_OBS_PUBLISH_S`` between writes) —
     called from the hot-ish paths that keep follower snapshots fresh
-    (op replay, watchdog ticks)."""
+    (op replay, watchdog ticks). The /3/Runtime contribution (phase
+    history + compile ledger) rides the same throttle."""
     global _LAST_PUBLISH
     now = time.monotonic()
     with _PUB_LOCK:
@@ -255,6 +256,12 @@ def maybe_publish() -> None:
             return
         _LAST_PUBLISH = now
     publish_snapshot()
+    try:
+        from h2o3_tpu.obs import compiles
+
+        compiles.publish_runtime()
+    except Exception:   # noqa: BLE001 — best-effort by contract
+        pass
 
 
 def cluster_snapshots() -> List[dict]:
@@ -328,6 +335,41 @@ def aggregate(snaps: List[dict]) -> List[dict]:
 
 def cluster_aggregate() -> List[dict]:
     return aggregate(cluster_snapshots())
+
+
+def histogram_quantiles(buckets: List[float], bucket_counts: List[int],
+                        count: int,
+                        qs: Tuple[float, ...] = (0.5, 0.95, 0.99)
+                        ) -> Dict[str, Optional[float]]:
+    """Estimated quantiles from cumulative bucket counts (the standard
+    histogram_quantile linear interpolation within the owning bucket;
+    targets past the last finite bucket report that bucket's bound, the
+    Prometheus convention). ``/3/Metrics?format=json`` attaches these so
+    JSON consumers get p50/p95/p99 without re-deriving them from raw
+    bucket counts."""
+    out: Dict[str, Optional[float]] = {}
+    total = int(count)
+    for q in qs:
+        key = f"p{int(q * 100)}"
+        if total <= 0 or not buckets:
+            out[key] = None
+            continue
+        target = q * total
+        val: Optional[float] = None
+        prev_cum = 0
+        for i, (le, cum) in enumerate(zip(buckets, bucket_counts)):
+            if cum >= target:
+                lo = buckets[i - 1] if i > 0 else 0.0
+                in_bucket = cum - prev_cum
+                frac = ((target - prev_cum) / in_bucket) if in_bucket else 1.0
+                val = lo + (le - lo) * frac
+                break
+            prev_cum = cum
+        if val is None:
+            # target lands in the +Inf bucket
+            val = float(buckets[-1])
+        out[key] = round(val, 6)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -419,6 +461,18 @@ def _install_default_metrics() -> None:
               "trees built across all forest trainers")
     r.counter("h2o3_log_messages_total",
               "framework log records, by level (warning and up)")
+
+    # -- lifecycle phase tracker (obs/phases.py) --
+    r.gauge("h2o3_phase_active",
+            "1 while the labeled lifecycle phase is in progress")
+    r.histogram("h2o3_phase_duration_seconds",
+                "lifecycle phase wall time (backend_init .. server_start)")
+    r.counter("h2o3_phase_completed_total",
+              "lifecycle phases completed inside their deadline, by phase")
+    r.counter("h2o3_phase_deadline_exceeded_total",
+              "lifecycle phase hard-deadline expiries, by phase")
+    r.counter("h2o3_phase_cpu_fallbacks_total",
+              "deadline expiries that engaged the CPU-chain fallback")
 
     # -- collector-backed series (existing ad-hoc counters re-registered) --
     def _dp(field):
@@ -525,6 +579,30 @@ def _install_default_metrics() -> None:
                  "persistent compile-cache misses", _cc("disk_misses"))
     r.counter_fn("h2o3_compile_cache_stores_total",
                  "executables stored to the persistent cache", _cc("stores"))
+
+    # -- compile-ledger views (obs/compiles.py is the ONE chokepoint
+    #    every XLA compile routes through; these fold it into /3/Metrics
+    #    so the cluster aggregation machinery carries it too) --
+    def _ledger(field):
+        def fn():
+            from h2o3_tpu.obs import compiles
+
+            return {(("family", fam),): float(a.get(field, 0))
+                    for fam, a in compiles.family_table().items()}
+        return fn
+
+    r.counter_fn("h2o3_compile_ledger_compiles_total",
+                 "ledger-recorded XLA compiles, by program family",
+                 _ledger("compiles"))
+    r.counter_fn("h2o3_compile_ledger_ms_total",
+                 "wall milliseconds of ledger-recorded XLA compiles, "
+                 "by program family", _ledger("ms_total"))
+    r.counter_fn("h2o3_compile_ledger_memory_hits_total",
+                 "in-process signature-cache hits, by program family",
+                 _ledger("hits_memory"))
+    r.counter_fn("h2o3_compile_ledger_disk_hits_total",
+                 "persistent compile-cache hits, by program family",
+                 _ledger("hits_disk"))
 
     def _wd(field):
         def fn():

@@ -259,29 +259,41 @@ class ScoringSession:
         key = (bucket, bool(local), bool(sharded))
         exe = self._exec.get(key)
         if exe is not None:
+            # warm path: a counter bump only (no ring row, no hashing) —
+            # /3/Runtime's scoring hit ratio must reflect the dominant
+            # in-memory tier, not just the disk tier
+            from h2o3_tpu.obs import compiles
+
+            compiles.record_hit("scoring", tier="memory")
             return exe
         from h2o3_tpu.artifact import compile_cache
+        from h2o3_tpu.obs import compiles
 
+        variant = "local" if local else "sharded" if sharded else "mesh"
+        sig = (str(getattr(self.model, "key", id(self))), bucket, variant)
         ckey = None
         if compile_cache.enabled():
             # checksum + key work only when a persistent tier exists —
             # with the cache off the first dispatch must not pay a
             # whole-forest hash for a key nobody will read
             ckey = compile_cache.cache_key(
-                self._model_checksum(), bucket,
-                variant=("local" if local
-                         else "sharded" if sharded else "mesh"))
+                self._model_checksum(), bucket, variant=variant)
             exe = compile_cache.load(ckey)
         if exe is None:
             fn = self._sharded_score_fn() if sharded else self._fn
-            t0 = time.perf_counter()
-            exe = fn.lower(*call_args).compile()
-            compile_cache.note_compile((time.perf_counter() - t0) * 1000)
+            # the ledger chokepoint lowers, compiles, times, records the
+            # row AND feeds the legacy note_compile counter — callers no
+            # longer self-report durations that could drift
+            exe = compiles.compile_jit("scoring", fn, call_args,
+                                       signature=sig,
+                                       program=f"fused_score_{variant}")
             self.fused_compiles += 1
             if ckey is not None:
                 compile_cache.store(ckey, exe)
         else:
             self.cache_hits += 1
+            compiles.record_hit("scoring", sig, "disk",
+                                program=f"fused_score_{variant}")
         self._exec[key] = exe
         self._traced.add(bucket)
         return exe

@@ -35,6 +35,7 @@ KINDS = frozenset({
     "job",              # durable job-progress saves
     "oplog",            # control-plane checkpoints
     "pallas_auto",      # pallas-vs-XLA microbenchmark verdicts
+    "phase",            # lifecycle phase begin/end (obs/phases.py)
     "profiler",         # /3/Profiler start/stop captures
     "rest",             # REST request ring (api/server.py merge)
     "scoring",          # fused serving dispatches

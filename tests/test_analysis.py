@@ -403,6 +403,98 @@ class TestSyncHygienePass:
 # registry passes (folded consistency guards)
 # ---------------------------------------------------------------------------
 
+class TestCompileLedgerPass:
+    """ISSUE-12 chokepoint invariant: no module outside obs/compiles.py
+    may run `.lower(...).compile(`, call `compile_stablehlo`, or write
+    the legacy `note_compile` counter directly."""
+
+    def test_chained_lower_compile_flagged(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/work.py": """
+            def f(jfn, args):
+                return jfn.lower(*args).compile()
+            """}, COMPILE_LEDGER_MODULES=())
+        got = run_pass(ctx, "compile-ledger")
+        assert len(got) == 1 and "obs/compiles.py" in got[0].message, got
+
+    def test_two_step_lowered_name_flagged(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/work.py": """
+            def f(jfn, x):
+                lowered = jfn.lower(x)
+                text = lowered.as_text()
+                return lowered.compile(), text
+            """}, COMPILE_LEDGER_MODULES=())
+        got = run_pass(ctx, "compile-ledger")
+        assert len(got) == 1 and "ledger" in got[0].message, got
+
+    def test_attribute_target_two_step_flagged(self, tmp_path):
+        """A lowering cached on an attribute must not evade the ban."""
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/work.py": """
+            class C:
+                def prep(self, jfn, x):
+                    self._lowered = jfn.lower(x)
+
+                def go(self):
+                    return self._lowered.compile()
+            """}, COMPILE_LEDGER_MODULES=())
+        got = run_pass(ctx, "compile-ledger")
+        assert len(got) == 1 and "ledger" in got[0].message, got
+
+    def test_stablehlo_and_note_compile_flagged(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/work.py": """
+            from h2o3_tpu import compat
+            from h2o3_tpu.artifact import compile_cache
+
+            def f(text, ms):
+                compile_cache.note_compile(ms)
+                return compat.compile_stablehlo(text)
+            """}, COMPILE_LEDGER_MODULES=())
+        got = run_pass(ctx, "compile-ledger")
+        msgs = " ".join(f.message for f in got)
+        assert len(got) == 2, got
+        assert "compile_stablehlo" in msgs and "note_compile" in msgs
+
+    def test_blessed_ledger_wrapper_not_flagged(self, tmp_path):
+        """The remediation the finding recommends — calling the ledger's
+        own compile_stablehlo(family, text) — must itself be clean."""
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/work.py": """
+            from h2o3_tpu.obs import compiles
+
+            def f(text):
+                return compiles.compile_stablehlo("scoring", text)
+            """}, COMPILE_LEDGER_MODULES=())
+        assert run_pass(ctx, "compile-ledger") == []
+
+    def test_chokepoint_and_genmodel_exempt_string_lower_not_flagged(
+            self, tmp_path):
+        ctx = mini_ctx(tmp_path, {
+            # the ledger itself may compile
+            "h2o3_tpu/obs/compiles.py": """
+                def compile_jit(family, jfn, args):
+                    return jfn.lower(*args).compile()
+                """,
+            # framework-free standalone runner: raw client is its contract
+            "h2o3_genmodel/aot.py": """
+                def load(client, text):
+                    return client.compile(text)
+                """,
+            # str.lower() + re.compile near-misses must stay clean
+            "h2o3_tpu/clean.py": """
+                import re
+
+                def g(name, pat):
+                    low = name.lower()
+                    return re.compile(pat), low
+                """,
+        }, COMPILE_LEDGER_MODULES=("h2o3_tpu/obs/compiles.py",))
+        assert run_pass(ctx, "compile-ledger") == []
+
+    def test_stale_chokepoint_registry_path_is_a_finding(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/clean.py": "x = 1\n"},
+                       COMPILE_LEDGER_MODULES=("h2o3_tpu/obs/gone.py",))
+        got = run_pass(ctx, "compile-ledger")
+        assert len(got) == 1 and "stale registry path" in got[0].message
+
+
 class TestRegistryPasses:
     def test_faultpoint_drift(self, tmp_path):
         files = {
@@ -429,6 +521,32 @@ class TestRegistryPasses:
         files["h2o3_tpu/user.py"] = (
             'from h2o3_tpu.utils import timeline\n'
             'def f():\n    timeline.record("alpha", "x")\n')
+        assert run_pass(mini_ctx(tmp_path, files), "timeline-kinds") == []
+
+    def test_phase_name_drift(self, tmp_path):
+        """ISSUE-12 half of the timeline-kinds guard: enter() literals vs
+        the obs/phases.py PHASES closed enumeration, both directions."""
+        files = {
+            "h2o3_tpu/obs/phases.py":
+                'PHASES = frozenset({"backend_init", "mesh_init"})\n',
+            "h2o3_tpu/boot.py":
+                'from h2o3_tpu.obs import phases\n'
+                'def f():\n'
+                '    with phases.enter("warp_init"):\n'
+                '        pass\n',
+        }
+        got = run_pass(mini_ctx(tmp_path, files), "timeline-kinds")
+        msgs = " ".join(f.message for f in got)
+        # undeclared use + two dead declared phases
+        assert "warp_init" in msgs
+        assert "backend_init" in msgs and "mesh_init" in msgs
+        files["h2o3_tpu/boot.py"] = (
+            'from h2o3_tpu.obs import phases\n'
+            'def f():\n'
+            '    with phases.enter("backend_init"):\n'
+            '        pass\n'
+            '    with phases.enter("mesh_init"):\n'
+            '        pass\n')
         assert run_pass(mini_ctx(tmp_path, files), "timeline-kinds") == []
 
     def test_knob_docs(self, tmp_path):

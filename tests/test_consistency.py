@@ -81,11 +81,13 @@ def actx():
 
 
 @pytest.mark.parametrize("pass_name", ["faultpoints", "metric-registry",
-                                       "timeline-kinds", "knob-docs"])
+                                       "timeline-kinds", "knob-docs",
+                                       "compile-ledger"])
 def test_registry_guard_pass(actx, pass_name):
-    """The four folded consistency guards, one pass each, so drift
-    failures name the responsible registry directly. (Covered by the
-    full run above too — this is the readable failure mode.)"""
+    """The folded consistency guards (plus the ISSUE-12 compile-ledger
+    chokepoint), one pass each, so drift failures name the responsible
+    registry directly. (Covered by the full run above too — this is the
+    readable failure mode.)"""
     from h2o3_tpu import analysis
 
     findings = analysis.run(actx, [pass_name])

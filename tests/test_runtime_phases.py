@@ -1,0 +1,328 @@
+"""Runtime phase tracker + compile ledger (ISSUE 12).
+
+Cheap tier by design: the ledger tests compile one scalar program, the
+deadline drill is pure host machinery driven by the ``phases.deadline``
+faultpoint (no device work — counter-asserted below, like PR 8's
+tracing test), and the REST test reuses the running session cluster.
+The "every fused compile lands in the ledger" integration evidence
+rides the trained-forest suites (test_sharded_frame / test_artifact)
+whose counters are now ledger views."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from h2o3_tpu.core import failure
+from h2o3_tpu.obs import compiles, flight, metrics, phases
+from h2o3_tpu.utils import timeline
+
+pytestmark = pytest.mark.obs
+
+
+def _metric_value(name, **labels):
+    m = metrics.REGISTRY.get(name)
+    snap = m.snapshot()
+    want = {str(k): str(v) for k, v in labels.items()}
+    for s in snap["samples"]:
+        if s["labels"] == want:
+            return s["value"]
+    return 0.0
+
+
+# ---------------------------------------------------------------------------
+# phase tracker
+# ---------------------------------------------------------------------------
+
+class TestPhases:
+    def test_enumeration_is_closed(self):
+        with pytest.raises(ValueError, match="closed"):
+            with phases.enter("warp_drive_init"):
+                pass
+
+    def test_normal_phase_records_history_timeline_and_metrics(self):
+        before_done = _metric_value("h2o3_phase_completed_total",
+                                    phase="server_start")
+        with phases.enter("server_start", port=0) as rec:
+            assert rec["status"] == "running"
+        hist = phases.history()
+        mine = [r for r in hist if r["phase"] == "server_start"]
+        assert mine and mine[-1]["status"] == "ok"
+        assert mine[-1]["ms"] is not None and mine[-1]["ms"] >= 0
+        evs = [e for e in timeline.events() if e["kind"] == "phase"
+               and e["what"] == "server_start"]
+        # begin event + completion event (with ms)
+        assert any(e.get("status") == "begin" for e in evs)
+        assert any(e.get("ms") is not None for e in evs)
+        assert _metric_value("h2o3_phase_completed_total",
+                             phase="server_start") == before_done + 1
+        assert phases.phase_report().get("server_start") is not None
+
+    def test_deadline_map_parsing(self, monkeypatch):
+        monkeypatch.setenv("H2O_TPU_PHASE_DEADLINE_S",
+                           "backend_init=45,first_compile=90,bogus=3")
+        d = phases.deadlines()
+        assert d == {"backend_init": 45.0, "first_compile": 90.0}
+        monkeypatch.setenv("H2O_TPU_PHASE_DEADLINE_S", "12")
+        assert phases.deadlines() == {p: 12.0 for p in phases.PHASES}
+        monkeypatch.setenv("H2O_TPU_PHASE_DEADLINE_S", "not-a-number")
+        assert phases.deadlines() == {}
+        monkeypatch.delenv("H2O_TPU_PHASE_DEADLINE_S")
+        assert phases.deadlines() == {}
+
+    def test_wedged_backend_init_deadline_drill(self, tmp_path,
+                                                monkeypatch):
+        """The ISSUE-12 satellite: a faked wedged backend_init must leave
+        a flight record NAMING the phase, engage the CPU fallback well
+        inside the stage budget, and add zero device work (ledger rows
+        and data-plane counters unchanged — the PR-8 counter-assertion
+        style)."""
+        from h2o3_tpu.core import sharded_frame
+
+        monkeypatch.setenv("H2O_TPU_OBS_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("H2O_TPU_PHASE_DEADLINE_S", "backend_init=0.2")
+        rows_before = len(compiles.ledger_rows())
+        dp_before = sharded_frame.counters()
+        exceeded_before = _metric_value(
+            "h2o3_phase_deadline_exceeded_total", phase="backend_init")
+        fb_before = _metric_value("h2o3_phase_cpu_fallbacks_total",
+                                  phase="backend_init")
+        engaged = []
+        t0 = time.perf_counter()
+        with failure.inject("phases.deadline"):
+            with phases.enter(
+                    "backend_init",
+                    fallback=lambda name: engaged.append(
+                        (name, time.perf_counter() - t0))):
+                pass
+        # the fallback engaged promptly after the 0.2 s deadline — not
+        # after some stage-budget-sized timeout
+        assert engaged and engaged[0][0] == "backend_init"
+        assert engaged[0][1] < 2.0
+        # the flight record names the wedged phase
+        recs = flight.list_records()
+        assert recs and recs[0]["reason"] == "phase_deadline_backend_init"
+        corpse = json.loads(flight.read_record(recs[0]["name"]))
+        assert corpse["extra"]["phase"] == "backend_init"
+        assert any(r["phase"] == "backend_init"
+                   for r in corpse["extra"]["phase_history"])
+        # history shows the expiry (the phase body itself completed —
+        # the record keeps the deadline verdict, not a retroactive ok)
+        mine = [r for r in phases.history()
+                if r["phase"] == "backend_init"][-1]
+        assert mine["status"] == "deadline"
+        assert _metric_value("h2o3_phase_deadline_exceeded_total",
+                             phase="backend_init") == exceeded_before + 1
+        assert _metric_value("h2o3_phase_cpu_fallbacks_total",
+                             phase="backend_init") == fb_before + 1
+        # no new device syncs / compiles: the drill is pure host work
+        assert len(compiles.ledger_rows()) == rows_before
+        assert sharded_frame.counters() == dp_before
+
+    def test_completed_phase_cancels_the_timer(self, monkeypatch):
+        monkeypatch.setenv("H2O_TPU_PHASE_DEADLINE_S", "mesh_init=0.2")
+        before = _metric_value("h2o3_phase_deadline_exceeded_total",
+                               phase="mesh_init")
+        with phases.enter("mesh_init"):
+            pass
+        time.sleep(0.35)        # past the would-be deadline
+        assert _metric_value("h2o3_phase_deadline_exceeded_total",
+                             phase="mesh_init") == before
+        assert [r for r in phases.history()
+                if r["phase"] == "mesh_init"][-1]["status"] == "ok"
+
+    def test_phase_report_survives_ring_churn(self):
+        """The boot durations must outlive the bounded history ring: a
+        long-lived server's recurring phases (server_start, cache loads)
+        must not evict backend_init from phase_report."""
+        assert "backend_init" in phases.phase_report() or \
+            "server_start" in phases.phase_report()
+        baseline = dict(phases.phase_report())
+        for _ in range(300):        # > the ring's maxlen
+            with phases.enter("mesh_init"):
+                pass
+        report = phases.phase_report()
+        for name, ms in baseline.items():
+            if name != "mesh_init":
+                assert name in report, (name, report)
+
+    def test_wedged_phase_names_the_oldest_open_phase(self):
+        # NO reset: the boot history must survive for the REST test, and
+        # the earlier deadline drill's record (expired but completed)
+        # must not read as wedged forever
+        assert phases.wedged_phase() is None
+        with phases.enter("device_discovery"):
+            # a freshly-running phase is NOT wedged on a live endpoint
+            # (grace window) — only one running past its deadline/grace
+            assert phases.wedged_phase() is None
+            assert phases.wedged_phase(grace_s=0.0) == "device_discovery"
+        assert phases.wedged_phase(grace_s=0.0) is None
+
+
+# ---------------------------------------------------------------------------
+# compile ledger
+# ---------------------------------------------------------------------------
+
+class TestCompileLedger:
+    def test_family_enumeration_is_closed(self):
+        with pytest.raises(ValueError, match="closed"):
+            compiles.record_compile("quantum", "sig", 1.0)
+        with pytest.raises(ValueError):
+            compiles.record_hit("scoring", "sig", "l5_cache")
+
+    def test_compile_jit_records_row_and_feeds_legacy_counter(self, cl):
+        import jax
+        import jax.numpy as jnp
+
+        from h2o3_tpu.artifact import compile_cache
+
+        cc_before = compile_cache.stats()
+        rows_before = len(compiles.ledger_rows())
+        sig = ("test", "ledger", time.time())
+        exe = compiles.compile_jit(
+            "scoring", jax.jit(lambda x: x * jnp.float32(2)),
+            (jax.ShapeDtypeStruct((), jnp.float32),),
+            signature=sig, program="test_scalar")
+        assert float(exe(jnp.float32(3))) == 6.0
+        rows = compiles.ledger_rows()
+        assert len(rows) == rows_before + 1
+        row = rows[-1]
+        assert row["family"] == "scoring" and row["cache"] == "compile"
+        assert row["ms"] > 0 and len(row["signature"]) == 16
+        assert row["device_kind"] and row["device_kind"].startswith("cpu")
+        # the legacy note_compile counter is a view over the ledger: same
+        # count AND the same milliseconds (zero drift by construction)
+        cc = compile_cache.stats()
+        assert cc["compiles"] == cc_before["compiles"] + 1
+        assert cc["compile_ms_total"] == pytest.approx(
+            cc_before["compile_ms_total"] + row["ms"])
+
+    def test_probe_family_does_not_feed_the_fused_counter(self, cl):
+        import jax
+        import jax.numpy as jnp
+
+        from h2o3_tpu.artifact import compile_cache
+
+        before = compile_cache.fused_compile_count()
+        compiles.compile_jit(
+            "probe", jax.jit(lambda x: x - jnp.float32(1)),
+            (jax.ShapeDtypeStruct((), jnp.float32),),
+            signature=("probe", time.time()))
+        assert compile_cache.fused_compile_count() == before
+
+    def test_hits_and_family_table_and_slowest(self):
+        t = time.time()
+        compiles.record_compile("rapids", ("a", t), 50.0, program="p1")
+        compiles.record_compile("rapids", ("b", t), 10.0, program="p2")
+        rows_before = len(compiles.ledger_rows())
+        compiles.record_hit("rapids", ("a", t), "memory")
+        compiles.record_hit("rapids", ("a", t), "disk")
+        # hits bump aggregates ONLY — they must never consume the
+        # bounded compile-row ring (warm traffic would evict the
+        # compile rows and empty slowest-N on long-lived clusters)
+        assert len(compiles.ledger_rows()) == rows_before
+        tab = compiles.family_table()["rapids"]
+        assert tab["compiles"] >= 2 and tab["ms_max"] >= 50.0
+        assert tab["hits_memory"] >= 1 and tab["hits_disk"] >= 1
+        slow = compiles.slowest(3)
+        assert slow == sorted(slow, key=lambda r: r["ms"], reverse=True)
+        assert all(r["cache"] == "compile" for r in slow)
+
+    def test_warm_scoring_hits_land_in_the_family_table(self, cl):
+        """The in-memory executable tier is the dominant warm serving
+        path — /3/Runtime's scoring hit ratio must count it."""
+        before = compiles.family_table().get("scoring", {}).get(
+            "hits_memory", 0)
+        compiles.record_hit("scoring", tier="memory")
+        assert compiles.family_table()["scoring"]["hits_memory"] == \
+            before + 1
+
+    def test_merge_family_tables(self):
+        merged = compiles.merge_family_tables([
+            {"scoring": {"compiles": 1, "hits_memory": 0, "hits_disk": 2,
+                         "ms_total": 10.0, "ms_max": 10.0}},
+            {"scoring": {"compiles": 3, "hits_memory": 1, "hits_disk": 0,
+                         "ms_total": 5.0, "ms_max": 4.0}},
+        ])
+        assert merged["scoring"]["compiles"] == 4
+        assert merged["scoring"]["hits_disk"] == 2
+        assert merged["scoring"]["ms_total"] == 15.0
+        assert merged["scoring"]["ms_max"] == 10.0
+
+    def test_boot_first_compile_is_in_the_ledger(self, cl):
+        # the supervised boot probe (core/runtime.py first_compile phase)
+        assert "probe" in compiles.family_table()
+        assert "first_compile" in phases.phase_report()
+        assert "backend_init" in phases.phase_report()
+
+
+# ---------------------------------------------------------------------------
+# histogram quantiles (/3/Metrics?format=json satellite)
+# ---------------------------------------------------------------------------
+
+class TestHistogramQuantiles:
+    def test_interpolates_inside_the_owning_bucket(self):
+        # 10 observations, all cumulative counts known exactly
+        q = metrics.histogram_quantiles(
+            [0.1, 0.5, 1.0], [2, 8, 10], 10)
+        # p50: target 5 -> bucket (0.1, 0.5], frac (5-2)/6
+        assert q["p50"] == pytest.approx(0.1 + 0.4 * 3 / 6)
+        # p95: target 9.5 -> bucket (0.5, 1.0], frac (9.5-8)/2
+        assert q["p95"] == pytest.approx(0.5 + 0.5 * 1.5 / 2)
+
+    def test_empty_histogram_reports_none(self):
+        q = metrics.histogram_quantiles([0.1, 1.0], [0, 0], 0)
+        assert q == {"p50": None, "p95": None, "p99": None}
+
+    def test_overflow_lands_on_last_finite_bucket(self):
+        # every observation beyond the largest bucket (+Inf territory)
+        q = metrics.histogram_quantiles([0.1, 1.0], [0, 0], 5)
+        assert q["p99"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# GET /3/Runtime + /3/Metrics quantiles over the wire
+# ---------------------------------------------------------------------------
+
+class TestRuntimeRest:
+    def test_runtime_route_and_metrics_quantiles(self, cl):
+        from h2o3_tpu.api.server import start_server
+
+        srv = start_server(port=0)
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            r = urllib.request.urlopen(base + "/3/Runtime", timeout=30)
+            # the satellite: /3/Runtime responses carry the trace id
+            assert r.headers.get("X-H2O3-Trace-Id")
+            out = json.loads(r.read())
+            assert out["__meta"]["schema_name"] == "RuntimeV3"
+            # complete boot phase history: backend_init .. first_compile
+            for p in ("backend_init", "device_discovery", "mesh_init",
+                      "first_compile", "server_start"):
+                assert p in out["phase_report"], p
+            # the boot probe compile is in the cluster-wide family table
+            assert "probe" in out["compile_families"]
+            slow = out["slowest_compiles"]
+            assert slow and all("signature" in r_ and "ms" in r_
+                                for r_ in slow)
+            assert out["processes"] and out["processes"][0]["proc"] == 0
+            # ?slowest=1 narrows the slow list
+            out1 = json.loads(urllib.request.urlopen(
+                base + "/3/Runtime?slowest=1", timeout=30).read())
+            assert len(out1["slowest_compiles"]) <= 1
+            # /3/Metrics?format=json histograms carry computed quantiles
+            mj = json.loads(urllib.request.urlopen(
+                base + "/3/Metrics?format=json", timeout=30).read())
+            hists = [m for m in mj["series"] if m["type"] == "histogram"]
+            assert hists
+            for m in hists:
+                for s in m["samples"]:
+                    assert set(s["quantiles"]) == {"p50", "p95", "p99"}
+            # a populated histogram reports real numbers
+            rest = next(m for m in hists
+                        if m["name"] == "h2o3_rest_request_seconds")
+            s0 = rest["samples"][0]
+            assert s0["count"] > 0 and s0["quantiles"]["p50"] is not None
+        finally:
+            srv.stop()
