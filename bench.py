@@ -381,6 +381,9 @@ def main():
             # from the SHARDED data plane (per-process packing + shard_map
             # margins) on a ≥2-device single-process mesh, and the stage's
             # auxiliary score_gathered_rows line must report 0
+            # the score stage's coalesced-flush phase (aux lines
+            # score_dispatches_per_flush / score_p99_ms) runs at reduced
+            # concurrency on the CPU fallback so the stage fits its budget
             score = _stage("cpu-score", [py, "-m", "h2o3_tpu.bench"], 140,
                            env_extra={"PALLAS_AXON_POOL_IPS": "",
                                       "JAX_PLATFORMS": "cpu",
@@ -389,6 +392,7 @@ def main():
                                        " --xla_force_host_platform_"
                                        "device_count=8"),
                                       "H2O3_BENCH_ONLY": "score",
+                                      "H2O3_BENCH_SCORE_CONCURRENCY": "8",
                                       "H2O3_BENCH_SCORE_TRAIN_ROWS": "5000"})
             if got is None:
                 got = score

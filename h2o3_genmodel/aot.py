@@ -116,6 +116,10 @@ class AotScorer:
         self.dir = str(art_dir)
         m = _read_manifest(self.dir)
         self.manifest = m
+        self.model_type: str = str(m.get("model_type") or "forest")
+        if self.model_type not in ("forest", "glm"):
+            raise ArtifactError(f"unsupported artifact model_type "
+                                f"{self.model_type!r}")
         self.names: List[str] = list(m["names"])
         self.category: str = str(m["model_category"])
         self.response_domain: List[str] = list(m.get("response_domain")
@@ -126,22 +130,34 @@ class AotScorer:
         self.nclasses = int(m["nclasses"])
         self.per_class = bool(m.get("per_class_trees"))
 
-        with np.load(io.BytesIO(_read_payload(self.dir,
-                                              m["files"]["forest"])),
+        payload = m["files"]["glm" if self.model_type == "glm"
+                             else "forest"]
+        with np.load(io.BytesIO(_read_payload(self.dir, payload)),
                      allow_pickle=False) as z:
             arrays = {k: np.asarray(z[k]) for k in z.files}
         self._arrays = arrays
         F = len(self.names)
-        if int(arrays["spec_is_cat"].shape[0]) != F:
-            raise ArtifactError("packed spec width disagrees with manifest "
-                                "names")
-        self.is_cat = arrays["spec_is_cat"].astype(bool)
+        if self.model_type == "glm":
+            g = m.get("glm")
+            if not isinstance(g, dict):
+                raise ArtifactError("glm artifact manifest missing its "
+                                    "'glm' configuration block")
+            self.glm: Dict[str, Any] = dict(g)
+            if int(g.get("n_cat", 0)) + int(g.get("n_num", 0)) != F:
+                raise ArtifactError("glm layout disagrees with manifest "
+                                    "names")
+        else:
+            if int(arrays["spec_is_cat"].shape[0]) != F:
+                raise ArtifactError("packed spec width disagrees with "
+                                    "manifest names")
+            self.is_cat = arrays["spec_is_cat"].astype(bool)
         self.domains: Dict[str, List[str]] = {
             k: list(v) for k, v in (m.get("domains") or {}).items()}
         # device-side constants are materialized on first use (load() stays
         # import-cheap for cold-start measurement)
         self._dev: Optional[tuple] = None
         self._exec: Dict[int, Any] = {}
+        self._post_jit = None                     # cached fused post program
         self.loaded_from: Dict[int, str] = {}     # bucket -> "exec"|"hlo"
 
     # -- device constants -------------------------------------------------
@@ -151,6 +167,11 @@ class AotScorer:
         import jax.numpy as jnp
 
         a = self._arrays
+        if self.model_type == "glm":
+            # the GLM program bakes the DataInfo moments in as constants;
+            # only beta (and the offset scalar) ride as arguments
+            self._dev = (jnp.asarray(a["beta"].astype(np.float32)),)
+            return self._dev
         F = len(self.names)
         lens = [int(v) for v in a["spec_edges_len"].reshape(-1)]
         emax = max(lens, default=0) or 1
@@ -220,18 +241,56 @@ class AotScorer:
             return self._exec[bucket]
         raise ArtifactError(f"artifact has no program for bucket {bucket}")
 
-    def _run(self, bucket: int, X_pad: np.ndarray) -> np.ndarray:
+    def _split_glm_cols(self, X_pad: np.ndarray) -> List[np.ndarray]:
+        """(bucket, P) matrix → the per-column argument list the GLM
+        program was lowered with: int32 categorical codes (NaN/negative →
+        -1, which the program's mode imputation sees as NA — the same
+        value adapt_test's unseen-level remap produces), then float32
+        numerics."""
+        ncat = int(self.glm["n_cat"])
+        cols: List[np.ndarray] = []
+        for i in range(ncat):
+            c = X_pad[:, i]
+            cols.append(np.where(np.isnan(c), -1.0, c).astype(np.int32))
+        for j in range(int(self.glm["n_num"])):
+            cols.append(np.ascontiguousarray(X_pad[:, ncat + j],
+                                             np.float32))
+        return cols
+
+    def _run_dev(self, bucket: int, X_pad: np.ndarray):
+        """Dispatch one bucket; returns the program output WITHOUT forcing
+        a host transfer (the serving-QPS path keeps it device-resident
+        through post-processing and fetches once)."""
         import jax.numpy as jnp
 
         got = self._executable(bucket)
-        args = (jnp.asarray(X_pad),) + self._device_args()
+        if self.model_type == "glm":
+            cols = self._split_glm_cols(X_pad)
+            (beta,) = self._device_args()
+            if got[0] == "loaded":
+                # the lowered pytree: (cols_tuple, beta, offset) — offset
+                # is the same concrete 0.0 _predict_raw passes. Host
+                # arrays go in as-is: the loaded executable's C++ call
+                # path device-puts them faster than an explicit asarray.
+                return got[1](tuple(cols), beta, 0.0)
+            _kind, exe, kept = got
+            flat = [jnp.asarray(c) for c in cols] + [beta,
+                                                     jnp.float32(0.0)]
+            outs = exe.execute([flat[i] for i in kept])
+            return outs[0]
         if got[0] == "loaded":
-            return np.asarray(got[1](*args))
+            # numpy straight in — the executable's own transfer path is
+            # measurably cheaper than jnp.asarray + call
+            return got[1](X_pad, *self._device_args())
+        args = (jnp.asarray(X_pad),) + self._device_args()
         _kind, exe, kept = got
         # jit pruned unused Python-level args from the XLA signature; the
         # raw-client execute path must bind only the kept ones, in order
         outs = exe.execute([args[i] for i in kept])
-        return np.asarray(outs[0])
+        return outs[0]
+
+    def _run(self, bucket: int, X_pad: np.ndarray) -> np.ndarray:
+        return np.asarray(self._run_dev(bucket, X_pad))
 
     # -- feature packing --------------------------------------------------
     def pack_features(self, cols: Dict[str, Any]) -> np.ndarray:
@@ -293,27 +352,85 @@ class AotScorer:
             return np.zeros((0,) if K == 1 else (0, K), np.float32)
         return outs[0] if len(outs) == 1 else np.concatenate(outs)
 
+    def _out_key(self) -> str:
+        return "probs" if self.post.get("kind") in (
+            "binomial", "multinomial", "glm_binomial",
+            "glm_multinomial") else "value"
+
+    def _post(self, f_dev):
+        """Post-processing (margins → probs/value) as ONE cached jit
+        program over the device-resident margins — the identical jnp ops
+        the server runs in _margin_to_raw, fused so a request pays a
+        single extra dispatch instead of one per eager op."""
+        fn = self._post_jit
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            kind = self.post.get("kind")
+            exp_link = self.post.get("linkinv") == "exp"
+            if kind in ("binomial", "glm_binomial"):
+                def post(f):
+                    p = 1.0 / (1.0 + jnp.exp(-f)) if kind == "binomial" \
+                        else f        # glm program already applied linkinv
+                    return jnp.stack([1 - p, p], axis=-1)
+            elif kind == "multinomial":
+                def post(f):
+                    return jax.nn.softmax(f, axis=-1)
+            elif kind == "glm_multinomial":
+                def post(f):          # probs computed inside the program
+                    return f
+            elif exp_link:
+                def post(f):
+                    return jnp.exp(f)
+            else:
+                def post(f):
+                    return f
+
+            fn = self._post_jit = jax.jit(post)
+        return fn(f_dev)
+
     def raw_predict(self, X: np.ndarray) -> Dict[str, np.ndarray]:
         """Margins + post-processing with the identical jnp ops the server
-        runs in SharedTreeModel._margin_to_raw."""
-        return self.raw_from_margins(self.margins(X))
+        runs in SharedTreeModel._margin_to_raw / GLM's linkinv — computed
+        as one device-resident pipeline per bucket chunk (program dispatch
+        → fused post program → ONE host fetch). This is the sustained-QPS
+        path: no intermediate host round-trip, no per-eager-op dispatch,
+        and an exactly-bucket-sized batch skips the pad copy."""
+        X = np.asarray(X, np.float32)
+        n = X.shape[0]
+        maxb = self.buckets[-1]
+        outs: List[np.ndarray] = []
+        pos = 0
+        while pos < n:
+            chunk = X[pos: pos + maxb]
+            m = chunk.shape[0]
+            bucket = self._bucket_for(m)
+            if m == bucket:
+                buf = np.ascontiguousarray(chunk, np.float32)
+            else:
+                buf = np.zeros((bucket, X.shape[1]), np.float32)
+                buf[:m] = chunk
+            out = self._post(self._run_dev(bucket, buf))
+            outs.append(np.asarray(out)[:m])
+            pos += m
+        if not outs:
+            K = (self.nclasses
+                 if (self.nclasses > 2 or self.per_class) else 1)
+            if self._out_key() == "probs":
+                width = self.nclasses if self.nclasses > 2 else 2
+                return {"probs": np.zeros((0, width), np.float32)}
+            return {"value": np.zeros((0,) if K == 1 else (0, K),
+                                      np.float32)}
+        res = outs[0] if len(outs) == 1 else np.concatenate(outs)
+        return {self._out_key(): res}
 
     def raw_from_margins(self, margins: np.ndarray
                          ) -> Dict[str, np.ndarray]:
         import jax.numpy as jnp
 
-        f = jnp.asarray(margins)
-        kind = self.post.get("kind")
-        if kind == "binomial":
-            p = 1.0 / (1.0 + jnp.exp(-f))
-            return {"probs": np.asarray(jnp.stack([1 - p, p], axis=-1))}
-        if kind == "multinomial":
-            import jax
-
-            return {"probs": np.asarray(jax.nn.softmax(f, axis=-1))}
-        if self.post.get("linkinv") == "exp":
-            return {"value": np.asarray(jnp.exp(f))}
-        return {"value": np.asarray(f)}
+        return {self._out_key():
+                np.asarray(self._post(jnp.asarray(margins)))}
 
     def score(self, cols: Dict[str, Any],
               raw: Dict[str, np.ndarray] = None) -> Dict[str, np.ndarray]:

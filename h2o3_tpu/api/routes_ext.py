@@ -779,6 +779,10 @@ def h_scoring_metrics(ctx: Ctx):
             "admission": admission.CONTROLLER.snapshot(),
             "compile_cache": compile_cache.stats(),
             "data_plane": sharded_frame.counters(),
+            # ISSUE-13 per-flush dispatch accounting: fused program
+            # executions by path (sharded/host/local/leaf_*) — the
+            # one-dispatch-per-flush contract's observable
+            "dispatches": scoring.dispatch_counters(),
             "rapids": fusion.stats()}
 
 

@@ -37,6 +37,7 @@ from urllib.parse import parse_qs, unquote, urlparse
 
 import numpy as np
 
+from h2o3_tpu import admission
 from h2o3_tpu.admission import AdmissionRejected
 from h2o3_tpu.api import schemas as S
 from h2o3_tpu.obs import metrics as obs_metrics
@@ -912,12 +913,17 @@ def h_predict_v3(ctx: Ctx):
             raise ApiError(f"leaf_node_assignment_type {la_type!r} "
                            "(Path or Node_ID)", 400)
         dest = dest or f"leaf_assignment_{m.key}_on_{fr.key}"
-        op_seq = oplog.broadcast("leaf_assignment", {
-            "model": str(m.key), "frame": str(fr.key),
-            "type": la_type, "destination_frame": dest})
-        with oplog.turn(op_seq):
-            pred = m.predict_leaf_node_assignment(fr, type=la_type, key=dest)
-            pred.install()
+        # explainability rides the same admission gate as predictions
+        # (ISSUE 13): an overloaded model sheds leaf-assignment traffic
+        # with 429/Retry-After too, instead of queueing it past the SLO
+        with admission.CONTROLLER.slot(str(m.key)):
+            op_seq = oplog.broadcast("leaf_assignment", {
+                "model": str(m.key), "frame": str(fr.key),
+                "type": la_type, "destination_frame": dest})
+            with oplog.turn(op_seq):
+                pred = m.predict_leaf_node_assignment(fr, type=la_type,
+                                                      key=dest)
+                pred.install()
         return {"__meta": S.meta("ModelMetricsListSchemaV3"),
                 "predictions_frame": {"name": str(pred.key)},
                 "model_metrics": []}
@@ -933,12 +939,13 @@ def h_predict_v3(ctx: Ctx):
             raise ApiError("staged_predict_proba needs a classification "
                            "GBM", 400)
         dest = dest or f"staged_proba_{m.key}_on_{fr.key}"
-        op_seq = oplog.broadcast("staged_proba", {
-            "model": str(m.key), "frame": str(fr.key),
-            "destination_frame": dest})
-        with oplog.turn(op_seq):
-            pred = m.staged_predict_proba(fr, key=dest)
-            pred.install()
+        with admission.CONTROLLER.slot(str(m.key)):
+            op_seq = oplog.broadcast("staged_proba", {
+                "model": str(m.key), "frame": str(fr.key),
+                "destination_frame": dest})
+            with oplog.turn(op_seq):
+                pred = m.staged_predict_proba(fr, key=dest)
+                pred.install()
         return {"__meta": S.meta("ModelMetricsListSchemaV3"),
                 "predictions_frame": {"name": str(pred.key)},
                 "model_metrics": []}
@@ -946,13 +953,18 @@ def h_predict_v3(ctx: Ctx):
         # genmodel TreeSHAP surfaced over REST (h2o-py predict_contributions)
         _check_contributions_size(fr)
         dest = dest or f"contributions_{m.key}_on_{fr.key}"
-        op_seq = oplog.broadcast("predict", {
-            "model": str(m.key), "frame": str(fr.key),
-            "destination_frame": dest, "contributions": True,
-            "with_metrics": False})
-        with oplog.turn(op_seq):
-            pred = m.predict_contributions(fr, key=dest)
-            pred.install()
+        # contributions bin through the same fused pack program training
+        # and serving use (ShardedFrame.pack_binned); the TreeSHAP walk
+        # itself is host-side by design — admission-gate it so heavy
+        # explainability traffic sheds instead of starving serving
+        with admission.CONTROLLER.slot(str(m.key)):
+            op_seq = oplog.broadcast("predict", {
+                "model": str(m.key), "frame": str(fr.key),
+                "destination_frame": dest, "contributions": True,
+                "with_metrics": False})
+            with oplog.turn(op_seq):
+                pred = m.predict_contributions(fr, key=dest)
+                pred.install()
         return {"__meta": S.meta("ModelMetricsListSchemaV3"),
                 "predictions_frame": {"name": str(pred.key)},
                 "model_metrics": []}
@@ -1001,6 +1013,15 @@ def h_predict_v4(ctx: Ctx):
     contribs = str(ctx.arg("predict_contributions", "")).lower() in ("1", "true")
     if contribs:
         _check_contributions_size(fr)  # same 400 as the sync v3 route
+    from h2o3_tpu import scoring
+
+    use_fused = not contribs and scoring.supports(m)
+    if use_fused:
+        # surface saturation BEFORE detaching into a background job: a
+        # request the gate would shed right now gets the synchronous 429
+        # + Retry-After (a failed async job carries no backoff hint).
+        # Non-consuming probe — the job's own slot() still gates.
+        admission.CONTROLLER.check(str(m.key))
     job = Job(description=f"{m.algo_name} "
                           f"{'contributions' if contribs else 'prediction'}")
     job.dest_type = "Key<Frame>"
@@ -1010,23 +1031,38 @@ def h_predict_v4(ctx: Ctx):
 
     from h2o3_tpu.parallel import oplog
 
-    op_seq = oplog.broadcast("predict", {
-        "model": str(m.key), "frame": str(fr.key),
-        "destination_frame": pred_key, "contributions": contribs,
-        "with_metrics": False})
+    if use_fused:
+        # fused /4 route (ISSUE 13): the async prediction rides the SAME
+        # admission-controlled, coalescing, compile-once fast path as the
+        # sync v3 route — score_request broadcasts its own coalesced
+        # "score_batch" op from the job thread, so async clients no
+        # longer fall off the fast path. Results are bitwise-identical
+        # to the eager predict (the fused-path contract).
+        def run_fused(j: Job):
+            pred, _mm = scoring.score_request(m, fr, pred_key,
+                                              with_metrics=False)
+            return pred
 
-    def run(j: Job):
-        with oplog.turn(op_seq):
-            if contribs:
-                # genuine h2o-py predict_contributions rides this async
-                # route (model_base.py:199: POST /4/Predictions + flag)
-                pred = m.predict_contributions(fr, key=pred_key)
-            else:
-                pred = m.predict(fr, key=pred_key)
-        pred.install()
-        return pred
+        job.start(run_fused, background=True)
+    else:
+        op_seq = oplog.broadcast("predict", {
+            "model": str(m.key), "frame": str(fr.key),
+            "destination_frame": pred_key, "contributions": contribs,
+            "with_metrics": False})
 
-    job.start(run, background=True)
+        def run(j: Job):
+            with oplog.turn(op_seq):
+                if contribs:
+                    # genuine h2o-py predict_contributions rides this
+                    # async route (model_base.py:199: POST /4/Predictions
+                    # + flag)
+                    pred = m.predict_contributions(fr, key=pred_key)
+                else:
+                    pred = m.predict(fr, key=pred_key)
+            pred.install()
+            return pred
+
+        job.start(run, background=True)
     # h2o-r predict.H2OModel reads key/dest at the TOP level of the v4
     # response (models.R:679 res$key$name, res$dest$name); h2o-py reads
     # the nested job — serve both shapes

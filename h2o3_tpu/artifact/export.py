@@ -29,15 +29,21 @@ FOREST_FILE = "forest.npz"
 
 
 def supports_export(model) -> Optional[str]:
-    """None when `model` can be exported (the fused-path forest family);
-    otherwise the reason string. Structural check only — export does not
-    care whether the serving fast path is env-enabled right now."""
+    """None when `model` can be exported (the fused-path forest family,
+    or a standalone-scorable GLM — the first non-forest class); otherwise
+    the reason string. Structural check only — export does not care
+    whether the serving fast path is env-enabled right now."""
+    from h2o3_tpu.artifact.glm import supports_glm_export
+    from h2o3_tpu.models.glm import GLMModel
     from h2o3_tpu.models.tree.shared_tree import SharedTreeModel
 
+    if isinstance(model, GLMModel):
+        return supports_glm_export(model)
     if not isinstance(model, SharedTreeModel):
-        return (f"{type(model).__name__} is not a SharedTree forest model; "
-                "AOT artifacts cover the fused scoring family (GBM/DRF/"
-                "XGBoost) — use MOJO export for other algos")
+        return (f"{type(model).__name__} is not a SharedTree forest model "
+                "or a GLM; AOT artifacts cover the fused scoring family "
+                "(GBM/DRF/XGBoost) and GLM — use MOJO export for other "
+                "algos")
     if model.forest is None or model.spec is None:
         return "model has no trained forest"
     if type(model)._predict_raw is not SharedTreeModel._predict_raw:
@@ -75,6 +81,70 @@ def default_buckets() -> List[int]:
     return sorted(_env_buckets())
 
 
+def _export_glm(model, out_dir: str, buckets: List[int]) -> Dict[str, Any]:
+    """GLM artifact (model_type="glm"): packed coefficients/moments npz +
+    an AOT-compiled fused expand+matmul+linkinv program per row bucket
+    (+ StableHLO fallback) — the first non-forest class through this
+    exporter. Forest-specific manifest keys carry inert defaults so ONE
+    schema covers both classes."""
+    from h2o3_tpu.artifact import aot, glm
+
+    arrays = glm.pack_glm(model)
+    meta = glm.glm_meta(model)
+    checksum = glm.glm_checksum(model)
+    entry = manifest.write_payload(out_dir, glm.GLM_FILE,
+                                   packer.dump_npz(arrays))
+    fingerprint = aot.backend_fingerprint(single_device=True)
+    execs, hlos = [], []
+    for b in buckets:
+        _compiled, blob, text, kept = glm.compile_glm_bucket(b, model)
+        if blob is not None:
+            e = manifest.write_payload(out_dir, f"exec_b{b}.bin", blob)
+            e.update(bucket=b, backend=fingerprint)
+            execs.append(e)
+        h = manifest.write_payload(out_dir, f"hlo_b{b}.mlir",
+                                   text.encode("utf-8"))
+        h.update(bucket=b, kept_args=kept)
+        hlos.append(h)
+
+    o = model._output
+    cat = o.model_category
+    post = {"kind": ("glm_binomial" if cat == "Binomial"
+                     else "glm_multinomial" if cat == "Multinomial"
+                     else "glm_regression")}
+    names = list(model.dinfo.predictor_names)
+    m = manifest.new_manifest(
+        model_type="glm",
+        algo=str(model.algo_name),
+        model_key=str(model.key),
+        model_category=str(cat),
+        model_checksum=checksum,
+        nclasses=int(meta["nclasses"]),
+        per_class_trees=False,
+        max_depth=0,
+        init_f=0.0,
+        n_trees=0,
+        names=names,
+        response_name=o.response_name,
+        response_domain=list(o.response_domain or []) or None,
+        domains={k: list(v) for k, v in model.dinfo.domains.items()},
+        post=post,
+        default_threshold=_default_threshold(model),
+        glm=meta,
+        files={"glm": entry},
+        buckets=buckets,
+        executables=execs,
+        stablehlo=hlos,
+    )
+    manifest.write_manifest(out_dir, m)
+    from h2o3_tpu.utils import timeline
+
+    timeline.record("artifact", "export", model=str(model.key),
+                    dir=out_dir, buckets=len(buckets),
+                    executables=len(execs))
+    return m
+
+
 def export_model(model, out_dir: str,
                  buckets: Optional[List[int]] = None) -> Dict[str, Any]:
     """Write the artifact directory for `model`; returns the manifest."""
@@ -86,6 +156,10 @@ def export_model(model, out_dir: str,
     if not buckets:
         raise ArtifactError("at least one positive row bucket is required")
     os.makedirs(out_dir, exist_ok=True)
+    from h2o3_tpu.models.glm import GLMModel
+
+    if isinstance(model, GLMModel):
+        return _export_glm(model, out_dir, buckets)
 
     forest, spec = model.forest, model.spec
     arrays = packer.pack_forest(forest, spec)

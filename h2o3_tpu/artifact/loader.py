@@ -44,6 +44,11 @@ def load_model(art_dir: str, model_id: Optional[str] = None,
 
     art_dir = persist.resolve(art_dir)
     m = manifest.read_manifest(art_dir)
+    if m.get("model_type", "forest") != "forest":
+        raise ArtifactError(
+            f"artifact model_type {m.get('model_type')!r} cannot be "
+            "imported into a serving cloud yet — score it standalone "
+            "with h2o3_genmodel.aot (forest artifacts import)")
     arrays = packer.load_npz(
         manifest.read_payload(art_dir, m["files"]["forest"]))
     try:

@@ -135,6 +135,21 @@ def validate(m: Any) -> Dict[str, Any]:
             raise ArtifactError(
                 f"manifest key {key!r} has type {type(m[key]).__name__}, "
                 f"expected {typ.__name__}")
+    mt = m.get("model_type", "forest")
+    if mt not in ("forest", "glm"):
+        raise ArtifactError(
+            f"unsupported model_type {mt!r} (this runtime loads 'forest' "
+            "and 'glm' artifacts)")
+    if mt == "glm":
+        if not isinstance(m.get("glm"), dict):
+            raise ArtifactError("glm artifact manifest missing its 'glm' "
+                                "configuration block")
+        if "glm" not in m["files"]:
+            raise ArtifactError("glm artifact manifest names no 'glm' "
+                                "payload file")
+    elif "forest" not in m["files"]:
+        raise ArtifactError("forest artifact manifest names no 'forest' "
+                            "payload file")
     for entry in list(m["files"].values()) + list(m["executables"]) \
             + list(m["stablehlo"]):
         if not isinstance(entry, dict) or "name" not in entry \

@@ -186,6 +186,48 @@ def run_scoring(train_rows: int = 20_000, ntrees: int = 10,
     print(f"H2O3_BENCH score_packed_rows {dp['packed_rows']}", flush=True)
     print(f"H2O3_BENCH score_gathered_rows {dp['gathered_rows']}",
           flush=True)
+
+    # -- coalesced-flush phase (ISSUE 13): concurrent small requests
+    # through the micro-batcher; the dispatch counters assert that a
+    # multi-entry flush costs ~ONE fused dispatch per bucket (the PR-7
+    # per-entry trade-off, removed) and the session p99 rides along for
+    # the SLO-admission trajectory
+    import os as _os
+    import threading as _threading
+
+    try:
+        conc = int(_os.environ.get("H2O3_BENCH_SCORE_CONCURRENCY", "16"))
+    except ValueError:
+        conc = 16
+    small = [make(128, False) for _ in range(max(conc, 2))]
+    sess.predict(small[0])                 # warm the small bucket
+    # dpf comes from the per-model stats delta — the process-wide
+    # h2o3_score_dispatches_total source stays monotonic
+    s0 = sess.stats.snapshot()
+
+    def submit(fr):
+        scoring.BATCHER.submit(model, fr)
+
+    for _ in range(4):
+        ths = [_threading.Thread(target=submit, args=(fr,))
+               for fr in small]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+    s1 = sess.stats.snapshot()
+    flushes = s1["batches"] - s0["batches"]
+    disp = s1["dispatches"] - s0["dispatches"]
+    dpf = disp / max(flushes, 1)
+    if dpf > 2.0:
+        # each small flush fits ONE row bucket: averaging > 2 dispatches
+        # per flush means coalescing regressed to per-entry dispatch —
+        # fail the stage loudly rather than record a stale claim
+        raise RuntimeError(
+            f"coalescing regression: {disp} fused dispatches over "
+            f"{flushes} flushes ({dpf:.2f}/flush; expected ~1)")
+    print(f"H2O3_BENCH score_dispatches_per_flush {dpf}", flush=True)
+    print(f"H2O3_BENCH score_p99_ms {s1.get('p99_ms', 0.0)}", flush=True)
     return rows / dt, "score_rows_per_sec"
 
 

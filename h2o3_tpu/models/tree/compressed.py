@@ -259,18 +259,13 @@ def _traverse_fn(max_depth: int, nclasses: int, per_class: bool = False):
     return run
 
 
-def _fused_margins(X, edges, is_cat, init, feat, thresh, na_left, left,
-                   right, leaf_val, cat_split, cat_table, tree_class,
-                   na_bins, max_depth: int, K: int):
-    """Traceable fused bin + traverse + init core: (N, F) raw float32
-    features → (N,) / (N, K) margins. Shared verbatim by the jit serving
-    path (_fused_score_fn) and the shard_map'd sharded-data-plane path
-    (_fused_score_sharded_fn) — every op is row-local, so the two lower to
-    bitwise-identical per-row programs. Binning matches
-    BinSpec.bin_columns bit-for-bit: numeric bin = #edges < x
-    (== searchsorted side='left', padded edge slots are +inf so they never
-    count); categorical bin = code, NA/out-of-range clamped to the
-    feature's NA bin."""
+def _bin_features(X, edges, is_cat, na_bins):
+    """Traceable binning core: (N, F) raw float32 features → (N, F) int32
+    bins, bitwise-matching BinSpec.bin_columns (numeric bin = #edges < x ==
+    searchsorted side='left' with +inf pad lanes never counting;
+    categorical bin = code, NA/out-of-range clamped to the feature's NA
+    bin). Shared by the fused score and fused leaf programs so every
+    explainability output bins exactly like serving does."""
     import jax.numpy as jnp
 
     nb = na_bins[None, :]
@@ -280,7 +275,56 @@ def _fused_margins(X, edges, is_cat, init, feat, thresh, na_left, left,
     # categorical: NaN→-1 before the int cast (NaN→int is undefined)
     codes = jnp.where(jnp.isnan(X), -1.0, X).astype(jnp.int32)
     cat_b = jnp.where((codes < 0) | (codes >= nb), nb, codes)
-    binned = jnp.where(is_cat[None, :], cat_b, num_b)
+    return jnp.where(is_cat[None, :], cat_b, num_b)
+
+
+def _forest_leaves(binned, feat, thresh, na_left, left, right, cat_split,
+                   cat_table, na_bins, max_depth: int):
+    """Traceable leaf-walk core: (N, F) integer bins → (N, T) leaf node
+    ids. The SAME step ops as _forest_margins' walk (so the leaf a row
+    lands in is by construction the leaf whose value the margin summed) —
+    shared by the per-request _leaf_fn and the fused leaf programs."""
+    import jax
+    import jax.numpy as jnp
+
+    N = binned.shape[0]
+
+    def walk(carry, tree):
+        tf, tt, tnl, tl, tr, tcs = tree
+
+        def step(_, node):
+            f = tf[node]
+            leaf = f < 0
+            fi = jnp.maximum(f, 0)
+            b = jnp.take_along_axis(binned, fi[:, None], axis=1)[:, 0]
+            is_na = b == na_bins[fi]
+            csid = tcs[node]
+            cat_left = cat_table[jnp.maximum(csid, 0),
+                                 jnp.minimum(b, cat_table.shape[1] - 1)]
+            go_left = jnp.where(csid >= 0, cat_left, b <= tt[node])
+            go_left = jnp.where(is_na, tnl[node], go_left)
+            return jnp.where(leaf, node,
+                             jnp.where(go_left, tl[node], tr[node]))
+
+        node = jax.lax.fori_loop(0, max_depth + 1, step,
+                                 jnp.zeros(N, jnp.int32))
+        return carry, node
+
+    _, leaves = jax.lax.scan(
+        walk, None, (feat, thresh, na_left, left, right, cat_split))
+    return jnp.transpose(leaves)       # (N, T)
+
+
+def _fused_margins(X, edges, is_cat, init, feat, thresh, na_left, left,
+                   right, leaf_val, cat_split, cat_table, tree_class,
+                   na_bins, max_depth: int, K: int):
+    """Traceable fused bin + traverse + init core: (N, F) raw float32
+    features → (N,) / (N, K) margins. Shared verbatim by the jit serving
+    path (_fused_score_fn) and the shard_map'd sharded-data-plane path
+    (_fused_score_sharded_fn) — every op is row-local, so the two lower to
+    bitwise-identical per-row programs. Binning is _bin_features (the
+    BinSpec.bin_columns-bitwise core)."""
+    binned = _bin_features(X, edges, is_cat, na_bins)
     acc = _forest_margins(binned, feat, thresh, na_left, left, right,
                           leaf_val, cat_split, cat_table, tree_class,
                           na_bins, max_depth, K)
@@ -343,37 +387,65 @@ def _fused_score_sharded_fn(max_depth: int, nclasses: int, per_class: bool,
 @functools.lru_cache(maxsize=8)
 def _leaf_fn(max_depth: int):
     import jax
-    import jax.numpy as jnp
 
     @jax.jit
     def run(binned, feat, thresh, na_left, left, right, leaf_val,
             cat_split, cat_table, tree_class, na_bins):
-        N = binned.shape[0]
-
-        def walk(carry, tree):
-            tf, tt, tnl, tl, tr, tcs = tree
-
-            def step(_, node):
-                f = tf[node]
-                leaf = f < 0
-                fi = jnp.maximum(f, 0)
-                b = jnp.take_along_axis(binned, fi[:, None], axis=1)[:, 0]
-                is_na = b == na_bins[fi]
-                csid = tcs[node]
-                cat_left = cat_table[jnp.maximum(csid, 0),
-                                     jnp.minimum(b, cat_table.shape[1] - 1)]
-                go_left = jnp.where(csid >= 0, cat_left, b <= tt[node])
-                go_left = jnp.where(is_na, tnl[node], go_left)
-                return jnp.where(leaf, node, jnp.where(go_left, tl[node], tr[node]))
-
-            node = jax.lax.fori_loop(0, max_depth + 1, step, jnp.zeros(N, jnp.int32))
-            return carry, node
-
-        _, leaves = jax.lax.scan(
-            walk, None, (feat, thresh, na_left, left, right, cat_split))
-        return jnp.transpose(leaves)       # (N, T)
+        return _forest_leaves(binned, feat, thresh, na_left, left, right,
+                              cat_split, cat_table, na_bins, max_depth)
 
     return run
+
+
+def _fused_leaves(X, edges, is_cat, feat, thresh, na_left, left, right,
+                  cat_split, cat_table, na_bins, max_depth: int):
+    """Traceable fused bin + leaf-walk core: (N, F) raw float32 features →
+    (N, T) leaf node ids — the explainability twin of _fused_margins
+    (leaf assignment, staged probabilities, RuleFit paths). Binning and
+    walk are the SAME cores serving uses, so
+    leaf = spec.bin_columns + forest.leaf_index bitwise."""
+    binned = _bin_features(X, edges, is_cat, na_bins)
+    return _forest_leaves(binned, feat, thresh, na_left, left, right,
+                          cat_split, cat_table, na_bins, max_depth)
+
+
+@functools.lru_cache(maxsize=32)
+def _fused_leaf_fn(max_depth: int):
+    """Explainability fast path: binning + leaf walk in ONE program over a
+    bucketed (N, F) raw feature matrix (host-packed serving layout)."""
+    import jax
+
+    @jax.jit
+    def run(X, edges, is_cat, feat, thresh, na_left, left, right,
+            cat_split, cat_table, na_bins):
+        return _fused_leaves(X, edges, is_cat, feat, thresh, na_left, left,
+                             right, cat_split, cat_table, na_bins,
+                             max_depth)
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _fused_leaf_sharded_fn(max_depth: int, mesh):
+    """Sharded-data-plane twin of _fused_leaf_fn: same fused core per row
+    shard under shard_map over the named 'rows' axis (every op is
+    row-local — no cross-shard communication; leaves come back
+    row-sharded (N, T))."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from h2o3_tpu.compat import shard_map as _compat_shard_map
+
+    def run(X, edges, is_cat, feat, thresh, na_left, left, right,
+            cat_split, cat_table, na_bins):
+        return _fused_leaves(X, edges, is_cat, feat, thresh, na_left, left,
+                             right, cat_split, cat_table, na_bins,
+                             max_depth)
+
+    in_specs = (P("rows", None),) + (P(),) * 10
+    fn = _compat_shard_map(run, mesh=mesh, in_specs=in_specs,
+                           out_specs=P("rows", None))
+    return jax.jit(fn)
 
 
 def forest_predict_fn():

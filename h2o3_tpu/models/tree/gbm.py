@@ -36,14 +36,23 @@ class GBMModel(SharedTreeModel):
             raise ValueError("staged_predict_proba needs a classification "
                              "GBM")
         adapted = self.adapt_test(frame)
-        binned = self.spec.bin_columns(adapted)
-        leaf_dev = self.forest.leaf_index(binned)
-        if not getattr(leaf_dev, "is_fully_addressable", True):
-            from jax.experimental import multihost_utils
+        from h2o3_tpu import scoring
 
-            leaf_dev = multihost_utils.process_allgather(leaf_dev,
-                                                         tiled=True)
-        leaf = np.asarray(leaf_dev)[: frame.nrows]
+        if scoring.supports(self):
+            # fused bucketed bin+leaf program (ISSUE 13): staged
+            # probabilities ride the ScoringSession's compiled
+            # explainability programs — bitwise-equal to the eager pass
+            leaf = scoring.session_for(self).leaf_matrix(adapted,
+                                                         frame.nrows)
+        else:
+            binned = self.spec.bin_columns(adapted)
+            leaf_dev = self.forest.leaf_index(binned)
+            if not getattr(leaf_dev, "is_fully_addressable", True):
+                from jax.experimental import multihost_utils
+
+                leaf_dev = multihost_utils.process_allgather(leaf_dev,
+                                                             tiled=True)
+            leaf = np.asarray(leaf_dev)[: frame.nrows]
         fo = self.forest
         lv = np.asarray(fo.leaf_val, np.float64)
         contrib = np.take_along_axis(lv, leaf.T, axis=1).T   # (N, T)

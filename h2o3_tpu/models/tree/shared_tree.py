@@ -141,17 +141,28 @@ class SharedTreeModel(Model):
             raise ValueError(f"leaf assignment type {type!r} "
                              "(Path or Node_ID)")
         adapted = self.adapt_test(frame)
-        binned = self.spec.bin_columns(adapted)
-        leaf_dev = self.forest.leaf_index(binned)
-        if not getattr(leaf_dev, "is_fully_addressable", True):
-            # multi-process cloud: every process reaches this inside its
-            # mirrored op (REST turn / follower replay), so the allgather
-            # is in lockstep
-            from jax.experimental import multihost_utils
+        from h2o3_tpu import scoring
 
-            leaf_dev = multihost_utils.process_allgather(leaf_dev,
-                                                         tiled=True)
-        leaf = np.asarray(leaf_dev)[: frame.nrows]
+        if scoring.supports(self):
+            # explainability fast path (ISSUE 13): the fused bucketed
+            # bin+leaf program from the model's ScoringSession — compiled
+            # once per row bucket (and persisted in the compile cache)
+            # instead of one jit trace per request shape. Bitwise-equal
+            # to the eager bin_columns + leaf_index pass below.
+            leaf = scoring.session_for(self).leaf_matrix(adapted,
+                                                         frame.nrows)
+        else:
+            binned = self.spec.bin_columns(adapted)
+            leaf_dev = self.forest.leaf_index(binned)
+            if not getattr(leaf_dev, "is_fully_addressable", True):
+                # multi-process cloud: every process reaches this inside
+                # its mirrored op (REST turn / follower replay), so the
+                # allgather is in lockstep
+                from jax.experimental import multihost_utils
+
+                leaf_dev = multihost_utils.process_allgather(leaf_dev,
+                                                             tiled=True)
+            leaf = np.asarray(leaf_dev)[: frame.nrows]
         fo = self.forest
         tcls = np.asarray(fo.tree_class)
         per_class = fo.per_class_trees

@@ -37,15 +37,17 @@ import time
 from typing import Any, Dict, List, Optional
 
 # closed program-family enumeration: scoring = fused bin+traverse serving
-# programs, binning = tree-training bin-matrix builds, rapids = statement
-# fusion, artifact = AOT exporter lowerings, pack = sharded data-plane
-# packers, probe = the supervised boot first-compile
-FAMILIES = frozenset({"scoring", "binning", "rapids", "artifact", "pack",
-                      "probe"})
+# programs, explain = fused bin+leaf explainability programs (leaf
+# assignment / staged probabilities), binning = tree-training bin-matrix
+# builds, rapids = statement fusion, artifact = AOT exporter lowerings,
+# pack = sharded data-plane packers, probe = the supervised boot
+# first-compile
+FAMILIES = frozenset({"scoring", "explain", "binning", "rapids", "artifact",
+                      "pack", "probe"})
 
 # persistent-compile-cache families whose actual compiles feed the legacy
 # note_compile() counter (the warm-restart zero-compile assertions)
-_CACHED_FAMILIES = ("scoring", "rapids")
+_CACHED_FAMILIES = ("scoring", "explain", "rapids")
 
 _KV_PREFIX = "obs/runtime/"
 

@@ -504,6 +504,24 @@ def _install_default_metrics() -> None:
                  "fused executables served from the persistent cache",
                  lambda: _scoring_field("compile_cache_hits"))
 
+    # -- per-flush dispatch accounting (ISSUE 13): the one-fused-dispatch-
+    #    per-flush contract is observable, by path label --
+    def _score_dispatches():
+        from h2o3_tpu import scoring
+
+        return {(("path", p),): float(n)
+                for p, n in scoring.dispatch_counters().items()}
+
+    r.counter_fn("h2o3_score_dispatches_total",
+                 "fused program executions on the serving/explainability "
+                 "paths, by path", _score_dispatches)
+    r.histogram("h2o3_score_flush_requests",
+                "requests coalesced per micro-batch flush",
+                buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+    r.histogram("h2o3_score_request_seconds",
+                "fused-path request latency (admission + batching + "
+                "dispatch), by model — the SLO-adaptive admission signal")
+
     def _rapids(field):
         def fn():
             from h2o3_tpu.rapids import fusion
@@ -554,6 +572,19 @@ def _install_default_metrics() -> None:
     r.counter_fn("h2o3_admission_timed_out_total",
                  "queued requests expired 503 before a slot freed",
                  _adm("timed_out"))
+    r.counter_fn("h2o3_admission_shed_slo_total",
+                 "requests shed 429 by the SLO queue-time gate",
+                 _adm("shed_slo"))
+
+    def _adm_limits():
+        from h2o3_tpu import admission
+
+        return {(("model", k),): float(v)
+                for k, v in admission.CONTROLLER.derived_limits().items()}
+
+    r.gauge_fn("h2o3_admission_limit",
+               "effective per-model inflight limit (static knob or "
+               "SLO-derived)", _adm_limits, agg="max")
 
     def _cc(field):
         def fn():

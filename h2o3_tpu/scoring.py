@@ -42,6 +42,31 @@ from h2o3_tpu.obs import tracing
 
 _DEFAULT_BUCKETS = (256, 1024, 4096, 16384)
 
+# -- per-process fused-dispatch accounting ----------------------------------
+# one increment per fused program execution on the serving/explainability
+# paths, by path label (sharded | host | local | leaf_sharded | leaf_host).
+# /3/ScoringMetrics serves these under ``dispatches`` and /3/Metrics as
+# ``h2o3_score_dispatches_total``; the consistency suite asserts a
+# multi-entry sharded flush records exactly one dispatch per row bucket.
+
+_DISP_LOCK = threading.Lock()
+_DISPATCHES: Dict[str, int] = {}
+
+
+def note_dispatch(path: str, n: int = 1) -> None:
+    with _DISP_LOCK:
+        _DISPATCHES[path] = _DISPATCHES.get(path, 0) + int(n)
+
+
+def dispatch_counters() -> Dict[str, int]:
+    with _DISP_LOCK:
+        return dict(_DISPATCHES)
+
+
+def reset_dispatch_counters() -> None:
+    with _DISP_LOCK:
+        _DISPATCHES.clear()
+
 
 def _shard_owners(arr) -> list:
     """Process indices (other than ours) owning shards of a device array —
@@ -104,14 +129,17 @@ class SessionStats:
         self.requests = 0
         self.batches = 0
         self.rows = 0
+        self.dispatches = 0          # fused program executions (all paths)
         self.max_batch_requests = 0
         self._lat_ms: collections.deque = collections.deque(maxlen=512)
 
-    def record_batch(self, n_requests: int, n_rows: int, ms: float) -> None:
+    def record_batch(self, n_requests: int, n_rows: int, ms: float,
+                     dispatches: int = 0) -> None:
         with self._lock:
             self.requests += n_requests
             self.batches += 1
             self.rows += n_rows
+            self.dispatches += int(dispatches)
             self.max_batch_requests = max(self.max_batch_requests, n_requests)
             self._lat_ms.append(float(ms))
 
@@ -119,8 +147,11 @@ class SessionStats:
         with self._lock:
             lat = np.asarray(self._lat_ms, np.float64)
             out = {"requests": self.requests, "batches": self.batches,
-                   "rows": self.rows,
+                   "rows": self.rows, "dispatches": self.dispatches,
                    "max_batch_requests": self.max_batch_requests}
+            if self.batches:
+                out["dispatches_per_flush"] = round(
+                    self.dispatches / self.batches, 3)
         if lat.size:
             out["p50_ms"] = round(float(np.percentile(lat, 50)), 3)
             out["p99_ms"] = round(float(np.percentile(lat, 99)), 3)
@@ -159,6 +190,8 @@ class ScoringSession:
                                    self.forest.nclasses,
                                    self.forest.per_class_trees)
         self._fn_sharded = None          # lazy shard_map'd twin (sharded plane)
+        self._fn_leaf = None             # lazy fused bin+leaf twin (explain)
+        self._fn_leaf_sharded = None     # ... and its shard_map'd variant
         self._traced: set = set()        # buckets activated so far
         # AOT executables per (bucket, local): dispatched explicitly so
         # compilation is observable (fused-compile counter) and cacheable
@@ -180,7 +213,7 @@ class ScoringSession:
         layouts): every column round-trips through this process's host, so
         the rows count as ``gathered`` on the data-plane counters. The
         default serving path packs shard-locally via _sharded_view /
-        _margin_sharded and never lands here."""
+        _margins_sharded_batch and never lands here."""
         from h2o3_tpu.core import sharded_frame
 
         sharded_frame.note_gathered(n)
@@ -247,16 +280,38 @@ class ScoringSession:
                 self.forest.per_class_trees, self._cl.mesh)
         return self._fn_sharded
 
+    def _leaf_score_fn(self, sharded: bool):
+        """Lazy fused bin+leaf programs (compressed.py _fused_leaf_fn /
+        _fused_leaf_sharded_fn) — the explainability twins of the scoring
+        programs, sharing the binning and walk cores bitwise."""
+        if sharded:
+            if self._fn_leaf_sharded is None:
+                from h2o3_tpu.models.tree.compressed import \
+                    _fused_leaf_sharded_fn
+
+                self._fn_leaf_sharded = _fused_leaf_sharded_fn(
+                    self.forest.max_depth, self._cl.mesh)
+            return self._fn_leaf_sharded
+        if self._fn_leaf is None:
+            from h2o3_tpu.models.tree.compressed import _fused_leaf_fn
+
+            self._fn_leaf = _fused_leaf_fn(self.forest.max_depth)
+        return self._fn_leaf
+
     def _executable_for(self, bucket: int, local: bool, call_args: tuple,
-                        sharded: bool = False):
-        """AOT executable for one (bucket, placement) — in-memory first,
-        then the persistent compile cache ($H2O_TPU_COMPILE_CACHE_DIR,
-        keyed by model checksum + bucket + variant + backend fingerprint),
-        and only then an actual XLA compile (counted, and stored back for
-        the next process/restart). A warm restart therefore compiles zero
-        fused programs. `sharded` selects the shard_map'd program family
-        (the sharded data plane's serving path)."""
-        key = (bucket, bool(local), bool(sharded))
+                        sharded: bool = False, kind: str = "score"):
+        """AOT executable for one (kind, bucket, placement) — in-memory
+        first, then the persistent compile cache
+        ($H2O_TPU_COMPILE_CACHE_DIR, keyed by model checksum + bucket +
+        variant + backend fingerprint), and only then an actual XLA
+        compile (counted, and stored back for the next process/restart).
+        A warm restart therefore compiles zero fused programs. `sharded`
+        selects the shard_map'd program family (the sharded data plane's
+        serving path); `kind` is ``score`` (fused bin+traverse margins,
+        ledger family "scoring") or ``leaf`` (fused bin+leaf walk for the
+        explainability outputs, ledger family "explain")."""
+        key = (kind, bucket, bool(local), bool(sharded))
+        family = "scoring" if kind == "score" else "explain"
         exe = self._exec.get(key)
         if exe is not None:
             # warm path: a counter bump only (no ring row, no hashing) —
@@ -264,12 +319,16 @@ class ScoringSession:
             # in-memory tier, not just the disk tier
             from h2o3_tpu.obs import compiles
 
-            compiles.record_hit("scoring", tier="memory")
+            compiles.record_hit(family, tier="memory")
             return exe
         from h2o3_tpu.artifact import compile_cache
         from h2o3_tpu.obs import compiles
 
         variant = "local" if local else "sharded" if sharded else "mesh"
+        if kind != "score":
+            variant = f"{kind}_{variant}"
+        progname = f"fused_score_{variant}" if kind == "score" \
+            else f"fused_{variant}"
         sig = (str(getattr(self.model, "key", id(self))), bucket, variant)
         ckey = None
         if compile_cache.enabled():
@@ -280,25 +339,28 @@ class ScoringSession:
                 self._model_checksum(), bucket, variant=variant)
             exe = compile_cache.load(ckey)
         if exe is None:
-            fn = self._sharded_score_fn() if sharded else self._fn
+            if kind == "score":
+                fn = self._sharded_score_fn() if sharded else self._fn
+            else:
+                fn = self._leaf_score_fn(sharded)
             # the ledger chokepoint lowers, compiles, times, records the
             # row AND feeds the legacy note_compile counter — callers no
             # longer self-report durations that could drift
-            exe = compiles.compile_jit("scoring", fn, call_args,
-                                       signature=sig,
-                                       program=f"fused_score_{variant}")
+            exe = compiles.compile_jit(family, fn, call_args,
+                                       signature=sig, program=progname)
             self.fused_compiles += 1
             if ckey is not None:
                 compile_cache.store(ckey, exe)
         else:
             self.cache_hits += 1
-            compiles.record_hit("scoring", sig, "disk",
-                                program=f"fused_score_{variant}")
+            compiles.record_hit(family, sig, "disk", program=progname)
         self._exec[key] = exe
-        self._traced.add(bucket)
+        if kind == "score":
+            self._traced.add(bucket)
         return exe
 
-    def _margin_x(self, X: np.ndarray, local: bool = False) -> np.ndarray:
+    def _margin_x(self, X: np.ndarray, local: bool = False,
+                  dispatched: Optional[list] = None) -> np.ndarray:
         """Margins for an (n, F) feature matrix via bucketed fused
         dispatch; returns host (n,) or (n, K) float32, exact per row.
         Rows beyond the largest bucket are chunked at it, so the set of
@@ -306,7 +368,9 @@ class ScoringSession:
         `local=True` (degraded-cloud serving on a real multi-process cloud)
         dispatches on this process's default device with NO mesh sharding —
         the global row sharding would be a collective the dead peer never
-        runs."""
+        runs. `dispatched` (a mutable list) receives one bucket entry per
+        fused dispatch, so per-model stats count exactly what ran instead
+        of re-deriving the chunking arithmetic."""
         import jax
 
         n = X.shape[0]
@@ -329,6 +393,9 @@ class ScoringSession:
             with tracing.span("dispatch", bucket=bucket, rows=m,
                               path="host"):
                 out = exe(*call_args)
+            note_dispatch("local" if local else "host")
+            if dispatched is not None:
+                dispatched.append(bucket)
             with tracing.span("fetch", rows=m, path="host"):
                 got = np.asarray(out)[:m]   # the one blocking transfer
             outs.append(got)
@@ -340,29 +407,56 @@ class ScoringSession:
             return np.zeros((0,) if K == 1 else (0, K), np.float32)
         return outs[0] if len(outs) == 1 else np.concatenate(outs)
 
-    def _margin_sharded(self, sf, n: int):
-        """Margins for a sharded-eligible adapted frame WITHOUT any host
-        round-trip: per chunk, ShardedFrame.pack_features builds the
-        (bucket, F) matrix from addressable shards and the shard_map'd
-        fused program scores it; the per-chunk row-sharded margins are
-        then assembled into ONE (padded_rows,) / (padded_rows, K) device
-        array (this reshard is the single gather of the serving path —
-        device-to-device, never through the coordinator host).
+    def _out_k(self) -> int:
+        return (self.forest.nclasses if (self.forest.nclasses > 2
+                                         or self.forest.per_class_trees)
+                else 1)
 
-        Bitwise contract: rows [0, n) equal the host-packed path's
-        margins; rows [n, padded_rows) are exactly 0.0, like
-        _raw_for_slice's pad — so the downstream margin→raw→frame math is
-        byte-identical between the two paths."""
+    def _reshard_bucket(self, x):
+        """Re-lay a device (bucket, F) matrix out as P('rows', None) — the
+        EXACT input sharding the shard_map'd fused programs are lowered
+        with (ShardedFrame.pack_features' out_shardings), so a coalesced
+        chunk and a directly-packed matrix hit the same AOT executable.
+        Device-to-device only; jit identity on multi-process (cross-host
+        resharding goes through XLA)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from h2o3_tpu.core.sharded_frame import ROW_AXIS
+
+        sh = NamedSharding(self._cl.mesh, P(ROW_AXIS, None))
+        if jax.process_count() > 1:
+            return jax.jit(lambda a: a, out_shardings=sh)(x)
+        return jax.device_put(x, sh)
+
+    def _margins_sharded_batch(self, items) -> Tuple[Any, int]:
+        """Fused margins for ALL sharded-eligible entries of one flush:
+        ``items`` is ``[(sf, n)]`` in flush order; returns (margins,
+        dispatches) where margins is ONE device array holding the flush's
+        exact logical rows back to back — (ΣN,) or (ΣN, K) — and
+        dispatches counts fused program executions.
+
+        A multi-entry flush device-concatenates the per-entry
+        shard-packed matrices (each already built from addressable shards
+        — zero gathers) and dispatches ONE fused program per row-bucket
+        chunk of the concatenation: the host path's
+        one-dispatch-per-bucket batching, now with no host round-trip.
+        This deletes the recorded PR-7 trade-off (one fused dispatch PER
+        ENTRY per flush). A single-entry flush keeps the direct per-chunk
+        dispatch — no concat/reshard detour on the latency path.
+
+        Bitwise contract: the fused program is row-local (bin + walk per
+        row), so every logical row's margin is independent of which
+        bucket chunk carried it — rows [0, n_i) equal the host-packed
+        path's margins per entry; pad lanes are zero-filled and sliced
+        off before anything reads them."""
         import jax.numpy as jnp
 
         maxb = self.buckets[-1]
-        P_rows = sf.padded_rows
-        outs: List[Any] = []
-        pos = 0
-        while pos < n:
-            m = min(maxb, n - pos)
-            bucket = self._bucket_for(m)
-            Xd = sf.pack_features(pos, n, bucket)
+        n_disp = 0
+
+        def dispatch(Xd, bucket: int, rows: int):
+            nonlocal n_disp
             call_args = (Xd, self._edges, self._is_cat, self._init) + \
                 tuple(self._arrays)
             exe = self._executable_for(bucket, False, call_args,
@@ -370,26 +464,153 @@ class ScoringSession:
             # host-side dispatch wall time only — the program is async and
             # NO block_until_ready is added here (the fused-path counters
             # assert the path is unchanged when profiling is off)
-            with tracing.span("dispatch", bucket=bucket, rows=m,
+            with tracing.span("dispatch", bucket=bucket, rows=rows,
                               path="sharded"):
                 out = exe(*call_args)
-            outs.append(out[:m])
-            pos += m
-        K = (self.forest.nclasses if (self.forest.nclasses > 2
-                                      or self.forest.per_class_trees)
-             else 1)
-        if not outs:
-            zero = jnp.zeros((P_rows,) if K == 1 else (P_rows, K),
-                             jnp.float32)
-            return self._cl.reshard_rows(zero)
-        cat = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
-        if P_rows > n:
-            pad = ((0, P_rows - n),) + ((0, 0),) * (cat.ndim - 1)
-            cat = jnp.pad(cat, pad)
-        from h2o3_tpu.core import sharded_frame
+            n_disp += 1
+            note_dispatch("sharded")
+            return out
 
-        sharded_frame.note_packed(n)
-        return self._cl.reshard_rows(cat)
+        outs: List[Any] = []
+        if len(items) == 1:
+            sf, n = items[0]
+            pos = 0
+            while pos < n:
+                m = min(maxb, n - pos)
+                bucket = self._bucket_for(m)
+                Xd = sf.pack_features(pos, n, bucket)
+                outs.append(dispatch(Xd, bucket, m)[:m])
+                pos += m
+        else:
+            parts: List[Any] = []
+            for sf, n in items:
+                pos = 0
+                while pos < n:
+                    m = min(maxb, n - pos)
+                    bucket = self._bucket_for(m)
+                    Xd = sf.pack_features(pos, n, bucket)
+                    parts.append(Xd if m == bucket else Xd[:m])
+                    pos += m
+            if parts:
+                total = sum(n for _, n in items)
+                # the device-side concat of per-entry shard-packed
+                # matrices — slices/concat/pad are cheap elementwise
+                # device ops, never a host staging
+                with tracing.span("pack", rows=total, path="coalesce"):
+                    X = parts[0] if len(parts) == 1 else \
+                        jnp.concatenate(parts)
+                N = int(X.shape[0])
+                pos = 0
+                while pos < N:
+                    m = min(maxb, N - pos)
+                    bucket = self._bucket_for(m)
+                    chunk = X[pos: pos + m]
+                    if m < bucket:
+                        chunk = jnp.pad(chunk, ((0, bucket - m), (0, 0)))
+                    chunk = self._reshard_bucket(chunk)
+                    outs.append(dispatch(chunk, bucket, m)[:m])
+                    pos += m
+        K = self._out_k()
+        if not outs:
+            return jnp.zeros((0,) if K == 1 else (0, K), jnp.float32), 0
+        return (outs[0] if len(outs) == 1
+                else jnp.concatenate(outs)), n_disp
+
+    def _lift_entry_margins(self, mg, n: int, padded_rows: int):
+        """Pad one entry's exact (n, …) device margins out to its frame's
+        padded row count and reshard over the named rows axis (the single
+        gather of the serving path — device-to-device, never through the
+        coordinator host). Pad rows are exactly 0.0, like
+        _raw_for_slice's pad — so the downstream margin→raw→frame math is
+        byte-identical between the sharded and host paths."""
+        import jax.numpy as jnp
+
+        if padded_rows > n:
+            pad = ((0, padded_rows - n),) + ((0, 0),) * (mg.ndim - 1)
+            mg = jnp.pad(mg, pad)
+        return self._cl.reshard_rows(mg)
+
+    # -- fused explainability (leaf walks) ---------------------------------
+    def leaf_matrix(self, adapted, n: int) -> np.ndarray:
+        """(n, T) int32 leaf node ids through the fused bucketed bin+leaf
+        programs — bitwise-identical to ``spec.bin_columns(adapted)`` +
+        ``forest.leaf_index(binned)`` (shared binning/walk cores), but
+        compiled once per row bucket instead of once per request shape.
+        Leaf assignment, staged probabilities and RuleFit-style path
+        consumers ride the same compiled-program discipline as serving
+        (recorded PR-2 follow-up). Sharded-eligible frames pack from
+        addressable shards; others take the host-packed fallback."""
+        import jax
+        import jax.numpy as jnp
+
+        if n <= 0:
+            return np.zeros((0, self.forest.n_trees), np.int32)
+        maxb = self.buckets[-1]
+        a = self._arrays
+        tail = (a[0], a[1], a[2], a[3], a[4], a[6], a[7], a[9])
+        outs: List[Any] = []
+        sf = self._sharded_view(adapted)
+        if sf is None and jax.process_count() > 1:
+            # ineligible frame on a multi-process cloud: the host-gather
+            # fallback below would pull non-addressable columns. Keep the
+            # eager device-side pass (the pre-fused path) — it runs in
+            # lockstep inside the mirrored op, like predict_batch's
+            # generic fallback, and is the bitwise reference anyway.
+            binned = self.spec.bin_columns(adapted)
+            leaves = self.forest.leaf_index(binned)
+            if not getattr(leaves, "is_fully_addressable", True):
+                from jax.experimental import multihost_utils
+
+                leaves = multihost_utils.process_allgather(leaves,
+                                                           tiled=True)
+            return np.asarray(leaves)[:n]
+        if sf is not None:
+            pos = 0
+            while pos < n:
+                m = min(maxb, n - pos)
+                bucket = self._bucket_for(m)
+                Xd = sf.pack_features(pos, n, bucket)
+                call_args = (Xd, self._edges, self._is_cat) + tail
+                exe = self._executable_for(bucket, False, call_args,
+                                           sharded=True, kind="leaf")
+                with tracing.span("dispatch", bucket=bucket, rows=m,
+                                  path="leaf_sharded"):
+                    out = exe(*call_args)
+                note_dispatch("leaf_sharded")
+                outs.append(out[:m])
+                pos += m
+            from h2o3_tpu.core import sharded_frame
+
+            sharded_frame.note_packed(n)
+        else:
+            X = self._features(adapted, n)
+            sharding = self._cl.row_sharding()
+            pos = 0
+            while pos < n:
+                chunk = X[pos: pos + maxb]
+                m = chunk.shape[0]
+                bucket = self._bucket_for(m)
+                buf = np.zeros((bucket, X.shape[1]), np.float32)
+                buf[:m] = chunk
+                xd = jax.device_put(buf, sharding)
+                call_args = (xd, self._edges, self._is_cat) + tail
+                exe = self._executable_for(bucket, False, call_args,
+                                           kind="leaf")
+                with tracing.span("dispatch", bucket=bucket, rows=m,
+                                  path="leaf_host"):
+                    out = exe(*call_args)
+                note_dispatch("leaf_host")
+                outs.append(out[:m])
+                pos += m
+        cat = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+        if not getattr(cat, "is_fully_addressable", True):
+            # multi-process cloud: every process reaches this inside its
+            # mirrored op (REST turn / follower replay), so the allgather
+            # is in lockstep
+            from jax.experimental import multihost_utils
+
+            cat = multihost_utils.process_allgather(cat, tiled=True)
+        return np.asarray(cat)[:n]
 
     @property
     def traversal_compiles(self) -> int:
@@ -432,16 +653,17 @@ class ScoringSession:
         coalesced into one bucketed program — or, multi-process, the
         generic predict path.
 
-        Known trade-off: sharded entries dispatch per entry (pack +
-        score + reshard each), where the host path concatenated every
-        entry's rows into one margin dispatch. The per-entry work that
-        dominates small requests (adapt, margin→raw, frame install,
-        metrics) was per-entry on BOTH paths, and the sharded path drops
-        the per-column host round-trips (~60 ms each through the TPU
-        tunnel), but a many-small-entry flush now pays one fused dispatch
-        per entry instead of ~one per bucket chunk — device-side
-        coalescing of eligible entries is a recorded serving follow-up
-        (ROADMAP item 3 remainder).
+        Coalesced dispatch (the PR-7 trade-off, removed): ALL
+        sharded-eligible entries of a flush are scored by ONE fused
+        dispatch per row-bucket chunk — their shard-packed matrices are
+        concatenated device-side (zero gathers) and the concatenation is
+        chunked at the bucket ladder exactly like the host path's
+        concatenated batches. A flush of many small entries therefore
+        costs ~one fused program execution per bucket, not one per entry;
+        the per-entry work that remains (adapt, margin→raw, frame
+        install, metrics) was per-entry on both paths. Dispatch counts
+        land on /3/ScoringMetrics (``dispatches``) and
+        ``h2o3_score_dispatches_total``.
 
         `local_only=True` is degraded-cloud serving: the followers are
         dead or stale, so no cross-process program may run. The fused
@@ -469,22 +691,15 @@ class ScoringSession:
         mp = jax.process_count() > 1
         results: List[Any] = [None] * len(entries)
         host_entries = []          # (idx, frame, adapted, n, dest, wm)
+        sharded_entries = []       # (idx, frame, n, dest, wm, sf)
+        n_dispatches = 0
         for i, (frame, dest, with_metrics) in enumerate(entries):
             adapted = self.model.adapt_test(frame)
             n = frame.nrows
             sf = None if local_mp else self._sharded_view(adapted)
             if sf is not None:
-                raw = self.model._margin_to_raw(self._margin_sharded(sf, n))
-                # result assembly is where this path first blocks on the
-                # device (frame install / metrics read host values) — the
-                # "fetch" phase of the request's span tree. No sync is
-                # ADDED: these calls block with or without tracing.
-                with tracing.span("fetch", rows=n, path="sharded"):
-                    pred = self.model._raw_to_frame(raw, n, key=dest)
-                    pred.install()
-                    mm = self.model._make_metrics(frame, raw) \
-                        if with_metrics else None
-                results[i] = (pred, mm)
+                sharded_entries.append((i, frame, n, dest, with_metrics,
+                                        sf))
             elif mp and not local_only:
                 # ineligible entry on a multi-process cloud: the generic
                 # path (device-side binning + traversal) keeps the program
@@ -501,10 +716,39 @@ class ScoringSession:
             else:
                 host_entries.append((i, frame, adapted, n, dest,
                                      with_metrics))
+        if sharded_entries:
+            from h2o3_tpu.core import sharded_frame
+
+            margins, nd = self._margins_sharded_batch(
+                [(sf, n) for _i, _f, n, _d, _w, sf in sharded_entries])
+            n_dispatches += nd
+            off = 0
+            for i, frame, n, dest, with_metrics, sf in sharded_entries:
+                mg = margins[off: off + n]
+                off += n
+                sharded_frame.note_packed(n)
+                raw = self.model._margin_to_raw(
+                    self._lift_entry_margins(mg, n, sf.padded_rows))
+                # result assembly is where this path first blocks on the
+                # device (frame install / metrics read host values) — the
+                # "fetch" phase of the request's span tree. No sync is
+                # ADDED: these calls block with or without tracing.
+                with tracing.span("fetch", rows=n, path="sharded"):
+                    pred = self.model._raw_to_frame(raw, n, key=dest)
+                    pred.install()
+                    mm = self.model._make_metrics(frame, raw) \
+                        if with_metrics else None
+                results[i] = (pred, mm)
         if host_entries:
             X = np.concatenate([self._features(a, n)
                                 for _, _, a, n, _, _ in host_entries])
-            margins = self._margin_x(X, local=local_mp)
+            # the host path coalesces into one margin dispatch per bucket
+            # chunk of the concatenated rows (the pre-PR-7 batching);
+            # _margin_x reports what actually ran
+            host_disp: list = []
+            margins = self._margin_x(X, local=local_mp,
+                                     dispatched=host_disp)
+            n_dispatches += len(host_disp)
             off = 0
             for i, frame, _a, n, dest, with_metrics in host_entries:
                 raw = self._raw_for_slice(margins[off: off + n], n,
@@ -517,11 +761,16 @@ class ScoringSession:
                 results[i] = (pred, mm)
         total_rows = sum(frame.nrows for frame, _, _ in entries)
         ms = (time.perf_counter() - t0) * 1000
-        self.stats.record_batch(len(entries), total_rows, ms)
+        self.stats.record_batch(len(entries), total_rows, ms,
+                                dispatches=n_dispatches)
+        from h2o3_tpu.obs import metrics as obs_metrics
         from h2o3_tpu.utils import timeline
 
+        obs_metrics.observe("h2o3_score_flush_requests",
+                            float(len(entries)))
         timeline.record("scoring", str(self.model.key), ms=ms,
                         requests=len(entries), rows=total_rows,
+                        dispatches=n_dispatches,
                         compiles=self.traversal_compiles)
         return results
 
@@ -789,8 +1038,22 @@ def score_request(model, frame, dest: Optional[str] = None,
     (prediction_frame, metrics_or_None). Over the per-model concurrency
     limit requests queue (bounded); overflow raises AdmissionRejected,
     which the REST layer maps to 429/503 + Retry-After — heavy traffic
-    degrades by queueing, not collapse."""
-    from h2o3_tpu import admission
+    degrades by queueing, not collapse.
 
-    with admission.CONTROLLER.slot(str(model.key)):
-        return BATCHER.submit(model, frame, dest, with_metrics)
+    Every served request's latency feeds the per-model admission ring:
+    the SLO-adaptive controller (``H2O_TPU_SCORE_SLO_MS``) derives the
+    inflight limit from the observed p99 against the target, and the
+    Retry-After hints from the observed drain rate."""
+    from h2o3_tpu import admission
+    from h2o3_tpu.obs import metrics as obs_metrics
+
+    mk = str(model.key)
+    t0 = time.perf_counter()
+    with admission.CONTROLLER.slot(mk):
+        t1 = time.perf_counter()
+        out = BATCHER.submit(model, frame, dest, with_metrics)
+        admission.CONTROLLER.note_latency(
+            mk, (time.perf_counter() - t1) * 1000.0)
+    obs_metrics.observe("h2o3_score_request_seconds",
+                        time.perf_counter() - t0, model=mk)
+    return out

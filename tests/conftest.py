@@ -84,9 +84,10 @@ _SMOKE_MODULES = {"test_core", "test_glm", "test_rapids", "test_java_mojo",
 # file order is kept within each cost class.
 _HEAVY_MODULES = [
     # many passing tests per second of training — earliest of the tail
-    # (test_sharded_frame trains small GBMs, so it rides the head of the
-    # heavy tail: the pure-host cheap modules still bank their dots first)
-    "test_sharded_frame",
+    # (test_sharded_frame/test_serving_qps train small GBMs, so they ride
+    # the head of the heavy tail: the pure-host cheap modules still bank
+    # their dots first)
+    "test_sharded_frame", "test_serving_qps",
     "test_job_resume", "test_trees", "test_checkpoint", "test_genmodel",
     "test_artifact", "test_mojo",
     "test_mojo_families", "test_explain", "test_ensemble",
@@ -109,6 +110,8 @@ _HEAVY_MODULES = [
 # test_sharded_frame's REST test — so it banks dots in the cheap phase.)
 _HEAVY_ITEMS = {
     "test_fused_paths_never_gather_columns_to_coordinator":
+        "test_sharded_frame",
+    "test_multi_entry_flush_is_one_dispatch_per_bucket":
         "test_sharded_frame",
 }
 

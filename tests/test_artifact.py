@@ -605,3 +605,131 @@ class TestAdaptiveReplayIdleTimeout:
         set_gaps(1000.0)                                 # clamped high
         assert watchdog.replay_idle_timeout_s() == \
             watchdog._REPLAY_IDLE_MAX_S
+
+
+class TestGlmArtifact:
+    """ISSUE-13 satellite: the first non-forest class through
+    artifact/export + h2o3_genmodel.aot. The exported program IS the
+    in-process ``_glm_predict`` jit program (lowered per bucket), so the
+    standalone runner is bitwise-identical to ``GLMModel.predict`` —
+    including the StableHLO fallback path."""
+
+    def _glm_frames(self, n=600, seed=31):
+        rng = np.random.default_rng(seed)
+        fr = Frame()
+        x1 = rng.standard_normal(n)
+        x1[::9] = np.nan
+        fr.add("x1", Column.from_numpy(x1))
+        fr.add("x2", Column.from_numpy(rng.standard_normal(n)))
+        fr.add("g", Column.from_numpy(
+            np.array(["a", "b", "c"])[rng.integers(0, 3, n)],
+            ctype="enum"))
+        y = np.where(rng.random(n) < 1 / (1 + np.exp(
+            -np.nan_to_num(x1))), "Y", "N")
+        fr.add("y", Column.from_numpy(y, ctype="enum"))
+        tn = 150
+        tx1 = rng.standard_normal(tn)
+        tx1[::5] = np.nan
+        test = Frame()
+        test.add("x1", Column.from_numpy(tx1))
+        test.add("x2", Column.from_numpy(rng.standard_normal(tn)))
+        gv = np.array(["a", "b", "c", "zz"])[rng.integers(0, 4, tn)]
+        test.add("g", Column.from_numpy(gv, ctype="enum"))
+        cols = {"x1": tx1, "x2": np.asarray(test.col("x2").data)[:tn],
+                "g": gv}
+        return fr, test, cols, tn
+
+    def test_binomial_glm_bitwise_incl_hlo_fallback(self, cl, tmp_path):
+        from h2o3_genmodel.aot import load_artifact
+        from h2o3_tpu import artifact
+        from h2o3_tpu.models.glm import GLM
+
+        fr, test, cols, tn = self._glm_frames()
+        m = GLM(family="binomial").train(y="y", training_frame=fr)
+        art = str(tmp_path / "glm_art")
+        man = artifact.export_model(m, art, buckets=[256])
+        assert man["model_type"] == "glm"
+        ref = m.predict(test)
+        s = load_artifact(art)
+        out = s.score(cols)
+        for lvl in ("N", "Y"):
+            assert np.array_equal(_bits(ref.col(lvl).data[:tn]),
+                                  _bits(out[lvl])), lvl
+        dom = ref.col("predict").domain
+        lab = [dom[i] for i in np.asarray(ref.col("predict").data)[:tn]]
+        assert lab == [str(v) for v in out["predict"]]
+        # the StableHLO fallback executes the exporter's exact program:
+        # margins stay bitwise without a loadable serialized executable
+        s2 = load_artifact(art)
+        s2.manifest["executables"] = []
+        out2 = s2.score(cols)
+        assert s2.loaded_from == {256: "hlo"}
+        assert np.array_equal(_bits(out["Y"]), _bits(out2["Y"]))
+        m.delete()
+
+    def test_regression_and_multinomial_glm_bitwise(self, cl, tmp_path):
+        from h2o3_genmodel.aot import load_artifact
+        from h2o3_tpu import artifact
+        from h2o3_tpu.models.glm import GLM
+
+        rng = np.random.default_rng(33)
+        n = 500
+        fr = Frame()
+        x = rng.standard_normal(n)
+        fr.add("x1", Column.from_numpy(x))
+        fr.add("x2", Column.from_numpy(rng.standard_normal(n)))
+        fr.add("y", Column.from_numpy(2 * x + rng.normal(0, 0.1, n)))
+        mr = GLM(family="gaussian").train(y="y", training_frame=fr)
+        art = str(tmp_path / "glm_reg")
+        artifact.export_model(mr, art, buckets=[128])
+        t = {"x1": rng.standard_normal(90), "x2": rng.standard_normal(90)}
+        tf = Frame()
+        tf.add("x1", Column.from_numpy(t["x1"]))
+        tf.add("x2", Column.from_numpy(t["x2"]))
+        ref = mr.predict(tf)
+        out = load_artifact(art).score(t)
+        assert np.array_equal(_bits(ref.col("predict").data[:90]),
+                              _bits(out["predict"]))
+        mr.delete()
+
+        fr3 = Frame()
+        fr3.add("x1", Column.from_numpy(x))
+        fr3.add("x2", Column.from_numpy(rng.standard_normal(n)))
+        fr3.add("y", Column.from_numpy(
+            np.array(["r", "s", "t"])[np.clip((x + 1.2).astype(int), 0,
+                                              2)], ctype="enum"))
+        mm = GLM(family="multinomial").train(y="y", training_frame=fr3)
+        art3 = str(tmp_path / "glm_multi")
+        artifact.export_model(mm, art3, buckets=[128])
+        ref3 = mm.predict(tf)
+        out3 = load_artifact(art3).score(t)
+        for lvl in ("r", "s", "t"):
+            assert np.array_equal(_bits(ref3.col(lvl).data[:90]),
+                                  _bits(out3[lvl])), lvl
+        mm.delete()
+
+    def test_glm_artifact_refuses_server_import(self, cl, tmp_path):
+        """GLM artifacts score standalone; the /3/Artifacts import path
+        (which re-hydrates forest models) refuses them with a clear
+        message instead of a KeyError."""
+        from h2o3_tpu import artifact
+        from h2o3_tpu.models.glm import GLM
+
+        fr, _test, _cols, _tn = self._glm_frames(seed=35)
+        m = GLM(family="binomial").train(y="y", training_frame=fr)
+        art = str(tmp_path / "glm_noimp")
+        artifact.export_model(m, art, buckets=[128])
+        with pytest.raises(artifact.ArtifactError, match="standalone"):
+            artifact.load_model(art)
+        m.delete()
+
+    def test_unsupported_glm_shapes_refused(self, cl, tmp_path):
+        from h2o3_tpu import artifact
+        from h2o3_tpu.models.glm import GLM
+
+        fr, _t, _c, _n = self._glm_frames(seed=37)
+        m = GLM(family="binomial", interactions=["x1", "x2"]).train(
+            y="y", training_frame=fr)
+        with pytest.raises(artifact.ArtifactError, match="interaction"):
+            artifact.export_model(m, str(tmp_path / "glm_bad"))
+        m.delete()

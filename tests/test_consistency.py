@@ -236,3 +236,51 @@ def test_fused_paths_never_gather_columns_to_coordinator():
         "the coordinator host (gathered_rows moved) — the sharded data "
         "plane contract is broken")
     assert after["packed_rows"] >= before["packed_rows"] + n + 100
+
+
+def test_multi_entry_flush_is_one_dispatch_per_bucket():
+    """ISSUE-13 guard: a multi-entry micro-batch flush on the sharded
+    path must coalesce into exactly ONE fused dispatch per row bucket
+    (device-side concat of the per-entry shard-packed matrices) with
+    ``gathered_rows`` untouched — the serving tier's
+    one-dispatch-per-flush contract. A regression back to the PR-7
+    per-entry dispatch (or to a host gather) trips this immediately."""
+    import numpy as np
+
+    import h2o3_tpu
+    from h2o3_tpu import scoring
+    from h2o3_tpu.core import sharded_frame
+    from h2o3_tpu.core.frame import Column, Frame
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    h2o3_tpu.init()
+    rng = np.random.default_rng(88)
+    n = 512
+    fr = Frame()
+    x = rng.standard_normal(n)
+    fr.add("x1", Column.from_numpy(x))
+    fr.add("y", Column.from_numpy(
+        np.where(rng.random(n) < 1 / (1 + np.exp(-x)), "Y", "N"),
+        ctype="enum"))
+    model = GBM(ntrees=2, max_depth=2, seed=8).train(
+        y="y", training_frame=fr)
+
+    def score_fr(m, seed):
+        sfr = Frame()
+        sfr.add("x1", Column.from_numpy(
+            np.random.default_rng(seed).standard_normal(m)))
+        return sfr
+
+    sess = scoring.ScoringSession(model)
+    frames = [score_fr(40 + 13 * i, 100 + i) for i in range(4)]
+    sess.predict(frames[0])                 # warm the one bucket involved
+    before = sharded_frame.counters()
+    scoring.reset_dispatch_counters()
+    sess.predict_batch([(f, None, False) for f in frames])
+    dc = scoring.dispatch_counters()
+    after = sharded_frame.counters()
+    assert dc.get("sharded") == 1, (
+        f"a 4-entry flush recorded {dc} fused dispatches — the "
+        "coalesced one-dispatch-per-bucket contract is broken")
+    assert after["gathered_rows"] == before["gathered_rows"], (
+        "the coalesced flush gathered columns to the coordinator host")
