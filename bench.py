@@ -398,11 +398,13 @@ def main():
                 got = score
         else:
             _record("cpu-score", ok=False, error="skipped: deadline")
-        if remaining() > 140:
-            # rapids data-plane metric: fused-vs-eager statement engine —
-            # pure CPU-measurable, so the trajectory gains a data-plane
-            # number even while the device tree stage is dark
-            rap = _stage("cpu-rapids", [py, "-m", "h2o3_tpu.bench"], 130,
+        if remaining() > 160:
+            # rapids data-plane metrics: fused-vs-eager statement engine,
+            # the lazy chained-session ratio (rapids_chained_vs_eager) and
+            # the device sort (rapids_sort_rows_per_sec) — pure
+            # CPU-measurable, so the trajectory gains data-plane numbers
+            # even while the device tree stage is dark
+            rap = _stage("cpu-rapids", [py, "-m", "h2o3_tpu.bench"], 150,
                          env_extra={"PALLAS_AXON_POOL_IPS": "",
                                     "JAX_PLATFORMS": "cpu",
                                     "XLA_FLAGS":

@@ -46,6 +46,10 @@ KNOB_HELPERS = frozenset({
     "h2o3_tpu.core.runtime.OptArgs.from_env",      # boot-time config fold
     "h2o3_tpu.core.sharded_frame.enabled",         # H2O_TPU_SHARDED_PLANE
     "h2o3_tpu.rapids.fusion.enabled",              # H2O_TPU_RAPIDS_FUSION
+    "h2o3_tpu.rapids.planner.enabled",             # H2O_TPU_RAPIDS_LAZY —
+    # reads process_count() too: deferral is deterministically OFF on
+    # multi-process clouds (a coordinator-only flush must never dispatch
+    # unmirrored collectives), so every process takes the same branch
     "h2o3_tpu.scoring.enabled",                    # H2O_TPU_SCORE_FAST —
     # the fused leaf routing (leaf_assignment/staged_proba replay) reads
     # it mirrored; like the sharded-plane switch, the documented contract

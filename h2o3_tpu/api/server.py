@@ -492,6 +492,16 @@ def h_job_cancel(ctx: Ctx):
 # -- rapids -----------------------------------------------------------------
 
 def h_rapids(ctx: Ctx):
+    """POST /99/Rapids — execute (or defer) one statement.
+
+    Lazy-session semantics (rapids/planner.py): a deferrable assignment
+    returns immediately with the temp's key/nrows/ncols — its columns
+    are lazy, so the reply costs no device work. The flush points are
+    (a) any later statement the planner cannot defer, and (b) ANY data
+    access on the temp — `GET /3/Frames/{key}` (the fetch h2o-py issues
+    on frame refresh), CSV export/download, and model builds on the temp
+    all materialize it transparently. `DELETE /4/sessions/{id}` retires
+    the session's whole DAG without computing dead temps."""
     ast = ctx.arg("ast", "")
     sid = str(ctx.arg("session_id", "default"))
     sess = _SESSIONS.setdefault(sid, Session(sid))

@@ -308,8 +308,102 @@ def run_rapids(n_rows: int = 2_000_000, reps: int = 5):
           flush=True)
     print(f"H2O3_BENCH rapids_fused_programs_compiled "
           f"{fc['fused_programs_compiled']}", flush=True)
-    print(f"H2O3_BENCH rapids_gathered_rows {dp['gathered_rows']}",
+
+    # ---- chained-session phase (ISSUE 14): the lazy whole-session DAG
+    # (defer + CSE + dead-temp elimination + inlined intermediates, ONE
+    # flush per pass) A/B'd against full op-at-a-time eager evaluation of
+    # the same statement stream. The chain mirrors a real feature-
+    # engineering session: a shared subexpression (CSE), an overwritten
+    # temp (dead v1), and intermediates that only feed downstream temps
+    # (inlined — never materialized).
+    from h2o3_tpu.rapids import planner
+
+    # the SAME heavy feature chains as the per-statement phase, split
+    # across temps the way a client session actually builds them: eager
+    # pays every prim dispatch plus a Column materialization per temp;
+    # lazy flushes once, inlining the single-consumer intermediates into
+    # one program, CSE-deduplicating the twin, and skipping the dead
+    # overwritten temp entirely. A dedicated 2x frame keeps this phase
+    # bandwidth-bound (the fixed per-flush planning cost amortized), the
+    # regime a production munging session actually runs in.
+    n_chain_rows = n_rows * 2
+    cfr = Frame(key="rapids_chain")
+    ca = rng.standard_normal(n_chain_rows)
+    ca[rng.integers(0, n_chain_rows, n_chain_rows // 50)] = np.nan
+    cfr.add("a", Column.from_numpy(ca))
+    cfr.add("b", Column.from_numpy(rng.standard_normal(n_chain_rows)))
+    cfr.add("c", Column.from_numpy(rng.uniform(0.5, 2.0, n_chain_rows)))
+    cfr.install()
+    CA, CB, CC = ("(cols rapids_chain [0])", "(cols rapids_chain [1])",
+                  "(cols rapids_chain [2])")
+    cclip = f"(ifelse (> {CA} 2) 2 (ifelse (< {CA} -2) -2 {CA}))"
+    cflags = (f"(& (| (> {CB} 0.25) (< {CC} 1)) "
+              f"(& (== (is.na {CA}) 0) (>= {CB} -3)))")
+    cbinned = (f"(ifelse (< {CA} -1) 0 (ifelse (< {CA} 0) 1 "
+               f"(ifelse (< {CA} 1) 2 (ifelse (< {CA} 2) 3 4))))")
+    chain = [
+        f"(tmp= rb_clip {cclip})",
+        f"(tmp= rb_flags {cflags})",
+        f"(tmp= rb_bin {cbinned})",
+        f"(tmp= rb_bin2 {cbinned})",              # CSE twin (both live)
+        "(tmp= rb_t (* rb_clip 2))",              # dead: overwritten next
+        "(tmp= rb_t (+ rb_clip rb_bin))",
+        "(tmp= rb_out (ifelse rb_flags rb_t (- rb_bin2 rb_clip)))",
+        "(rm rb_clip)", "(rm rb_flags)", "(rm rb_t)",
+    ]
+    n_chain_stmts = sum(1 for s in chain if not s.startswith("(rm"))
+
+    def chain_pass(csess):
+        for s in chain:
+            exec_rapids(s, csess)
+        out = exec_rapids("rb_out", csess)
+        out.col(0).data.block_until_ready()
+        for k in ("rb_out", "rb_bin", "rb_bin2"):
+            exec_rapids(f"(rm {k})", csess)
+
+    csess = Session("bench_chain")
+
+    def chain_once(lazy: bool) -> float:
+        with planner.force(lazy), fusion.force(lazy):
+            t0 = time.perf_counter()
+            chain_pass(csess)
+            return time.perf_counter() - t0
+
+    chain_reps = reps + 3
+    chain_rows = n_chain_rows * n_chain_stmts * chain_reps
+    chain_once(False)                     # warm both modes (no compiles
+    chain_once(True)                      # in the measured window)
+    dt_chain_eager = 0.0
+    dt_chain_lazy = 0.0
+    for _ in range(chain_reps):           # interleaved A/B: machine noise
+        dt_chain_eager += chain_once(False)   # hits both modes equally
+        dt_chain_lazy += chain_once(True)
+    csess.end()
+    cfr.delete()
+    chained_rps = chain_rows / dt_chain_lazy
+    print(f"H2O3_BENCH rapids_chained_rows_per_sec {chained_rps}",
           flush=True)
+    print(f"H2O3_BENCH rapids_chained_vs_eager "
+          f"{dt_chain_eager / dt_chain_lazy}", flush=True)
+    lz = planner.counters()
+    print(f"H2O3_BENCH rapids_cse_hits {lz['cse_hits']}", flush=True)
+    print(f"H2O3_BENCH rapids_dead_temps {lz['dead_temps_eliminated']}",
+          flush=True)
+
+    # ---- device sort metric (ISSUE 14): permutation computed, compacted
+    # and applied on device — rows/sec through sort_frame, warm.
+    from h2o3_tpu.ops.sort import sort_frame
+
+    sort_reps = max(reps // 2, 2)
+    sort_frame(fr, ["a"]).col(0).data.block_until_ready()   # warm compile
+    t0 = time.perf_counter()
+    for _ in range(sort_reps):
+        sort_frame(fr, ["a"]).col(0).data.block_until_ready()
+    dt_sort = time.perf_counter() - t0
+    sort_rps = n_rows * sort_reps / dt_sort
+    print(f"H2O3_BENCH rapids_sort_rows_per_sec {sort_rps}", flush=True)
+    print(f"H2O3_BENCH rapids_gathered_rows "
+          f"{sharded_frame.counters()['gathered_rows']}", flush=True)
     sess.end()
     fr.delete()
     return fused_rps, "rapids_fused_rows_per_sec"

@@ -51,6 +51,7 @@ ROW_AXIS = "rows"
 _LOCK = threading.Lock()
 _PACKED = 0
 _GATHERED = 0
+_SORTED = 0
 
 
 def note_packed(n: int) -> None:
@@ -69,16 +70,27 @@ def note_gathered(n: int) -> None:
         _GATHERED += int(n)
 
 
+def note_sorted(n: int) -> None:
+    """Record `n` rows ordered by a device sort whose permutation never
+    crossed to the host (ops/sort.py device paths — the lazy-session PR's
+    'sort stops being the host-keyed path' observable)."""
+    global _SORTED
+    with _LOCK:
+        _SORTED += int(n)
+
+
 def counters() -> dict:
     with _LOCK:
-        return {"packed_rows": _PACKED, "gathered_rows": _GATHERED}
+        return {"packed_rows": _PACKED, "gathered_rows": _GATHERED,
+                "device_sorted_rows": _SORTED}
 
 
 def reset_counters() -> None:
-    global _PACKED, _GATHERED
+    global _PACKED, _GATHERED, _SORTED
     with _LOCK:
         _PACKED = 0
         _GATHERED = 0
+        _SORTED = 0
 
 
 def enabled() -> bool:

@@ -485,6 +485,9 @@ def _install_default_metrics() -> None:
     r.counter_fn("h2o3_data_plane_packed_rows_total",
                  "rows packed shard-locally (no host round-trip)",
                  _dp("packed_rows"))
+    r.counter_fn("h2o3_data_plane_device_sorted_rows_total",
+                 "rows ordered by device sorts whose permutation never "
+                 "crossed to the host", _dp("device_sorted_rows"))
     r.counter_fn("h2o3_data_plane_gathered_rows_total",
                  "rows whose columns were gathered to this host "
                  "(exceptional path)", _dp("gathered_rows"))
@@ -553,6 +556,45 @@ def _install_default_metrics() -> None:
                  _rapids("fused_rows"))
     r.histogram("h2o3_rapids_statement_seconds",
                 "rapids statement wall time over POST /99/Rapids (seconds)")
+
+    # -- lazy-session planner (cross-statement DAG, rapids/planner.py) --
+    def _lazy(field):
+        def fn():
+            from h2o3_tpu.rapids import planner
+
+            return float(planner.counters()[field])
+        return fn
+
+    r.counter_fn("h2o3_rapids_deferred_statements_total",
+                 "statements deferred into session DAGs", _lazy("deferred_statements"))
+    r.counter_fn("h2o3_rapids_flushes_total",
+                 "lazy-session DAG flushes", _lazy("flushes"))
+    r.counter_fn("h2o3_rapids_cse_hits_total",
+                 "deferred statements served from an identical node "
+                 "(common-subexpression elimination)", _lazy("cse_hits"))
+    r.counter_fn("h2o3_rapids_dead_temps_eliminated_total",
+                 "deferred statements never computed (output overwritten "
+                 "or removed before any observation)",
+                 _lazy("dead_temps_eliminated"))
+    r.counter_fn("h2o3_rapids_inlined_intermediates_total",
+                 "deferred intermediates spliced into a consumer's fused "
+                 "program without materializing a Column",
+                 _lazy("inlined_intermediates"))
+    r.counter_fn("h2o3_rapids_fused_sort_selections_total",
+                 "sort+row-slice pairs executed as one windowed gather",
+                 _lazy("fused_sort_selections"))
+    r.gauge_fn("h2o3_rapids_deferred_pending",
+               "deferred statements awaiting flush",
+               _lazy("deferred_pending"))
+
+    def _parse_cache_size():
+        from h2o3_tpu.rapids import parser as rapids_parser
+
+        return float(rapids_parser.parse_cache_stats()["size"])
+
+    r.gauge_fn("h2o3_rapids_parse_cache_entries",
+               "entries in the bounded statement-parse memo "
+               "(H2O_TPU_RAPIDS_PARSE_CACHE)", _parse_cache_size)
 
     def _adm(field):
         def fn():

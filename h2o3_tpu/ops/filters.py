@@ -132,6 +132,25 @@ def take_rows(frame: Frame, rows: np.ndarray, key: Optional[str] = None) -> Fram
     return out
 
 
+def take_order_rows(frame: Frame, order, k: int, offset: int = 0,
+                    key: Optional[str] = None) -> Frame:
+    """Gather `k` rows through a DEVICE index array starting at `offset`
+    — the no-host-round-trip sibling of take_rows: the permutation from a
+    device sort / device join never crosses to the host. `order` may be
+    any length; it is padded (pad slots gather row 0, then re-sentineled
+    by the `idx < k` mask like every other gather) and window-sliced on
+    device."""
+    cl = _cluster()
+    out_len = cl.pad_rows(k)
+    order = jnp.asarray(order).astype(jnp.int32)
+    need = offset + out_len
+    if int(order.shape[0]) < need:
+        order = jnp.pad(order, (0, need - int(order.shape[0])))
+    if offset:
+        order = jax.lax.dynamic_slice_in_dim(order, offset, out_len)
+    return _apply_order(frame, order, k, key=key)
+
+
 def rbind(frames: Sequence[Frame], key: Optional[str] = None) -> Frame:
     """Stack frames by rows (water/rapids/ast/prims/mungers/AstRBind)."""
     cl = _cluster()

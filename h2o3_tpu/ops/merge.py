@@ -29,7 +29,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from h2o3_tpu.core.frame import Column, Frame, T_CAT
-from h2o3_tpu.ops.filters import take_rows
+from h2o3_tpu.ops.filters import take_order_rows, take_rows
 
 def _key_arrays(left: Frame, right: Frame, bx: Sequence[str],
                 by: Sequence[str]):
@@ -148,14 +148,23 @@ def _emit_fn(total: int):
     return jax.jit(run)
 
 
-def _device_pairs(pairs, nl: int, nr: int, all_x: bool, all_y: bool):
+def _matched_pairs(pairs, nl: int, nr: int):
+    """Shared rank -> bound -> count core of the device join. Returns
+    (lo, cnt, order_r, r_matched, total) with everything device-resident
+    except `total` — the one host sync (XLA needs the static output
+    size)."""
     cols = []
     for la, ra in pairs:
         cols.append(la)
         cols.append(ra)
     lo, cnt, order_r, r_matched = _rank_fn(nl, nr, len(pairs))(*cols)
+    total = int(np.asarray(cnt).sum())
+    return lo, cnt, order_r, r_matched, total
+
+
+def _device_pairs(pairs, nl: int, nr: int, all_x: bool, all_y: bool):
+    lo, cnt, order_r, r_matched, total = _matched_pairs(pairs, nl, nr)
     cnt_np = np.asarray(cnt)
-    total = int(cnt_np.sum())             # the one host sync (static size)
     if total:
         l_idx, r_idx = (np.asarray(a) for a in
                         _emit_fn(total)(lo, cnt, order_r))
@@ -231,21 +240,40 @@ def merge(left: Frame, right: Frame, all_x=False, all_y=False,
         raise ValueError("no join columns")
 
     pairs = _key_arrays(left, right, bx, by)
-    if pairs is not None:
+    lrows = rrows = None
+    if pairs is not None and not all_x and not all_y:
+        # inner join: the matched-pair index arrays stay ON DEVICE end to
+        # end (rank -> emit -> gather); no unmatched rows, so the host
+        # mask/patch machinery below has nothing to do
         from h2o3_tpu.core import sharded_frame
 
         sharded_frame.note_packed(left.nrows + right.nrows)
-        lrows, rrows = _device_pairs(pairs, left.nrows, right.nrows,
-                                     all_x, all_y)
+        lo, cnt, order_r, _, total = _matched_pairs(pairs, left.nrows,
+                                                    right.nrows)
+        if total:
+            l_idx, r_idx = _emit_fn(total)(lo, cnt, order_r)
+        else:
+            l_idx = r_idx = np.zeros(0, np.int64)
+        lpart = take_order_rows(left, l_idx, total)
+        rpart = take_order_rows(right, r_idx, total)
     else:
-        lrows, rrows = _host_pairs(left, right, bx, by, all_x, all_y)
+        if pairs is not None:
+            from h2o3_tpu.core import sharded_frame
 
-    lpart = take_rows(left, np.maximum(lrows, 0))
-    rpart = take_rows(right, np.maximum(rrows, 0))
+            sharded_frame.note_packed(left.nrows + right.nrows)
+            lrows, rrows = _device_pairs(pairs, left.nrows, right.nrows,
+                                         all_x, all_y)
+        else:
+            lrows, rrows = _host_pairs(left, right, bx, by, all_x, all_y)
+        lpart = take_rows(left, np.maximum(lrows, 0))
+        rpart = take_rows(right, np.maximum(rrows, 0))
+
+    lneg = lrows is not None and (lrows < 0).any()
+    rneg = rrows is not None and (rrows < 0).any()
     out = Frame()
     for n in left.names:
         col = lpart.col(n)
-        if (lrows < 0).any():
+        if lneg:
             if n in bx and (rrows >= 0).any():
                 # key columns of right-only rows come from the right side
                 col = _patch_keys(col, right.col(by[bx.index(n)]),
@@ -258,7 +286,7 @@ def merge(left: Frame, right: Frame, all_x=False, all_y=False,
             continue
         nm = n if n not in out else n + "_y"
         col = rpart.col(n)
-        if (rrows < 0).any():
+        if rneg:
             col = _mask_rows(col, rrows < 0)
         out.add(nm, col)
     return out

@@ -11,14 +11,14 @@ columns via the shared-gather path (ops/filters.take-style)."""
 from __future__ import annotations
 
 from h2o3_tpu.compat import shard_map as _compat_shard_map
-from typing import List, Sequence, Union
+from typing import List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from h2o3_tpu.core.frame import Frame
-from h2o3_tpu.ops.filters import take_rows
+from h2o3_tpu.ops.filters import take_order_rows, take_rows
 
 
 @jax.jit
@@ -26,6 +26,16 @@ def _order_single(key):
     # NaN (NA + padding) sorts last: replace with +inf
     k = jnp.where(jnp.isnan(key), jnp.inf, key)
     return jnp.argsort(k, stable=True)
+
+
+@jax.jit
+def _compact_order(order, nrows):
+    """Drop pad-row indices from a sorted permutation ON DEVICE (stable:
+    the relative order of kept rows is untouched) — the replacement for
+    the old host-side ``idx[idx < nrows]`` filter that staged the whole
+    permutation on the coordinator."""
+    keep = order < nrows
+    return order[jnp.argsort(~keep, stable=True)]
 
 
 # ---------------------------------------------------------------------------
@@ -118,10 +128,29 @@ def _sample_sort_fn(mesh, n_shard: int, n_samples: int, cap: int):
     return jax.jit(fn)
 
 
-def sample_sort_order(key, nrows: int):
-    """Distributed sample sort of one f32 key column -> host row order.
+@functools.lru_cache(maxsize=8)
+def _sample_compact_fn(total: int):
+    """Device epilogue of the sample sort: drop pad slots (-1 rowids) and
+    beyond-logical rows stably, and report whether the cross-shard
+    ordering invariant ever broke (the ONE scalar the host reads)."""
+    @jax.jit
+    def run(ks, rs, nrows):
+        keep = (rs >= 0) & (rs < nrows)
+        o = jnp.argsort(~keep, stable=True)
+        order = rs[o]
+        kk = jnp.where(keep[o], ks[o], jnp.inf)
+        viol = jnp.any(kk[1:] < kk[:-1])
+        return order, viol
 
-    key: (N,) row-sharded device array. Returns (nrows,) int64 permutation.
+    return run
+
+
+def sample_sort_order(key, nrows: int):
+    """Distributed sample sort of one f32 key column -> DEVICE row order.
+
+    key: (N,) row-sharded device array. Returns an (nrows,) int32 DEVICE
+    permutation (stable); nothing crosses to the host but one boolean
+    sync checking the cross-shard ordering invariant.
     Correctness beats the global argsort path only at multi-shard scale;
     sort_frame picks this path for large sharded frames."""
     from h2o3_tpu.core.runtime import cluster
@@ -142,30 +171,53 @@ def sample_sort_order(key, nrows: int):
 
     rowid = jax.device_put(rowid, NamedSharding(mesh, P("rows")))
     ks, rs = fn(key.astype(jnp.float32), rowid)
-    rs_np = np.asarray(rs)
-    ks_np = np.asarray(ks)
-    # drop pad slots and rows beyond the logical count, preserve global order
-    # across shard boundaries (each shard's received range is sorted; ranges
-    # are ordered by bucket construction)
-    valid = rs_np >= 0
-    order = rs_np[valid]
-    keys = ks_np[valid]
+    ks = ks.reshape(-1)
+    rs = rs.reshape(-1)
     # buckets guarantee cross-shard ordering (shard d holds keys in
-    # (split_{d-1}, split_d], sorted); verify the O(n) invariant and only
-    # fall back to a host sort if it was ever violated
-    if len(keys) > 1 and not (keys[1:] >= keys[:-1]).all():
-        order = order[np.argsort(keys, kind="stable")]
-    return order[order < nrows][:nrows]
+    # (split_{d-1}, split_d], sorted); verify the O(n) invariant on
+    # device and only fall back to a host sort if it was ever violated
+    from h2o3_tpu.core import sharded_frame
+
+    order, viol = _sample_compact_fn(int(rs.shape[0]))(ks, rs,
+                                                       jnp.int32(nrows))
+    if bool(viol):
+        # broken cross-shard invariant: the repair stages keys + rowids
+        # on the host — counted gathered, NOT device-sorted
+        sharded_frame.note_gathered(int(nrows))
+        rs_np = np.asarray(rs)
+        ks_np = np.asarray(ks)
+        valid = (rs_np >= 0) & (rs_np < nrows)
+        o = rs_np[valid][np.argsort(ks_np[valid], kind="stable")]
+        return o[:nrows]
+    sharded_frame.note_sorted(int(nrows))
+    return order[:nrows]
 
 
 SAMPLE_SORT_MIN_ROWS = 250_000      # below this a global argsort wins
 
 
-def sort_frame(frame: Frame, by: Union[str, int, Sequence], ascending=True) -> Frame:
+def sort_frame(frame: Frame, by: Union[str, int, Sequence], ascending=True,
+               rows: Optional[tuple] = None) -> Frame:
+    """Sort `frame` by key columns, entirely on device: the permutation is
+    computed, compacted, and applied without ever crossing to the host
+    (the old path staged the full int permutation on the coordinator).
+
+    `rows=(lo, hi)` is the fused downstream selection the lazy session
+    planner pipes in when the DAG shows a sort feeding one row slice
+    (``h2o.sort(fr).head(k)``): only the selected window of the sorted
+    permutation is gathered — bitwise-identical to slicing the fully
+    materialized sorted frame, at O(hi-lo) gather cost instead of O(n)."""
+    from h2o3_tpu.core import sharded_frame
+
     if isinstance(by, (str, int)):
         by = [by]
     names = [frame.names[b] if isinstance(b, int) else b for b in by]
     asc = ascending if isinstance(ascending, (list, tuple)) else [ascending] * len(names)
+    lo, hi = (0, frame.nrows) if rows is None else (
+        max(0, min(int(rows[0]), frame.nrows)),
+        max(0, min(int(rows[1]), frame.nrows)))
+    hi = max(lo, hi)
+    k = hi - lo
     # single ascending numeric key at scale on a real mesh: sample sort
     if len(names) == 1 and (asc[0] if isinstance(asc, list) else asc):
         from h2o3_tpu.core.runtime import cluster
@@ -174,8 +226,11 @@ def sort_frame(frame: Frame, by: Union[str, int, Sequence], ascending=True) -> F
         c = frame.col(names[0])
         if (cl.n_devices > 1 and frame.nrows >= SAMPLE_SORT_MIN_ROWS
                 and not c.is_categorical and c.data is not None):
+            # sample_sort_order does its own device-sorted/gathered
+            # accounting (its invariant-repair fallback is host-keyed)
             order = sample_sort_order(c.data, frame.nrows)
-            return take_rows(frame, order)
+            sharded_frame.note_packed(int(k))
+            return take_order_rows(frame, order, k, offset=lo)
     # lexicographic: sort by last key first (stable), host-composed device sorts
     order = None
     for name, a in reversed(list(zip(names, asc))):
@@ -190,6 +245,9 @@ def sort_frame(frame: Frame, by: Union[str, int, Sequence], ascending=True) -> F
         else:
             key = jnp.take(key, order)
             order = jnp.take(order, _order_single(key))
-    idx = np.asarray(order)
-    idx = idx[idx < frame.nrows][: frame.nrows]
-    return take_rows(frame, idx)
+    # pad rows (NaN keys) interleave with NA-keyed real rows at the tail:
+    # compact them out on device, exactly like the old host-side filter
+    order = _compact_order(order, jnp.int32(frame.nrows))
+    sharded_frame.note_sorted(int(frame.nrows))
+    sharded_frame.note_packed(int(k))
+    return take_order_rows(frame, order, k, offset=lo)

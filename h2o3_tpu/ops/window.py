@@ -1,0 +1,155 @@
+"""Window functions as device segmented scans.
+
+Reference: water/rapids/ast/prims/mungers/AstRankWithinGroupBy.java — an
+MRTask sort + per-group host walk. The first jax_graft port kept the host
+walk (a python loop over every row); this module is the device-resident
+replacement the lazy-session PR brings in (ROADMAP item 3):
+
+- **one fused program** per (key-count, direction, layout) geometry: a
+  composed stable lexsort (pad flag senior, then group keys, then sort
+  keys with NaN-last sub-keys — exactly ``np.lexsort``'s ordering), then
+  a **segmented scan**: group-change flags -> segment base via a cummax
+  propagation -> rank = running-valid-count minus segment base. No host
+  loop, no column staging; the ranks come back as a row-sharded device
+  column (rows counted ``packed`` on the data-plane counters).
+- NA semantics mirror the host walk bitwise: rows with an NA sort key
+  get an NA rank and do not advance any group's counter; NA *group* keys
+  follow tuple-comparison semantics (every NaN group row is its own
+  group; enum NA codes group together under code -1).
+- ``difflag1`` rides the same module as the one-lag window op: an exact
+  f32 shifted difference over the padded buffer (single-op IEEE rounding
+  equals the host's f64-subtract-then-f32-store bitwise, because stored
+  f32 inputs are exact in f64).
+
+The host loop remains as the string/ragged fallback and counts its rows
+``gathered`` — the same demotion contract as every other device path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Column, Frame, T_CAT, T_INT, T_NUM, T_TIME
+
+_DEV_CTYPES = (T_NUM, T_INT, T_CAT, T_TIME)
+
+
+@functools.lru_cache(maxsize=32)
+def _rank_fn(n_g: int, n_s: int, asc: tuple, padded: int):
+    """(nrows, *gcols, *scols) -> (padded,) f32 ranks (NaN = NA/pad).
+
+    Stable lexsort by composition of stable argsorts (least-significant
+    level first — the textbook np.lexsort equivalence), then the
+    segmented scan described in the module docstring."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(nrows, *cols):
+        g = [c.astype(jnp.float32) for c in cols[:n_g]]
+        s = [c.astype(jnp.float32) for c in cols[n_g:]]
+        idx = jnp.arange(padded)
+        is_pad = idx >= nrows
+        # lexsort levels, MOST significant first. Each NaN-able level is
+        # two sub-levels (nan flag senior, value junior) so NaN sorts
+        # last at that level exactly like np.lexsort, for ascending AND
+        # descending keys (-NaN is still NaN).
+        levels = [is_pad.astype(jnp.int8)]
+        for v in g:
+            levels.append(jnp.isnan(v).astype(jnp.int8))
+            levels.append(jnp.where(jnp.isnan(v), jnp.float32(0), v))
+        for v, a in zip(s, asc):
+            levels.append(jnp.isnan(v).astype(jnp.int8))
+            levels.append(jnp.where(jnp.isnan(v), jnp.float32(0),
+                                    v if a else -v))
+        order = None
+        for k in reversed(levels):
+            if order is None:
+                order = jnp.argsort(k, stable=True)
+            else:
+                order = order[jnp.argsort(k[order], stable=True)]
+        # segment starts: group tuple changed between consecutive sorted
+        # rows. Raw values compare (NaN != NaN -> True), mirroring the
+        # host walk's tuple comparison where every NaN group row is its
+        # own group; the pad flag bounds the final real segment.
+        pad_s = is_pad[order]
+        change = pad_s[1:] != pad_s[:-1]
+        for v in g:
+            vs = v[order]
+            change = change | (vs[1:] != vs[:-1])
+        start = jnp.concatenate([jnp.ones(1, bool), change])
+        # validity: a row ranks only when every sort key is present (the
+        # host walk's `continue`), and pads never rank
+        valid = ~is_pad
+        for v in s:
+            valid = valid & ~jnp.isnan(v)
+        vs_ = valid[order].astype(jnp.float32)
+        c = jnp.cumsum(vs_)
+        # segment base = running valid count just before the segment
+        # start; cummax propagates it (values at starts are
+        # non-decreasing because c is)
+        base = jax.lax.cummax(jnp.where(start, c - vs_, jnp.float32(0)))
+        rank_s = jnp.where(valid[order], c - base, jnp.nan)
+        return jnp.zeros(padded, jnp.float32).at[order].set(rank_s)
+
+    return jax.jit(run)
+
+
+def rank_within_groupby_device(fr: Frame, gidx: Sequence[int],
+                               sidx: Sequence[int],
+                               asc: Sequence[bool]) -> Optional[Column]:
+    """Device segmented-scan rank; None when a key column is host-resident
+    (strings) or layouts disagree — callers fall back to the host walk
+    and count the rows gathered."""
+    import jax.numpy as jnp
+
+    cols = []
+    padded = None
+    for i in list(gidx) + list(sidx):
+        c = fr.col(int(i))
+        if c.ctype not in _DEV_CTYPES:
+            return None
+        d = c.data                        # faults evicted columns back in
+        if d is None:
+            return None
+        if padded is None:
+            padded = int(d.shape[0])
+        elif int(d.shape[0]) != padded:
+            return None                   # ragged layout
+        cols.append(d)
+    if padded is None:
+        return None
+    fn = _rank_fn(len(list(gidx)), len(list(sidx)),
+                  tuple(bool(a) for a in asc), padded)
+    rank = fn(jnp.int32(fr.nrows), *cols)
+    from h2o3_tpu.core import sharded_frame
+
+    sharded_frame.note_packed(int(fr.nrows))
+    return Column.from_device(rank, T_NUM, fr.nrows)
+
+
+@functools.lru_cache(maxsize=8)
+def _diff_fn(padded: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(d):
+        x = d.astype(jnp.float32)
+        return jnp.concatenate([jnp.full(1, jnp.nan, jnp.float32),
+                                x[1:] - x[:-1]])
+
+    return run
+
+
+def difflag1_device(col: Column) -> Optional[Column]:
+    """One-lag difference on device (row 0 = NA). Bitwise-identical to the
+    host f64 walk: stored f32 values are exact in f64, so both paths round
+    the same exact difference once."""
+    d = col.data
+    if d is None:
+        return None
+    out = _diff_fn(int(d.shape[0]))(d)
+    return Column.from_device(out, T_NUM, col.nrows)

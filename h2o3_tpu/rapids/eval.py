@@ -37,6 +37,33 @@ class Session:
         # so an id-keyed map can credit a brand-new Column with a dead
         # Column's leftover refcount and corrupt the rm/end bookkeeping
         self.refcnt: Dict[int, int] = {}     # Column.token -> temp refs
+        self._planner = None                 # lazy-session DAG (planner.py)
+
+    @property
+    def planner(self):
+        """The session's deferred-statement DAG planner, created on first
+        touch (rapids/planner.SessionPlanner)."""
+        if self._planner is None:
+            from h2o3_tpu.rapids.planner import SessionPlanner
+
+            self._planner = SessionPlanner(self)
+        return self._planner
+
+    def pin_columns(self, cols) -> None:
+        """Pin input Columns a deferred statement reads: the refcount
+        keeps rm/end bookkeeping honest while a not-yet-flushed DAG node
+        still needs them (the node also holds hard references, so the
+        buffers cannot be GC'd out from under the flush)."""
+        for c in cols:
+            self.refcnt[c.token] = self.refcnt.get(c.token, 0) + 1
+
+    def unpin_columns(self, cols) -> None:
+        for c in cols:
+            n = self.refcnt.get(c.token, 0) - 1
+            if n <= 0:
+                self.refcnt.pop(c.token, None)
+            else:
+                self.refcnt[c.token] = n
 
     def _track(self, fr: Frame, delta: int):
         for c in fr.columns:
@@ -63,12 +90,19 @@ class Session:
         return self.refcnt.get(col.token, 0)
 
     def remove(self, key: str):
+        if self._planner is not None:
+            # a pending deferred output for this key becomes a dead temp:
+            # the flush will never compute it unless a still-deferred
+            # statement reads it
+            self._planner.note_removed(key)
         old = self.temps.pop(key, None)
         if old is not None:
             self._track(old, -1)
         DKV.remove(key)
 
     def end(self):
+        if self._planner is not None:
+            self._planner.end()      # retire the whole DAG, compute nothing
         for k in list(self.temps):
             self.remove(k)
 
@@ -869,9 +903,15 @@ def _eval_lambda(env: Env, lam, args):
 def exec_rapids(expr: str, session: Optional[Session] = None):
     """Parse + evaluate one Rapids statement (water/rapids/Rapids.exec).
 
-    Fusible chains execute as single XLA programs (rapids/fusion.py);
-    parse/plan/execute child spans land on the active trace (inert when
-    no trace is active — wall-clock only, no device syncs)."""
+    With the lazy session engine on (rapids/planner.py,
+    H2O_TPU_RAPIDS_LAZY), assignment statements the planner can model
+    defer into the session's DAG and return a Frame whose columns
+    materialize on first data access; any statement the planner cannot
+    defer is an observation point that flushes the DAG first, preserving
+    statement order. Fusible chains execute as single XLA programs
+    (rapids/fusion.py); parse/plan/execute child spans land on the
+    active trace (inert when no trace is active — wall-clock only, no
+    device syncs)."""
     from h2o3_tpu.obs import tracing
 
     session = session or Session()
@@ -884,6 +924,9 @@ def exec_rapids(expr: str, session: Optional[Session] = None):
         # StrLit/list at top level (e.g. "frame_id") → lookup
         if isinstance(ast, StrLit):
             return env.lookup(ast.s)
+        got = _planner.offer_statement(ast, env)
+        if got is not _planner._MISS:
+            return got
         with tracing.span("execute"):
             return _eval(ast, env)
     finally:
@@ -896,3 +939,5 @@ from h2o3_tpu.rapids import prims_ext as _prims_ext  # noqa: E402,F401
 # the statement fusion engine (classification registry + planner); imported
 # after the registries are complete so its guard surface sees every prim
 from h2o3_tpu.rapids import fusion as _fusion  # noqa: E402
+# the lazy-session DAG planner (defer/flush across statements)
+from h2o3_tpu.rapids import planner as _planner  # noqa: E402

@@ -99,7 +99,8 @@ _BARRIER_NAMES = {
     ",", ":=", "GB", "append", "assign", "cbind", "colnames=",
     "columnsByType", "cor", "cummax", "cummin", "cumprod", "cumsum",
     "distance", "filterNACols", "getTimeZone", "h2o.fillna", "h2o.impute",
-    "any.factor", "any.na", "is.character", "is.factor", "is.numeric",
+    "any.factor", "any.na", "difflag1", "is.character", "is.factor",
+    "is.numeric",
     "kurtosis", "median", "merge", "model.reset.threshold", "na.omit",
     "ncol", "nlevels", "none", "nrow", "prod", "prod.na", "quantile",
     "rbind", "rename", "rm", "rows", "scale", "setDomain", "setTimeZone",
@@ -107,20 +108,24 @@ _BARRIER_NAMES = {
     "tmp=", "unique", "which.max", "which.min", "x",
     "mean", "sum", "min", "max", "sd", "var", "all", "any", "naCnt",
     "nacnt",
+    # device-resident since the lazy-session PR: segmented-scan ranking
+    # and the device diff (ops/window.py) — host loop only as the counted
+    # ragged/string fallback
+    "rank_within_groupby",
 }
 
 # host-materializing prims — the exceptional path (barrier_fallbacks)
 _HOST_NAMES = {
     "apply", "as.Date", "as.character", "as.factor", "as.numeric",
     "ascharacter", "asfactor", "asnumeric", "countmatches", "cut", "day",
-    "dayOfWeek", "ddply", "difflag1", "dropdup", "entropy", "flatten",
+    "dayOfWeek", "ddply", "dropdup", "entropy", "flatten",
     "getrow", "grep", "grouped_permute", "h2o.mad",
     "h2o.random_stratified_split", "h2o.runif", "h2o.splitframe", "hist",
     "hour", "isax", "kfold_column", "levels", "listTimeZones", "ls",
     "lstrip", "mad", "match", "maxNA", "melt", "millis", "minNA",
     "minute", "mktime", "mode", "modulo_kfold_column", "moment", "month",
     "nchar", "num_valid_substrings", "perfectAUC", "pivot",
-    "rank_within_groupby", "relevel", "rep_len", "replaceall",
+    "relevel", "rep_len", "replaceall",
     "replacefirst", "rstrip", "second", "segment_models_as_frame", "seq",
     "seq_len",
     "setLevel", "signif", "strDistance", "stratified_kfold_column",
@@ -331,6 +336,26 @@ class _Planner:
             raise _NotFusible
         if name not in fr:
             raise _NotFusible
+        return self._frame_leaf(fr, name)
+
+    def _bind_value(self, v) -> Tuple[tuple, bool]:
+        """Resolved Id value -> plan node. Overridable hook: the lazy
+        session planner (rapids/planner.py) splices deferred-temp
+        expression trees here instead of materializing their Columns."""
+        if isinstance(v, Frame):
+            if v.ncols != 1:
+                raise _NotFusible
+            return self._frame_leaf(v, v.names[0]), True
+        if isinstance(v, Column):
+            return self._leaf(v), True
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return self._const(float(v)), False
+        raise _NotFusible
+
+    def _frame_leaf(self, fr: Frame, name: str) -> tuple:
+        """Named-column leaf binding (same overridable hook contract as
+        _bind_value — the lazy planner intercepts pending deferred
+        outputs before their lazy Columns are touched)."""
         return self._leaf(fr.col(name))
 
     # -- recursive build ---------------------------------------------------
@@ -348,15 +373,7 @@ class _Planner:
                 v = self.env.lookup(ast.name)
             except KeyError:
                 raise _NotFusible
-            if isinstance(v, Frame):
-                if v.ncols != 1:
-                    raise _NotFusible
-                return self._leaf(v.col(0)), True
-            if isinstance(v, Column):
-                return self._leaf(v), True
-            if isinstance(v, (int, float)) and not isinstance(v, bool):
-                return self._const(float(v)), False
-            raise _NotFusible
+            return self._bind_value(v)
         if not isinstance(ast, list) or not ast or \
                 not isinstance(ast[0], Id):
             raise _NotFusible
@@ -739,16 +756,37 @@ def _program_for(plan: Plan) -> _Program:
 # execution
 # ---------------------------------------------------------------------------
 
+_CONST_CACHE: Dict[bytes, Any] = {}
+_CONST_LOCK = threading.Lock()
+_CONST_CAP = 1024
+
+
+def _const_arg(v: float):
+    """Device scalar for a traced constant, cached by its f32 bits — a
+    fresh jnp.float32 per dispatch costs a device_put each, which
+    dominated warm fused dispatch on profile (constants repeat across a
+    session's statements; NaN bits key fine as bytes)."""
+    k = np.float32(v).tobytes()
+    a = _CONST_CACHE.get(k)
+    if a is None:
+        import jax.numpy as jnp
+
+        a = jnp.float32(v)
+        with _CONST_LOCK:
+            if len(_CONST_CACHE) >= _CONST_CAP:
+                _CONST_CACHE.pop(next(iter(_CONST_CACHE)))
+            _CONST_CACHE[k] = a
+    return a
+
+
 def _run_program(plan: Plan):
     """Dispatch one program, resolving sub-program leaves first (each is
     its own compiled program; outputs stay device-resident between
     segments)."""
-    import jax.numpy as jnp
-
     prog = _program_for(plan)
     args = [(_run_program(leaf) if isinstance(leaf, Plan) else leaf.data)
             for leaf in plan.leaves]
-    args += [jnp.float32(v) for v in plan.consts]
+    args += [_const_arg(v) for v in plan.consts]
     try:
         out = prog.exe(*args)
     except Exception:   # noqa: BLE001 — AOT layout/placement mismatch
@@ -809,9 +847,18 @@ def note_statement_result(fused_programs_before: int) -> None:
 
 
 def stats() -> dict:
-    """Counters + cache occupancy (the /3/ScoringMetrics `rapids` block)."""
+    """Counters + cache occupancy (the /3/ScoringMetrics `rapids` block):
+    fusion counters, the lazy-session planner's counters (deferral/CSE/
+    dead-temp/inline/sort-fusion), and the bounded statement-parse memo."""
+    from h2o3_tpu.rapids import parser as _parser
+    from h2o3_tpu.rapids import planner as _planner
+
     out = counters()
     with _PROG_LOCK:
         out["cached_programs"] = len(_PROGRAMS)
     out["enabled"] = enabled()
+    lazy = _planner.counters()
+    lazy["enabled"] = _planner.enabled()
+    out["lazy"] = lazy
+    out["parse_cache"] = _parser.parse_cache_stats()
     return out

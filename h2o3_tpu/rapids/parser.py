@@ -12,6 +12,7 @@ float, str wrapped in StrLit, Id for identifiers.
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
 from typing import Any, List
 
@@ -169,11 +170,28 @@ def parse(s: str):
     return ast
 
 
-@functools.lru_cache(maxsize=1024)
+# LRU cap for the statement-parse memo: long-lived serving sessions see an
+# unbounded stream of distinct statement strings (literals differ per
+# request), so the memo must be bounded or it grows without limit. Read
+# once at import (uniform-env contract, like H2O_TPU_HOST_MATRIX_CELLS);
+# occupancy is surfaced on the /3/ScoringMetrics `rapids` block.
+_PARSE_CACHE_CAP = max(
+    int(os.environ.get("H2O_TPU_RAPIDS_PARSE_CACHE", "1024") or 1024), 16)
+
+
+@functools.lru_cache(maxsize=_PARSE_CACHE_CAP)
 def parse_cached(s: str):
     """Memoized :func:`parse` for the statement hot path: h2o-py clients
     re-send the same AST strings constantly (every frame refresh), and the
     evaluator treats parsed ASTs as read-only, so caching by the exact
     expression string is safe. Parse errors are not cached (lru_cache
-    does not memoize raises)."""
+    does not memoize raises). Bounded by H2O_TPU_RAPIDS_PARSE_CACHE
+    entries (LRU eviction)."""
     return parse(s)
+
+
+def parse_cache_stats() -> dict:
+    """Occupancy/effectiveness of the bounded statement-parse memo."""
+    info = parse_cached.cache_info()
+    return {"size": info.currsize, "cap": info.maxsize,
+            "hits": info.hits, "misses": info.misses}

@@ -1,0 +1,942 @@
+"""Lazy whole-session Rapids: the cross-statement DAG planner.
+
+Reference: H2O-3's clients already build lazy expression ASTs client-side
+and only ship them on an observation (h2o-py ExprNode._eager_frame,
+PAPER.md L7) — but the server still executes every shipped statement
+eagerly, materializing a result Column per statement. This module makes
+the SESSION the compilation unit (ROADMAP item 3):
+
+- **Deferral.** ``(tmp= k expr)`` / ``(assign k expr)`` statements whose
+  RHS the fusion engine could plan (fusible elementwise/comparison/
+  logic/ifelse/is.na chains over device columns), plus device ``sort``
+  statements and contiguous row slices over a deferred sort, are
+  recorded as DAG nodes instead of executing. The assigned temp is a
+  real Frame whose columns are **lazy** (``Column.file_backed`` with a
+  planner loader), so nrows/names/types answer without execution and ANY
+  data access — REST frame fetch, CSV export, rollups, a model build —
+  is automatically an observation point that flushes the DAG. Statements
+  the planner cannot defer flush first and then run eagerly, preserving
+  statement order exactly.
+- **SSA bindings + pinning.** Every identifier in a deferred RHS is
+  resolved at defer time and snapshotted on the node (overwriting or
+  ``rm``-ing a temp later cannot change what an already-deferred
+  statement reads — the regression the refcount pin guards), and the
+  concrete input Columns are pinned in the Session's refcounts until the
+  node retires.
+- **Flush planning.** At a flush the planner computes liveness: nodes
+  whose key was overwritten or removed and that no live node depends on
+  are **dead temps** — never computed. A deferred intermediate consumed
+  by exactly one live fused statement is **inlined**: its expression
+  tree splices into the consumer's fused program as a traced
+  intermediate (no Column ever materializes), bitwise-identical by the
+  fusion emitter's shared-expression + rewrite-edge-split contract.
+  Structurally identical live nodes are **CSE-deduplicated** (one
+  program execution, one Column, counted ``cse_hits``). A device sort
+  whose only live consumer is a row slice executes as one fused
+  sort+selection (``ops/sort.sort_frame(rows=(lo, hi))``): only the
+  selected window of the sorted permutation is gathered.
+- **Caching.** Fused flush programs ride the PR-9 signature cache + the
+  PR-6 persistent compile cache and the PR-12 compile ledger unchanged
+  (family ``rapids``) — a warm session flushes with zero XLA compiles.
+- **Fallbacks.** Any node whose fused plan fails (ragged layout, evicted
+  host column) replays its recorded AST through the eager evaluator over
+  its snapshotted bindings — the same statement-order semantics, so lazy
+  results are bitwise-identical to eager evaluation by construction.
+  Multi-process clouds stay eager: a flush triggered by a
+  coordinator-only REST fetch would dispatch collectives the followers
+  never join (the PR-5/PR-7 mirrored-program invariant), so
+  ``enabled()`` deterministically reports False there.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Column, Frame, T_CAT, T_NUM
+from h2o3_tpu.ops import elementwise as E
+from h2o3_tpu.rapids import fusion
+from h2o3_tpu.rapids.parser import Id, NumList, Span, StrLit, StrList
+
+_MISS = object()
+
+# ---------------------------------------------------------------------------
+# enable / force switches (same contract as fusion.enabled / fusion.force)
+# ---------------------------------------------------------------------------
+
+_FORCE: Optional[bool] = None
+
+
+def enabled() -> bool:
+    """Master switch for lazy-session deferral (H2O_TPU_RAPIDS_LAZY,
+    default on). Deterministically OFF on multi-process clouds: a flush
+    can be triggered by a coordinator-only observation (REST frame
+    fetch), and its device programs must not run unmirrored around
+    shared collectives."""
+    if _FORCE is not None:
+        return _FORCE
+    import jax
+
+    if jax.process_count() > 1:
+        return False
+    return os.environ.get("H2O_TPU_RAPIDS_LAZY", "1").lower() not in (
+        "0", "false", "off")
+
+
+class force:
+    """Context manager pinning deferral on/off regardless of the env knob
+    (bench A/B runs and the equivalence suite)."""
+
+    def __init__(self, on: bool):
+        self._on = bool(on)
+        self._prev: Optional[bool] = None
+
+    def __enter__(self):
+        global _FORCE
+        self._prev = _FORCE
+        _FORCE = self._on
+        return self
+
+    def __exit__(self, *exc):
+        global _FORCE
+        _FORCE = self._prev
+        return False
+
+
+# ---------------------------------------------------------------------------
+# counters (surfaced on the /3/ScoringMetrics `rapids` block + /3/Metrics)
+# ---------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+_COUNTS = {
+    "deferred_statements": 0,      # statements recorded as DAG nodes
+    "flushes": 0,                  # DAG flushes (>= 1 node processed)
+    "cse_hits": 0,                 # nodes served from an identical node
+    "dead_temps_eliminated": 0,    # nodes never computed (unobservable)
+    "inlined_intermediates": 0,    # nodes spliced into consumers' programs
+    "fused_sort_selections": 0,    # sort+slice pairs run as one window
+    "eager_replays": 0,            # nodes replayed through the evaluator
+}
+_PENDING = 0                       # deferred statements awaiting flush
+
+
+def _bump(key: str, n: int = 1) -> None:
+    with _LOCK:
+        _COUNTS[key] += int(n)
+
+
+def _pending_add(n: int) -> None:
+    global _PENDING
+    with _LOCK:
+        _PENDING += int(n)
+
+
+def counters() -> dict:
+    with _LOCK:
+        out = dict(_COUNTS)
+        out["deferred_pending"] = _PENDING
+        return out
+
+
+def reset_counters() -> None:
+    global _PENDING
+    with _LOCK:
+        for k in _COUNTS:
+            _COUNTS[k] = 0
+        _PENDING = 0
+
+
+class _NotDeferrable(Exception):
+    """Internal: this statement must flush + run eagerly."""
+
+
+# ---------------------------------------------------------------------------
+# DAG nodes + lazy frames
+# ---------------------------------------------------------------------------
+
+class _Node:
+    __slots__ = ("kind", "key", "ast", "bindings", "deps", "out",
+                 "out_cols", "out_names", "output_dead", "state", "seq",
+                 "by", "asc", "src_frame", "src", "lo", "hi", "nrows",
+                 "pinned")
+
+    def __init__(self, kind: str):
+        self.kind = kind               # "expr" | "sort" | "slice"
+        self.key: Optional[str] = None
+        self.ast = None
+        self.bindings: Dict[str, Any] = {}
+        self.deps: List["_Node"] = []
+        self.out: Optional[Frame] = None
+        self.out_cols: List[Column] = []
+        self.out_names: List[str] = []
+        self.output_dead = False
+        self.state = "pending"         # pending -> done
+        self.seq = 0
+        self.by = None                 # sort: key names
+        self.asc = True                # sort: direction(s)
+        self.src_frame: Optional[Frame] = None   # sort: input frame
+        self.src: Optional["_Node"] = None       # slice: the sort node
+        self.lo = 0                    # slice window
+        self.hi = 0
+        self.nrows = 0
+        self.pinned: List[Column] = []
+
+
+class DeferredFrame(Frame):
+    """Pending output of a deferred statement: a normal Frame whose lazy
+    Columns materialize (via the owning planner) on first data access —
+    which makes every data-touching surface an observation point with no
+    call-site changes."""
+
+    def __init__(self, node: _Node, key: Optional[str] = None):
+        super().__init__(key=key)
+        self._node = node
+
+    def __repr__(self) -> str:
+        return (f"<DeferredFrame {self._key} {self.nrows}x{self.ncols} "
+                f"{self._node.kind}:{self._node.state}>")
+
+
+def _lazy_column(planner: "SessionPlanner", node: _Node, ctype: str,
+                 nrows: int, domain=None) -> Column:
+    holder: Dict[str, Column] = {}
+
+    def _load():
+        planner.observe(node)
+        col = holder["col"]
+        if col._data is None:
+            raise RuntimeError(
+                f"deferred rapids node #{node.seq} ({node.kind}) failed "
+                "to materialize")
+        # ensure() bound the device buffer via the data setter; the
+        # getter re-checks and never uses this return value
+        return None
+
+    col = Column.file_backed(_load, ctype, nrows, domain=domain)
+    holder["col"] = col
+    return col
+
+
+# ---------------------------------------------------------------------------
+# deferral scanning — mirrors the eager evaluator's accepted shapes so any
+# statement the eager path would REJECT (bad arity, unknown column, row
+# mismatch) is never deferred: its error surfaces at the original statement
+# ---------------------------------------------------------------------------
+
+class _Scan:
+    __slots__ = ("bindings", "deps", "_dep_ids", "nrows", "cols")
+
+    def __init__(self):
+        self.bindings: Dict[str, Any] = {}
+        self.deps: List[_Node] = []
+        self._dep_ids: set = set()
+        self.nrows: Optional[int] = None
+        self.cols: List[Column] = []   # concrete columns (to pin)
+
+
+class _SnapEnv:
+    """Env over a node's SSA binding snapshot (defer-time resolution)."""
+
+    __slots__ = ("b",)
+
+    def __init__(self, bindings: Dict[str, Any]):
+        self.b = bindings
+
+    def lookup(self, name: str):
+        if name in self.b:
+            return self.b[name]
+        raise KeyError(name)
+
+
+class SessionPlanner:
+    """Per-Session deferred-statement DAG (see module docstring)."""
+
+    def __init__(self, session):
+        self.session = session
+        self._lock = threading.RLock()
+        self._nodes: List[_Node] = []
+        self._by_key: Dict[str, _Node] = {}
+        self._by_token: Dict[int, _Node] = {}
+        self._cse: Dict[tuple, Column] = {}
+        self._seq = 0
+        self._flushing = False
+
+    # -- lookup ------------------------------------------------------------
+    def node_for_token(self, tok: int) -> Optional[_Node]:
+        return self._by_token.get(tok)
+
+    def node_for_frame(self, fr: Frame) -> Optional[_Node]:
+        """The single pending node ALL of fr's columns belong to."""
+        node = None
+        for c in fr.columns:
+            n = self._by_token.get(c.token)
+            if n is None or n.state != "pending" or \
+                    (node is not None and n is not node):
+                return None
+            node = n
+        return node
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._nodes)
+
+    # -- statement entry ---------------------------------------------------
+    def offer(self, ast, env):
+        """Returns the statement result when deferred, else _MISS after
+        flushing any pending DAG (the statement is an observation point —
+        except `rm`, which only retires)."""
+        from h2o3_tpu.obs import tracing
+
+        with self._lock:
+            if enabled():
+                try:
+                    got = self._try_defer(ast, env)
+                except _NotDeferrable:
+                    got = _MISS
+                if got is not _MISS:
+                    return got
+            if self._is_rm(ast):
+                return _MISS       # retirement rides Session.remove
+            if self._is_assign(ast):
+                # the key WILL be rebound; its pending node (if any) is
+                # observable only through still-deferred readers
+                k = self._assign_key(ast)
+                old = self._by_key.pop(k, None)
+                if old is not None:
+                    old.output_dead = True
+            if self._nodes:
+                with tracing.span("flush", reason="statement"):
+                    self.flush()
+            return _MISS
+
+    @staticmethod
+    def _is_assign(ast) -> bool:
+        return (isinstance(ast, list) and len(ast) == 3
+                and isinstance(ast[0], Id)
+                and ast[0].name in ("tmp=", "assign"))
+
+    @staticmethod
+    def _assign_key(ast) -> str:
+        k = ast[1]
+        return k.name if isinstance(k, Id) else str(k)
+
+    @staticmethod
+    def _is_rm(ast) -> bool:
+        return (isinstance(ast, list) and len(ast) == 2
+                and isinstance(ast[0], Id) and ast[0].name == "rm")
+
+    # -- deferral ----------------------------------------------------------
+    def _try_defer(self, ast, env):
+        if not self._is_assign(ast):
+            # bare statements hand their result straight back to the
+            # caller — deferring buys nothing and would skew the eager
+            # counter contracts; chaining happens through temps
+            return _MISS
+        key = self._assign_key(ast)
+        rhs = ast[2]
+        if not (isinstance(rhs, list) and rhs and isinstance(rhs[0], Id)):
+            return _MISS
+        head = rhs[0].name
+        if head == "sort":
+            node = self._scan_sort(rhs, env)
+        elif head == "rows":
+            node = self._scan_slice(rhs, env)
+        elif head in fusion.ROOT_OPS:
+            node = self._scan_expr_node(rhs, env)
+        else:
+            return _MISS
+        self._seq += 1
+        node.seq = self._seq
+        node.key = key
+        old = self._by_key.get(key)
+        if old is not None:
+            old.output_dead = True
+        self._by_key[key] = node
+        self._nodes.append(node)
+        for c in node.out_cols:
+            self._by_token[c.token] = node
+        self.session.pin_columns(node.pinned)
+        _bump("deferred_statements")
+        _pending_add(1)
+        return self.session.assign(key, node.out)
+
+    def _bind_name(self, name: str, env, sc: _Scan):
+        if name in sc.bindings:
+            return sc.bindings[name]
+        try:
+            v = env.lookup(name)
+        except KeyError:
+            raise _NotDeferrable
+        sc.bindings[name] = v
+        return v
+
+    def _note_col(self, col: Column, sc: _Scan) -> None:
+        if col.ctype not in fusion._LEAF_CTYPES:
+            raise _NotDeferrable
+        if sc.nrows is None:
+            sc.nrows = col.nrows
+        elif sc.nrows != col.nrows:
+            raise _NotDeferrable       # eager would raise a row mismatch
+        node = self._by_token.get(col.token)
+        if node is not None and node.state == "pending":
+            if id(node) not in sc._dep_ids:
+                sc._dep_ids.add(id(node))
+                sc.deps.append(node)
+        else:
+            sc.cols.append(col)
+
+    def _scan_expr(self, ast, env, sc: _Scan) -> bool:
+        """-> is_col; raises _NotDeferrable on any shape the fusion
+        planner (or the eager evaluator) would not accept."""
+        if isinstance(ast, bool):
+            raise _NotDeferrable
+        if isinstance(ast, (int, float)):
+            return False
+        if isinstance(ast, Id):
+            v = self._bind_name(ast.name, env, sc)
+            if isinstance(v, Frame):
+                if v.ncols != 1:
+                    raise _NotDeferrable
+                self._note_col(v.col(0), sc)
+                return True
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                return False
+            raise _NotDeferrable
+        if not isinstance(ast, list) or not ast or \
+                not isinstance(ast[0], Id):
+            raise _NotDeferrable
+        name = ast[0].name
+        if name in ("cols", "cols_py"):
+            if len(ast) != 3 or not isinstance(ast[1], Id):
+                raise _NotDeferrable
+            fr = self._bind_name(ast[1].name, env, sc)
+            if not isinstance(fr, Frame):
+                raise _NotDeferrable
+            cname = _cols_sel_name(fr, ast[2])
+            self._note_col(fr.col(cname), sc)
+            return True
+        if name in fusion._BIN_NAMES:
+            if len(ast) != 3:
+                raise _NotDeferrable
+            l = self._scan_expr(ast[1], env, sc)
+            r = self._scan_expr(ast[2], env, sc)
+            return l or r
+        if name in fusion._LOGICAL_NAMES:
+            if len(ast) != 3:
+                raise _NotDeferrable
+            l = self._scan_expr(ast[1], env, sc)
+            r = self._scan_expr(ast[2], env, sc)
+            if not (l or r):
+                raise _NotDeferrable
+            return True
+        if name in E._UNOPS:
+            if len(ast) != 2 or not self._scan_expr(ast[1], env, sc):
+                raise _NotDeferrable
+            return True
+        if name == "ifelse":
+            if len(ast) != 4 or not self._scan_expr(ast[1], env, sc):
+                raise _NotDeferrable
+            self._scan_expr(ast[2], env, sc)
+            self._scan_expr(ast[3], env, sc)
+            return True
+        if name == "is.na":
+            if len(ast) != 2 or not self._scan_expr(ast[1], env, sc):
+                raise _NotDeferrable
+            return True
+        raise _NotDeferrable
+
+    def _scan_expr_node(self, rhs, env) -> _Node:
+        sc = _Scan()
+        if not self._scan_expr(rhs, env, sc) or sc.nrows is None:
+            raise _NotDeferrable
+        node = _Node("expr")
+        node.ast = rhs
+        node.bindings = sc.bindings
+        node.deps = sc.deps
+        node.nrows = sc.nrows
+        node.pinned = sc.cols
+        col = _lazy_column(self, node, T_NUM, sc.nrows)
+        name = _expr_out_name(rhs)
+        df = DeferredFrame(node)
+        df.add(name, col)
+        node.out = df
+        node.out_cols = [col]
+        node.out_names = [name]
+        return node
+
+    def _scan_sort(self, rhs, env) -> _Node:
+        # (sort fr by asc...) — device-only: every column rides a lazy
+        # device Column, so host-resident (string) frames stay eager
+        if len(rhs) < 3 or not isinstance(rhs[1], Id):
+            raise _NotDeferrable
+        sc = _Scan()
+        fr = self._bind_name(rhs[1].name, env, sc)
+        if not isinstance(fr, Frame) or not fr.ncols:
+            raise _NotDeferrable
+        by = _sort_by_names(fr, rhs[2])
+        asc = _sort_ascending(rhs[3:])
+        for c in fr.columns:
+            self._note_col(c, sc)
+        node = _Node("sort")
+        node.ast = rhs
+        node.bindings = sc.bindings
+        node.deps = sc.deps
+        node.nrows = fr.nrows
+        node.pinned = sc.cols
+        node.by = by
+        node.asc = asc
+        node.src_frame = fr
+        df = DeferredFrame(node)
+        for nm in fr.names:
+            c = fr.col(nm)
+            lc = _lazy_column(self, node, c.ctype, fr.nrows,
+                              domain=c.domain)
+            df.add(nm, lc)
+            node.out_cols.append(lc)
+            node.out_names.append(nm)
+        node.out = df
+        return node
+
+    def _scan_slice(self, rhs, env) -> _Node:
+        # (rows s [lo:hi]) over a DEFERRED sort — the pair the planner
+        # fuses into one windowed sort+selection
+        if len(rhs) != 3 or not isinstance(rhs[1], Id):
+            raise _NotDeferrable
+        sc = _Scan()
+        fr = self._bind_name(rhs[1].name, env, sc)
+        if not isinstance(fr, Frame) or not fr.ncols:
+            raise _NotDeferrable
+        src = self.node_for_frame(fr)
+        if src is None or src.kind != "sort":
+            raise _NotDeferrable
+        sel = rhs[2]
+        if not isinstance(sel, NumList):
+            raise _NotDeferrable
+        from h2o3_tpu.rapids.eval import _idx_list
+
+        idx = _idx_list(sel, fr.nrows)
+        if not len(idx) or idx[0] < 0 or \
+                not np.array_equal(idx, np.arange(idx[0], idx[-1] + 1)):
+            raise _NotDeferrable
+        n = fr.nrows
+        lo = max(0, min(int(idx[0]), n))
+        hi = max(lo, min(int(idx[-1]) + 1, n))
+        node = _Node("slice")
+        node.ast = rhs
+        node.bindings = sc.bindings
+        node.deps = [src]
+        node.src = src
+        node.lo = lo
+        node.hi = hi
+        node.nrows = hi - lo
+        df = DeferredFrame(node)
+        for nm in fr.names:
+            c = fr.col(nm)
+            lc = _lazy_column(self, node, c.ctype, node.nrows,
+                              domain=c.domain)
+            df.add(nm, lc)
+            node.out_cols.append(lc)
+            node.out_names.append(nm)
+        node.out = df
+        return node
+
+    # -- session hooks -----------------------------------------------------
+    def note_removed(self, key: str) -> None:
+        with self._lock:
+            n = self._by_key.pop(key, None)
+            if n is not None:
+                n.output_dead = True
+
+    def end(self) -> None:
+        """Session teardown: every pending output is unobservable —
+        retire the whole DAG without computing anything."""
+        with self._lock:
+            nodes = self._nodes
+            for n in nodes:
+                n.output_dead = True
+            dead = [n for n in nodes if n.state == "pending"]
+            _bump("dead_temps_eliminated", len(dead))
+            self._retire(nodes)
+
+    # -- flush -------------------------------------------------------------
+    def flush(self, target: Optional[_Node] = None) -> None:
+        """Observation point: plan the deferred DAG (liveness, CSE,
+        inlining, sort+selection fusion) and execute what is observable,
+        in statement order."""
+        with self._lock:
+            nodes = list(self._nodes)
+            if not nodes:
+                return
+            _bump("flushes")
+            needed = self._needed(nodes, target)
+            consumers: Dict[int, set] = {}
+            for n in nodes:
+                if id(n) not in needed:
+                    continue
+                for d in n.deps:
+                    consumers.setdefault(id(d), set()).add(id(n))
+            by_id = {id(n): n for n in nodes}
+            inline: set = set()
+            slice_fused: set = set()
+            for n in nodes:
+                if id(n) not in needed or not n.output_dead:
+                    continue
+                cons = consumers.get(id(n), set())
+                if len(cons) != 1:
+                    continue
+                consumer = by_id.get(next(iter(cons)))
+                if consumer is None:
+                    continue
+                # expr inlining only pays off inside a FUSED consumer
+                # program; with fusion off every consumer eager-replays,
+                # which needs its deps materialized anyway
+                if n.kind == "expr" and consumer.kind == "expr" and \
+                        fusion.enabled():
+                    inline.add(id(n))
+                elif n.kind == "sort" and consumer.kind == "slice":
+                    slice_fused.add(id(consumer))
+            self._flushing = True
+            try:
+                for n in nodes:
+                    if id(n) not in needed or id(n) in inline:
+                        continue
+                    if n.kind == "sort" and self._sort_is_fused(
+                            n, consumers, by_id, slice_fused):
+                        continue
+                    self._materialize(n, inline, slice_fused)
+            finally:
+                self._flushing = False
+            _bump("inlined_intermediates", len(inline))
+            dead = [n for n in nodes if id(n) not in needed]
+            _bump("dead_temps_eliminated", len(dead))
+            self._retire(nodes)
+
+    @staticmethod
+    def _sort_is_fused(n: _Node, consumers, by_id, slice_fused) -> bool:
+        cons = consumers.get(id(n), set())
+        return (len(cons) == 1 and next(iter(cons)) in slice_fused)
+
+    @staticmethod
+    def _needed(nodes: List[_Node], target: Optional[_Node]) -> set:
+        roots = [n for n in nodes if not n.output_dead]
+        if target is not None and target.state == "pending":
+            roots.append(target)
+        needed: set = set()
+        stack = list(roots)
+        while stack:
+            n = stack.pop()
+            if id(n) in needed:
+                continue
+            needed.add(id(n))
+            stack.extend(d for d in n.deps if d.state == "pending")
+        return needed
+
+    def _retire(self, nodes: List[_Node]) -> None:
+        for n in nodes:
+            self.session.unpin_columns(n.pinned)
+            n.pinned = []
+            for c in n.out_cols:
+                if self._by_token.get(c.token) is n:
+                    self._by_token.pop(c.token)
+            if n.key is not None and self._by_key.get(n.key) is n:
+                self._by_key.pop(n.key)
+        _pending_add(-len(nodes))
+        self._nodes = []
+        self._cse.clear()
+
+    # -- materialization ---------------------------------------------------
+    def observe(self, node: _Node) -> None:
+        """A lazy Column of `node` was touched: this is an observation
+        point. Flush the CURRENT epoch with full planning (liveness /
+        CSE / inlining / sort+selection fusion) when the node belongs to
+        it; a retired straggler (dead-eliminated earlier, observed now)
+        materializes alone from its recorded recipe."""
+        from h2o3_tpu.obs import tracing
+
+        with self._lock:
+            if node.state == "done":
+                return
+            if not self._flushing and any(n is node for n in self._nodes):
+                with tracing.span("flush", reason="data_access"):
+                    self.flush(target=node)
+            if node.state != "done":
+                # mid-flush re-entry (an eager replay touching a lazy
+                # leaf) or a retired straggler: materialize directly —
+                # re-entering flush() here would loop on its inline set
+                self.ensure(node)
+
+    def ensure(self, node: _Node) -> None:
+        """Idempotent on-demand materialization (lazy-Column loaders and
+        cross-epoch stragglers: a dead-eliminated node observed later
+        still computes, from its own recorded recipe)."""
+        with self._lock:
+            if node.state == "done":
+                return
+            self._materialize(node, frozenset(), frozenset())
+
+    def _materialize(self, node: _Node, inline: set,
+                     slice_fused: set) -> None:
+        if node.state == "done":
+            return
+        if node.kind == "slice" and id(node) in slice_fused and \
+                node.src is not None and node.src.state == "pending":
+            for d in node.src.deps:
+                self.ensure(d)
+            self._mat_slice_fused(node)
+            node.state = "done"
+            return
+        for d in node.deps:
+            if id(d) not in inline:
+                self.ensure(d)
+        if node.kind == "expr":
+            self._mat_expr(node, inline)
+        elif node.kind == "sort":
+            self._mat_sort(node)
+        else:
+            self.ensure(node.src)
+            self._mat_slice(node)
+        node.state = "done"
+
+    def _mat_expr(self, node: _Node, inline: set) -> None:
+        col: Optional[Column] = None
+        if fusion.enabled():
+            plan = self._build_plan(node, inline)
+            if plan is not None:
+                ck = _cse_key(plan)
+                col = self._cse.get(ck)
+                if col is not None:
+                    _bump("cse_hits")
+                else:
+                    try:
+                        col = fusion.execute_plan(plan)
+                    except Exception:   # noqa: BLE001 — eager is the
+                        col = None      # contract, never fail a flush
+                    if col is not None:
+                        self._cse[ck] = col
+        if col is None:
+            # eager replay touches dep columns directly — every dep must
+            # be materialized first, INCLUDING inline-marked ones (whose
+            # consumer-side fused plan never happened), or the lazy-leaf
+            # loader would re-enter the flush
+            for d in node.deps:
+                self.ensure(d)
+            col = self._eager_col(node)
+            _bump("eager_replays")
+        node.out_cols[0].data = col.data
+
+    def _build_plan(self, node: _Node, inline: set):
+        pl = _LazyPlanner(_SnapEnv(node.bindings), self, inline)
+        try:
+            root, is_col = pl.build(node.ast)
+        except fusion._NotFusible:
+            return None
+        p = pl.plan
+        if not is_col or p.padded is None or p.n_ops == 0:
+            return None
+        p.root = root
+        p.out_name = fusion._out_name(root)
+        fusion._split_rewrite_edges(p)
+        fusion._finish_signature(p)
+        return p
+
+    def _eager_col(self, node: _Node) -> Column:
+        from h2o3_tpu.rapids import eval as _ev
+
+        env = _ev.Env(self.session)
+        env.vars.update(node.bindings)
+        res = _ev._eval(node.ast, env)
+        return res if isinstance(res, Column) else _ev._one_col(res)
+
+    def _mat_sort(self, node: _Node) -> None:
+        from h2o3_tpu.ops.sort import sort_frame
+
+        res = sort_frame(node.src_frame, node.by, ascending=node.asc)
+        self._fill(node, res)
+
+    def _mat_slice(self, node: _Node) -> None:
+        from h2o3_tpu.ops.filters import slice_rows
+
+        res = slice_rows(node.src.out, node.lo, node.hi)
+        self._fill(node, res)
+
+    def _mat_slice_fused(self, node: _Node) -> None:
+        from h2o3_tpu.ops.sort import sort_frame
+
+        src = node.src
+        res = sort_frame(src.src_frame, src.by, ascending=src.asc,
+                         rows=(node.lo, node.hi))
+        _bump("fused_sort_selections")
+        self._fill(node, res)
+
+    @staticmethod
+    def _fill(node: _Node, res: Frame) -> None:
+        for lc, nm in zip(node.out_cols, node.out_names):
+            src = res.col(nm)
+            if src.data is None:
+                raise RuntimeError(
+                    f"deferred {node.kind} produced a host column {nm!r}")
+            lc.data = src.data
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"pending": len(self._nodes)}
+
+
+# ---------------------------------------------------------------------------
+# fused planning over deferred leaves
+# ---------------------------------------------------------------------------
+
+class _LazyPlanner(fusion._Planner):
+    """fusion._Planner that resolves PENDING deferred outputs: inlined
+    deps splice their expression trees (traced intermediates — no Column
+    materializes); everything else is ensured and bound as a leaf."""
+
+    def __init__(self, env, planner: SessionPlanner, inline: set):
+        super().__init__(env)
+        self._lazy = planner
+        self._inline = inline
+
+    def _bind_value(self, v):
+        if isinstance(v, Frame) and v.ncols == 1:
+            col = v.col(0)
+            node = self._lazy.node_for_token(col.token)
+            if node is not None and node.state == "pending":
+                return self._pending(node, col), True
+        return super()._bind_value(v)
+
+    def _frame_leaf(self, fr, name):
+        col = fr.col(name)
+        node = self._lazy.node_for_token(col.token)
+        if node is not None and node.state == "pending":
+            return self._pending(node, col)
+        return super()._frame_leaf(fr, name)
+
+    def _pending(self, node: _Node, col: Column):
+        if id(node) in self._inline and node.kind == "expr":
+            env0 = self.env
+            self.env = _SnapEnv(node.bindings)
+            try:
+                n, is_col = self.build(node.ast)
+            finally:
+                self.env = env0
+            if not is_col:
+                raise fusion._NotFusible
+            return n
+        self._lazy.ensure(node)
+        return self._leaf(col)
+
+
+def _cse_key(plan) -> tuple:
+    """Value-level identity of a fused plan: program signature (structure
+    × dtypes × rows bucket) + concrete leaf Column tokens + constant
+    VALUES (constants are traced in the program cache, but CSE needs
+    value equality)."""
+    leaves = tuple(("P",) + _cse_key(l) if isinstance(l, fusion.Plan)
+                   else ("C", l.token) for l in plan.leaves)
+    return (plan.signature, leaves, tuple(plan.consts))
+
+
+# ---------------------------------------------------------------------------
+# scan helpers
+# ---------------------------------------------------------------------------
+
+def _expr_out_name(ast) -> str:
+    name = ast[0].name
+    if name in fusion._BIN_NAMES or name in fusion._LOGICAL_NAMES:
+        return fusion._OP_ALIAS.get(name, name)
+    if name in E._UNOPS:
+        return name
+    if name == "is.na":
+        return "isNA"
+    return "C1"
+
+
+def _cols_sel_name(fr: Frame, sel) -> str:
+    """Single-column (cols fr sel) selector -> column name; mirrors
+    fusion._Planner._leaf_from_cols exactly."""
+    if isinstance(sel, StrLit):
+        name = sel.s
+    elif isinstance(sel, StrList) and len(sel) == 1:
+        name = sel[0]
+    elif (isinstance(sel, NumList) and len(sel) == 1
+          and not isinstance(sel[0], Span)):
+        i = int(sel[0])
+        if not 0 <= i < fr.ncols:
+            raise _NotDeferrable
+        name = fr.names[i]
+    elif isinstance(sel, (int, float)) and not isinstance(sel, bool):
+        i = int(sel)
+        if not 0 <= i < fr.ncols:
+            raise _NotDeferrable
+        name = fr.names[i]
+    else:
+        raise _NotDeferrable
+    if name not in fr:
+        raise _NotDeferrable
+    return name
+
+
+def _sort_by_names(fr: Frame, by) -> List[str]:
+    """Mirror of the eager sort prim's names_of, restricted to the shapes
+    the planner can verify statically (anything else stays eager)."""
+    from h2o3_tpu.rapids.eval import _idx_list
+
+    if isinstance(by, str):
+        names = [by]
+    elif isinstance(by, StrLit):
+        names = [by.s]
+    elif isinstance(by, (int, float)) and not isinstance(by, bool):
+        i = int(by)
+        if not 0 <= i < fr.ncols:
+            raise _NotDeferrable
+        names = [fr.names[i]]
+    elif isinstance(by, StrList):
+        names = [s.s if isinstance(s, StrLit) else s for s in by]
+    elif isinstance(by, NumList):
+        try:
+            names = [fr.names[i] for i in _idx_list(by, fr.ncols)]
+        except IndexError:
+            raise _NotDeferrable
+    else:
+        raise _NotDeferrable
+    if not names or any(n not in fr for n in names):
+        raise _NotDeferrable
+    return names
+
+
+def _sort_ascending(rest):
+    """Mirror of the eager sort prim's direction parsing (only the first
+    direction argument is consulted; 1 = asc, <= 0 = desc)."""
+    if not rest:
+        return True
+    a0 = rest[0]
+    items = a0 if isinstance(a0, (list, NumList)) else [a0]
+    asc = []
+    for a in items:
+        if not isinstance(a, (int, float)) or isinstance(a, bool):
+            raise _NotDeferrable
+        asc.append(int(a) > 0)
+    return asc
+
+
+# ---------------------------------------------------------------------------
+# eval entry
+# ---------------------------------------------------------------------------
+
+def offer_statement(ast, env):
+    """exec_rapids hook: defer when possible, flush when the statement is
+    an observation point. Cheap no-op for sessions that never deferred
+    anything while the knob is off."""
+    s = env.session
+    if getattr(s, "_planner", None) is None and not enabled():
+        return _MISS
+    return s.planner.offer(ast, env)
+
+
+def stats() -> dict:
+    """Counters for the /3/ScoringMetrics `rapids` block + /3/Metrics."""
+    return counters()

@@ -879,16 +879,18 @@ def _set_tz(env, tz):
 
 @prim("difflag1")
 def _difflag1(env, fr):
-    import jax
-    import jax.numpy as jnp
+    from h2o3_tpu.ops import window
 
     c = _one_col(fr)
+    dev = window.difflag1_device(c) if c.is_numeric or c.ctype == T_TIME \
+        else None
+    if dev is not None:
+        return _colfr(dev, "difflag1")
+    # host fallback (strings / host-resident columns) — the counted
+    # exceptional path
+    from h2o3_tpu.core import sharded_frame
 
-    @jax.jit
-    def diff(d):
-        return jnp.concatenate([jnp.asarray([jnp.nan], d.dtype),
-                                d[1:] - d[:-1]])
-
+    sharded_frame.note_gathered(c.nrows)
     x = np.asarray(c.to_numpy(), np.float64)
     vals = np.concatenate([[np.nan], x[1:] - x[:-1]])
     return _colfr(Column.from_numpy(vals), "difflag1")
@@ -1340,13 +1342,26 @@ def _apply(env, fr, margin, fun):
 def _rank_within_group(env, fr, group_cols, sort_cols, ascending, new_col, sort_orders_for_grouped=0):
     gidx = _idx_list(group_cols, fr.ncols)
     sidx = _idx_list(sort_cols, fr.ncols)
+    # normalize direction flags to one per sort key (pad with ascending)
     asc = ([bool(_scalar(a)) for a in ascending]
            if isinstance(ascending, (list, NumList)) else
            [True] * len(sidx))
+    asc = (asc + [True] * len(sidx))[: len(sidx)]
+    from h2o3_tpu.ops import window
+
+    rank_col = window.rank_within_groupby_device(fr, gidx, sidx, asc)
+    if rank_col is not None:
+        out = fr.subframe(fr.names)
+        out.add(_s(new_col).strip('"'), rank_col)
+        return out
+    # host walk (string/ragged key columns) — the counted exceptional path
+    from h2o3_tpu.core import sharded_frame
+
+    sharded_frame.note_gathered(fr.nrows)
     gkeys = [np.asarray(fr.col(int(i)).to_numpy()) for i in gidx]
     skeys = [np.asarray(fr.col(int(i)).to_numpy(), np.float64) for i in sidx]
     order_keys = []
-    for k, a in zip(reversed(skeys), reversed(asc + [True] * len(sidx))):
+    for k, a in zip(reversed(skeys), reversed(asc)):
         order_keys.append(k if a else -k)
     order = np.lexsort(tuple(order_keys) + tuple(reversed(gkeys)))
     rank = np.full(fr.nrows, np.nan)

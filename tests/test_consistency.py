@@ -192,6 +192,20 @@ def test_rapids_prims_declare_fusibility_class():
     # the planner's root set must be a subset of the fusible class
     assert fusion.ROOT_OPS <= {n for n, c in fusion.PRIM_FUSION.items()
                                if c == fusion.FUSIBLE}
+    # the LAZY session planner's deferral surface: fusible roots plus the
+    # two device barrier prims it models as DAG nodes — a reclassification
+    # of either would silently change what defers
+    for nm in ("sort", "rows"):
+        assert fusion.PRIM_FUSION.get(nm) == fusion.BARRIER, (
+            f"rapids/planner.py defers {nm!r} statements as device DAG "
+            f"nodes; it must stay barrier-class, got "
+            f"{fusion.PRIM_FUSION.get(nm)!r}")
+    # the newly device-resident prims must never regress to host class
+    # (their device paths are the lazy-session PR's acceptance surface)
+    for nm in ("rank_within_groupby", "difflag1"):
+        assert fusion.PRIM_FUSION.get(nm) == fusion.BARRIER, (
+            f"{nm!r} is device-resident (ops/window.py); host class would "
+            f"misreport it as a barrier_fallbacks exceptional path")
 
 
 def test_fused_paths_never_gather_columns_to_coordinator():

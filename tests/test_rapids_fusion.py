@@ -1,4 +1,4 @@
-"""Rapids statement-fusion suite (ISSUE 10).
+"""Rapids statement-fusion + lazy-session suite (ISSUEs 10 + 14).
 
 Covers: (1) the fused-vs-eager bitwise-equivalence property over
 randomized AST chains (elementwise/filter/reduce/ifelse compositions,
@@ -9,7 +9,14 @@ sharded-data-plane guard (``gathered_rows == 0`` on fused statements and
 on enum-keyed group-by / device-join inputs, with numeric-key group-by
 and host joins as the counted demoted path); (4) the Session refcount
 token fix; (5) the h2o3_rapids_* observability surface, including the
-traced-statement zero-added-syncs assertion.
+traced-statement zero-added-syncs assertion; (6) the LAZY session
+engine (rapids/planner.py): randomized chained multi-statement sessions
+lazy-vs-eager bitwise (incl. CSE dedup, dead temps, overwrites and the
+SSA pinning regression), deferral/flush counter semantics, and the
+fused sort+selection window; (7) the device relational prims
+(segmented-scan rank_within_groupby, device difflag1, device sort) vs
+their host-walk references across NaN ordering, ties and descending
+keys, with ``gathered_rows == 0`` counter-asserted.
 """
 
 import gc
@@ -19,7 +26,7 @@ import pytest
 
 from h2o3_tpu.core.frame import Column, Frame, T_CAT
 from h2o3_tpu.rapids import Session, exec_rapids
-from h2o3_tpu.rapids import fusion
+from h2o3_tpu.rapids import fusion, planner
 
 pytestmark = pytest.mark.rapids
 
@@ -179,9 +186,13 @@ def test_mask_multiply_na_propagation(cl, fr, sess):
 
 def test_assigned_statement_fuses(cl, fr, sess):
     """(tmp= ...) roots fuse their RHS — the evaluator offers the inner
-    compute node, so assignment costs no fusion opportunity."""
+    compute node, so assignment costs no fusion opportunity. (Lazy
+    deferral pinned off: this is the EAGER-path contract; the lazy
+    engine's own counter semantics live in TestLazySession.)"""
+    from h2o3_tpu.rapids import planner
+
     before = fusion.counters()["fused_programs"]
-    with fusion.force(True):
+    with planner.force(False), fusion.force(True):
         out = exec_rapids(
             f"(tmp= fusion_assigned (* (+ (cols {FR} [0]) 1) 2))", sess)
     assert fusion.counters()["fused_programs"] == before + 1
@@ -189,6 +200,452 @@ def test_assigned_statement_fuses(cl, fr, sess):
         ref = exec_rapids(f"(* (+ (cols {FR} [0]) 1) 2)", sess)
     assert np.array_equal(out.col(0).to_numpy(), ref.col(0).to_numpy(),
                           equal_nan=True)
+
+
+# ---------------------------------------------------------------------------
+# lazy session engine (ISSUE 14): chained statements lazy-vs-eager bitwise
+# ---------------------------------------------------------------------------
+
+def _gen_chain(rng, n_stmts, prefix):
+    """Random chained session: tmp= statements over frame columns AND
+    earlier temps (single-col Id refs), with overwrites sprinkled in.
+    Returns (statements, live_keys)."""
+    temps = []
+    stmts = []
+
+    def leaf(depth):
+        roll = rng.random()
+        if roll < 0.5:
+            return f"(cols {FR} [{int(rng.integers(0, 3))}])"
+        if roll < 0.8 and temps:
+            return temps[int(rng.integers(0, len(temps)))]
+        return f"{rng.uniform(-2, 2):.3f}"
+
+    def expr(depth):
+        if depth <= 0:
+            l = leaf(depth)
+            return l if l.startswith("(") or l.lstrip("-")[0].isalpha() \
+                else f"(+ {l} (cols {FR} [0]))"
+        roll = rng.random()
+        if roll < 0.45:
+            op = _BINS[rng.integers(0, len(_BINS))]
+            return f"({op} {expr(depth - 1)} {leaf(depth)})"
+        if roll < 0.6:
+            op = _CMPS[rng.integers(0, len(_CMPS))]
+            return f"({op} {expr(depth - 1)} {leaf(depth)})"
+        if roll < 0.75:
+            op = _UNS[rng.integers(0, len(_UNS))]
+            return f"({op} {expr(depth - 1)})"
+        return (f"(ifelse (> {expr(depth - 1)} 0) "
+                f"{leaf(depth)} {expr(depth - 1)})")
+
+    for i in range(n_stmts):
+        if temps and rng.random() < 0.25:
+            key = temps[int(rng.integers(0, len(temps)))]   # overwrite
+        else:
+            key = f"{prefix}_t{i}"
+        stmts.append(f"(tmp= {key} {expr(int(rng.integers(1, 4)))})")
+        if key not in temps:
+            temps.append(key)
+    return stmts, temps
+
+
+class TestLazySession:
+    def _run_chain(self, stmts, keys, lazy: bool, sess):
+        with planner.force(lazy), fusion.force(lazy):
+            for s in stmts:
+                exec_rapids(s, sess)
+            return {k: np.asarray(exec_rapids(k, sess).col(0).to_numpy())
+                    for k in keys}
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_randomized_chained_sessions_bitwise(self, seed, cl, fr):
+        """The ISSUE-14 acceptance property: a whole deferred session —
+        CSE, dead temps from overwrites, inlined intermediates — must be
+        bitwise indistinguishable from op-at-a-time eager evaluation of
+        the same statements, for EVERY live temp."""
+        rng = np.random.default_rng(1000 + seed)
+        stmts, keys = _gen_chain(rng, int(rng.integers(3, 8)), f"lz{seed}")
+        s_lazy = Session(f"lz{seed}")
+        s_eager = Session(f"le{seed}")
+        try:
+            lazy = self._run_chain(stmts, keys, True, s_lazy)
+            # lazy first, then eager re-assigns the same keys eagerly
+            eager = self._run_chain(stmts, keys, False, s_eager)
+            for k in keys:
+                assert lazy[k].dtype == eager[k].dtype
+                assert np.array_equal(lazy[k], eager[k],
+                                      equal_nan=True), (k, stmts)
+        finally:
+            s_lazy.end()
+            s_eager.end()
+
+    def test_deferral_and_flush_counters(self, cl, fr):
+        s = Session("lz_count")
+        try:
+            with planner.force(True), fusion.force(True):
+                c0 = planner.counters()
+                exec_rapids(f"(tmp= lzc_a (+ (cols {FR} [0]) 1))", s)
+                exec_rapids("(tmp= lzc_b (* lzc_a 2))", s)
+                c1 = planner.counters()
+                assert c1["deferred_statements"] == \
+                    c0["deferred_statements"] + 2
+                assert c1["deferred_pending"] >= c0["deferred_pending"] + 2
+                progs0 = fusion.counters()["fused_programs"]
+                v = exec_rapids("lzc_b", s).col(0).to_numpy()
+                c2 = planner.counters()
+                assert c2["flushes"] == c1["flushes"] + 1
+                assert c2["deferred_pending"] == 0
+                assert fusion.counters()["fused_programs"] > progs0
+            with planner.force(False), fusion.force(False):
+                ref = exec_rapids(
+                    f"(* (+ (cols {FR} [0]) 1) 2)", Session("lz_ref"))
+            assert np.array_equal(v, ref.col(0).to_numpy(), equal_nan=True)
+        finally:
+            s.end()
+
+    def test_cse_dedup_identical_statements(self, cl, fr):
+        """Two structurally identical deferred temps compute ONE program
+        execution (counter-asserted) with bitwise-equal results."""
+        s = Session("lz_cse")
+        try:
+            with planner.force(True), fusion.force(True):
+                exec_rapids(f"(tmp= cse_a (* (+ (cols {FR} [0]) "
+                            f"(cols {FR} [1])) 2))", s)
+                exec_rapids(f"(tmp= cse_b (* (+ (cols {FR} [0]) "
+                            f"(cols {FR} [1])) 2))", s)
+                hits0 = planner.counters()["cse_hits"]
+                va = exec_rapids("cse_a", s).col(0).to_numpy()
+                vb = exec_rapids("cse_b", s).col(0).to_numpy()
+            assert planner.counters()["cse_hits"] == hits0 + 1
+            assert np.array_equal(va, vb, equal_nan=True)
+        finally:
+            s.end()
+
+    def test_dead_temp_is_never_computed(self, cl, fr):
+        """Overwritten/rm-ed temps with no live reader are eliminated:
+        the flush runs zero programs for them."""
+        s = Session("lz_dead")
+        try:
+            with planner.force(True), fusion.force(True):
+                exec_rapids(f"(tmp= dead_x (exp (cols {FR} [0])))", s)
+                exec_rapids("(rm dead_x)", s)
+                exec_rapids(f"(tmp= dead_y (+ (cols {FR} [1]) 1))", s)
+                d0 = planner.counters()["dead_temps_eliminated"]
+                exec_rapids("dead_y", s).col(0).to_numpy()
+                assert planner.counters()["dead_temps_eliminated"] == d0 + 1
+        finally:
+            s.end()
+
+    def test_overwrite_preserves_ssa_inputs(self, cl, fr):
+        """The satellite regression: assign temp -> overwrite the SAME
+        temp with an RHS that reads it -> flush must compute from the
+        ORIGINAL version (defer-time SSA snapshot), not the rebound
+        key."""
+        base = None
+        with planner.force(False), fusion.force(False):
+            base = exec_rapids(f"(* (+ (cols {FR} [0]) 1) 2)",
+                               Session("lz_ssa_ref")).col(0).to_numpy()
+        s = Session("lz_ssa")
+        try:
+            with planner.force(True), fusion.force(True):
+                exec_rapids(f"(tmp= ssa_w (+ (cols {FR} [0]) 1))", s)
+                exec_rapids("(tmp= ssa_w (* ssa_w 2))", s)   # reads v1
+                out = exec_rapids("ssa_w", s).col(0).to_numpy()
+            assert np.array_equal(out, base, equal_nan=True)
+        finally:
+            s.end()
+
+    def test_deferred_inputs_are_pinned(self, cl, fr):
+        """Defer over a session temp, rm the temp, flush: the node's
+        snapshot still computes (refcount pin + hard refs)."""
+        s = Session("lz_pin")
+        try:
+            with planner.force(False), fusion.force(False):
+                exec_rapids(f"(tmp= pin_src (+ (cols {FR} [0]) "
+                            f"(cols {FR} [1])))", s)
+            src_col = s.temps["pin_src"].col(0)
+            base_refs = s.column_refs(src_col)
+            with planner.force(True), fusion.force(True):
+                exec_rapids("(tmp= pin_out (* pin_src 3))", s)
+                assert s.column_refs(src_col) == base_refs + 1
+                exec_rapids("(rm pin_src)", s)
+                out = exec_rapids("pin_out", s).col(0).to_numpy()
+            assert s.column_refs(src_col) <= base_refs
+            with planner.force(False), fusion.force(False):
+                ref = exec_rapids(f"(* (+ (cols {FR} [0]) (cols {FR} [1]))"
+                                  f" 3)", Session("lz_pin_ref"))
+            assert np.array_equal(out, ref.col(0).to_numpy(),
+                                  equal_nan=True)
+        finally:
+            s.end()
+
+    def test_sort_selection_fuses_to_window(self, cl, fr):
+        """sort -> head over a dead sort temp runs as ONE windowed
+        sort+selection (counter-asserted), bitwise-identical to the
+        materialized path, with zero gathered rows."""
+        from h2o3_tpu.core import sharded_frame
+
+        s = Session("lz_topk")
+        try:
+            with planner.force(True):
+                exec_rapids(f"(tmp= tk_s (sort {FR} [0] [1]))", s)
+                exec_rapids("(tmp= tk_h (rows tk_s [0:7]))", s)
+                exec_rapids("(rm tk_s)", s)
+                f0 = planner.counters()["fused_sort_selections"]
+                g0 = sharded_frame.counters()["gathered_rows"]
+                head = exec_rapids("tk_h", s)
+                hv = {n: head.col(n).to_numpy() for n in head.names}
+                assert planner.counters()["fused_sort_selections"] == f0 + 1
+                assert sharded_frame.counters()["gathered_rows"] == g0
+            with planner.force(False):
+                ref = exec_rapids(f"(rows (sort {FR} [0] [1]) [0:7])",
+                                  Session("lz_topk_ref"))
+            assert head.nrows == ref.nrows == 7
+            for n in ref.names:
+                if ref.col(n).is_categorical:
+                    assert list(head.col(n).values()) == \
+                        list(ref.col(n).values())
+                else:
+                    assert np.array_equal(hv[n], ref.col(n).to_numpy(),
+                                          equal_nan=True), n
+        finally:
+            s.end()
+
+    def test_observation_statement_flushes_first(self, cl, fr):
+        """A statement the planner cannot defer is an observation point:
+        pending temps materialize BEFORE it runs (statement order)."""
+        s = Session("lz_obs")
+        try:
+            with planner.force(True), fusion.force(True):
+                exec_rapids(f"(tmp= obs_a (+ (cols {FR} [0]) 5))", s)
+                assert planner.counters()["deferred_pending"] >= 1
+                m = exec_rapids("(mean obs_a)", s)      # barrier: flush
+                assert planner.counters()["deferred_pending"] == 0
+            with planner.force(False), fusion.force(False):
+                ref = exec_rapids(f"(mean (+ (cols {FR} [0]) 5))",
+                                  Session("lz_obs_ref"))
+            assert (m == ref) or (m != m and ref != ref)
+        finally:
+            s.end()
+
+    def test_eager_replay_with_dead_intermediate_terminates(self, cl, fr):
+        """Review regression: with fusion OFF (the emergency-rollback
+        knob) an rm'd single-consumer intermediate must eager-replay
+        cleanly — the flush used to mark it inlined, and the consumer's
+        eager replay re-entered the flush through the lazy-leaf loader
+        without bound."""
+        s = Session("lz_replay")
+        try:
+            with planner.force(True), fusion.force(False):
+                exec_rapids(f"(tmp= rp_a (+ (cols {FR} [0]) 1))", s)
+                exec_rapids("(tmp= rp_b (* rp_a 2))", s)
+                exec_rapids("(rm rp_a)", s)
+                out = exec_rapids("rp_b", s).col(0).to_numpy()
+            with planner.force(False), fusion.force(False):
+                ref = exec_rapids(f"(* (+ (cols {FR} [0]) 1) 2)",
+                                  Session("lz_replay_ref"))
+            assert np.array_equal(out, ref.col(0).to_numpy(),
+                                  equal_nan=True)
+        finally:
+            s.end()
+
+    def test_failed_fused_execute_falls_back_without_recursion(
+            self, cl, fr, monkeypatch):
+        """Same recursion surface via the other trigger: execute_plan
+        raising mid-flush (fusion ON, inline set populated) must degrade
+        to eager replay with deps force-materialized."""
+        s = Session("lz_replay2")
+        try:
+            with planner.force(True), fusion.force(True):
+                exec_rapids(f"(tmp= rp2_a (+ (cols {FR} [0]) 1))", s)
+                exec_rapids("(tmp= rp2_b (* rp2_a 2))", s)
+                exec_rapids("(rm rp2_a)", s)
+                monkeypatch.setattr(
+                    fusion, "execute_plan",
+                    lambda plan: (_ for _ in ()).throw(
+                        RuntimeError("forced execute failure")))
+                e0 = planner.counters()["eager_replays"]
+                out = exec_rapids("rp2_b", s).col(0).to_numpy()
+                assert planner.counters()["eager_replays"] > e0
+            with planner.force(False), fusion.force(False):
+                ref = exec_rapids(f"(* (+ (cols {FR} [0]) 1) 2)",
+                                  Session("lz_replay2_ref"))
+            assert np.array_equal(out, ref.col(0).to_numpy(),
+                                  equal_nan=True)
+        finally:
+            s.end()
+
+    def test_session_end_retires_without_compute(self, cl, fr):
+        s = Session("lz_end")
+        with planner.force(True):
+            exec_rapids(f"(tmp= end_a (log (cols {FR} [2])))", s)
+            e0 = planner.counters()["eager_replays"]
+            p0 = fusion.counters()["fused_programs"]
+            d0 = planner.counters()["dead_temps_eliminated"]
+            s.end()
+        assert planner.counters()["dead_temps_eliminated"] == d0 + 1
+        assert planner.counters()["eager_replays"] == e0
+        assert fusion.counters()["fused_programs"] == p0
+
+
+# ---------------------------------------------------------------------------
+# device relational prims vs host references (NaNs, ties, descending)
+# ---------------------------------------------------------------------------
+
+def _host_rank_reference(cols_g, cols_s, asc):
+    """The exact pre-device host walk (lexsort + per-group counter that
+    skips NA sort keys without advancing)."""
+    n = len(cols_g[0]) if cols_g else len(cols_s[0])
+    gkeys = [np.asarray(c) for c in cols_g]
+    skeys = [np.asarray(c, np.float64) for c in cols_s]
+    order_keys = []
+    for k, a in zip(reversed(skeys), reversed(list(asc))):
+        order_keys.append(k if a else -k)
+    order = np.lexsort(tuple(order_keys) + tuple(reversed(gkeys)))
+    rank = np.full(n, np.nan)
+    prev_g = None
+    r = 0
+    for pos in order:
+        gk = tuple(k[pos] for k in gkeys)
+        if any(np.isnan(np.asarray(skeys)[:, pos])):
+            continue
+        if gk != prev_g:
+            prev_g = gk
+            r = 0
+        r += 1
+        rank[pos] = r
+    return rank
+
+
+class TestDeviceRelational:
+    @pytest.fixture()
+    def rk_fr(self, cl):
+        rng = np.random.default_rng(7)
+        n = 61                                     # odd: exercises padding
+        f = Frame(key="rank_dev_fr")
+        f.add("g", Column.from_numpy(
+            np.asarray([["u", "v", "w"][i % 3] for i in range(n)],
+                       object), ctype=T_CAT))
+        gn = rng.integers(0, 3, n).astype(np.float64)
+        gn[5] = np.nan                             # NaN group key
+        f.add("gn", Column.from_numpy(gn))
+        s1 = np.round(rng.standard_normal(n), 1)   # heavy ties
+        s1[[2, 9, 33]] = np.nan                    # NA sort keys
+        f.add("s1", Column.from_numpy(s1))
+        f.add("s2", Column.from_numpy(rng.standard_normal(n)))
+        f.install()
+        yield f
+        f.delete()
+
+    @pytest.mark.parametrize("gsel,ssel,asc", [
+        ([0], [2], [True]),                 # enum group, NA + ties
+        ([0], [2], [False]),                # descending
+        ([1], [2, 3], [True, False]),       # NaN group key, mixed dirs
+        ([0, 1], [3], [True]),              # multi group keys
+        ([], [2], [False]),                 # global rank, desc, NAs
+    ])
+    def test_rank_within_groupby_device_vs_host(self, cl, rk_fr, gsel,
+                                                ssel, asc):
+        from h2o3_tpu.core import sharded_frame
+        from h2o3_tpu.ops import window
+
+        g0 = sharded_frame.counters()["gathered_rows"]
+        dev = window.rank_within_groupby_device(rk_fr, gsel, ssel, asc)
+        assert dev is not None
+        assert sharded_frame.counters()["gathered_rows"] == g0
+        ref = _host_rank_reference(
+            [np.asarray(rk_fr.col(i).to_numpy()) for i in gsel],
+            [np.asarray(rk_fr.col(i).to_numpy(), np.float64)
+             for i in ssel], asc)
+        got = np.asarray(dev.to_numpy(), np.float64)
+        assert np.array_equal(got, ref, equal_nan=True), (gsel, ssel, asc)
+
+    def test_rank_prim_stays_device(self, cl, rk_fr, sess):
+        from h2o3_tpu.core import sharded_frame
+
+        g0 = sharded_frame.counters()["gathered_rows"]
+        out = exec_rapids(
+            '(rank_within_groupby rank_dev_fr [0] [2] [1] "rk" 0)', sess)
+        assert sharded_frame.counters()["gathered_rows"] == g0
+        ref = _host_rank_reference(
+            [np.asarray(rk_fr.col(0).to_numpy())],
+            [np.asarray(rk_fr.col(2).to_numpy(), np.float64)], [True])
+        assert np.array_equal(np.asarray(out.col("rk").to_numpy(),
+                                         np.float64), ref, equal_nan=True)
+
+    def test_difflag1_device_bitwise(self, cl, rk_fr, sess):
+        out = exec_rapids("(difflag1 (cols rank_dev_fr [2]))",
+                          sess).col(0).to_numpy()
+        x = np.asarray(rk_fr.col("s1").to_numpy(), np.float64)
+        ref = np.concatenate([[np.nan], x[1:] - x[:-1]]).astype(np.float32)
+        assert np.array_equal(out, ref, equal_nan=True)
+
+    @pytest.mark.parametrize("asc", [[True], [False], [True, False],
+                                     [False, False]])
+    def test_device_sort_matches_numpy_lexsort(self, cl, rk_fr, asc):
+        """Device sort (NaN keys last, stable ties, descending) against
+        the numpy reference, with the permutation never leaving device
+        (device_sorted_rows counter-asserted, gathered 0)."""
+        from h2o3_tpu.core import sharded_frame
+        from h2o3_tpu.ops.sort import sort_frame
+
+        names = ["s1", "s2"][: len(asc)]
+        c0 = sharded_frame.counters()
+        out = sort_frame(rk_fr, names, ascending=asc)
+        c1 = sharded_frame.counters()
+        assert c1["device_sorted_rows"] == \
+            c0["device_sorted_rows"] + rk_fr.nrows
+        assert c1["gathered_rows"] == c0["gathered_rows"]
+        keys = []
+        for nm, a in zip(reversed(names), reversed(asc)):
+            k = np.asarray(rk_fr.col(nm).to_numpy(), np.float64)
+            keys.append(k if a else -k)
+        order = np.lexsort(tuple(keys))
+        for nm in rk_fr.names:
+            ref = np.asarray(rk_fr.col(nm).to_numpy())[order]
+            got = np.asarray(out.col(nm).to_numpy())
+            assert np.array_equal(got, ref, equal_nan=True), nm
+
+    def test_sort_window_equals_full_sort_slice(self, cl, rk_fr):
+        from h2o3_tpu.ops.filters import slice_rows
+        from h2o3_tpu.ops.sort import sort_frame
+
+        full = slice_rows(sort_frame(rk_fr, ["s1"], ascending=[False]),
+                          3, 11)
+        win = sort_frame(rk_fr, ["s1"], ascending=[False], rows=(3, 11))
+        assert win.nrows == full.nrows == 8
+        for nm in rk_fr.names:
+            assert np.array_equal(win.col(nm).to_numpy(),
+                                  full.col(nm).to_numpy(),
+                                  equal_nan=True), nm
+
+    def test_inner_merge_keeps_indices_on_device(self, cl):
+        """Inner device join: pair indices never staged on host —
+        gathered stays 0 and the result matches the host-pair path."""
+        from h2o3_tpu.core import sharded_frame
+        from h2o3_tpu.ops.merge import merge
+
+        l = Frame(key="mrg_dev_l")
+        l.add("k", Column.from_numpy(np.arange(30, dtype=float) % 7))
+        l.add("v", Column.from_numpy(np.arange(30, dtype=float)))
+        r = Frame(key="mrg_dev_r")
+        r.add("k", Column.from_numpy(np.asarray([0., 2., 4., 6.])))
+        r.add("w", Column.from_numpy(np.asarray([10., 20., 30., 40.])))
+        try:
+            g0 = sharded_frame.counters()["gathered_rows"]
+            out = merge(l, r)
+            assert sharded_frame.counters()["gathered_rows"] == g0
+            lk = np.asarray(l.col("k").to_numpy())
+            hits = np.isin(lk, [0., 2., 4., 6.])
+            assert out.nrows == int(hits.sum())
+            wmap = {0.: 10., 2.: 20., 4.: 30., 6.: 40.}
+            ok = np.asarray(out.col("k").to_numpy())
+            ow = np.asarray(out.col("w").to_numpy())
+            assert all(wmap[float(k)] == float(w) for k, w in zip(ok, ow))
+        finally:
+            l.delete()
+            r.delete()
 
 
 # ---------------------------------------------------------------------------
