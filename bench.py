@@ -352,6 +352,7 @@ def main():
         if got is not None:
             for sname, env in (("score", {"H2O3_BENCH_ONLY": "score"}),
                                ("rapids", {"H2O3_BENCH_ONLY": "rapids"}),
+                               ("parse", {"H2O3_BENCH_ONLY": "parse"}),
                                ("artifact", {"H2O3_BENCH_ONLY": "artifact"}),
                                ("drf-deep", {"H2O3_BENCH_ONLY": "drf"}),
                                ("pallas", {"H2O3_BENCH_ONLY": "pallas"}),
@@ -417,6 +418,23 @@ def main():
                 got = rap
         else:
             _record("cpu-rapids", ok=False, error="skipped: deadline")
+        if remaining() > 160:
+            # chunked sharded ingest metric (ISSUE 15): parse_mb_per_sec
+            # with the chunked-vs-monolithic speedup and the
+            # coordinator-bytes-0 evidence as aux lines — CPU-measurable,
+            # same 8-virtual-device mesh as the score/rapids stages
+            par = _stage("cpu-parse", [py, "-m", "h2o3_tpu.bench"], 150,
+                         env_extra={"PALLAS_AXON_POOL_IPS": "",
+                                    "JAX_PLATFORMS": "cpu",
+                                    "XLA_FLAGS":
+                                    (os.environ.get("XLA_FLAGS", "") +
+                                     " --xla_force_host_platform_"
+                                     "device_count=8"),
+                                    "H2O3_BENCH_ONLY": "parse"})
+            if got is None:
+                got = par
+        else:
+            _record("cpu-parse", ok=False, error="skipped: deadline")
         if remaining() > 170:
             # serving-tier artifact metrics land even on a dead tunnel
             _stage("cpu-artifact", [py, "-m", "h2o3_tpu.bench"], 160,

@@ -55,6 +55,13 @@ KNOB_HELPERS = frozenset({
     # it mirrored; like the sharded-plane switch, the documented contract
     # is "set identically on every process" (README env index)
     "h2o3_tpu.artifact.compile_cache.cache_dir",   # cache DIR (host I/O)
+    # chunked sharded ingest knobs (ISSUE 15): read mirrored inside the
+    # import_file / parse_stream op replays; the ops contract pins the
+    # env uniform, and chunk layout is a pure function of (bytes, knobs)
+    "h2o3_tpu.ingest.chunked.enabled",             # H2O_TPU_INGEST_CHUNKED
+    "h2o3_tpu.ingest.chunked.chunk_bytes",         # H2O_TPU_INGEST_CHUNK_BYTES
+    "h2o3_tpu.ingest.chunked.ingest_workers",      # H2O_TPU_INGEST_WORKERS
+    "h2o3_tpu.ingest.chunked.parquet_batch",       # lazy-parquet batch width
 })
 
 # audited divergent-looking call sites that are mirrored-safe; reason is

@@ -466,6 +466,48 @@ def h_parse(ctx: Ctx):
             "destination_frame": {"name": dest}}
 
 
+def h_parsestream(ctx: Ctx):
+    """POST /3/ParseStream — stream-append a CSV micro-batch to an
+    installed frame (ISSUE 15 streaming scenario: train-on-static +
+    score-on-streaming). Body: ``destination_frame`` (existing frame),
+    ``data`` (CSV rows, NO header, columns in frame order), optional
+    ``separator``. Rows land as new shard-tail chunks through one fused
+    device concat per column (ingest/chunked.append_csv) with rollups
+    updated incrementally; on multi-process clouds the append rides the
+    oplog so every process grows the same shards in lockstep."""
+    dest = (str(ctx.arg("destination_frame") or "")).strip('"')
+    fr = _frame_or_404(dest)
+    data = ctx.arg("data")
+    if not data:
+        raise ApiError("data (CSV rows, no header) required", 400)
+    from h2o3_tpu.ingest import chunked
+    from h2o3_tpu.parallel import oplog
+
+    # default to the separator the frame was IMPORTED with (a tab-separated
+    # frame streams tab-separated rows without repeating it per request);
+    # the broadcast carries the RESOLVED value so followers parse alike
+    sep = chunked.stream_separator(fr, str(ctx.arg("separator") or "") or
+                                   None)
+
+    # preflight BEFORE the broadcast (the h_predict_v3 pattern): a batch
+    # with a stray delimiter or a non-numeric token in a numeric column
+    # must be a clean 400 here — raising inside every follower's mirrored
+    # replay would fail the whole cloud. The batch deliberately parses
+    # twice (preflight + append): micro-batches are small by design, and
+    # threading the parsed result into only the coordinator's append would
+    # fork its code path from the follower replay's
+    try:
+        chunked.validate_batch(fr, str(data), sep)
+    except ValueError as e:
+        raise ApiError(str(e), 400)
+    op_seq = oplog.broadcast("parse_stream", {
+        "frame": dest, "data": str(data), "separator": sep})
+    with oplog.turn(op_seq):
+        added = chunked.append_csv(fr, str(data), sep)
+    return {"__meta": S.meta("ParseStreamV3"), "destination_frame": dest,
+            "rows_appended": added, "total_rows": fr.nrows}
+
+
 # -- jobs -------------------------------------------------------------------
 
 def _find_job(key: str) -> Job:
@@ -1627,7 +1669,7 @@ def h_metadata_endpoints(ctx: Ctx):
 
 _SCHEMA_REGISTRY = [
     "CloudV3", "JobV3", "JobsV3", "FrameV3", "FramesV3", "ColV3",
-    "ParseSetupV3", "ParseV3", "ImportFilesV3", "InitIDV3",
+    "ParseSetupV3", "ParseV3", "ParseStreamV3", "ImportFilesV3", "InitIDV3",
     "RapidsFrameV3", "RapidsScalarV3", "RapidsStringV3",
     "ModelsV3", "ModelBuildersV3", "ModelParameterSchemaV3",
     "ModelMetricsBinomialV3", "ModelMetricsMultinomialV3",
@@ -1680,6 +1722,8 @@ ROUTES: List[Tuple[str, str, Callable, str]] = [
     ("POST", "/3/PostFile.bin", h_postfile, "Upload a raw file (binary)"),
     ("POST", "/3/ParseSetup", h_parsesetup, "Guess parse setup"),
     ("POST", "/3/Parse", h_parse, "Parse files into a Frame"),
+    ("POST", "/3/ParseStream", h_parsestream,
+     "Stream-append CSV micro-batch rows to a frame"),
     ("GET", "/3/Jobs", h_jobs_list, "List jobs"),
     ("GET", "/3/Jobs/{job_id}", h_job_get, "Job status"),
     ("POST", "/3/Jobs/{job_id}/cancel", h_job_cancel, "Cancel a job"),

@@ -574,6 +574,79 @@ def run_artifact(train_rows: int = 20_000, ntrees: int = 10,
     return reqs / dt, "artifact_qps"
 
 
+def run_parse(n_rows: int = 400_000, n_num: int = 6, n_cat: int = 2):
+    """Ingest metric (ISSUE 15): chunked sharded parse throughput in
+    MB/sec over one large mixed CSV, A/B'd against the monolithic
+    single-thread path on the SAME file (aux ``parse_chunked_vs_mono``,
+    acceptance bar >= 1.5x). ``parse_coordinator_ingest_bytes`` rides
+    along and must read 0 for the chunked run — the zero-gather contract
+    the counter exists for — plus the chunk count and the split/parse/ship
+    overlap ratio."""
+    import os
+    import tempfile
+
+    import h2o3_tpu
+    from h2o3_tpu.ingest import chunked
+    from h2o3_tpu.ingest.parser import import_file
+
+    h2o3_tpu.init()
+    rng = np.random.default_rng(7)
+    d = tempfile.mkdtemp(prefix="h2o3_bench_parse_")
+    path = os.path.join(d, "bench_parse.csv")
+    import pandas as pd
+
+    cols = {}
+    for i in range(n_num):
+        cols[f"n{i}"] = np.round(rng.standard_normal(n_rows), 6)
+    doms = [np.array(["alpha", "beta", "gamma", "delta"]),
+            np.array(["x", "y", "z"])]
+    for i in range(n_cat):
+        cols[f"c{i}"] = doms[i % 2][rng.integers(0, len(doms[i % 2]),
+                                                 n_rows)]
+    pd.DataFrame(cols).to_csv(path, index=False)
+    size_mb = os.path.getsize(path) / 1e6
+
+    def timed(chunked_on: bool, tag: str) -> float:
+        os.environ["H2O_TPU_INGEST_CHUNKED"] = "1" if chunked_on else "0"
+        try:
+            t0 = time.perf_counter()
+            fr = import_file(path, destination_frame=f"bench_parse_{tag}")
+            fr.col(fr.names[0]).data.block_until_ready()
+            dt = time.perf_counter() - t0
+            fr.delete()
+            return dt
+        finally:
+            os.environ.pop("H2O_TPU_INGEST_CHUNKED", None)
+
+    # tiny warm parse per mode keeps import/installation cost out of the
+    # measured window (the flagship warm-up convention)
+    warm = os.path.join(d, "warm.csv")
+    with open(warm, "w") as f:
+        f.write("a,b\n1,x\n2,y\n")
+    for on in (False, True):
+        os.environ["H2O_TPU_INGEST_CHUNKED"] = "1" if on else "0"
+        import_file(warm, destination_frame="bench_parse_warm").delete()
+    os.environ.pop("H2O_TPU_INGEST_CHUNKED", None)
+
+    dt_mono = timed(False, "mono")
+    c0 = chunked.counters()
+    dt_chunked = timed(True, "chunked")
+    c1 = chunked.counters()
+    coord_delta = (c1["coordinator_ingest_bytes"]
+                   - c0["coordinator_ingest_bytes"])
+    print(f"H2O3_BENCH parse_mono_mb_per_sec {size_mb / dt_mono}",
+          flush=True)
+    print(f"H2O3_BENCH parse_chunked_vs_mono {dt_mono / dt_chunked}",
+          flush=True)
+    print(f"H2O3_BENCH parse_coordinator_ingest_bytes {coord_delta}",
+          flush=True)
+    print(f"H2O3_BENCH parse_chunks {c1['chunks'] - c0['chunks']}",
+          flush=True)
+    print(f"H2O3_BENCH parse_overlap_ratio {c1['overlap_ratio']}",
+          flush=True)
+    return size_mb / dt_chunked, "parse_mb_per_sec"
+
+
 def run_glm(n_rows: int = 1_000_000, p: int = 32, iters: int = 20):
     """GLM IRLS secondary metric (matches the repo-root bench_glm shape)."""
     import jax
@@ -645,6 +718,9 @@ if __name__ == "__main__":
     elif mode == "rapids":
         value, metric = run_rapids(
             n_rows=int(os.environ.get("H2O3_BENCH_RAPIDS_ROWS", 2_000_000)))
+    elif mode == "parse":
+        value, metric = run_parse(
+            n_rows=int(os.environ.get("H2O3_BENCH_PARSE_ROWS", 400_000)))
     elif mode == "pallas":
         # Pallas-vs-XLA on silicon: same flagship config, Pallas histogram
         # path forced on (smaller tree count to fit the stage budget)

@@ -45,11 +45,13 @@ NA_CAT = np.int32(-1)
 _COLUMN_TOKENS = itertools.count(1)
 
 
-def _code_dtype(n_levels: int):
+def code_dtype(n_levels: int):
     """Narrowest signed code dtype that fits the domain plus the -1 NA
     sentinel (SURVEY §7 narrow-dtype design — the replacement for the
     reference's 19-codec chunk zoo, water/fvec/NewChunk.java compress()).
-    Ops upcast at their boundaries (binning/DataInfo cast to int32/f32)."""
+    Ops upcast at their boundaries (binning/DataInfo cast to int32/f32).
+    The ONE categorical storage rule — shared by from_numpy and the
+    chunked sharded ingest assembly (ingest/chunked.py)."""
     if n_levels <= 126:
         return np.int8
     if n_levels <= 32766:
@@ -57,11 +59,21 @@ def _code_dtype(n_levels: int):
     return np.int32
 
 
+_code_dtype = code_dtype        # historical internal name
+
+
+def numeric_store_dtype(ctype: str):
+    """The ONE numeric storage rule (shared by pad_numeric_host and the
+    chunked sharded ingest assembly): T_NUM honors the cluster's bf16
+    opt-in; T_TIME/T_INT stay f32."""
+    return _numeric_dtype() if ctype == T_NUM else np.dtype(np.float32)
+
+
 def pad_numeric_host(arr, n: int, padded: int, ctype: str) -> np.ndarray:
-    """The one place deciding numeric padded-buffer dtype rules (shared by
-    Column.from_numpy and file-backed loaders): T_NUM honors the cluster's
-    bf16 opt-in; T_TIME/T_INT stay f32; pad tail is NaN."""
-    dt = _numeric_dtype() if ctype == T_NUM else np.dtype(np.float32)
+    """The one place deciding numeric padded-buffer layout (shared by
+    Column.from_numpy and file-backed loaders): dtype per
+    numeric_store_dtype; pad tail is NaN."""
+    dt = numeric_store_dtype(ctype)
     buf = np.full(padded, np.nan, dt)
     buf[:n] = np.asarray(arr, np.float64).astype(dt)
     return buf
@@ -440,6 +452,32 @@ class Frame(Keyed):
         if col.nrows != self.nrows:
             raise ValueError("row mismatch")
         self._cols[name] = col
+        return self
+
+    def swap_columns(self, mapping: Dict[str, Column]) -> "Frame":
+        """Atomically swap EVERY column for a same-length replacement —
+        the streaming-append path (ingest/chunked.append_csv) grows all
+        columns to the new row count in one step, which replace()'s
+        per-column row guard would reject mid-swap. The mapping must
+        cover exactly the frame's columns and agree on one row count."""
+        if set(mapping) != set(self._names):
+            raise ValueError("swap_columns must cover exactly the frame's "
+                             "columns")
+        rows = {c.nrows for c in mapping.values()}
+        if len(rows) > 1:
+            raise ValueError(f"swap_columns row counts disagree: {rows}")
+        # ONE reference rebind (GIL-atomic). A reader calling col() per
+        # column MAY observe mixed generations across calls, which is
+        # benign by the append invariant: the new columns preserve rows
+        # [0, old_n) bitwise (cat codes renumber WITH their domain inside
+        # one Column, so label semantics hold), and a reader can only
+        # target the appended rows after reading the new nrows — i.e.
+        # after this rebind is visible, when every col() already returns
+        # the new generation (attribute reads are monotonic under the
+        # GIL). Appends that grow the PADDED capacity may transiently
+        # hand a mixed-layout column set to a packed scorer — a per-
+        # request retryable layout miss, not corruption.
+        self._cols = {nm: mapping[nm] for nm in self._names}
         return self
 
     def drop(self, name: str) -> "Frame":

@@ -101,6 +101,19 @@ def enabled() -> bool:
         "0", "false", "off")
 
 
+def shard_geometry(cl, padded: int):
+    """(shard_rows, addressable shard indices) for a padded row count.
+    The authority is the row sharding's OWN index map (what put_rows
+    materializes), never process_index — the chunked sharded ingest
+    (ingest/chunked.py) uses it to land each byte-range chunk's rows
+    directly in their owning shard buffers."""
+    shard_rows = padded // max(cl.row_shards, 1)
+    sh = cl.row_sharding()
+    idx_map = sh.addressable_devices_indices_map((padded,))
+    return shard_rows, {(sl[0].start or 0) // shard_rows
+                        for sl in idx_map.values()}
+
+
 # -- compiled packers (cached per geometry, not per request) ----------------
 
 @functools.lru_cache(maxsize=64)

@@ -5,18 +5,21 @@ over the byte-chunks of FileVecs where each map parses one 4MB chunk to
 NewChunks, then two more distributed rounds union + renumber categorical
 domains (:518 GatherCategoricalDomainsTask, :475 UpdateCategoricalChunksTask).
 
-TPU-native: the host parses (optionally via the C++ fast parser in
-h2o3_tpu/native, else numpy), producing typed columns; categorical interning
-happens in one host pass (single-process) or one gather at the coordinator
-(multi-host); the result is device_put row-sharded straight into HBM —
-overlap of parse and H2D transfer is the multi-host input-pipeline hot path
-(SURVEY.md §7 hard part 7)."""
+TPU-native: CSV files ride the CHUNKED SHARDED pipeline (ingest/chunked.py)
+— record-aligned ~4 MB byte ranges parse concurrently across cores, per-chunk
+domain stats reduce cheaply, and every chunk's rows land directly in their
+owning row shard's buffers (``make_array_from_callback``), so no whole
+column is ever staged on one host (``coordinator_ingest_bytes`` stays 0; on
+multi-process clouds each process parses only numeric byte ranges it owns).
+Non-CSV / compressed formats keep the legacy monolithic path (host parse →
+per-column concat → device_put), whose staged bytes the counter records."""
 
 from __future__ import annotations
 
 import glob as _glob
 import os
-from typing import Dict, List, Optional, Sequence
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -31,6 +34,41 @@ def parse_setup(paths, **kw) -> ParseSetup:
     return guess_setup(p, **kw)
 
 
+def csv_read_kwargs(setup: ParseSetup) -> dict:
+    """The ONE pandas read_csv argument block — shared verbatim by the
+    monolithic path below and the chunked byte-range parser
+    (ingest/chunked._parse_chunk_bytes). The chunked path's bitwise
+    contract with its monolithic fallback depends on per-token conversion
+    being identical, so NA handling / dtype rules must change HERE, never
+    in one caller. Header handling is the caller's (chunks never contain
+    the header; the whole-file read consumes it)."""
+    na = [s for s in setup.na_strings if s != ""]
+    # T_TIME reads as RAW string tokens: per-chunk (or per-file) pandas
+    # type inference could hand numeric-looking date tokens ('20200101')
+    # to to_datetime as floats — epoch-ns garbage, and DIFFERENT garbage
+    # depending on which tokens share a chunk. Forcing str makes both
+    # paths convert the same tokens column-wide.
+    return dict(
+        sep=setup.separator, names=setup.column_names,
+        quotechar=setup.quote_char or '"',
+        na_values=na, keep_default_na=True, skipinitialspace=True,
+        dtype={n: (object if t in (T_CAT, T_STR)
+                   else (str if t == T_TIME else np.float64))
+               for n, t in zip(setup.column_names, setup.column_types)},
+        engine="c",
+    )
+
+
+def _note_parse_opts(fr, setup: ParseSetup) -> None:
+    """Record the parse options streaming appends must reuse: a frame
+    imported with custom ``na_strings`` (or quote char) must read
+    /3/ParseStream tokens exactly as a cold parse of the concatenated
+    data would (ingest/chunked._stream_setup reads this back)."""
+    fr._parse_opts = {"na_strings": list(setup.na_strings),
+                      "quote_char": setup.quote_char,
+                      "separator": setup.separator}
+
+
 def _parse_csv_host(path: str, setup: ParseSetup) -> Dict[str, np.ndarray]:
     """Parse one file into host columns. Tries the native C++ parser first
     (h2o3_tpu/native/csv_parser.cpp), falls back to pandas/numpy."""
@@ -41,7 +79,6 @@ def _parse_csv_host(path: str, setup: ParseSetup) -> Dict[str, np.ndarray]:
         return cols
     import pandas as pd
 
-    na = [s for s in setup.na_strings if s != ""]
     # python string storage + object dtype: pandas 3's arrow-backed
     # StringDtype construction has segfaulted on REST worker threads under
     # concurrent XLA activity. Set the option GLOBALLY (idempotent): a scoped
@@ -50,13 +87,8 @@ def _parse_csv_host(path: str, setup: ParseSetup) -> Dict[str, np.ndarray]:
     # another is still inside read_csv
     pd.set_option("mode.string_storage", "python")
     df = pd.read_csv(
-        path, sep=setup.separator,
-        header=0 if setup.check_header == 1 else None,
-        names=setup.column_names,
-        na_values=na, keep_default_na=True, skipinitialspace=True,
-        dtype={n: (object if t in (T_CAT, T_STR) else np.float64)
-               for n, t in zip(setup.column_names, setup.column_types) if t != T_TIME},
-        engine="c",
+        path, header=0 if setup.check_header == 1 else None,
+        **csv_read_kwargs(setup),
     )
     out = {}
     for name, t in zip(setup.column_names, setup.column_types):
@@ -144,6 +176,28 @@ def parse(paths: Sequence[str], setup: ParseSetup,
             if hdr != hdr0:
                 raise ValueError(f"column mismatch across files: {p} has "
                                  f"{hdr}, expected {hdr0}")
+    if setup.parse_type == "CSV":
+        # the chunked sharded pipeline (ingest/chunked.py): byte-range
+        # parallel parse straight into row shards, zero coordinator bytes.
+        # None = ineligible (compressed/remote-only/empty) — legacy path;
+        # ChunkLayoutError = the record scan disagreed with the parser
+        # (non-RFC quoting) — the monolithic path handles those exactly
+        # as before
+        from h2o3_tpu.ingest import chunked
+
+        try:
+            got = chunked.parse_csv_sharded(paths, setup)
+        except chunked.ChunkLayoutError as e:
+            log.warn(str(e))
+            got = None
+        if got is not None:
+            fr = H2OFrame(destination_frame=destination_frame)
+            for name in setup.column_names:
+                fr.add(name, got[name])
+            _note_parse_opts(fr, setup)
+            log.info(f"parsed {len(paths)} file(s) chunked -> "
+                     f"{fr.nrows}x{fr.ncols} [{fr.frame_id}]")
+            return fr
     if len(paths) == 1:
         results = [_parse_one(paths[0], setup)]
     else:
@@ -179,9 +233,17 @@ def parse(paths: Sequence[str], setup: ParseSetup,
                    if setup.column_names and len(setup.column_names) == len(names)
                    else list(names))
     fr = H2OFrame(destination_frame=destination_frame)
+    from h2o3_tpu.ingest import chunked as _chunked
+
     for name, final, t in zip(names, final_names, types):
         parts = [r[0][name] for r in results]
         arr = np.concatenate(parts) if len(parts) > 1 else parts[0]
+        if t != T_STR:
+            # whole-column host staging before device_put: the legacy
+            # monolithic assembly — the bytes the chunked path zeroes
+            # (object arrays count their pointer bytes; the real string
+            # payload is host-resident either way)
+            _chunked.note_coordinator_bytes(arr.nbytes)
         if t == T_CAT:
             fr.add(final, Column.from_numpy(arr, ctype=T_CAT))
         elif t == T_STR:
@@ -190,6 +252,7 @@ def parse(paths: Sequence[str], setup: ParseSetup,
             fr.add(final, Column.from_numpy(arr, ctype=T_TIME))
         else:
             fr.add(final, Column.from_numpy(arr))
+    _note_parse_opts(fr, setup)
     log.info(f"parsed {len(paths)} file(s) -> {fr.nrows}x{fr.ncols} [{fr.frame_id}]")
     return fr
 
@@ -230,13 +293,85 @@ def import_file(path: str, destination_frame: Optional[str] = None,
 upload_file = import_file  # same machinery in-process
 
 
+class _ParquetBatchLoader:
+    """Shared first-touch loader for one lazily-opened Parquet frame: the
+    first touched column reads a window of ADJACENT still-pending columns
+    through ONE column-pruned ``read_table`` (H2O_TPU_INGEST_PARQUET_BATCH
+    wide) and caches the others' padded buffers, so N first touches cost
+    ceil(N / batch) file opens instead of N re-open/re-reads."""
+
+    def __init__(self, path: str, n: int, padded: int,
+                 pending: List[Tuple[str, str]]):
+        self._path = path
+        self._n = n
+        self._padded = padded
+        self._pending = list(pending)          # (name, ctype), file order
+        self._cache: Dict[str, np.ndarray] = {}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._inflight: set = set()            # names in a window being read
+
+    def load(self, name: str, ctype: str) -> np.ndarray:
+        from h2o3_tpu.core.frame import pad_numeric_host
+        from h2o3_tpu.ingest import chunked, formats
+
+        with self._lock:
+            while True:
+                buf = self._cache.pop(name, None)
+                if buf is not None:
+                    return buf
+                if name not in self._inflight:
+                    break
+                # another thread's window read covers this column: wait
+                # for its install instead of issuing a duplicate read
+                self._cond.wait()
+            idx = next((i for i, (nm, _t) in enumerate(self._pending)
+                        if nm == name), None)
+            if idx is None:
+                batch = [(name, ctype)]        # re-load after eviction
+            else:
+                batch = self._pending[idx:idx + chunked.parquet_batch()]
+                del self._pending[idx:idx + len(batch)]
+            self._inflight.update(nm for nm, _ in batch)
+        import pyarrow.parquet as pq
+
+        # the disk read runs OUTSIDE the lock (Column.data keeps slow loads
+        # outside its swap lock for the same reason): concurrent fault-ins
+        # of OTHER windows must not serialize behind this one
+        got: Dict[str, np.ndarray] = {}
+        try:
+            tbl = pq.read_table(self._path, columns=[nm for nm, _ in batch])
+            cols, _types = formats.arrow_to_host_cols(tbl)
+            for nm, ct in batch:
+                b = pad_numeric_host(cols[nm], self._n, self._padded, ct)
+                chunked.note_coordinator_bytes(b.nbytes)
+                got[nm] = b
+        finally:
+            with self._lock:
+                self._inflight.difference_update(nm for nm, _ in batch)
+                for nm, b in got.items():
+                    if nm != name:
+                        self._cache[nm] = b
+                # bounded prefetch: never-touched neighbors must not pin a
+                # wide frame's data in host RAM forever (an evicted entry
+                # re-reads as a single column; a waiter orphaned by a
+                # FAILED read retries it the same way)
+                cap = max(4 * chunked.parquet_batch(), 16)
+                while len(self._cache) > cap:
+                    self._cache.pop(next(iter(self._cache)))
+                self._cond.notify_all()
+        return got[name]
+
+
 def lazy_import_parquet(path: str,
                         destination_frame: Optional[str] = None) -> H2OFrame:
     """File-backed Frame over a Parquet file (water/fvec/FileVec.java
     analog): numeric/time columns stay ON DISK until first touched — open a
     frame wider than HBM, column-prune, and only the touched columns
     materialize (through the normal padded-shard path). Categorical/string
-    columns load eagerly (their domains are frame metadata)."""
+    columns load eagerly (their domains are frame metadata); first-touch
+    numeric loads BATCH through one shared column-pruned read
+    (_ParquetBatchLoader) instead of re-opening the file per column."""
     from h2o3_tpu import persist
     from h2o3_tpu.core.runtime import cluster
     from h2o3_tpu.ingest import formats
@@ -255,8 +390,17 @@ def lazy_import_parquet(path: str,
     eager = [nm for nm, t in zip(names, types) if t in (T_CAT, T_STR)]
     eager_cols = {}
     if eager:
+        from h2o3_tpu.ingest import chunked
+
         tbl = pq.read_table(local, columns=eager)
         eager_cols, _types = formats.arrow_to_host_cols(tbl)
+        for nm in eager:
+            # whole-column host staging — counted like every other
+            # coordinator-side assembly (object arrays count pointer bytes)
+            chunked.note_coordinator_bytes(eager_cols[nm].nbytes)
+    lazy = _ParquetBatchLoader(
+        local, n, padded,
+        [(nm, t) for nm, t in zip(names, types) if t not in (T_CAT, T_STR)])
     for name, t in zip(names, types):
         if t in (T_CAT, T_STR):
             fr.add(name, Column.from_numpy(
@@ -264,13 +408,9 @@ def lazy_import_parquet(path: str,
             continue
 
         def loader(col=name, ct=t):
-            from h2o3_tpu.core.frame import pad_numeric_host
-
-            tbl = pq.read_table(local, columns=[col])
-            arr, _types = formats.arrow_to_host_cols(tbl)
-            return pad_numeric_host(arr[col], n, padded, ct)
+            return lazy.load(col, ct)
 
         fr.add(name, Column.file_backed(loader, t, n))
     log.info(f"lazy-opened parquet {n}x{len(names)} [{fr.frame_id}] "
-             f"(numeric columns load on first touch)")
+             f"(numeric columns load on first touch, batched)")
     return fr

@@ -492,6 +492,38 @@ def _install_default_metrics() -> None:
                  "rows whose columns were gathered to this host "
                  "(exceptional path)", _dp("gathered_rows"))
 
+    # -- chunked sharded ingest (ingest/chunked.py, ISSUE 15): the
+    #    coordinator-bytes counter is the ingest-side gathered_rows analog --
+    def _ing(field):
+        def fn():
+            from h2o3_tpu.ingest import chunked
+
+            return float(chunked.counters()[field])
+        return fn
+
+    r.counter_fn("h2o3_ingest_chunks_total",
+                 "byte-range chunks parsed by this process", _ing("chunks"))
+    r.counter_fn("h2o3_ingest_chunk_rows_total",
+                 "rows ingested through the chunked sharded parse path",
+                 _ing("chunk_rows"))
+    r.counter_fn("h2o3_ingest_coordinator_bytes_total",
+                 "ingest bytes staged as whole-column host buffers: the "
+                 "legacy/fallback paths, plus T_TIME columns (column-wide "
+                 "datetime inference) — 0 on the chunked path otherwise",
+                 _ing("coordinator_ingest_bytes"))
+    r.counter_fn("h2o3_ingest_stream_appends_total",
+                 "streaming micro-batch appends (POST /3/ParseStream)",
+                 _ing("stream_appends"))
+    r.counter_fn("h2o3_ingest_stream_rows_total",
+                 "rows appended through the streaming shard-tail path",
+                 _ing("stream_rows"))
+    r.gauge_fn("h2o3_ingest_overlap_ratio",
+               "fraction of aggregate split/parse/resolve/ship seconds "
+               "hidden by pipelining (multi-core parse + async H2D) in "
+               "the last chunked parse", _ing("overlap_ratio"), agg="max")
+    r.histogram("h2o3_ingest_parse_seconds",
+                "per-chunk parse wall time (seconds)")
+
     r.counter_fn("h2o3_scoring_requests_total",
                  "fused-path scoring requests",
                  lambda: _scoring_field("requests"))

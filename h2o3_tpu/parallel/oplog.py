@@ -666,6 +666,19 @@ def _apply(kind: str, p: Dict[str, Any]) -> None:
         import_file(p["path"], destination_frame=p.get("destination_frame"),
                     **kw)
         return
+    if kind == "parse_stream":
+        # streaming micro-batch append: every process parses the SAME
+        # batch text and grows its own shard tails through the same fused
+        # concat programs (ingest/chunked.append_csv), so the sharded
+        # frame stays consistent cloud-wide
+        from h2o3_tpu.core.dkv import DKV
+        from h2o3_tpu.ingest.chunked import append_csv
+
+        fr = DKV.get(p["frame"])
+        if fr is None:
+            raise KeyError(f"parse_stream: frame {p['frame']!r} not found")
+        append_csv(fr, p["data"], p.get("separator") or None)
+        return
     if kind == "train":
         from h2o3_tpu.core.dkv import DKV
         from h2o3_tpu.models.model_builder import BUILDERS

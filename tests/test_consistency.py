@@ -252,6 +252,50 @@ def test_fused_paths_never_gather_columns_to_coordinator():
     assert after["packed_rows"] >= before["packed_rows"] + n + 100
 
 
+def test_ingest_never_stages_whole_columns_on_coordinator(tmp_path):
+    """ISSUE-15 guard (the ingest-side gathered_rows contract): a CSV
+    import must ride the chunked sharded pipeline — every chunk's rows
+    land directly in their owning row shard — and the whole
+    import→train→score arc must leave ``coordinator_ingest_bytes``
+    untouched. A regression that re-introduces the one-gather-at-the-
+    coordinator assembly (the pre-ISSUE-15 docstring's own words) trips
+    this immediately."""
+    import numpy as np
+
+    import h2o3_tpu
+    from h2o3_tpu import scoring
+    from h2o3_tpu.core.frame import Column, Frame
+    from h2o3_tpu.ingest import chunked
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    h2o3_tpu.init()
+    rng = np.random.default_rng(99)
+    n = 600
+    p = tmp_path / "smoke.csv"
+    with open(p, "w") as f:
+        f.write("x1,g,y\n")
+        for i in range(n):
+            x = rng.normal()
+            f.write(f"{x:.6f},{'ab'[i % 2]},{'Y' if x > 0 else 'N'}\n")
+    before = chunked.counters()
+    fr = h2o3_tpu.import_file(str(p), destination_frame="ingest_smoke")
+    model = GBM(ntrees=2, max_depth=2, seed=5).train(
+        y="y", training_frame=fr)
+    sfr = Frame()
+    sfr.add("x1", Column.from_numpy(rng.standard_normal(64)))
+    sfr.add("g", Column.from_numpy(
+        np.array(["a", "b"])[rng.integers(0, 2, 64)], ctype="enum"))
+    scoring.ScoringSession(model).predict(sfr)
+    after = chunked.counters()
+    assert after["coordinator_ingest_bytes"] == \
+        before["coordinator_ingest_bytes"], (
+        "import→train→score staged whole ingest columns on the "
+        "coordinator host — the chunked sharded ingest contract is "
+        "broken")
+    assert after["chunk_rows"] >= before["chunk_rows"] + n
+    fr.delete()
+
+
 def test_multi_entry_flush_is_one_dispatch_per_bucket():
     """ISSUE-13 guard: a multi-entry micro-batch flush on the sharded
     path must coalesce into exactly ONE fused dispatch per row bucket
