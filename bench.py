@@ -352,6 +352,7 @@ def main():
         if got is not None:
             for sname, env in (("score", {"H2O3_BENCH_ONLY": "score"}),
                                ("rapids", {"H2O3_BENCH_ONLY": "rapids"}),
+                               ("pipeline", {"H2O3_BENCH_ONLY": "pipeline"}),
                                ("parse", {"H2O3_BENCH_ONLY": "parse"}),
                                ("artifact", {"H2O3_BENCH_ONLY": "artifact"}),
                                ("drf-deep", {"H2O3_BENCH_ONLY": "drf"}),
@@ -418,6 +419,26 @@ def main():
                 got = rap
         else:
             _record("cpu-rapids", ok=False, error="skipped: deadline")
+        if remaining() > 160:
+            # munge→score pipeline fusion (ISSUE 16): raw-row scoring
+            # throughput with the pipeline_vs_staged ratio and the
+            # zero-materialization counters as aux lines — CPU-measurable
+            # on the same 8-virtual-device mesh
+            pipe = _stage("cpu-pipeline", [py, "-m", "h2o3_tpu.bench"],
+                          150,
+                          env_extra={"PALLAS_AXON_POOL_IPS": "",
+                                     "JAX_PLATFORMS": "cpu",
+                                     "XLA_FLAGS":
+                                     (os.environ.get("XLA_FLAGS", "") +
+                                      " --xla_force_host_platform_"
+                                      "device_count=8"),
+                                     "H2O3_BENCH_ONLY": "pipeline",
+                                     "H2O3_BENCH_PIPELINE_TRAIN_ROWS":
+                                     "5000"})
+            if got is None:
+                got = pipe
+        else:
+            _record("cpu-pipeline", ok=False, error="skipped: deadline")
         if remaining() > 160:
             # chunked sharded ingest metric (ISSUE 15): parse_mb_per_sec
             # with the chunked-vs-monolithic speedup and the

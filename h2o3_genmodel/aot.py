@@ -117,7 +117,7 @@ class AotScorer:
         m = _read_manifest(self.dir)
         self.manifest = m
         self.model_type: str = str(m.get("model_type") or "forest")
-        if self.model_type not in ("forest", "glm"):
+        if self.model_type not in ("forest", "glm", "pipeline"):
             raise ArtifactError(f"unsupported artifact model_type "
                                 f"{self.model_type!r}")
         self.names: List[str] = list(m["names"])
@@ -130,6 +130,28 @@ class AotScorer:
         self.nclasses = int(m["nclasses"])
         self.per_class = bool(m.get("per_class_trees"))
 
+        if self.model_type == "pipeline":
+            # the munge→score program ships with every constant (feature
+            # plan consts + model tables) baked in; the manifest's
+            # `pipeline` block and plan payload are the human-readable
+            # record of WHAT was fused, verified here but not interpreted
+            p = m.get("pipeline")
+            if not isinstance(p, dict):
+                raise ArtifactError("pipeline artifact manifest missing "
+                                    "its 'pipeline' block")
+            self.pipeline: Dict[str, Any] = dict(p)
+            if "pipeline" not in m["files"]:
+                raise ArtifactError("pipeline artifact manifest names no "
+                                    "'pipeline' payload file")
+            _read_payload(self.dir, m["files"]["pipeline"])
+            self._arrays: Dict[str, np.ndarray] = {}
+            self.domains: Dict[str, List[str]] = {
+                k: list(v) for k, v in (m.get("domains") or {}).items()}
+            self._dev: Optional[tuple] = None
+            self._exec: Dict[int, Any] = {}
+            self._post_jit = None
+            self.loaded_from: Dict[int, str] = {}
+            return
         payload = m["files"]["glm" if self.model_type == "glm"
                              else "forest"]
         with np.load(io.BytesIO(_read_payload(self.dir, payload)),
@@ -167,6 +189,9 @@ class AotScorer:
         import jax.numpy as jnp
 
         a = self._arrays
+        if self.model_type == "pipeline":
+            self._dev = ()           # everything is baked into the program
+            return self._dev
         if self.model_type == "glm":
             # the GLM program bakes the DataInfo moments in as constants;
             # only beta (and the offset scalar) ride as arguments
@@ -264,6 +289,17 @@ class AotScorer:
         import jax.numpy as jnp
 
         got = self._executable(bucket)
+        if self.model_type == "pipeline":
+            # one program: raw (bucket, R) matrix in, margins/mu out.
+            # The offset scalar rides as the second argument exactly like
+            # the glm lowering (kept-args filtering prunes it for forest
+            # cores).
+            if got[0] == "loaded":
+                return got[1](X_pad, 0.0)
+            _kind, exe, kept = got
+            flat = [jnp.asarray(X_pad), jnp.float32(0.0)]
+            outs = exe.execute([flat[i] for i in kept])
+            return outs[0]
         if self.model_type == "glm":
             cols = self._split_glm_cols(X_pad)
             (beta,) = self._device_args()

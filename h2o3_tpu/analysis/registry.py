@@ -54,6 +54,9 @@ KNOB_HELPERS = frozenset({
     # the fused leaf routing (leaf_assignment/staged_proba replay) reads
     # it mirrored; like the sharded-plane switch, the documented contract
     # is "set identically on every process" (README env index)
+    "h2o3_tpu.pipeline.enabled",                   # H2O_TPU_PIPELINE_FUSION
+    # — requires planner.enabled() which is deterministically OFF on
+    # multi-process clouds, so the splice never fires mirrored
     "h2o3_tpu.artifact.compile_cache.cache_dir",   # cache DIR (host I/O)
     # chunked sharded ingest knobs (ISSUE 15): read mirrored inside the
     # import_file / parse_stream op replays; the ops contract pins the

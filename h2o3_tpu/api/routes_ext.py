@@ -769,7 +769,7 @@ def h_scoring_metrics(ctx: Ctx):
     (``rapids``: statements, fused programs/compiles/cache hits, barrier
     fallbacks, host-materialized cells). The per-dispatch events are also
     in /3/Timeline under kind='scoring'."""
-    from h2o3_tpu import admission, scoring
+    from h2o3_tpu import admission, pipeline, scoring
     from h2o3_tpu.artifact import compile_cache
     from h2o3_tpu.core import sharded_frame
     from h2o3_tpu.rapids import fusion
@@ -783,7 +783,11 @@ def h_scoring_metrics(ctx: Ctx):
             # executions by path (sharded/host/local/leaf_*) — the
             # one-dispatch-per-flush contract's observable
             "dispatches": scoring.dispatch_counters(),
-            "rapids": fusion.stats()}
+            "rapids": fusion.stats(),
+            # munge→score splice: fused pipeline dispatches, spliced
+            # plan nodes, and the materialized-column counter whose 0 is
+            # the "no intermediate Column" contract's observable
+            "pipeline": pipeline.stats()}
 
 
 def h_metrics(ctx: Ctx):
@@ -1268,6 +1272,48 @@ def h_assembly_fit(ctx: Ctx):
             "result": {"name": str(out.key)}}
 
 
+def h_assembly_pipeline(ctx: Ctx):
+    """POST /99/Assembly/{assembly_id}/pipeline — export the assembly's
+    munge fused with a model as a standalone *pipeline artifact*
+    (artifact/pipeline.py): one program from raw columns to prediction,
+    scored by h2o3_genmodel.aot with no cluster and no munge replay.
+    Coordinator-local like the model artifact export (no oplog op)."""
+    from h2o3_tpu import artifact
+    from h2o3_tpu import assembly as A
+
+    pipe = DKV.get(ctx.params["assembly_id"])
+    if not isinstance(pipe, A.H2OAssembly):
+        raise ApiError(
+            f"assembly {ctx.params['assembly_id']!r} not found", 404)
+    fr = _frame_or_404(str(ctx.arg("frame", "") or "").strip('"'))
+    m = _model_or_404(str(ctx.arg("model_id", "") or "").strip('"'))
+    out_dir = str(ctx.arg("dir", "") or "").strip('"')
+    if not out_dir:
+        raise ApiError("dir required (server-side artifact directory)", 400)
+    raw_buckets = _parse_list(ctx.arg("buckets")) or None
+    try:
+        buckets = [int(b) for b in raw_buckets] if raw_buckets else None
+    except (TypeError, ValueError):
+        raise ApiError(f"buckets must be integers, got {raw_buckets!r}",
+                       400) from None
+    try:
+        man = pipe.export_pipeline(m, fr, out_dir, buckets=buckets)
+    except artifact.ArtifactError as e:
+        raise ApiError(str(e), 400) from None
+    return {"__meta": S.meta("AssemblyPipelineV99"),
+            "assembly_id": S.key_ref(ctx.params["assembly_id"],
+                                     "Key<Assembly>"),
+            "model_id": str(m.key),
+            "dir": out_dir,
+            "model_type": man.get("model_type"),
+            "inner": (man.get("pipeline") or {}).get("inner"),
+            "inputs": [i.get("name")
+                       for i in (man.get("pipeline") or {}).get("inputs",
+                                                                [])],
+            "buckets": man.get("buckets"),
+            "executables": len(man.get("executables") or [])}
+
+
 def h_assembly_java(ctx: Ctx):
     """GET /99/Assembly.java/{assembly_id}/{pojo_name} — the munging
     pipeline as source (reference emits a Java MungeTransformer; we emit a
@@ -1451,6 +1497,8 @@ EXTRA_ROUTES = [
     ("POST", "/3/Grid.bin/import", h_grid_import, "Import grid"),
     ("GET", "/99/Grids", h_grids_list, "List grids"),
     ("POST", "/99/Assembly", h_assembly_fit, "Fit a munging assembly"),
+    ("POST", "/99/Assembly/{assembly_id}/pipeline", h_assembly_pipeline,
+     "Export assembly+model as a standalone pipeline artifact"),
     ("GET", "/99/Assembly.java/{assembly_id}/{pojo_name}", h_assembly_java,
      "Assembly pipeline source"),
     ("POST", "/3/ImportHiveTable", h_import_hive, "Import a Hive table"),

@@ -25,6 +25,8 @@ from h2o3_tpu.artifact.export import export_model, supports_export
 from h2o3_tpu.artifact.loader import describe, load_model
 from h2o3_tpu.artifact.manifest import (FORMAT, FORMAT_VERSION,
                                         ArtifactError)
+from h2o3_tpu.artifact.pipeline import export_pipeline
 
-__all__ = ["export_model", "supports_export", "load_model", "describe",
-           "ArtifactError", "FORMAT", "FORMAT_VERSION"]
+__all__ = ["export_model", "supports_export", "export_pipeline",
+           "load_model", "describe", "ArtifactError", "FORMAT",
+           "FORMAT_VERSION"]

@@ -44,11 +44,19 @@ def load_model(art_dir: str, model_id: Optional[str] = None,
 
     art_dir = persist.resolve(art_dir)
     m = manifest.read_manifest(art_dir)
-    if m.get("model_type", "forest") != "forest":
+    mt = m.get("model_type", "forest")
+    if mt == "glm":
+        return _load_glm(art_dir, m, model_id, install)
+    if mt == "pipeline":
         raise ArtifactError(
-            f"artifact model_type {m.get('model_type')!r} cannot be "
-            "imported into a serving cloud yet — score it standalone "
-            "with h2o3_genmodel.aot (forest artifacts import)")
+            "pipeline artifacts bind a munge plan to a model and have no "
+            "in-cluster frame to run it against — score raw rows "
+            "standalone with h2o3_genmodel.aot instead, or import the "
+            "wrapped model from its own forest/glm artifact")
+    if mt != "forest":
+        raise ArtifactError(
+            f"artifact model_type {mt!r} cannot be imported into a "
+            "serving cloud (forest and glm artifacts import)")
     arrays = packer.load_npz(
         manifest.read_payload(art_dir, m["files"]["forest"]))
     try:
@@ -118,6 +126,105 @@ def load_model(art_dir: str, model_id: Optional[str] = None,
 
         timeline.record("artifact", "import", model=dest, dir=art_dir,
                         n_trees=int(m.get("n_trees", forest.n_trees)))
+    return model
+
+
+def _load_glm(art_dir: str, m: Dict[str, Any], model_id: Optional[str],
+              install: bool):
+    """GLM artifact -> servable GLMModel: DataInfo rebuilt from the packed
+    moments npz + manifest layout, checksum-verified against the manifest
+    before anything reaches the DKV. The re-hydrated model serves through
+    the SAME ``_glm_predict`` program the exporter lowered, so its
+    predictions are bitwise-identical to the artifact's standalone output
+    by construction."""
+    from h2o3_tpu.artifact import glm as artifact_glm
+    from h2o3_tpu.core.dkv import DKV, Key
+    from h2o3_tpu.models.data_info import DataInfo
+    from h2o3_tpu.models.glm import GLMModel
+    from h2o3_tpu.models.model import Model, ModelCategory
+    from h2o3_tpu.models.mojo import _threshold_metrics
+
+    arrays = packer.load_npz(
+        manifest.read_payload(art_dir, m["files"]["glm"]))
+    meta = m.get("glm") or {}
+    names = list(m["names"])
+    n_cat, n_num = int(meta.get("n_cat", -1)), int(meta.get("n_num", -1))
+    if n_cat < 0 or n_num < 0 or n_cat + n_num != len(names):
+        raise ArtifactError(
+            "glm artifact layout is inconsistent: manifest names "
+            f"({len(names)}) != n_cat + n_num ({n_cat}+{n_num})")
+
+    model = GLMModel.__new__(GLMModel)
+    Model.__init__(model, parms={})
+    # Model.__init__ auto-installs under a fresh key: withdraw it NOW so a
+    # validation failure below cannot leak a half-constructed model
+    DKV.remove(str(model.key))
+    model.beta = np.asarray(arrays["beta"], np.float32)
+    model.linkname = str(meta.get("linkname", "identity"))
+    model.link_power = float(meta.get("link_power", 0.0))
+    model.null_deviance = float("nan")
+    model.residual_deviance = float("nan")
+    model.aic = float("nan")
+    model.iterations = 0
+    model.p_values = None
+    model.std_errors = None
+
+    doms = {k: list(v) for k, v in (m.get("domains") or {}).items()}
+    d = DataInfo.__new__(DataInfo)
+    d.response_name = m.get("response_name")
+    d.weights_name = None
+    d.offset_name = None
+    d.standardize = bool(meta.get("standardize", True))
+    d.missing_values_handling = "MeanImputation"
+    d.cat_names = names[:n_cat]          # categoricals first (layout rule)
+    d.num_names = names[n_cat:]
+    d.predictor_names = list(names)
+    for n in d.cat_names:
+        if n not in doms:
+            raise ArtifactError(
+                f"glm artifact names categorical predictor {n!r} but "
+                "carries no domain for it")
+    d.domains = {n: doms[n] for n in d.cat_names}
+    d.cards = [int(c) for c in meta.get(
+        "cards", [len(d.domains[n]) for n in d.cat_names])]
+    d.num_means = np.asarray(arrays["num_means"], np.float32)
+    d.num_sigmas = np.asarray(arrays["num_sigmas"], np.float32)
+    d.cat_modes = np.asarray(arrays["cat_modes"], np.int32)
+    d.impute_values = np.asarray(arrays["impute_values"], np.float32)
+    d._recompute_layout(bool(meta.get("use_all_factor_levels", False)))
+    model.dinfo = d
+    if model.beta.shape[0] != d.fullN + 1:
+        raise ArtifactError(
+            f"glm artifact beta length {model.beta.shape[0]} does not "
+            f"match the expanded layout ({d.fullN}+intercept)")
+
+    o = model._output
+    o.names = names
+    o.domains = doms
+    o.response_name = m.get("response_name")
+    o.response_domain = list(m.get("response_domain") or []) or None
+    o.model_category = str(m["model_category"])
+    if int(meta.get("nclasses", o.nclasses)) != o.nclasses:
+        raise ArtifactError(
+            "glm artifact nclasses disagrees with its response domain")
+    # checksum spans packed arrays AND the rebuilt meta (glm_meta reads
+    # dinfo + _output), so it proves the whole re-hydration round-trips
+    if artifact_glm.glm_checksum(model) != m["model_checksum"]:
+        raise ArtifactError("model checksum mismatch — the packed glm "
+                            "payload does not match the manifest")
+    if o.model_category == ModelCategory.Binomial:
+        o.training_metrics = _threshold_metrics(
+            float(m["default_threshold"]))
+
+    dest = str(model_id or m.get("model_key")
+               or f"artifact_model_{m['model_checksum'][:12]}")
+    model._key = Key(dest)
+    if install:
+        model.install()
+        from h2o3_tpu.utils import timeline
+
+        timeline.record("artifact", "import", model=dest, dir=art_dir,
+                        algo="glm")
     return model
 
 

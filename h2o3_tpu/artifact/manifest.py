@@ -136,10 +136,10 @@ def validate(m: Any) -> Dict[str, Any]:
                 f"manifest key {key!r} has type {type(m[key]).__name__}, "
                 f"expected {typ.__name__}")
     mt = m.get("model_type", "forest")
-    if mt not in ("forest", "glm"):
+    if mt not in ("forest", "glm", "pipeline"):
         raise ArtifactError(
-            f"unsupported model_type {mt!r} (this runtime loads 'forest' "
-            "and 'glm' artifacts)")
+            f"unsupported model_type {mt!r} (this runtime loads 'forest', "
+            "'glm' and 'pipeline' artifacts)")
     if mt == "glm":
         if not isinstance(m.get("glm"), dict):
             raise ArtifactError("glm artifact manifest missing its 'glm' "
@@ -147,6 +147,21 @@ def validate(m: Any) -> Dict[str, Any]:
         if "glm" not in m["files"]:
             raise ArtifactError("glm artifact manifest names no 'glm' "
                                 "payload file")
+    elif mt == "pipeline":
+        p = m.get("pipeline")
+        if not isinstance(p, dict):
+            raise ArtifactError("pipeline artifact manifest missing its "
+                                "'pipeline' block")
+        if not isinstance(p.get("inputs"), list) or not p["inputs"]:
+            raise ArtifactError("pipeline artifact declares no raw "
+                                "inputs")
+        if p.get("inner") not in ("forest", "glm"):
+            raise ArtifactError(
+                f"pipeline artifact wraps unsupported inner model "
+                f"{p.get('inner')!r}")
+        if "pipeline" not in m["files"]:
+            raise ArtifactError("pipeline artifact manifest names no "
+                                "'pipeline' payload file")
     elif "forest" not in m["files"]:
         raise ArtifactError("forest artifact manifest names no 'forest' "
                             "payload file")

@@ -232,6 +232,42 @@ class H2OAssembly:
         return [f"{n}: {getattr(s, 'describe', lambda: type(s).__name__)()}"
                 for n, s in self.steps]
 
+    # -- munge→score pipeline artifact (artifact/pipeline.py) -------------
+    def export_pipeline(self, model, frame: Frame, out_dir: str,
+                        buckets: Optional[Sequence[int]] = None):
+        """Fuse this assembly's munge with `model`'s scoring core into ONE
+        standalone program and write a *pipeline artifact*: the steps
+        replay LAZILY through a private Rapids session so every engineered
+        column stays a pending expression node, and the exporter splices
+        those nodes into the model's fused scoring program —
+        h2o3_genmodel.aot then scores RAW rows in `frame`'s schema with no
+        munge replay at serve time, bitwise-identical to in-process.
+
+        Only Rapids-backed steps (the REST wire format) can stay lazy;
+        assemblies whose steps touch column data directly (H2OScaler and
+        friends) materialize their outputs and the export refuses with
+        the reason. Returns the written manifest."""
+        import uuid as _uuid
+
+        from h2o3_tpu.artifact.pipeline import export_pipeline as _export
+        from h2o3_tpu.rapids import Session
+        from h2o3_tpu.rapids import planner as lazy_planner
+
+        sess = Session(f"assembly_pipe_{_uuid.uuid4().hex[:8]}")
+        try:
+            with lazy_planner.force(True):
+                out = frame
+                for _name, step in self.steps:
+                    if isinstance(step, RestStep):
+                        out = step.transform(out, session=sess)
+                    elif self.fitted:
+                        out = step.transform(out)
+                    else:
+                        out = step.fit_transform(out)
+                return _export(model, out, out_dir, buckets=buckets)
+        finally:
+            sess.end()
+
     def to_source(self, name: str = "MungePipeline") -> str:
         """Self-contained replay source (the reference emits a Java munging
         POJO via GET /99/Assembly.java; we emit the equivalent pipeline as
@@ -267,20 +303,26 @@ class RestStep:
             re.search(r'\(cols(?:_py)?\s+dummy\s+"([^"]+)"\)', self.ast)
         return m.group(1) if m else None
 
-    def _exec(self, fr: Frame):
+    def _exec(self, fr: Frame, session=None):
         import re
 
+        from h2o3_tpu.core.dkv import Key
         from h2o3_tpu.rapids import exec_rapids
 
         expr = re.sub(r"\bdummy\b", str(fr.key), self.ast)
-        return exec_rapids(expr)
+        if session is not None:
+            # bind through a session temp: assignment statements are what
+            # the lazy planner defers, so the step's expression stays a
+            # pending DAG node (the pipeline-artifact export path)
+            expr = f"(tmp= {Key.make('assembly_t')} {expr})"
+        return exec_rapids(expr, session)
 
     def fit_transform(self, fr: Frame) -> Frame:
         return self.transform(fr)
 
-    def transform(self, fr: Frame) -> Frame:
+    def transform(self, fr: Frame, session=None) -> Frame:
         fr.install()
-        res = self._exec(fr)
+        res = self._exec(fr, session)
         if self.klass == "H2OColSelect":
             return res if isinstance(res, Frame) else fr
         old = self._old_col()

@@ -682,6 +682,102 @@ def run_glm(n_rows: int = 1_000_000, p: int = 32, iters: int = 20):
     return n_rows * iters / dt, "glm_irls_rows_per_sec"
 
 
+def run_pipeline(train_rows: int = 20_000, n_rows: int = 200_000,
+                 reps: int = 5, ntrees: int = 10, max_depth: int = 5):
+    """Munge→score pipeline-fusion metric (ISSUE 16): raw columns through
+    a lazy Rapids feature chain into a GBM predict, A/B'd with the splice
+    forced off (staged: flush the munge DAG, materialize the engineered
+    Columns, then bucketed scoring) vs on (ONE fused program per row
+    bucket, zero intermediate Columns). Each repetition re-engineers the
+    features from the raw frame — a staged predict flushes the DAG, so
+    every pass must pay (or fuse away) the full munge cost, exactly like
+    a serving tier scoring raw rows. Warm pass excluded in both modes;
+    the pipeline counters prove the fused passes materialized nothing."""
+    import h2o3_tpu
+    from h2o3_tpu import pipeline, scoring
+    from h2o3_tpu.core.frame import Column, Frame
+    from h2o3_tpu.models.tree.gbm import GBM
+    from h2o3_tpu.rapids import fusion, planner
+    from h2o3_tpu.rapids.eval import Session, exec_rapids
+
+    h2o3_tpu.init()
+    rng = np.random.default_rng(7)
+
+    # train on the ENGINEERED feature names — serving receives raw r1/r2
+    tr = Frame()
+    x1 = rng.standard_normal(train_rows)
+    x2 = rng.standard_normal(train_rows)
+    logit = 0.8 * x1 - 0.6 * x2
+    tr.add("x1", Column.from_numpy(x1))
+    tr.add("x2", Column.from_numpy(x2))
+    tr.add("y", Column.from_numpy(
+        np.where(rng.random(train_rows) < 1 / (1 + np.exp(-logit)),
+                 "Y", "N"), ctype="enum"))
+    model = GBM(ntrees=ntrees, max_depth=max_depth, seed=7).train(
+        y="y", training_frame=tr)
+    ssn = scoring.session_for(model)
+
+    raw = Frame(key="pipe_bench_raw")
+    r1 = rng.standard_normal(n_rows)
+    r1[::97] = np.nan                       # real NA traffic
+    raw.add("r1", Column.from_numpy(r1))
+    raw.add("r2", Column.from_numpy(rng.standard_normal(n_rows)))
+    raw.install()
+
+    sess = Session("bench_pipe")
+    seq = [0]
+    R1, R2 = "(cols pipe_bench_raw [0])", "(cols pipe_bench_raw [1])"
+
+    def engineer():
+        # fresh temps every pass: the staged mode flushed the previous
+        # DAG, so reusing a frame would let it skip the munge entirely
+        seq[0] += 1
+        p = f"pb{seq[0]}"
+        exec_rapids(f"(tmp= {p}_a (+ {R1} 0.5))", sess)
+        exec_rapids(f"(tmp= {p}_b (ifelse (> {R2} 0) {R2} {p}_a))", sess)
+        return exec_rapids(
+            f'(tmp= {p}_pf (colnames= (cbind {p}_a {p}_b) [0 1] '
+            f'["x1" "x2"]))', sess)
+
+    def timed(on: bool) -> float:
+        with planner.force(True), fusion.force(True), pipeline.force(on):
+            ssn.predict(engineer())          # warm (compiles excluded)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = ssn.predict(engineer())
+                c = out.col(0)
+                if hasattr(c.data, "block_until_ready"):
+                    c.data.block_until_ready()
+            return time.perf_counter() - t0
+
+    dt_staged = timed(False)
+    pipeline.reset_counters()
+    dt_fused = timed(True)
+    pc = pipeline.counters()
+    staged_rps = n_rows * reps / dt_staged
+    fused_rps = n_rows * reps / dt_fused
+    print(f"H2O3_BENCH pipeline_staged_rows_per_sec {staged_rps}",
+          flush=True)
+    print(f"H2O3_BENCH pipeline_vs_staged {fused_rps / staged_rps}",
+          flush=True)
+    # zero-materialization evidence next to the throughput number: the
+    # fused passes spliced the munge DAG straight into the score program
+    # (same counters as the /3/ScoringMetrics pipeline block)
+    print(f"H2O3_BENCH pipeline_fused_dispatches "
+          f"{pc['fused_dispatches']}", flush=True)
+    print(f"H2O3_BENCH pipeline_materialized_columns "
+          f"{pc['materialized_columns']}", flush=True)
+    if pc["materialized_columns"]:
+        # the whole point of the splice is zero intermediate Columns —
+        # fail the stage loudly rather than record a stale claim
+        raise RuntimeError(
+            f"pipeline fusion regression: {pc['materialized_columns']} "
+            "intermediate columns materialized during fused passes "
+            "(expected 0)")
+    sess.end()
+    return fused_rps, "pipeline_rows_per_sec"
+
+
 if __name__ == "__main__":
     # subprocess entry for the watchdog in the repo-root bench.py; each
     # secondary metric runs as its OWN watchdog stage (H2O3_BENCH_ONLY=…)
@@ -718,6 +814,11 @@ if __name__ == "__main__":
     elif mode == "rapids":
         value, metric = run_rapids(
             n_rows=int(os.environ.get("H2O3_BENCH_RAPIDS_ROWS", 2_000_000)))
+    elif mode == "pipeline":
+        value, metric = run_pipeline(
+            train_rows=int(os.environ.get("H2O3_BENCH_PIPELINE_TRAIN_ROWS",
+                                          20_000)),
+            n_rows=int(os.environ.get("H2O3_BENCH_PIPELINE_ROWS", 200_000)))
     elif mode == "parse":
         value, metric = run_parse(
             n_rows=int(os.environ.get("H2O3_BENCH_PARSE_ROWS", 400_000)))

@@ -504,6 +504,21 @@ def _interaction_frame(frame: Frame, interactions, response=None) -> Frame:
 class GLMModel(Model):
     algo_name = "glm"
 
+    def predict(self, frame: Frame, key=None) -> Frame:
+        # munge→score splice: a frame fed by a still-pending lazy Rapids
+        # pipeline scores through ONE `pipeline`-family program over the
+        # fused feature plans — no engineered Column materializes. Any
+        # frame the splice cannot hold takes the staged adapt→expand path.
+        from h2o3_tpu import pipeline
+
+        try:
+            raw = pipeline.try_glm_raw(self, frame)
+        except Exception:   # noqa: BLE001 — staged path is the contract
+            raw = None
+        if raw is not None:
+            return self._raw_to_frame(raw, frame.nrows, key)
+        return super().predict(frame, key)
+
     def adapt_test(self, test: Frame) -> Frame:
         ints = self._parms.get("interactions")
         if ints:

@@ -39,15 +39,16 @@ from typing import Any, Dict, List, Optional
 # closed program-family enumeration: scoring = fused bin+traverse serving
 # programs, explain = fused bin+leaf explainability programs (leaf
 # assignment / staged probabilities), binning = tree-training bin-matrix
-# builds, rapids = statement fusion, artifact = AOT exporter lowerings,
-# pack = sharded data-plane packers, probe = the supervised boot
-# first-compile
-FAMILIES = frozenset({"scoring", "explain", "binning", "rapids", "artifact",
-                      "pack", "probe"})
+# builds, rapids = statement fusion, pipeline = munge→score splices (the
+# rapids feature graph + the model core in ONE program), artifact = AOT
+# exporter lowerings, pack = sharded data-plane packers, probe = the
+# supervised boot first-compile
+FAMILIES = frozenset({"scoring", "explain", "binning", "rapids", "pipeline",
+                      "artifact", "pack", "probe"})
 
 # persistent-compile-cache families whose actual compiles feed the legacy
 # note_compile() counter (the warm-restart zero-compile assertions)
-_CACHED_FAMILIES = ("scoring", "explain", "rapids")
+_CACHED_FAMILIES = ("scoring", "explain", "rapids", "pipeline")
 
 _KV_PREFIX = "obs/runtime/"
 

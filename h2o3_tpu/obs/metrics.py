@@ -619,6 +619,40 @@ def _install_default_metrics() -> None:
                "deferred statements awaiting flush",
                _lazy("deferred_pending"))
 
+    # -- munge→score pipeline fusion (h2o3_tpu/pipeline.py) --------------
+    def _pipe(field):
+        def fn():
+            from h2o3_tpu import pipeline
+
+            return float(pipeline.counters()[field])
+        return fn
+
+    r.counter_fn("h2o3_pipeline_captures_total",
+                 "predict calls spliced onto a pending feature DAG",
+                 _pipe("captures"))
+    r.counter_fn("h2o3_pipeline_fused_dispatches_total",
+                 "fused munge→score program executions",
+                 _pipe("fused_dispatches"))
+    r.counter_fn("h2o3_pipeline_spliced_nodes_total",
+                 "pending DAG nodes spliced into fused scoring programs",
+                 _pipe("spliced_nodes"))
+    r.counter_fn("h2o3_pipeline_materialized_columns_total",
+                 "engineered Columns materialized on the pipeline path "
+                 "(the zero-materialization contract's observable)",
+                 _pipe("materialized_columns"))
+    r.counter_fn("h2o3_pipeline_fused_rows_total",
+                 "logical rows scored through fused pipeline programs",
+                 _pipe("fused_rows"))
+    r.counter_fn("h2o3_pipeline_programs_compiled_total",
+                 "pipeline programs actually XLA-compiled",
+                 _pipe("programs_compiled"))
+    r.counter_fn("h2o3_pipeline_compile_cache_hits_total",
+                 "pipeline programs served warm (signature or disk tier)",
+                 _pipe("compile_cache_hits"))
+    r.counter_fn("h2o3_pipeline_fallbacks_total",
+                 "captured pipelines that fell back to the staged path",
+                 _pipe("fallbacks"))
+
     def _parse_cache_size():
         from h2o3_tpu.rapids import parser as rapids_parser
 

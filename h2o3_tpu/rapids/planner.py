@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import os
 import threading
+import weakref
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -119,6 +120,7 @@ _COUNTS = {
     "inlined_intermediates": 0,    # nodes spliced into consumers' programs
     "fused_sort_selections": 0,    # sort+slice pairs run as one window
     "eager_replays": 0,            # nodes replayed through the evaluator
+    "transparent_statements": 0,   # metadata-only munges run over lazy cols
 }
 _PENDING = 0                       # deferred statements awaiting flush
 
@@ -251,6 +253,22 @@ class _SnapEnv:
         raise KeyError(name)
 
 
+# live planners, discoverable by column token: the pipeline splicer
+# (h2o3_tpu/pipeline.py) receives only a Frame and must find which
+# planner's pending DAG its lazy columns belong to WITHOUT touching the
+# columns (a data access would be an observation point and flush the DAG)
+_PLANNERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def pending_node_for_token(tok: int):
+    """(planner, node) owning a still-pending lazy column, else None."""
+    for pl in list(_PLANNERS):
+        n = pl.node_for_token(tok)
+        if n is not None and n.state == "pending":
+            return pl, n
+    return None
+
+
 class SessionPlanner:
     """Per-Session deferred-statement DAG (see module docstring)."""
 
@@ -263,6 +281,7 @@ class SessionPlanner:
         self._cse: Dict[tuple, Column] = {}
         self._seq = 0
         self._flushing = False
+        _PLANNERS.add(self)
 
     # -- lookup ------------------------------------------------------------
     def node_for_token(self, tok: int) -> Optional[_Node]:
@@ -308,6 +327,16 @@ class SessionPlanner:
                 old = self._by_key.pop(k, None)
                 if old is not None:
                     old.output_dead = True
+            if self._nodes and self._is_transparent(ast):
+                # metadata-only munges (cbind / append / colnames= / cols)
+                # move Column REFS between frames without reading a single
+                # value — running them eagerly over still-lazy columns is
+                # NOT an observation. The assembled frame keeps its pending
+                # tokens, so a downstream predict can splice the whole
+                # feature DAG into one munge→score program
+                # (h2o3_tpu/pipeline.py) with zero materializations.
+                _bump("transparent_statements")
+                return _MISS
             if self._nodes:
                 with tracing.span("flush", reason="statement"):
                     self.flush()
@@ -328,6 +357,20 @@ class SessionPlanner:
     def _is_rm(ast) -> bool:
         return (isinstance(ast, list) and len(ast) == 2
                 and isinstance(ast[0], Id) and ast[0].name == "rm")
+
+    # prims verified metadata-only in rapids/eval.py: they assemble frames
+    # from Column references (Frame.cbind/subframe/add/rename) and never
+    # touch `.data`, so lazy columns pass through them un-observed
+    _TRANSPARENT = frozenset({"cbind", "append", "colnames=", "cols",
+                              "cols_py"})
+
+    @classmethod
+    def _is_transparent(cls, ast) -> bool:
+        if cls._is_assign(ast):
+            ast = ast[2]
+        return (isinstance(ast, list) and bool(ast)
+                and isinstance(ast[0], Id)
+                and ast[0].name in cls._TRANSPARENT)
 
     # -- deferral ----------------------------------------------------------
     def _try_defer(self, ast, env):

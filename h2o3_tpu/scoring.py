@@ -692,10 +692,26 @@ class ScoringSession:
         results: List[Any] = [None] * len(entries)
         host_entries = []          # (idx, frame, adapted, n, dest, wm)
         sharded_entries = []       # (idx, frame, n, dest, wm, sf)
+        pipe_entries = []          # (idx, frame, n, dest, wm, capture)
         n_dispatches = 0
         for i, (frame, dest, with_metrics) in enumerate(entries):
-            adapted = self.model.adapt_test(frame)
             n = frame.nrows
+            # pipeline splice FIRST: capture must see the frame BEFORE
+            # adapt_test touches column data (a lazy-column fault is an
+            # observation point and would flush the pending feature DAG)
+            if not mp and not local_only:
+                from h2o3_tpu import pipeline
+
+                if pipeline.enabled():
+                    try:
+                        cap = pipeline.try_capture(self, frame)
+                    except Exception:   # noqa: BLE001 — staged is the
+                        cap = None      # contract for anything capture
+                    if cap is not None:  # cannot hold
+                        pipe_entries.append((i, frame, n, dest,
+                                             with_metrics, cap))
+                        continue
+            adapted = self.model.adapt_test(frame)
             sf = None if local_mp else self._sharded_view(adapted)
             if sf is not None:
                 sharded_entries.append((i, frame, n, dest, with_metrics,
@@ -716,6 +732,37 @@ class ScoringSession:
             else:
                 host_entries.append((i, frame, adapted, n, dest,
                                      with_metrics))
+        if pipe_entries:
+            from h2o3_tpu import pipeline
+            from h2o3_tpu.core import sharded_frame
+
+            for i, frame, n, dest, with_metrics, cap in pipe_entries:
+                # munge→score as ONE program per bucket: the captured
+                # feature DAG and the forest core dispatch together; no
+                # engineered Column ever materializes
+                try:
+                    mg, nd = pipeline.execute_margins(self, cap)
+                except Exception:   # noqa: BLE001 — abandon to staged
+                    pipeline.note_fallback(cap)
+                    adapted = self.model.adapt_test(frame)
+                    sf = None if local_mp else self._sharded_view(adapted)
+                    if sf is not None:
+                        sharded_entries.append((i, frame, n, dest,
+                                                with_metrics, sf))
+                    else:
+                        host_entries.append((i, frame, adapted, n, dest,
+                                             with_metrics))
+                    continue
+                n_dispatches += nd
+                sharded_frame.note_packed(n)
+                raw = self.model._margin_to_raw(
+                    self._lift_entry_margins(mg, n, cap.padded))
+                with tracing.span("fetch", rows=n, path="pipeline"):
+                    pred = self.model._raw_to_frame(raw, n, key=dest)
+                    pred.install()
+                    mm = self.model._make_metrics(frame, raw) \
+                        if with_metrics else None
+                results[i] = (pred, mm)
         if sharded_entries:
             from h2o3_tpu.core import sharded_frame
 

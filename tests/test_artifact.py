@@ -708,19 +708,29 @@ class TestGlmArtifact:
                                   _bits(out3[lvl])), lvl
         mm.delete()
 
-    def test_glm_artifact_refuses_server_import(self, cl, tmp_path):
-        """GLM artifacts score standalone; the /3/Artifacts import path
-        (which re-hydrates forest models) refuses them with a clear
-        message instead of a KeyError."""
+    def test_glm_artifact_server_import_bitwise(self, cl, tmp_path):
+        """GLM artifacts re-import through the /3/Artifacts path: the
+        loader rebuilds coefficients, the DataInfo layout, and the
+        threshold metrics, and the imported model's predictions are
+        bitwise-identical to the exporting model's."""
         from h2o3_tpu import artifact
         from h2o3_tpu.models.glm import GLM
 
-        fr, _test, _cols, _tn = self._glm_frames(seed=35)
+        fr, test, _cols, tn = self._glm_frames(seed=35)
         m = GLM(family="binomial").train(y="y", training_frame=fr)
-        art = str(tmp_path / "glm_noimp")
+        art = str(tmp_path / "glm_imp")
         artifact.export_model(m, art, buckets=[128])
-        with pytest.raises(artifact.ArtifactError, match="standalone"):
-            artifact.load_model(art)
+        ref = m.predict(test)
+        loaded = artifact.load_model(art, model_id="glm_reimported")
+        assert loaded.key == "glm_reimported"
+        out = loaded.predict(test)
+        for lvl in ("N", "Y"):
+            assert np.array_equal(
+                _bits(np.asarray(ref.col(lvl).data)[:tn]),
+                _bits(np.asarray(out.col(lvl).data)[:tn])), lvl
+        assert (np.asarray(ref.col("predict").data)[:tn].tolist()
+                == np.asarray(out.col("predict").data)[:tn].tolist())
+        loaded.delete()
         m.delete()
 
     def test_unsupported_glm_shapes_refused(self, cl, tmp_path):

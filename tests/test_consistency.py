@@ -342,3 +342,69 @@ def test_multi_entry_flush_is_one_dispatch_per_bucket():
         "coalesced one-dispatch-per-bucket contract is broken")
     assert after["gathered_rows"] == before["gathered_rows"], (
         "the coalesced flush gathered columns to the coordinator host")
+
+
+def test_pipeline_splice_is_one_program_per_bucket_with_zero_gathers():
+    """ISSUE-16 guard: a 3-statement lazy Rapids feature chain feeding a
+    GBM predict must run as EXACTLY ONE ``pipeline``-family fused program
+    for its row bucket — engineered Columns never materialize
+    (``materialized_columns`` stays 0) and ``gathered_rows`` never moves.
+    A regression that re-materializes the munge output (or re-splits the
+    dispatch) trips this immediately."""
+    import numpy as np
+
+    import h2o3_tpu
+    from h2o3_tpu import pipeline, scoring
+    from h2o3_tpu.core import sharded_frame
+    from h2o3_tpu.core.frame import Column, Frame
+    from h2o3_tpu.models.tree.gbm import GBM
+    from h2o3_tpu.obs import compiles
+    from h2o3_tpu.rapids import Session, exec_rapids, fusion, planner
+
+    h2o3_tpu.init()
+    rng = np.random.default_rng(66)
+    n = 500
+    tr = Frame()
+    x = rng.standard_normal(n)
+    tr.add("x1", Column.from_numpy(x))
+    tr.add("x2", Column.from_numpy(rng.standard_normal(n)))
+    tr.add("y", Column.from_numpy(
+        np.where(rng.random(n) < 1 / (1 + np.exp(-x)), "Y", "N"),
+        ctype="enum"))
+    model = GBM(ntrees=2, max_depth=2, seed=6).train(
+        y="y", training_frame=tr)
+    m = 300
+    raw = Frame(key="consist_pipe_raw")
+    raw.add("r1", Column.from_numpy(rng.standard_normal(m)))
+    raw.add("r2", Column.from_numpy(rng.standard_normal(m)))
+    raw.install()
+    with planner.force(True), fusion.force(True), pipeline.force(True):
+        s = Session("consist_pipe")
+        # split-free 3-statement chain: one fused program, no sub-plans
+        exec_rapids('(tmp= cp_a (+ (cols consist_pipe_raw [0]) 1))', s)
+        exec_rapids('(tmp= cp_b (ifelse (> (cols consist_pipe_raw [1]) 0) '
+                    '(cols consist_pipe_raw [1]) cp_a))', s)
+        pf = exec_rapids('(tmp= cp_pf (colnames= (cbind cp_a cp_b) [0 1] '
+                         '["x1" "x2"]))', s)
+        rows_before = [r for r in compiles.ledger_rows()
+                       if r["family"] == "pipeline"]
+        gath_before = sharded_frame.counters()["gathered_rows"]
+        pcount_before = pipeline.counters()
+        scoring.session_for(model).predict(pf, key="consist_pipe_out")
+        rows = [r for r in compiles.ledger_rows()
+                if r["family"] == "pipeline"][len(rows_before):]
+        pcount = pipeline.counters()
+        s.end()
+    assert len(rows) == 1, (
+        f"a 3-statement chain + predict landed {len(rows)} pipeline "
+        "ledger rows for its one row bucket — the one-program-per-bucket "
+        "contract is broken")
+    assert rows[0]["cache"] == "compile"
+    assert pcount["fused_dispatches"] == \
+        pcount_before["fused_dispatches"] + 1
+    assert pcount["materialized_columns"] == \
+        pcount_before["materialized_columns"], (
+        "the fused munge→score path materialized an engineered Column")
+    assert sharded_frame.counters()["gathered_rows"] == gath_before, (
+        "the fused munge→score path gathered columns to the coordinator")
+    model.delete()

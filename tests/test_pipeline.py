@@ -1,0 +1,386 @@
+"""ISSUE-16: munge→score pipeline fusion + standalone pipeline artifacts.
+
+Acceptance surface:
+
+- a frame fed by a still-PENDING lazy Rapids feature pipeline scores
+  through ONE fused ``pipeline``-family program per row bucket, with ZERO
+  engineered Columns materialized (``pipeline_materialized_columns`` /
+  ``materialized_columns`` counter-asserted), BITWISE-identical to the
+  staged flush→adapt→score path — for GBM (binomial + multinomial) and
+  GLM (binomial + multinomial + regression), NA paths included;
+- frames the splice cannot hold (unseen categorical levels) fall back to
+  the staged path and stay correct;
+- an exported *pipeline artifact* scores RAW rows in a FRESH process
+  (no h2o3_tpu import) bitwise-identically to in-process serving;
+- a warm restart against ``$H2O_TPU_COMPILE_CACHE_DIR`` compiles ZERO
+  ``pipeline``-family programs.
+"""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu import pipeline, scoring
+from h2o3_tpu.core.frame import Column, Frame
+from h2o3_tpu.models.glm import GLM
+from h2o3_tpu.models.tree.gbm import GBM
+from h2o3_tpu.rapids import Session, exec_rapids
+from h2o3_tpu.rapids import fusion, planner
+
+
+def _bits(a):
+    a = np.asarray(a)
+    return a.view(np.uint32) if a.dtype == np.float32 else a
+
+
+def _assert_frames_bitwise(a: Frame, b: Frame, n: int) -> None:
+    assert list(a.names) == list(b.names)
+    for nm in a.names:
+        ca, cb = np.asarray(a.col(nm).data)[:n], np.asarray(b.col(nm).data)[:n]
+        assert np.array_equal(_bits(ca), _bits(cb)), \
+            f"column {nm!r} differs from the staged path"
+
+
+def _train_frame(seed: int, n: int = 700, classes: int = 2) -> Frame:
+    rng = np.random.default_rng(seed)
+    fr = Frame()
+    x1, x2 = rng.standard_normal(n), rng.standard_normal(n)
+    g = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+    fr.add("x1", Column.from_numpy(x1))
+    fr.add("x2", Column.from_numpy(x2))
+    fr.add("g", Column.from_numpy(g, ctype="enum"))
+    if classes == 0:                              # regression response
+        fr.add("y", Column.from_numpy(
+            1.3 * x1 - x2 + (g == "a") + 0.1 * rng.standard_normal(n)))
+    elif classes == 2:
+        logit = 1.2 * x1 - x2 + (g == "a") * 0.5
+        fr.add("y", Column.from_numpy(
+            np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "Y", "N"),
+            ctype="enum"))
+    else:
+        score = np.stack([x1, -x2, 0.5 * x1 + x2
+                          + (g == "b")], axis=-1)
+        fr.add("y", Column.from_numpy(
+            np.array(["c0", "c1", "c2"])[np.argmax(
+                score + rng.gumbel(size=score.shape), axis=-1)],
+            ctype="enum"))
+    return fr
+
+
+def _raw_frame(key: str, seed: int, m: int = 257) -> Frame:
+    """Raw (un-engineered) serving rows: NaNs in r1, all 3 g levels."""
+    rng = np.random.default_rng(seed + 1000)
+    f = Frame(key=key)
+    r1 = rng.standard_normal(m)
+    r1[::9] = np.nan                                       # NA path
+    f.add("r1", Column.from_numpy(r1))
+    f.add("r2", Column.from_numpy(rng.standard_normal(m)))
+    g = np.array(["a", "b", "c"])[rng.integers(0, 3, m)]
+    g[:3] = ["a", "b", "c"]          # pin the training domain exactly
+    f.add("g", Column.from_numpy(g, ctype="enum"))
+    f.install()
+    return f
+
+
+def _engineer(sess: Session, p: str, rawkey: str, *, variant: int) -> Frame:
+    """Lazy engineered frame x1/x2/g over the raw columns. variant 0 is
+    split-free (exportable as one program); variant 1 contains a
+    multiply-into-subtract — a compiler-rewrite boundary that becomes a
+    separate cached sub-program (Plan leaf) in-process."""
+    if variant == 0:
+        exec_rapids(f'(tmp= {p}_x1 (+ (cols {rawkey} [0]) 0.5))', sess)
+        exec_rapids(f'(tmp= {p}_x2 (ifelse (> (cols {rawkey} [1]) 0) '
+                    f'(cols {rawkey} [1]) (cols {rawkey} [0])))', sess)
+    else:
+        exec_rapids(f'(tmp= {p}_x1 (- (* (cols {rawkey} [0]) 2) '
+                    f'(cols {rawkey} [1])))', sess)
+        exec_rapids(f'(tmp= {p}_x2 (+ (cols {rawkey} [1]) 1))', sess)
+    return exec_rapids(
+        f'(tmp= {p}_pf (colnames= (cbind {p}_x1 {p}_x2 '
+        f'(cols {rawkey} [2])) [0 1 2] ["x1" "x2" "g"]))', sess)
+
+
+# ---------------------------------------------------------------------------
+# randomized property suite: pipeline-fused == staged, bitwise
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed,classes", [
+    (0, 2), (1, 2), (2, 2), (3, 2), (4, 2), (5, 2),
+    (6, 3), (7, 3), (8, 3),
+])
+def test_gbm_pipeline_bitwise_vs_staged(cl, seed, classes):
+    """The tentpole contract for forests: predict over a pending feature
+    DAG runs as ONE fused munge→score dispatch with zero engineered
+    Columns materialized, and every output column is bitwise-identical
+    to the staged flush→adapt→predict path."""
+    tr = _train_frame(seed, classes=classes)
+    model = GBM(ntrees=3, max_depth=3, seed=seed + 1).train(
+        y="y", training_frame=tr)
+    try:
+        with planner.force(True), fusion.force(True), pipeline.force(True):
+            s = Session(f"pl_gbm_{seed}")
+            raw = _raw_frame(f"plraw_gbm_{seed}", seed)
+            pf = _engineer(s, f"pg{seed}", str(raw.key), variant=seed % 2)
+            ssn = scoring.session_for(model)
+            before = pipeline.counters()
+            fused = ssn.predict(pf, key=f"pl_gbm_out_{seed}")
+            after = pipeline.counters()
+            assert after["captures"] == before["captures"] + 1
+            assert after["fused_dispatches"] > before["fused_dispatches"]
+            assert after["spliced_nodes"] >= before["spliced_nodes"] + 2
+            assert after["materialized_columns"] == \
+                before["materialized_columns"], \
+                "an engineered Column materialized on the fused path"
+            with pipeline.force(False):
+                staged = ssn.predict(pf, key=f"pl_gbm_ref_{seed}")
+            _assert_frames_bitwise(fused, staged, raw.nrows)
+            s.end()
+    finally:
+        model.delete()
+
+
+@pytest.mark.parametrize("seed,classes", [
+    (10, 2), (11, 2), (12, 2), (13, 3), (14, 3), (15, 0),
+])
+def test_glm_pipeline_bitwise_vs_staged(cl, seed, classes):
+    """The GLM half of the splice: per-feature fused plans feed the
+    linear-predictor core in ONE ``pipeline``-family program; bitwise
+    against the staged path for binomial, multinomial and regression."""
+    tr = _train_frame(seed, classes=classes)
+    fam = {2: "binomial", 3: "multinomial", 0: "gaussian"}[classes]
+    model = GLM(family=fam, lambda_=0.0).train(y="y", training_frame=tr)
+    try:
+        with planner.force(True), fusion.force(True), pipeline.force(True):
+            s = Session(f"pl_glm_{seed}")
+            raw = _raw_frame(f"plraw_glm_{seed}", seed)
+            pf = _engineer(s, f"pl{seed}", str(raw.key), variant=seed % 2)
+            before = pipeline.counters()
+            fused = model.predict(pf, key=f"pl_glm_out_{seed}")
+            after = pipeline.counters()
+            assert after["captures"] == before["captures"] + 1
+            assert after["fused_dispatches"] > before["fused_dispatches"]
+            assert after["materialized_columns"] == \
+                before["materialized_columns"]
+            with pipeline.force(False):
+                staged = model.predict(pf, key=f"pl_glm_ref_{seed}")
+            _assert_frames_bitwise(fused, staged, raw.nrows)
+            s.end()
+    finally:
+        model.delete()
+
+
+def test_unseen_level_falls_back_to_staged(cl):
+    """A raw categorical whose domain differs from training (unseen
+    level) cannot splice — the predict must silently take the staged
+    path and still be correct."""
+    tr = _train_frame(21)
+    model = GBM(ntrees=3, max_depth=3, seed=3).train(
+        y="y", training_frame=tr)
+    try:
+        with planner.force(True), fusion.force(True), pipeline.force(True):
+            s = Session("pl_unseen")
+            rng = np.random.default_rng(77)
+            m = 120
+            raw = Frame(key="plraw_unseen")
+            raw.add("r1", Column.from_numpy(rng.standard_normal(m)))
+            raw.add("r2", Column.from_numpy(rng.standard_normal(m)))
+            g = np.array(["a", "b", "c", "zz"])[rng.integers(0, 4, m)]
+            g[:4] = ["a", "b", "c", "zz"]            # 4-level domain
+            raw.add("g", Column.from_numpy(g, ctype="enum"))
+            raw.install()
+            pf = _engineer(s, "pu", "plraw_unseen", variant=0)
+            ssn = scoring.session_for(model)
+            before = pipeline.counters()
+            got = ssn.predict(pf, key="pl_unseen_out")
+            assert pipeline.counters()["captures"] == before["captures"], \
+                "a domain-mismatched frame must not capture"
+            with pipeline.force(False):
+                ref = ssn.predict(pf, key="pl_unseen_ref")
+            _assert_frames_bitwise(got, ref, m)
+            s.end()
+    finally:
+        model.delete()
+
+
+# ---------------------------------------------------------------------------
+# warm restart: zero pipeline compiles
+# ---------------------------------------------------------------------------
+
+def test_warm_restart_compiles_zero_pipeline_programs(cl, tmp_path,
+                                                      monkeypatch):
+    """PR-6 persistent tier for the new family: populate
+    $H2O_TPU_COMPILE_CACHE_DIR, drop every in-memory program (simulated
+    restart), re-run the same pipeline predict — the ``pipeline`` family
+    must compile ZERO programs and serve from the disk tier."""
+    from h2o3_tpu.obs import compiles
+
+    monkeypatch.setenv("H2O_TPU_COMPILE_CACHE_DIR", str(tmp_path))
+    tr = _train_frame(31)
+    model = GBM(ntrees=3, max_depth=3, seed=9).train(
+        y="y", training_frame=tr)
+    try:
+        with planner.force(True), fusion.force(True), pipeline.force(True):
+            s = Session("pl_warm_a")
+            raw = _raw_frame("plraw_warm_a", 31)
+            pf = _engineer(s, "pw", str(raw.key), variant=0)
+            ssn = scoring.session_for(model)
+            cold = ssn.predict(pf, key="pl_warm_cold")
+            s.end()
+            if not any(p.name.startswith("xc_")
+                       for p in tmp_path.iterdir()):
+                pytest.skip("this jax cannot serialize executables")
+            # simulated restart: every memory tier dropped
+            pipeline.clear_programs()
+            fusion.clear_programs()
+            before = compiles.family_table().get("pipeline", {})
+            s2 = Session("pl_warm_b")
+            raw2 = _raw_frame("plraw_warm_b", 31)     # identical data
+            pf2 = _engineer(s2, "pw2", str(raw2.key), variant=0)
+            warm = ssn.predict(pf2, key="pl_warm_warm")
+            after = compiles.family_table()["pipeline"]
+            assert after["compiles"] == before.get("compiles", 0), \
+                "a warm restart must compile zero pipeline programs"
+            assert after["hits_disk"] > before.get("hits_disk", 0)
+            _assert_frames_bitwise(warm, cold, raw.nrows)
+            s2.end()
+    finally:
+        model.delete()
+
+
+# ---------------------------------------------------------------------------
+# standalone pipeline artifacts: raw rows, fresh process, bitwise
+# ---------------------------------------------------------------------------
+
+_RUNNER = r"""
+import sys
+import numpy as np
+
+assert "h2o3_tpu" not in sys.modules
+from h2o3_genmodel.aot import load_artifact
+assert "h2o3_tpu" not in sys.modules, "genmodel pulled in the framework"
+
+inp = np.load(sys.argv[-2], allow_pickle=False)
+cols = {}
+for k in inp.files:
+    if k.startswith("num_"):
+        cols[k[4:]] = inp[k]
+    elif k.startswith("cat_"):
+        cols[k[4:]] = [None if v == "" else str(v) for v in inp[k]]
+out = {}
+for tag in ("gbm", "glm"):
+    s = load_artifact(sys.argv[-4] if tag == "gbm" else sys.argv[-3])
+    got = s.score(cols)
+    for k, v in got.items():
+        a = np.asarray(v)
+        if a.dtype.kind in "fiu":
+            out[f"{tag}_{k}"] = a
+        else:
+            out[f"{tag}_{k}"] = a.astype(str)
+np.savez(sys.argv[-1], **out)
+"""
+
+
+def test_pipeline_artifact_scores_raw_rows_in_fresh_process(cl, tmp_path):
+    """The deployment contract: ``export_pipeline`` for a GBM and a GLM
+    over the SAME pending feature DAG; a fresh python process (no
+    h2o3_tpu import) scores the RAW columns through h2o3_genmodel.aot
+    bitwise-identically to the in-process fused predictions."""
+    from h2o3_tpu.artifact.pipeline import export_pipeline
+
+    tr = _train_frame(41)
+    gbm = GBM(ntrees=3, max_depth=3, seed=5).train(
+        y="y", training_frame=tr)
+    glm = GLM(family="binomial", lambda_=0.0).train(
+        y="y", training_frame=tr)
+    refs = {}
+    raw_np = {}
+    try:
+        for tag, model in (("gbm", gbm), ("glm", glm)):
+            with planner.force(True), fusion.force(True), \
+                    pipeline.force(True):
+                s = Session(f"pl_art_{tag}")
+                raw = _raw_frame(f"plraw_art_{tag}", 41)
+                if not raw_np:
+                    raw_np = {
+                        "num_r1": np.asarray(raw.col("r1").to_numpy(),
+                                             np.float32),
+                        "num_r2": np.asarray(raw.col("r2").to_numpy(),
+                                             np.float32),
+                        "cat_g": np.asarray(
+                            [raw.col("g").domain[int(c)]
+                             for c in np.asarray(
+                                 raw.col("g").data)[:raw.nrows]]),
+                    }
+                pf = _engineer(s, f"pa{tag}", str(raw.key), variant=0)
+                export_pipeline(model, pf,
+                                str(tmp_path / f"art_{tag}"),
+                                buckets=[512])
+                if tag == "gbm":
+                    refs[tag] = scoring.session_for(model).predict(
+                        pf, key=f"pl_art_out_{tag}")
+                else:
+                    refs[tag] = model.predict(pf, key=f"pl_art_out_{tag}")
+                s.end()
+
+        script = tmp_path / "runner.py"
+        script.write_text(_RUNNER)
+        in_npz = tmp_path / "raw_cols.npz"
+        np.savez(in_npz, **raw_np)
+        out_npz = tmp_path / "out.npz"
+        root = str(pathlib.Path(__file__).resolve().parents[1])
+        env = dict(os.environ,
+                   PYTHONPATH=os.pathsep.join(
+                       [root] + [p for p in
+                                 os.environ.get("PYTHONPATH", "").split(
+                                     os.pathsep) if p]))
+        proc = subprocess.run(
+            [sys.executable, str(script), str(tmp_path / "art_gbm"),
+             str(tmp_path / "art_glm"), str(in_npz), str(out_npz)],
+            capture_output=True, text=True, timeout=300, env=env)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        with np.load(out_npz, allow_pickle=False) as z:
+            for tag in ("gbm", "glm"):
+                ref = refs[tag]
+                n = len(raw_np["num_r1"])
+                dom = ref.col("predict").domain
+                lab = [dom[int(i)]
+                       for i in np.asarray(ref.col("predict").data)[:n]]
+                assert lab == list(z[f"{tag}_predict"]), \
+                    f"{tag}: standalone labels differ"
+                for lvl in ("N", "Y"):
+                    assert np.array_equal(
+                        _bits(np.asarray(ref.col(lvl).data)[:n]),
+                        _bits(z[f"{tag}_{lvl}"])), \
+                        f"{tag} {lvl!r}: standalone probs not bitwise"
+    finally:
+        gbm.delete()
+        glm.delete()
+
+
+def test_export_refuses_rewrite_boundary_features(cl, tmp_path):
+    """A feature with a multiply-feeding-subtract splits into separate
+    programs in-process; exporting it as ONE standalone program would
+    license the FMA rewrites the split prevents — the exporter must
+    refuse with the reason rather than ship a non-bitwise artifact."""
+    from h2o3_tpu.artifact import ArtifactError
+    from h2o3_tpu.artifact.pipeline import export_pipeline
+
+    tr = _train_frame(51)
+    model = GBM(ntrees=2, max_depth=2, seed=2).train(
+        y="y", training_frame=tr)
+    try:
+        with planner.force(True), fusion.force(True), pipeline.force(True):
+            s = Session("pl_refuse")
+            raw = _raw_frame("plraw_refuse", 51)
+            pf = _engineer(s, "pr", str(raw.key), variant=1)  # FMA split
+            with pytest.raises(ArtifactError, match="rewrite"):
+                export_pipeline(model, pf, str(tmp_path / "art_refuse"),
+                                buckets=[512])
+            s.end()
+    finally:
+        model.delete()
