@@ -151,6 +151,7 @@ def run_compile_ledger(ctx: Context) -> List[Finding]:
     silently under-counts /3/Runtime and the compile-seconds series."""
     allowed = set(ctx.reg("COMPILE_LEDGER_MODULES",
                           ("h2o3_tpu/obs/compiles.py",)))
+    jit_scope = tuple(ctx.reg("JIT_LEDGER_SCOPE", ()))
     compat = ctx.reg("COMPAT_MODULE", "h2o3_tpu/compat.py")
     findings: List[Finding] = []
     for mod in ctx.project.modules.values():
@@ -212,6 +213,27 @@ def run_compile_ledger(ctx: Context) -> List[Finding]:
                     "writer of the fused-compile counter (it times the "
                     "compile itself, so compile_ms_total cannot drift "
                     "from the per-program rows)", symbol=mod.rel))
+        # bare `jax.jit` ban inside the ledgered-jit scopes (ISSUE 17):
+        # calls, decorators and bare references all resolve to the same
+        # Attribute/Name node, so one walk catches every spelling
+        if any(mod.rel.startswith(p) for p in jit_scope):
+            seen_jit = set()
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Attribute):
+                    name = _normalize(_dotted(node), mod.imports)
+                elif isinstance(node, ast.Name):
+                    name = mod.imports.get(node.id)
+                else:
+                    continue
+                if name == "jax.jit" and node.lineno not in seen_jit:
+                    seen_jit.add(node.lineno)
+                    findings.append(ctx.finding(
+                        "compile-ledger", mod, node,
+                        "bare `jax.jit` in a ledgered-jit scope — use "
+                        "obs/compiles.ledgered_jit(family, fn) so the "
+                        "compiles this jit triggers land in the ledger "
+                        "(family `tree` for models/tree/)",
+                        symbol=mod.rel))
     # registry self-check: a renamed chokepoint must not turn this pass
     # into a green no-op
     for rel in allowed:
@@ -220,6 +242,14 @@ def run_compile_ledger(ctx: Context) -> List[Finding]:
                 "compile-ledger", "h2o3_tpu/analysis/registry.py", 0,
                 f"COMPILE_LEDGER_MODULES entry `{rel}` matches no module "
                 f"— stale registry path; fix it", symbol=rel, snippet=rel))
+    for prefix in jit_scope:
+        if not any(m.rel.startswith(prefix)
+                   for m in ctx.project.modules.values()):
+            findings.append(Finding(
+                "compile-ledger", "h2o3_tpu/analysis/registry.py", 0,
+                f"JIT_LEDGER_SCOPE prefix `{prefix}` matches no module — "
+                f"stale registry path; fix it", symbol=prefix,
+                snippet=prefix))
     return findings
 
 

@@ -65,6 +65,10 @@ KNOB_HELPERS = frozenset({
     "h2o3_tpu.ingest.chunked.chunk_bytes",         # H2O_TPU_INGEST_CHUNK_BYTES
     "h2o3_tpu.ingest.chunked.ingest_workers",      # H2O_TPU_INGEST_WORKERS
     "h2o3_tpu.ingest.chunked.parquet_batch",       # lazy-parquet batch width
+    "h2o3_tpu.models.tree.pallas_hist.hist_budget_bytes",
+    # — H2O_TPU_HIST_VMEM_MB: the frontier-tile budget is a pure function
+    # of (env, geometry); the ops contract pins the env uniform, so every
+    # process plans the same tiling and lowers the same program
 })
 
 # audited divergent-looking call sites that are mirrored-safe; reason is
@@ -74,10 +78,15 @@ GUARDED = {
         "the ONE seed-derivation policy: REST pins wildcard seeds before "
         "any broadcast (_pin_seed_and_wire), so this fresh entropy only "
         "runs library-mode (single process)",
-    "h2o3_tpu.models.tree.pallas_hist.use_pallas":
-        "auto-mode microbenchmark is wall-clock but multi-process clouds "
-        "deterministically keep XLA (PR-7 hardening) — the timing branch "
-        "is single-process only",
+    "h2o3_tpu.models.tree.pallas_hist.decide_lowering":
+        "H2O_TPU_PALLAS_HIST read is env-contract-pinned; the auto-mode "
+        "branch is wall-clock but multi-process clouds deterministically "
+        "keep the matmul lowering (PR-7 hardening) — the timing path is "
+        "single-process only",
+    "h2o3_tpu.models.tree.pallas_hist.auto_decide":
+        "three-way microbenchmark: wall-clock timing + cache-dir verdict "
+        "reads, reachable only through decide_lowering's single-process "
+        "auto branch (multi-process clouds never call it)",
     "h2o3_tpu.core.dkv.Key.make":
         "random key suffixes are process-local DKV names; cross-process "
         "keys always ride op payloads, never shape device programs",
@@ -202,6 +211,13 @@ COMPAT_MODULE = "h2o3_tpu/compat.py"
 # series silently under-count. h2o3_genmodel/ is exempt like the compat
 # pass: the standalone runners are framework-free by contract.
 COMPILE_LEDGER_MODULES = ("h2o3_tpu/obs/compiles.py",)
+
+# module prefixes where BARE `jax.jit` is banned outright (ISSUE 17):
+# every jit in these subsystems must be a `compiles.ledgered_jit` so the
+# compiles it triggers land under the subsystem's family. models/tree/
+# predates the ledger (histogram.py's bare @jax.jit was the one compile
+# family /3/Runtime couldn't see) — this scope closes that hole.
+JIT_LEDGER_SCOPE = ("h2o3_tpu/models/tree/",)
 
 # ---------------------------------------------------------------------------
 # sync-hygiene pass

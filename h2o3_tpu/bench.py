@@ -90,7 +90,21 @@ def run_flagship(n_rows: int = 1_000_000, n_num: int = 8, n_cat: int = 2,
     t0 = time.perf_counter()
     GBM(ntrees=ntrees, max_depth=max_depth).train(y="y", training_frame=fr)
     dt = time.perf_counter() - t0
+    _print_hist_aux()
     return n_rows * ntrees / dt, "gbm_rows_per_sec"
+
+
+def _print_hist_aux():
+    """Which histogram lowering the timed train actually ran, plus its
+    frontier tile width — so a device round's corpse (or number) says
+    which path produced it. Values are numeric (the driver floats every
+    H2O3_BENCH line): hist_lowering is the LOWERINGS index."""
+    from h2o3_tpu.models.tree import pallas_hist
+
+    rep = pallas_hist.hist_report()
+    print(f"H2O3_BENCH hist_lowering "
+          f"{pallas_hist.lowering_code(rep['lowering'])}", flush=True)
+    print(f"H2O3_BENCH hist_tile_S {rep['tile_S']}", flush=True)
 
 
 def run_drf_deep(n_rows: int = 200_000, ntrees: int = 5,
@@ -117,6 +131,7 @@ def run_drf_deep(n_rows: int = 200_000, ntrees: int = 5,
     DRF(ntrees=ntrees, max_depth=max_depth, seed=1).train(
         y="y", training_frame=fr)
     dt = time.perf_counter() - t0
+    _print_hist_aux()
     return n_rows * ntrees / dt, "drf_deep_rows_per_sec"
 
 

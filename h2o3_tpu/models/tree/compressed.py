@@ -249,14 +249,15 @@ def _traverse_fn(max_depth: int, nclasses: int, per_class: bool = False):
 
     K = nclasses if (nclasses > 2 or per_class) else 1
 
-    @jax.jit
     def run(binned, feat, thresh, na_left, left, right, leaf_val,
             cat_split, cat_table, tree_class, na_bins):
         return _forest_margins(binned, feat, thresh, na_left, left, right,
                                leaf_val, cat_split, cat_table, tree_class,
                                na_bins, max_depth, K)
 
-    return run
+    from h2o3_tpu.obs import compiles
+
+    return compiles.ledgered_jit("tree", run, program="forest_traverse")
 
 
 def _bin_features(X, edges, is_cat, na_bins):
@@ -343,14 +344,15 @@ def _fused_score_fn(max_depth: int, nclasses: int, per_class: bool = False):
 
     K = nclasses if (nclasses > 2 or per_class) else 1
 
-    @jax.jit
     def run(X, edges, is_cat, init, feat, thresh, na_left, left, right,
             leaf_val, cat_split, cat_table, tree_class, na_bins):
         return _fused_margins(X, edges, is_cat, init, feat, thresh,
                               na_left, left, right, leaf_val, cat_split,
                               cat_table, tree_class, na_bins, max_depth, K)
 
-    return run
+    from h2o3_tpu.obs import compiles
+
+    return compiles.ledgered_jit("tree", run, program="fused_score")
 
 
 @functools.lru_cache(maxsize=32)
@@ -381,20 +383,23 @@ def _fused_score_sharded_fn(max_depth: int, nclasses: int, per_class: bool,
     out_specs = P("rows", None) if K > 1 else P("rows")
     fn = _compat_shard_map(run, mesh=mesh, in_specs=in_specs,
                            out_specs=out_specs)
-    return jax.jit(fn)
+    from h2o3_tpu.obs import compiles
+
+    return compiles.ledgered_jit("tree", fn, program="fused_score_sharded")
 
 
 @functools.lru_cache(maxsize=8)
 def _leaf_fn(max_depth: int):
     import jax
 
-    @jax.jit
     def run(binned, feat, thresh, na_left, left, right, leaf_val,
             cat_split, cat_table, tree_class, na_bins):
         return _forest_leaves(binned, feat, thresh, na_left, left, right,
                               cat_split, cat_table, na_bins, max_depth)
 
-    return run
+    from h2o3_tpu.obs import compiles
+
+    return compiles.ledgered_jit("tree", run, program="forest_leaves")
 
 
 def _fused_leaves(X, edges, is_cat, feat, thresh, na_left, left, right,
@@ -415,14 +420,15 @@ def _fused_leaf_fn(max_depth: int):
     bucketed (N, F) raw feature matrix (host-packed serving layout)."""
     import jax
 
-    @jax.jit
     def run(X, edges, is_cat, feat, thresh, na_left, left, right,
             cat_split, cat_table, na_bins):
         return _fused_leaves(X, edges, is_cat, feat, thresh, na_left, left,
                              right, cat_split, cat_table, na_bins,
                              max_depth)
 
-    return run
+    from h2o3_tpu.obs import compiles
+
+    return compiles.ledgered_jit("tree", run, program="fused_leaves")
 
 
 @functools.lru_cache(maxsize=32)
@@ -445,7 +451,9 @@ def _fused_leaf_sharded_fn(max_depth: int, mesh):
     in_specs = (P("rows", None),) + (P(),) * 10
     fn = _compat_shard_map(run, mesh=mesh, in_specs=in_specs,
                            out_specs=P("rows", None))
-    return jax.jit(fn)
+    from h2o3_tpu.obs import compiles
+
+    return compiles.ledgered_jit("tree", fn, program="fused_leaves_sharded")
 
 
 def forest_predict_fn():

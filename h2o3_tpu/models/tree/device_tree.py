@@ -212,10 +212,13 @@ def _search_level(hist, *, nbins, is_cat, maxB, min_rows, min_split_improvement,
 def _grow_fn(max_depth: int, F: int, maxB: int, nbins: tuple, is_cat: tuple,
              min_rows: float, min_split_improvement: float,
              has_masks: bool, mesh, n_shard: int, blk: int, cap: int,
-             use_pallas: bool = False):
+             lowering: str = "matmul"):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
+
+    from h2o3_tpu.models.tree import pallas_hist
+    from h2o3_tpu.obs import compiles
 
     nblk = -(-n_shard // blk)
     pad_to = nblk * blk
@@ -224,25 +227,29 @@ def _grow_fn(max_depth: int, F: int, maxB: int, nbins: tuple, is_cat: tuple,
     tot_slots = sum(widths)
     Smax = max(widths)
     K = pack_width(maxB)
+    TB = F * maxB
+
+    def hist_gather_pl(binned, row_node, live, w, y, S):
+        """(S, F, maxB, 3) via the fused Pallas gather→accumulate kernel
+        (pallas_hist.py): flat node·TB + offset[f] + bin indices
+        scatter-added into a VMEM-resident accumulator — no one-hot ever
+        materializes, all features in one grid pass. Dead rows encode as
+        node = -1 / w = 0 (no tile owns them). The frontier tile plan is
+        static per level; `lowering` is part of the _grow_fn cache key
+        (the env/auto decision is taken at CALL time in
+        grow_tree_device), so toggling the flag mid-process picks the
+        right compiled program instead of a stale cache entry."""
+        node = jnp.where(live, row_node, -1)
+        w_live = jnp.where(live, w, 0.0)
+        acc = pallas_hist.hist_gather(
+            binned, node, w_live, y,
+            offsets=np.arange(F, dtype=np.int32) * maxB, TB=TB, S=S)
+        acc = jax.lax.psum(acc, "rows")
+        return acc.reshape(S, F, maxB, 3)
 
     def hist_matmul(binned, row_node, live, w, y, S):
-        """(S, F, maxB, 3) via blocked bf16 one-hot matmul + psum. With
-        H2O_TPU_PALLAS_HIST set, the block loop runs as the fused Pallas
-        kernel (pallas_hist.py) that never materializes the one-hots in
-        HBM; the XLA fallback below materializes O per block."""
-        from h2o3_tpu.models.tree import pallas_hist
-
-        # use_pallas is part of the _grow_fn cache key: the env flag is read
-        # at CALL time in grow_tree_device, so toggling it mid-process picks
-        # the right compiled program instead of a stale cache entry
-        if use_pallas:
-            w_live = jnp.where(live, w, 0.0)
-            acc = pallas_hist.hist_pallas(
-                binned, row_node, w_live, y, F=F, maxB=maxB, S=S,
-                blk=pallas_hist.pick_blk(F, maxB, S), vma=("rows",))
-            acc = jax.lax.psum(acc, "rows")
-            return acc.reshape(F, maxB, S, 3).transpose(2, 0, 1, 3)
-
+        """(S, F, maxB, 3) via blocked bf16 one-hot matmul + psum — the
+        MXU lowering; O(N·F·maxB·S·3) FLOPs, almost all on zeros."""
         def body(i, acc):
             sl = lambda a: jax.lax.dynamic_slice_in_dim(a, i * blk, blk, 0)
             bb = sl(binned)
@@ -319,7 +326,18 @@ def _grow_fn(max_depth: int, F: int, maxB: int, nbins: tuple, is_cat: tuple,
             S = widths[d]
             live = row_leaf < 0
             if d < max_depth:
-                hist_fn = hist_matmul if S <= MATMUL_S_LIMIT else hist_scatter
+                if lowering == "pallas":
+                    # per-level static fallback: when even a one-slot
+                    # frontier tile busts the VMEM budget, this level
+                    # takes the scatter lowering (the planner's contract)
+                    hist_fn = (hist_gather_pl
+                               if pallas_hist.plan_tiles(TB, S) is not None
+                               else hist_scatter)
+                elif lowering == "scatter":
+                    hist_fn = hist_scatter
+                else:
+                    hist_fn = (hist_matmul if S <= MATMUL_S_LIMIT
+                               else hist_scatter)
                 hist = hist_fn(binned, row_node, live, w, yc, S)
                 fm = masks[d] if has_masks else None
                 (split_feat, t_star, na_left, gain,
@@ -397,12 +415,13 @@ def _grow_fn(max_depth: int, F: int, maxB: int, nbins: tuple, is_cat: tuple,
     # pallas interpret mode (CPU tests) lowers pallas_call to slices whose
     # internal index constants carry empty vma sets, tripping check_vma;
     # compiled TPU lowering annotates properly, so only interpret relaxes it
-    check_vma = not (use_pallas and jax.default_backend() != "tpu")
+    check_vma = not (lowering == "pallas" and jax.default_backend() != "tpu")
     fn = _compat_shard_map(tree_program, mesh=mesh,
                        in_specs=in_specs,
                        out_specs=(P(), P(), P("rows")),
                        check_vma=check_vma)
-    return jax.jit(fn)
+    return compiles.ledgered_jit(
+        "tree", fn, program=f"tree_grow_d{max_depth}_{lowering}")
 
 
 def _pick_blk(n_shard: int, F: int, maxB: int) -> int:
@@ -447,18 +466,25 @@ def grow_tree_device(binned, w, y, spec, *, max_depth: int, min_rows: float,
     has_masks = feat_masks is not None
     from h2o3_tpu.models.tree import pallas_hist
 
-    # lowering decision at the widest matmul-path level of this tree's
-    # program (that level dominates the histogram cost; wider levels use
-    # the scatter path either way): forced by H2O_TPU_PALLAS_HIST=1,
-    # measured once per (F, maxB, S, backend) under =auto
+    # lowering decision at the widest matmul-comparable level of this
+    # tree's program (that level dominates the histogram cost; wider
+    # frontiers tile or scatter either way): forced by
+    # H2O_TPU_PALLAS_HIST=1/scatter, measured once per
+    # (F, maxB, S, backend) under =auto, one-hot matmul by default
     cap_v = frontier_cap(F, maxB)
     widths = level_widths(int(max_depth), cap_v)
     s_widest = max([wd for wd in widths[: int(max_depth)]
                     if wd <= MATMUL_S_LIMIT], default=1)
+    lowering = pallas_hist.decide_lowering(F, maxB, s_widest)
+    if lowering == "pallas":
+        # record the tile plan at the WIDEST level of this tree — the
+        # frontier the budget planner actually has to fit (bench aux)
+        pallas_hist.note_plan(F * maxB, max(widths[: int(max_depth)],
+                                            default=1))
     fn = _grow_fn(int(max_depth), F, maxB, tuple(int(b) for b in spec.nbins),
                   tuple(bool(c) for c in spec.is_cat), float(min_rows),
                   float(min_split_improvement), has_masks, mesh, n_shard, blk,
-                  cap_v, use_pallas=pallas_hist.use_pallas(F, maxB, s_widest))
+                  cap_v, lowering=lowering)
     w = w.astype(jnp.float32)
     y = y.astype(jnp.float32)
     if num is None:
@@ -512,7 +538,10 @@ def _apply_fn(max_depth: int, maxB: int, mesh, cap: int):
     fn = _compat_shard_map(apply, mesh=mesh,
                        in_specs=(P("rows", None), P(), P()),
                        out_specs=P("rows"))
-    return jax.jit(fn)
+    from h2o3_tpu.obs import compiles
+
+    return compiles.ledgered_jit("tree", fn,
+                                 program=f"tree_apply_d{max_depth}")
 
 
 def apply_packed(binned, packed, values, max_depth: int, maxB: int):

@@ -50,7 +50,10 @@ def _drf_step_fns(sampling: bool):
             oob_cnt = oob_cnt + oob.astype(jnp.float32)
             return mean.astype(jnp.float32), oob_sum, oob_cnt
 
-        fns = (jax.jit(pre), jax.jit(post))
+        from h2o3_tpu.obs import compiles
+
+        fns = (compiles.ledgered_jit("tree", pre, program="drf_pre"),
+               compiles.ledgered_jit("tree", post, program="drf_post"))
         _DRF_STEPS[key] = fns
     return fns
 

@@ -44,6 +44,16 @@ def grow_tree_host(binned, hist_w, hist_y, spec, *, max_depth: int,
     row_leaf = jnp.full(N, -1, jnp.int32)
     slots = [0]                   # tree nid per active slot
 
+    if max_depth == 0:
+        # a stump needs exactly two scalars — summing (w, w·y) over the
+        # active rows directly is two device reductions, not a full
+        # (nodes, tot_bins, 3) histogram build
+        act = row_node >= 0
+        w32 = jnp.where(act, jnp.asarray(hist_w, jnp.float32), 0.0)
+        wy = float(jnp.sum(w32 * jnp.asarray(hist_y, jnp.float32)))
+        tree.nodes[0].weight = float(jnp.sum(w32))
+        tree.nodes[0].pred = wy / max(tree.nodes[0].weight, 1e-12)
+
     # per-level timings under H2O_TPU_PROFILE (this grower is the one
     # place a level boundary exists on the host; the profile-mode sync is
     # the routing pass the level already blocks on below)
@@ -53,15 +63,17 @@ def grow_tree_host(binned, hist_w, hist_y, spec, *, max_depth: int,
             break
         t_lvl0 = _time.perf_counter()
         S = len(slots)
-        # the final level never splits, so skip its histogram build unless
-        # it's also the root stats pass
-        if depth < max_depth or depth == 0:
+        # the final level never splits, so it never builds a histogram
+        # (the max_depth=0 root stats come from the pre-loop reductions)
+        if depth < max_depth:
             hist = build_histogram(binned, row_node, hist_w, hist_y, spec, S)
-        if depth == 0:
-            o, B = int(spec.offsets[0]), int(spec.nbins[0])
-            tree.nodes[0].weight = float(hist[0, o:o + B, 0].sum())
-            wy = float(hist[0, o:o + B, 1].sum())
-            tree.nodes[0].pred = wy / max(tree.nodes[0].weight, 1e-12)
+            if depth == 0:
+                # root stats ride the level hist already in hand: sum the
+                # (w, wy) lanes of feature 0 across its bins
+                o, B = int(spec.offsets[0]), int(spec.nbins[0])
+                tree.nodes[0].weight = float(hist[0, o:o + B, 0].sum())
+                wy = float(hist[0, o:o + B, 1].sum())
+                tree.nodes[0].pred = wy / max(tree.nodes[0].weight, 1e-12)
         if depth == max_depth:
             splits = [None] * S
         else:

@@ -61,7 +61,9 @@ def _pre_fn(dist, sample: bool):
             den = dist.gamma_denom(w_t, y, z, f)
             return z, w_t, num, den, mask
 
-        fn = jax.jit(pre)
+        from h2o3_tpu.obs import compiles
+
+        fn = compiles.ledgered_jit("tree", pre, program="tree_pre")
         _STEP_FNS[k] = fn
     return fn
 
@@ -92,7 +94,9 @@ def _post_fn(builder, clip: float):
                                   gamma[jnp.maximum(row_leaf, 0)], 0.0)
             return gamma.astype(jnp.float32), f_new
 
-        fn = jax.jit(post)
+        from h2o3_tpu.obs import compiles
+
+        fn = compiles.ledgered_jit("tree", post, program="tree_post")
         _STEP_FNS[k] = fn
     return fn
 
@@ -638,9 +642,20 @@ class SharedTree(ModelBuilder):
             f_valid = (pf.predict_binned(vs["binned"]).astype(jnp.float32)
                        if vs is not None else None)
         else:
-            # init: log class priors
-            pri = np.asarray(jax.jit(
-                lambda: jnp.zeros(K).at[yi].add(w, mode="drop"))())
+            # init: log class priors — explicit args, NOT a closure over
+            # (yi, w): the cached wrapper would bake the first train's
+            # arrays into every later K-class fit
+            from h2o3_tpu.obs import compiles
+
+            kprior = _STEP_FNS.get(("prior", K))
+            if kprior is None:
+                def prior(yi, w):
+                    return jnp.zeros(K).at[yi].add(w, mode="drop")
+
+                kprior = compiles.ledgered_jit("tree", prior,
+                                               program="tree_prior")
+                _STEP_FNS[("prior", K)] = kprior
+            pri = np.asarray(kprior(yi, jnp.asarray(w, jnp.float32)))
             pri = np.maximum(pri / max(pri.sum(), 1e-12), 1e-9)
             init = np.log(pri).astype(np.float32)
             f = jnp.broadcast_to(jnp.asarray(init), (N, K)).astype(jnp.float32)
@@ -668,7 +683,9 @@ class SharedTree(ModelBuilder):
                 az = jnp.abs(z)
                 return z, w_t, w_t * z, w_t * az * (1 - az)
 
-            kpre = jax.jit(premk)
+            from h2o3_tpu.obs import compiles
+
+            kpre = compiles.ledgered_jit("tree", premk, program="tree_premk")
             _STEP_FNS[("premk", K)] = kpre
         kpost = _STEP_FNS.get(("postmk", K, leaf_clip))
         if kpost is None:
@@ -682,7 +699,10 @@ class SharedTree(ModelBuilder):
                                 gamma[jnp.maximum(row_leaf, 0)], 0.0)
                 return gamma.astype(jnp.float32), f.at[:, k].add(upd)
 
-            kpost = jax.jit(postmk)
+            from h2o3_tpu.obs import compiles
+
+            kpost = compiles.ledgered_jit("tree", postmk,
+                                          program="tree_postmk")
             _STEP_FNS[("postmk", K, leaf_clip)] = kpost
 
         root_key = jax.random.PRNGKey(self._seed())

@@ -42,9 +42,11 @@ from typing import Any, Dict, List, Optional
 # builds, rapids = statement fusion, pipeline = munge→score splices (the
 # rapids feature graph + the model core in ONE program), artifact = AOT
 # exporter lowerings, pack = sharded data-plane packers, probe = the
-# supervised boot first-compile
+# supervised boot first-compile, tree = tree-grower programs (histogram
+# builds, grow/apply steps, per-tree pre/post residual math, compressed
+# forest traversal — everything a GBM/DRF train compiles)
 FAMILIES = frozenset({"scoring", "explain", "binning", "rapids", "pipeline",
-                      "artifact", "pack", "probe"})
+                      "artifact", "pack", "probe", "tree"})
 
 # persistent-compile-cache families whose actual compiles feed the legacy
 # note_compile() counter (the warm-restart zero-compile assertions)
@@ -210,6 +212,91 @@ def compile_stablehlo(family: str, text: str, signature: Any = None,
     record_compile(family, signature if signature is not None else text[:256],
                    ms, program=program)
     return exe
+
+
+# a key whose AOT lowering failed (or whose executable rejected a call):
+# dispatch through the plain jit wrapper from then on. Distinct sentinel —
+# None would be ambiguous with a missing key under dict.get.
+_JIT_FALLBACK = object()
+
+
+def _arg_key(args) -> str:
+    """Shape/dtype signature of a call's arguments. Array leaves key by
+    (shape, dtype); non-array leaves (python scalars, bools) key by TYPE
+    only — jit treats them as weak-typed dynamic args, so keying their
+    values would recompile per learning-rate/sample-rate value."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    parts = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            parts.append((tuple(shape), str(dtype)))
+        else:
+            parts.append(type(leaf).__name__)
+    return repr((parts, str(treedef)))
+
+
+class _LedgeredJit:
+    """A ``jax.jit`` wrapper whose every compile lands in the ledger.
+
+    First call per argument shape class AOT-compiles through
+    :func:`compile_jit` (one timed ledger row); subsequent calls hit the
+    executable cache and bump :func:`record_hit` — so a warm re-train
+    adds ZERO compile rows. Shapes the AOT path cannot serve (lowering
+    failure, or an executable rejecting a call over sharding/weak-type
+    drift) permanently fall back to the plain jit wrapper for that key.
+    ``lower`` passes through, so callers that AOT-compile under their own
+    family (scoring's executable cache over compressed-forest programs)
+    keep working."""
+
+    def __init__(self, family, fn, program=None, jit_kw=None):
+        import jax
+
+        _check(family)
+        self._family = family
+        self._program = program
+        self._jfn = jax.jit(fn, **(jit_kw or {}))
+        self._exe: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def lower(self, *args, **kw):
+        return self._jfn.lower(*args, **kw)
+
+    def __call__(self, *args):
+        key = _arg_key(args)
+        exe = self._exe.get(key)
+        if exe is None:
+            with self._lock:
+                exe = self._exe.get(key)
+                if exe is None:
+                    try:
+                        exe = compile_jit(self._family, self._jfn, args,
+                                          signature=key,
+                                          program=self._program)
+                    except Exception:   # noqa: BLE001 — AOT-hostile shape
+                        exe = _JIT_FALLBACK
+                    self._exe[key] = exe
+        else:
+            record_hit(self._family, tier="memory")
+        if exe is _JIT_FALLBACK:
+            return self._jfn(*args)
+        try:
+            return exe(*args)
+        except Exception:   # noqa: BLE001 — input layout the AOT
+            # executable can't accept (sharding / weak-type drift):
+            # this key dispatches through plain jit from now on
+            self._exe[key] = _JIT_FALLBACK
+            return self._jfn(*args)
+
+
+def ledgered_jit(family: str, fn, program: Optional[str] = None, **jit_kw):
+    """``jax.jit(fn)`` with ledger-visible compiles: the legal spelling
+    of a jit under the ``jax.jit`` ban scopes (models/tree/). Keyword
+    args pass through to ``jax.jit``."""
+    return _LedgeredJit(family, fn, program=program, jit_kw=jit_kw)
 
 
 # ---------------------------------------------------------------------------

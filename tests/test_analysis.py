@@ -494,6 +494,58 @@ class TestCompileLedgerPass:
         got = run_pass(ctx, "compile-ledger")
         assert len(got) == 1 and "stale registry path" in got[0].message
 
+    def test_bare_jit_banned_inside_ledgered_scope(self, tmp_path):
+        """ISSUE-17: inside a JIT_LEDGER_SCOPE prefix every jit must go
+        through obs/compiles.ledgered_jit — a bare jax.jit (decorator,
+        call, or `from jax import jit` alias) bypasses the `tree`
+        family ledger. All three spellings must be flagged."""
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/models/tree/work.py": """
+            import jax
+            from jax import jit
+
+            @jax.jit
+            def deco(x):
+                return x + 1
+
+            def call(fn):
+                return jax.jit(fn)
+
+            def aliased(fn):
+                return jit(fn)
+            """}, COMPILE_LEDGER_MODULES=(),
+            JIT_LEDGER_SCOPE=("h2o3_tpu/models/tree/",))
+        got = run_pass(ctx, "compile-ledger")
+        assert len(got) == 3, got
+        assert all("ledgered_jit" in f.message for f in got), got
+
+    def test_ledgered_jit_and_out_of_scope_jit_not_flagged(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {
+            # in scope, but routed through the ledger: clean
+            "h2o3_tpu/models/tree/good.py": """
+                from h2o3_tpu.obs import compiles
+
+                def build(fn):
+                    return compiles.ledgered_jit("tree", fn, program="p")
+                """,
+            # bare jit OUTSIDE the scope prefix: not this pass's business
+            "h2o3_tpu/elsewhere.py": """
+                import jax
+
+                @jax.jit
+                def f(x):
+                    return x * 2
+                """,
+        }, COMPILE_LEDGER_MODULES=(),
+            JIT_LEDGER_SCOPE=("h2o3_tpu/models/tree/",))
+        assert run_pass(ctx, "compile-ledger") == []
+
+    def test_stale_jit_scope_prefix_is_a_finding(self, tmp_path):
+        ctx = mini_ctx(tmp_path, {"h2o3_tpu/clean.py": "x = 1\n"},
+                       COMPILE_LEDGER_MODULES=(),
+                       JIT_LEDGER_SCOPE=("h2o3_tpu/models/gone/",))
+        got = run_pass(ctx, "compile-ledger")
+        assert len(got) == 1 and "stale registry path" in got[0].message
+
 
 class TestRegistryPasses:
     def test_faultpoint_drift(self, tmp_path):
