@@ -361,7 +361,13 @@ def main():
                                # kill->elect->HEALTHY drill: control-plane
                                # only, so it bypasses the accelerator tunnel
                                ("recover", {"H2O3_BENCH_ONLY": "recover",
-                                            "JAX_PLATFORMS": "cpu"})):
+                                            "JAX_PLATFORMS": "cpu"}),
+                               # kill-mid-grid -> watchdog search resume ->
+                               # leaderboard complete (search_recover_secs
+                               # + the members-overlap concurrency aux)
+                               ("search-recover",
+                                {"H2O3_BENCH_ONLY": "search-recover",
+                                 "JAX_PLATFORMS": "cpu"})):
                 if remaining() < 180:
                     _record(sname, ok=False, error="skipped: deadline")
                     continue

@@ -33,6 +33,7 @@ MIRRORED_ROOTS = (
     "h2o3_tpu.models.model_builder.ModelBuilder.train",   # broadcast trains
     "h2o3_tpu.scoring.execute_batch",                 # score_batch replays
     "h2o3_tpu.rapids.eval.exec_rapids",               # rapids op replays
+    "h2o3_tpu.automl.search.SearchEngine.run",        # search member walks
 )
 
 # sanctioned env accessors: defaulting + documentation ride these, and the
@@ -69,6 +70,17 @@ KNOB_HELPERS = frozenset({
     # — H2O_TPU_HIST_VMEM_MB: the frontier-tile budget is a pure function
     # of (env, geometry); the ops contract pins the env uniform, so every
     # process plans the same tiling and lowers the same program
+    "h2o3_tpu.automl.search.search_concurrency",
+    # — H2O_TPU_SEARCH_CONCURRENCY: deterministically 1 when oplog is
+    # active (every process walks the identical member sequence); the
+    # env/admission sizing only runs single-process
+    "h2o3_tpu.automl.search.search_ckpt_enabled",
+    # — H2O_TPU_SEARCH_CKPT gates host-side durable-state writes only;
+    # it never shapes the member/program sequence
+    "h2o3_tpu.automl.search.member_deadline_s",
+    # — H2O_TPU_SEARCH_MEMBER_DEADLINE_S is deterministically 0 when
+    # oplog is active (per-process deadline kills would desynchronize the
+    # mirrored member walks)
 })
 
 # audited divergent-looking call sites that are mirrored-safe; reason is

@@ -704,6 +704,14 @@ def h_steam_metrics(ctx: Ctx):
             "idle_millis": 0, "cloud_size": info["cloud_size"]}
 
 
+def _search_stats() -> dict:
+    """Engine counters for the CloudStatus search block (import kept out
+    of module load so the API layer stays light)."""
+    from h2o3_tpu.automl import search
+
+    return search.stats()
+
+
 def h_cloud_status(ctx: Ctx):
     """GET /3/CloudStatus — the supervised cloud health state machine
     (HEALTHY/DEGRADED/FAILED/RECOVERING) with its evidence: per-process
@@ -750,6 +758,12 @@ def h_cloud_status(ctx: Ctx):
             # autonomous recovery watchdog: enabled/running, action
             # counters (elections, rejoins, jobs resumed), last action
             "watchdog": watchdog.status(),
+            # durable AutoML/grid searches: engine counters plus every
+            # search-state record still on disk/KV (a non-empty list during
+            # a healthy cloud means a search is mid-flight; after a
+            # coordinator loss it is the watchdog's resume worklist)
+            "search": {"stats": _search_stats(),
+                       "states": ckpt.search_state_records()},
             "job_progress": ckpt.job_progress_records(),
             "rejoins": oplog.rejoin_records(),
             "oplog_errors": [{"seq": seq, "kind": rec.get("kind"),

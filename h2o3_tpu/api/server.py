@@ -1193,6 +1193,9 @@ def h_automl_build(ctx: Ctx):
     job = Job(description="AutoML", dest=project)
     job.dest_type = "Key<AutoML>"
     job.dest_key = project
+    # durable search: the engine checkpoints member state under this Job's
+    # key so a watchdog on a surviving node can resume the search in place
+    aml._search_job = job
 
     from h2o3_tpu.parallel import oplog
 
@@ -1331,6 +1334,8 @@ def h_grid_build(ctx: Ctx):
         base = cls(**params)
         grid = H2OGridSearch(base, hyper, grid_id=grid_id,
                              search_criteria=criteria)
+        # durable search: member state checkpoints under this Job's key
+        grid._search_job = j
         with oplog.turn(op_seq):
             grid.train(y=y, training_frame=train, validation_frame=valid,
                        parallelism=parallelism, recovery_dir=recovery_dir)

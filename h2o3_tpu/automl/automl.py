@@ -84,6 +84,16 @@ class H2OAutoML:
         self.event_log: List[Dict[str, Any]] = []
         self._metric_name: str = "rmse"
 
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        # runtime-only search machinery (the engine holds a live RLock,
+        # the job rides its own DKV key): never into a control-plane
+        # checkpoint — a restored AutoML is a leaderboard, not a run
+        d.pop("_search_engine", None)
+        d.pop("_search_job", None)
+        d.pop("_resume_search_state", None)
+        return d
+
     def _apply_target_encoding(self, y, train, valid, lb):
         """KFold TargetEncoder over the shared AutoML fold assignment
         (reference ai.h2o.automl.preprocessing.TargetEncoding): encoded
@@ -148,6 +158,7 @@ class H2OAutoML:
               training_frame: Optional[Frame] = None,
               validation_frame: Optional[Frame] = None,
               leaderboard_frame: Optional[Frame] = None) -> "H2OAutoML":
+        from h2o3_tpu.automl.search import SearchEngine
         from h2o3_tpu.models.model_builder import BUILDERS
 
         if training_frame is None or y is None:
@@ -162,6 +173,42 @@ class H2OAutoML:
         self._leaderboard_frame = leaderboard_frame
         self._lb_cache: Dict[str, float] = {}
 
+        # durable search controller: the re-dispatch spec captures frame
+        # KEYS before the TE transform (a resume re-derives the encoded
+        # frames from the raw inputs, exactly like the original run)
+        job = getattr(self, "_search_job", None)
+        search_spec = {
+            "kind": "automl", "description": "AutoML",
+            "dest": self.project_name,
+            "spec": {"max_models": self.max_models,
+                     "max_runtime_secs": self.max_runtime_secs,
+                     "seed": self.seed, "nfolds": self.nfolds,
+                     "sort_metric": self.sort_metric,
+                     "include_algos": self.include_algos,
+                     "exclude_algos": self.exclude_algos,
+                     "project_name": self.project_name,
+                     "preprocessing": self.preprocessing},
+            "x": list(x) if isinstance(x, (list, tuple)) else x, "y": y,
+            "training_frame": str(training_frame.key),
+            "validation_frame": (str(validation_frame.key)
+                                 if validation_frame is not None else None),
+            "leaderboard_frame": (str(leaderboard_frame.key)
+                                  if leaderboard_frame is not None else None),
+        }
+        engine = SearchEngine(
+            str(job.key) if job is not None else self.project_name,
+            "automl", search_spec, job=job,
+            state=getattr(self, "_resume_search_state", None))
+        self._search_engine = engine
+
+        def _note_failure(mem, attempt):
+            retrying = mem.get("status") != "parked"
+            self._log(f"step {mem['name']} attempt {attempt} FAILED: "
+                      f"{mem.get('error')}"
+                      + (" — retrying" if retrying else " — parked"))
+
+        engine.on_member_failure = _note_failure
+
         if "target_encoding" in self.preprocessing:
             training_frame, validation_frame, leaderboard_frame = \
                 self._apply_target_encoding(y, training_frame,
@@ -169,54 +216,89 @@ class H2OAutoML:
             self._leaderboard_frame = leaderboard_frame
 
         t0 = time.time()
-        self._log(f"AutoML start: project={self.project_name}")
+        self._log(f"AutoML start: project={self.project_name}"
+                  + (" (resumed)" if engine.resumed else ""))
         plan = self._steps(classification)
         self._plan = plan
+
+        def score(mem, model):
+            return _metric(model, self._metric_name)
 
         def run_steps(steps, budget_end, model_cap):
             # WorkAllocations: the remaining time budget splits over
             # remaining step weights, so a slow early model shrinks what
             # later steps may spend instead of starving them outright
+            steps = [st for st in steps if st["algo"] in BUILDERS]
             total_weight = sum(st["weight"] for st in steps) or 1
-            spent_weight = 0
+            box = {"spent": 0, "stopped": False}
+            members = []
             for st in steps:
-                algo, params = st["algo"], dict(st["params"])
-                if model_cap and len(self.models) >= model_cap:
+                mem = engine.member(st["name"], st["algo"], st["params"])
+                mem["_step"] = st
+                if mem.get("status") == "done" and mem.get("model_id"):
+                    st["model_id"] = mem["model_id"]
+                if mem.get("status") == "parked":
+                    st["failed"] = True
+                members.append(mem)
+
+            def can_start(inflight):
+                if model_cap and len(self.models) + inflight >= model_cap:
+                    box["stopped"] = True
                     return False
-                if budget_end is not None:
-                    remaining = budget_end - time.time()
-                    if remaining <= 0:
+                if budget_end is not None and budget_end - time.time() <= 0:
+                    if not box["stopped"]:
                         self._log("time budget exhausted")
-                        return False
-                    rem_weight = max(total_weight - spent_weight, 1)
+                    box["stopped"] = True
+                    return False
+                return True
+
+            def build(mem):
+                st = mem["_step"]
+                algo, params = st["algo"], dict(st["params"])
+                if budget_end is not None:
+                    remaining = max(budget_end - time.time(), 0.0)
+                    rem_weight = max(total_weight - box["spent"], 1)
                     alloc = remaining * st["weight"] / rem_weight
                     params["max_runtime_secs"] = alloc
                     self._log(f"step {st['name']}: allocated {alloc:.1f}s "
                               f"of {remaining:.1f}s remaining")
-                spent_weight += st["weight"]
-                cls = BUILDERS.get(algo)
-                if cls is None:
-                    continue
+                box["spent"] += st["weight"]
                 params.update(seed=self.seed)
                 if self.nfolds:
                     params.update(nfolds=self.nfolds,
                                   keep_cross_validation_predictions=True)
                 if getattr(self, "_te_fold_col", None):
                     params.update(fold_column=self._te_fold_col)
-                try:
-                    b = cls(**params)
-                    m = b.train(x=x, y=y, training_frame=training_frame,
-                                validation_frame=validation_frame)
+                b = BUILDERS[algo](**params)
+                m = b.train(x=x, y=y, training_frame=training_frame,
+                            validation_frame=validation_frame)
+                self.models.append(m)
+                st["model_id"] = str(m.key)
+                self._log(f"built {st['name']} ({algo}): "
+                          f"{self._metric_name}="
+                          f"{_metric(m, self._metric_name):.4f}")
+                return m
+
+            def reattach(mem):
+                from h2o3_tpu.core.dkv import DKV
+
+                m = DKV.get(mem["model_id"]) if mem.get("model_id") else None
+                if m is not None:
                     self.models.append(m)
-                    st["model_id"] = str(m.key)
-                    self._log(f"built {st['name']} ({algo}): "
-                              f"{self._metric_name}="
-                              f"{_metric(m, self._metric_name):.4f}")
-                except Exception as e:   # noqa: BLE001 — AutoML keeps going
+                    mem["_step"]["model_id"] = mem["model_id"]
+                    self._log(f"reattached {mem['name']} from durable "
+                              f"search state")
+                return m
+
+            ok = engine.run(members, build, can_start=can_start,
+                            reattach=reattach, score_fn=score)
+            for mem in members:
+                st = mem["_step"]
+                if mem.get("status") == "parked" and not st.get("failed"):
                     st["failed"] = True
-                    self._log(f"FAILED {st['name']} ({algo}): "
-                              f"{type(e).__name__}: {e}")
-            return True
+                    self._log(f"FAILED {st['name']} ({st['algo']}): "
+                              f"{mem.get('error')}")
+            return ok and not box["stopped"]
 
         budget_end = (t0 + self.max_runtime_secs
                       if self.max_runtime_secs else None)
@@ -272,6 +354,7 @@ class H2OAutoML:
             self.include_algos is None or "stackedensemble" in self.include_algos)
         if se_wanted:
             self._build_ensembles(y, training_frame)
+        engine.finish()
         self._log(f"AutoML done: {len(self.models)} models")
         return self
 

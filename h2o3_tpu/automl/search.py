@@ -1,0 +1,691 @@
+"""Durable, supervised search engine for AutoML and grid search.
+
+Reference: ai/h2o/automl/AutoML.java runs the search as a plain in-process
+loop — a coordinator crash mid-search loses the whole leaderboard even
+though every individual trainer has durable progress (parallel/ckpt.py).
+Podracer's split (PAPERS.md) is the fix: members are embarrassingly
+parallel workers, the controller holds only small durable search state.
+
+Both ``H2OAutoML.train`` and ``H2OGridSearch.train`` dispatch members
+through one :class:`SearchEngine`:
+
+- **durable leaderboard** — a ``SearchState`` record (member plan,
+  per-member status/attempts/scores, re-dispatch spec) persisted through
+  the PR-5 checkpoint machinery (``ckpt.save_search_state``: atomic
+  replace + ``.prev`` rotation + KV record + restricted unpickler) on
+  every member completion, resumable mid-search from any snapshot;
+- **concurrent member scheduling** — members run as real ``Job``s across
+  free capacity (``H2O_TPU_SEARCH_CONCURRENCY=auto`` sizes off the
+  admission gauges); a crashed/poisoned member burns its attempt and
+  strike-parks at ``MAX_ATTEMPTS`` without failing the search, and a
+  per-member deadline (``H2O_TPU_SEARCH_MEMBER_DEADLINE_S``) keeps one
+  wedged member from eating the budget (obs/phases.py-style timer);
+- **watchdog search resume** — after coordinator loss + election the
+  watchdog calls :func:`resume_orphaned`, which reloads the newest state
+  and re-dispatches the remaining members under the ORIGINAL search key.
+
+Mirrored-program discipline: on an oplog-active cloud concurrency is
+pinned to 1 and lost done-members are never retrained, so every process
+replaying the search op walks an identical member (and therefore device
+program) sequence from the same durable state file.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from typing import Any, Callable, Dict, List, Optional
+
+from h2o3_tpu.parallel.watchdog import MAX_ATTEMPTS
+
+_WIRE_TYPES = (str, int, float, bool, list, tuple, type(None))
+
+_LOCK = threading.Lock()
+
+# Device lane for collective-bearing builders. Tree/DL training programs
+# carry cross-device collectives; when two such programs execute at once
+# the XLA CPU runtime can interleave their rendezvous (each run waiting
+# for all participants while the other holds the worker threads) and
+# deadlock permanently. Builders that are not explicitly marked
+# ``parallel_safe`` therefore serialize their device work on this lane —
+# member Jobs still schedule, munge, and report concurrently.
+_DEVICE_LANE = threading.Lock()
+
+
+def _exclusive(m: dict) -> bool:
+    """True when this member's builder must hold the device lane."""
+    try:
+        from h2o3_tpu.models.model_builder import BUILDERS
+        cls = BUILDERS.get(m.get("algo"))
+    except Exception:   # noqa: BLE001 — unknown algo: assume exclusive
+        cls = None
+    return not bool(getattr(cls, "parallel_safe", False))
+_STATS: Dict[str, int] = {}
+
+
+def _zero() -> Dict[str, int]:
+    return dict(members_done=0, members_failed=0, members_parked=0,
+                attempts=0, running=0, overlap=0, searches_resumed=0,
+                state_saves=0, state_save_errors=0)
+
+
+_STATS.update(_zero())
+
+
+def stats() -> Dict[str, int]:
+    """Process-wide search counters (``/3/Metrics`` + ``/3/CloudStatus``):
+    members done/failed/parked, dispatch attempts, currently-running
+    members, the high-water overlap gauge, searches resumed by the
+    watchdog, and state-save outcomes."""
+    with _LOCK:
+        return dict(_STATS)
+
+
+def reset_stats() -> None:
+    with _LOCK:
+        _STATS.update(_zero())
+
+
+def _bump(**kw) -> None:
+    with _LOCK:
+        for k, v in kw.items():
+            _STATS[k] = _STATS.get(k, 0) + v
+        if _STATS["running"] > _STATS["overlap"]:
+            _STATS["overlap"] = _STATS["running"]
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+def search_ckpt_enabled() -> bool:
+    """``H2O_TPU_SEARCH_CKPT=0`` disables durable search state."""
+    from h2o3_tpu.parallel import retry
+
+    return retry.env_int("H2O_TPU_SEARCH_CKPT", 1) > 0
+
+
+def member_deadline_s() -> float:
+    """Per-member wall-clock deadline (``H2O_TPU_SEARCH_MEMBER_DEADLINE_S``,
+    0 = none). A member past it is failed externally — the attempt burns
+    and the search moves on instead of one wedged build eating the whole
+    budget. Only honored single-process (a per-process timer firing at
+    different instants would desync mirrored replays)."""
+    from h2o3_tpu.parallel import distributed as D
+    from h2o3_tpu.parallel import oplog, retry
+
+    if oplog.active() or D.process_count() > 1:
+        return 0.0
+    return retry.env_float("H2O_TPU_SEARCH_MEMBER_DEADLINE_S", 0.0)
+
+
+def search_concurrency() -> int:
+    """Member-scheduling width. Deterministically 1 on an oplog-active
+    cloud (every process must walk the identical member sequence — same
+    reason planner deferral is off multi-process). Off-oplog:
+    ``H2O_TPU_SEARCH_CONCURRENCY`` as an explicit int, or ``auto`` (the
+    default) sizes off free admission capacity from the same controller
+    that feeds the ``/3/Metrics`` gauges — and stays at 1 when admission
+    runs uncapped, because width is only worth paying for when the
+    operator has already told us how much device pressure is safe."""
+    from h2o3_tpu.parallel import distributed as D
+    from h2o3_tpu.parallel import oplog
+
+    if oplog.active() or D.process_count() > 1:
+        # multi-process cloud: the member walk replays mirrored (as a
+        # broadcast op on the coordinator, inside the op turn on followers
+        # and resumes) — width >1 would diverge completion order across
+        # processes
+        return 1
+    raw = (os.environ.get("H2O_TPU_SEARCH_CONCURRENCY") or "auto").strip()
+    if raw.lower() != "auto":
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            return 1
+    from h2o3_tpu import admission
+
+    snap = admission.CONTROLLER.snapshot()
+    cap = int(snap.get("max_inflight") or 0)
+    if cap <= 0:          # uncapped admission = no sizing signal: stay serial
+        return 1
+    inflight = sum(int(m.get("inflight") or 0)
+                   for m in (snap.get("models") or {}).values())
+    return min(4, max(1, cap - inflight))
+
+
+def _scrub_params(params: Optional[dict]) -> dict:
+    """Wire-safe member params for the durable record: JSON-able values
+    only, and — the PR-11 defect class — never a live wall-clock budget
+    on an oplog-active cloud (per-process time would desynchronize the
+    mirrored fit loops on a replay/resume)."""
+    from h2o3_tpu.parallel import oplog
+
+    out = {k: v for k, v in (params or {}).items()
+           if isinstance(v, _WIRE_TYPES)}
+    if oplog.active() and float(out.get("max_runtime_secs") or 0.0) > 0:
+        out["max_runtime_secs"] = 0.0
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+class SearchEngine:
+    """One search's durable controller: the member plan and per-member
+    status/attempt/score records, saved on every member completion.
+
+    Statuses: ``pending`` -> ``running`` -> ``done`` | ``failed`` (attempt
+    burned, retryable) | ``parked`` (quarantined at MAX_ATTEMPTS or a
+    deterministic config error — never fails the search)."""
+
+    def __init__(self, key: str, kind: str, spec: Optional[dict] = None,
+                 job=None, state: Optional[dict] = None,
+                 sdir: Optional[str] = None,
+                 persist: Optional[bool] = None):
+        from h2o3_tpu.parallel import oplog
+
+        self.key = str(key)
+        self.kind = str(kind)
+        self.spec = dict(spec or {})
+        self.job = job
+        self.sdir = sdir
+        if persist is None:
+            persist = sdir is not None or \
+                (job is not None and search_ckpt_enabled())
+        self.persist = bool(persist)
+        # optional owner hook: called with (member, attempt) after a failed
+        # or parked attempt — AutoML routes it into its user-facing event
+        # log (the reference records every step failure there)
+        self.on_member_failure = None
+        # mirrored clouds never retrain a done member whose model fell out
+        # of a DKV: the extra build would diverge the replayed program
+        # sequence between processes (single-process resume may rebuild)
+        self.retrain_lost = not oplog.active()
+        self._lock = threading.RLock()
+        self.members: Dict[str, dict] = {}
+        self.order: List[str] = []
+        self.saves = 0
+        restored = state or {}
+        if "state" in restored and isinstance(restored.get("state"), dict):
+            restored = restored["state"]     # full ckpt payload accepted
+        self.resumed = bool(restored.get("members"))
+        for name in restored.get("order") or sorted(
+                restored.get("members") or {}):
+            m = dict((restored.get("members") or {}).get(name) or {})
+            if not m:
+                continue
+            if m.get("status") == "running":
+                # in flight when its coordinator died: the attempt burned
+                # with the process — carried on the member's counter
+                m["status"] = "failed"
+                m["attempts"] = int(m.get("attempts") or 0) + 1
+                m["error"] = ("member was in flight when its "
+                              "coordinator died")
+            self.members[name] = m
+            self.order.append(name)
+        self.saves = int(restored.get("saves") or 0)
+
+    # -- plan -------------------------------------------------------------
+    def member(self, name: str, algo: Optional[str] = None,
+               params: Optional[dict] = None) -> dict:
+        """Get-or-create the durable record for one member. A restored
+        record keeps its status/attempts/model_id; the runtime algo and
+        params are authoritative (the plan regenerates from the pinned
+        seed, so names are stable across a resume)."""
+        with self._lock:
+            m = self.members.get(name)
+            if m is None:
+                m = {"name": str(name), "status": "pending", "attempts": 0,
+                     "model_id": None, "score": None, "error": None}
+                self.members[name] = m
+                self.order.append(name)
+            if algo is not None:
+                m["algo"] = str(algo)
+            m["params"] = _scrub_params(params)
+            return m
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            out: Dict[str, int] = {}
+            for m in self.members.values():
+                st = str(m.get("status", "pending"))
+                out[st] = out.get(st, 0) + 1
+            return out
+
+    def state(self) -> dict:
+        """Durable snapshot: member records minus ``_``-prefixed runtime
+        stashes, plus the re-dispatch spec."""
+        with self._lock:
+            members = {n: {k: v for k, v in m.items()
+                           if not str(k).startswith("_")}
+                       for n, m in self.members.items()}
+            return {"search": self.key, "kind": self.kind,
+                    "spec": dict(self.spec), "members": members,
+                    "order": list(self.order), "saves": self.saves,
+                    "dest": self.spec.get("dest")}
+
+    # -- persistence ------------------------------------------------------
+    def save(self) -> None:
+        """Persist the current snapshot; NEVER raises — a failed save must
+        not kill a healthy search (the previous snapshot stands)."""
+        if not self.persist:
+            return
+        from h2o3_tpu.core import failure
+        from h2o3_tpu.parallel import ckpt
+
+        try:
+            failure.faultpoint("search.state_save")
+            with self._lock:
+                self.saves += 1
+            ckpt.save_search_state(self.key, self.state(), sdir=self.sdir)
+            _bump(state_saves=1)
+        except Exception as e:   # noqa: BLE001 — durable state is
+            # best-effort per save; the rotation keeps the previous
+            # generation readable and the NEXT save retries
+            _bump(state_save_errors=1)
+            from h2o3_tpu.utils.log import get_logger
+
+            get_logger().error(
+                "search %s: state save failed (%s: %s) — previous "
+                "snapshot stands", self.key, type(e).__name__, e)
+
+    def finish(self) -> None:
+        """The completed search supersedes its durable state. A
+        caller-chosen export dir (grid ``recovery_dir``) keeps its files —
+        it doubles as the user-visible export surface — and only the
+        cloud-wide KV record is dropped."""
+        if not self.persist:
+            return
+        from h2o3_tpu.parallel import ckpt
+
+        ckpt.delete_search_state(self.key, sdir=self.sdir,
+                                 keep_files=self.sdir is not None)
+
+    # -- scheduling -------------------------------------------------------
+    def run(self, members: List[dict], build_fn: Callable[[dict], Any],
+            can_start: Optional[Callable[[int], bool]] = None,
+            reattach: Optional[Callable[[dict], Any]] = None,
+            score_fn: Optional[Callable[[dict, Any], Any]] = None,
+            concurrency: Optional[int] = None) -> bool:
+        """Drive `members` (plan order) to a terminal state. ``build_fn``
+        trains one member and returns its model; ``can_start(inflight)``
+        is the budget/cap gate re-checked before every dispatch;
+        ``reattach`` re-adopts an already-done member's model on resume.
+        Returns False when the gate stopped the search with members still
+        pending (budget/model-cap exhausted), True otherwise."""
+        from h2o3_tpu.obs import tracing
+
+        conc = int(concurrency) if concurrency else search_concurrency()
+        self._trace = tracing.span("search.run", search=self.key,
+                                   kind=self.kind, concurrency=conc)
+        with self._trace:
+            ok = self._run(members, build_fn, can_start, reattach,
+                           score_fn, conc)
+        self.save()
+        return ok
+
+    def _run(self, members, build_fn, can_start, reattach, score_fn,
+             conc) -> bool:
+        todo: List[dict] = []
+        for m in members:
+            st = m.get("status")
+            if st == "done":
+                if reattach is not None:
+                    model = reattach(m)
+                    if model is None and self.retrain_lost \
+                            and m.get("model_id"):
+                        # the finished model did not survive (wiped DKV):
+                        # single-process resume rebuilds it
+                        m["status"] = "pending"
+                        todo.append(m)
+                continue
+            if st == "parked":
+                continue
+            todo.append(m)
+        if conc <= 1:
+            for m in todo:
+                if can_start is not None and not can_start(0):
+                    return False
+                self._build_one(m, build_fn, score_fn)
+            return True
+        stopped = False
+        with ThreadPoolExecutor(max_workers=conc,
+                                thread_name_prefix="h2o3-search") as ex:
+            pending = list(todo)
+            futures: Dict[Any, dict] = {}
+            while pending or futures:
+                while pending and len(futures) < conc and \
+                        (can_start is None or can_start(len(futures))):
+                    m = pending.pop(0)
+                    futures[ex.submit(self._build_one, m, build_fn,
+                                      score_fn)] = m
+                if not futures:
+                    # the gate refused with nothing in flight: the
+                    # budget/model cap is spent for good
+                    stopped = bool(pending)
+                    break
+                done, _ = wait(list(futures), return_when=FIRST_COMPLETED)
+                for f in done:
+                    futures.pop(f, None)
+                    f.result()   # an engine-level crash must propagate
+        return not stopped
+
+    def _build_one(self, m: dict, build_fn, score_fn=None) -> None:
+        """One member driven to a terminal state: each attempt is a real
+        ``Job`` (REST-visible on /3/Jobs) on its own worker thread with a
+        deadline timer. Crashes burn the attempt and retry in place up to
+        MAX_ATTEMPTS, then quarantine-park; deterministic config errors
+        (ValueError/TypeError) park on the first attempt — a poisoned
+        member can never fail the search."""
+        from h2o3_tpu.core import failure
+        from h2o3_tpu.core.job import Job
+        from h2o3_tpu.obs import tracing
+
+        dl = member_deadline_s()
+        ctx = getattr(self, "_trace", None)
+        ctx = ctx.ctx() if ctx else None
+        while True:
+            with self._lock:
+                m["attempts"] = int(m.get("attempts") or 0) + 1
+                m["status"] = "running"
+                attempt = m["attempts"]
+            _bump(attempts=1, running=1)
+            job = Job(description=f"{self.kind} member {m['name']}",
+                      dest=m.get("model_id"))
+            box: Dict[str, Any] = {}
+
+            def work(j, _m=m, _box=box, _attempt=attempt):
+                try:
+                    with tracing.activate(ctx), \
+                            tracing.span("search.member", member=_m["name"],
+                                         algo=_m.get("algo"),
+                                         attempt=_attempt):
+                        failure.faultpoint("search.member_train")
+                        if _exclusive(_m):
+                            with _DEVICE_LANE:
+                                _box["model"] = build_fn(_m)
+                        else:
+                            _box["model"] = build_fn(_m)
+                except BaseException as e:
+                    _box["exc"] = e
+                    raise
+
+            job.start(work, background=True)
+            job._thread.join(timeout=dl if dl > 0 else None)
+            wedged = job._thread.is_alive()
+            if wedged:
+                # phases-style deadline: fail the job from outside (the
+                # worker may be wedged in a dead collective and never
+                # unwind); the thread is leaked by design
+                job.fail(f"search member {m['name']} exceeded its "
+                         f"{dl:g}s deadline (attempt {attempt})")
+            _bump(running=-1)
+            exc = box.get("exc")
+            if not wedged and exc is None:
+                model = box.get("model")
+                with self._lock:
+                    m["status"] = "done"
+                    m["error"] = None
+                    if model is not None and m.get("model_id") is None:
+                        mk = getattr(model, "key", None)
+                        if mk is not None:
+                            m["model_id"] = str(mk)
+                    if score_fn is not None:
+                        try:
+                            m["score"] = score_fn(m, model)
+                        except Exception:   # noqa: BLE001 — a scoring
+                            # hiccup must not undo a finished member
+                            m["score"] = None
+                _bump(members_done=1)
+                self._progress()
+                self.save()
+                return
+            err = (job.exception if wedged else
+                   f"{type(exc).__name__}: {exc}")
+            deterministic = isinstance(exc, (ValueError, TypeError))
+            with self._lock:
+                m["error"] = str(err)[:500]
+                if deterministic or attempt >= MAX_ATTEMPTS or wedged:
+                    # quarantine: config poison is parked on sight, a
+                    # crasher at the attempt cap, a wedged member
+                    # immediately (its leaked thread may still be running)
+                    m["status"] = "parked"
+                else:
+                    m["status"] = "failed"
+            _bump(members_failed=1)
+            if m["status"] == "parked":
+                _bump(members_parked=1)
+            from h2o3_tpu.utils.log import get_logger
+
+            get_logger().warning(
+                "search %s: member %s attempt %d %s: %s", self.key,
+                m["name"], attempt,
+                "parked" if m["status"] == "parked" else "failed", err)
+            cb = self.on_member_failure
+            if cb is not None:
+                try:
+                    cb(dict(m), attempt)
+                except Exception:   # noqa: BLE001 — an owner's log hook
+                    pass            # must never undo quarantine handling
+            self._progress()
+            self.save()
+            if m["status"] == "parked":
+                return
+
+    def _progress(self) -> None:
+        if self.job is None:
+            return
+        c = self.counts()
+        total = max(1, len(self.members))
+        done = c.get("done", 0) + c.get("parked", 0)
+        try:
+            self.job.update(min(0.99, done / total),
+                            f"{done}/{total} members settled")
+        except Exception:   # noqa: BLE001 — JobCancelled propagates from
+            # the member thread's own update calls; the engine's courtesy
+            # progress tick must not
+            pass
+
+
+# ---------------------------------------------------------------------------
+# watchdog resume: orphaned search state -> re-dispatch under original key
+# ---------------------------------------------------------------------------
+
+# bounded retries for search records whose Job is gone AND whose state is
+# unreadable (same discipline as watchdog._strike for job progress)
+_STRIKES: Dict[str, int] = {}
+
+
+def _strike(search_key: str) -> None:
+    from h2o3_tpu.parallel import ckpt
+
+    _STRIKES[search_key] = _STRIKES.get(search_key, 0) + 1
+    if _STRIKES[search_key] >= MAX_ATTEMPTS:
+        ckpt.delete_search_state(search_key)
+        _STRIKES.pop(search_key, None)
+        from h2o3_tpu.utils.log import get_logger
+
+        get_logger().warning(
+            "watchdog: durable search state for %s was unreadable %d "
+            "times — record dropped", search_key, MAX_ATTEMPTS)
+
+
+def _recreate_search_job(search_key: str, state: dict):
+    """Rebuild the search's Job shell under its ORIGINAL key (the object
+    lived on the dead coordinator) so clients keep polling the same id."""
+    from h2o3_tpu.core.dkv import DKV, Key
+    from h2o3_tpu.core.job import Job
+
+    spec = state.get("spec") or {}
+    job = Job(description=spec.get("description")
+              or f"{state.get('kind', 'search')} search",
+              dest=spec.get("dest"))
+    DKV.remove(str(job.key))
+    job._key = Key(search_key)
+    job.status = Job.FAILED
+    job.failed_externally = True
+    job.exception = ("search was in flight when its coordinator died; "
+                     "recreated from durable search state for resume")
+    job.install()
+    return job
+
+
+def resume_orphaned() -> List[str]:
+    """Re-dispatch every externally-failed search with durable state;
+    returns the search keys resumed. Called by the watchdog tick after
+    job resume — same verdict/GC/attempt-cap discipline."""
+    from h2o3_tpu.core.dkv import DKV
+    from h2o3_tpu.core.job import Job
+    from h2o3_tpu.parallel import ckpt
+
+    resumed: List[str] = []
+    for rec in ckpt.search_state_records():
+        sk = str(rec.get("search"))
+        job = DKV.get(sk)
+        data = None
+        if job is None:
+            data = ckpt.load_search_state(sk)
+            if data is None:
+                _strike(sk)
+                continue
+            st = data.get("state") or {}
+            if not (st.get("spec") or {}).get("kind"):
+                # no re-dispatch recipe: no process can act on this — GC
+                ckpt.delete_search_state(sk)
+                continue
+            job = _recreate_search_job(sk, st)
+        if not isinstance(job, Job):
+            continue
+        if job.status in (Job.DONE, Job.CANCELLED) or \
+                (job.status == Job.FAILED and not job.failed_externally):
+            ckpt.delete_search_state(sk)
+            continue
+        if not (job.status == Job.FAILED and job.failed_externally):
+            continue                     # RUNNING/RESUMING: leave it be
+        if job.attempt >= MAX_ATTEMPTS:
+            ckpt.delete_search_state(sk)
+            continue
+        if data is None:
+            data = ckpt.load_search_state(sk)
+        if data is None:
+            job.attempt += 1
+            job.exception = (f"search resume pass {job.attempt}: durable "
+                             f"search state for {sk} is unreadable")
+            continue
+        if _dispatch_search_resume(job, data.get("state") or {}):
+            resumed.append(sk)
+    return resumed
+
+
+def _dispatch_search_resume(job, state: dict) -> bool:
+    """One re-dispatch: RESUMING (atomic), broadcast the resume op so
+    followers fast-forward from the same state file, and rebuild the
+    search on the job's new worker thread under the ORIGINAL key."""
+    from h2o3_tpu.core.dkv import DKV
+    from h2o3_tpu.parallel import oplog
+
+    spec = state.get("spec") or {}
+    kind = spec.get("kind")
+    train = DKV.get(str(spec.get("training_frame") or ""))
+    if not kind or train is None:
+        job.attempt += 1
+        what = ("no re-dispatch recipe in the durable state" if not kind
+                else f"training frame {spec.get('training_frame')!r} is "
+                     f"not in this process's DKV")
+        job.exception = f"search resume pass {job.attempt}: {what}"
+        return False
+    members = state.get("members") or {}
+    ndone = sum(1 for m in members.values() if m.get("status") == "done")
+    if not job.restart(resumed_from_iteration=ndone):
+        return False
+    inner = dict(spec.get("spec") or {})
+    if oplog.active() and float(inner.get("max_runtime_secs") or 0.0) > 0:
+        # same PR-11 discipline as job resume: a wall-clock budget in a
+        # re-broadcast spec would desynchronize the mirrored member loops
+        inner["max_runtime_secs"] = 0.0
+        spec = dict(spec, spec=inner)
+        state = dict(state, spec=spec)
+    op_seq = None
+    if oplog.active():
+        try:
+            op_seq = oplog.broadcast("search_resume",
+                                     {"search": str(job.key), "kind": kind})
+        except Exception as e:   # noqa: BLE001 — cloud relapsed mid-resume
+            job.fail(f"search resume could not broadcast: {e}")
+            return False
+
+    def run(j):
+        with oplog.turn(op_seq):
+            return run_from_state(state, job=j)
+
+    job.start(run, background=True)
+    _bump(searches_resumed=1)
+    from h2o3_tpu.utils import timeline
+    from h2o3_tpu.utils.log import get_logger
+
+    timeline.record("search", "resumed", search=str(job.key),
+                    attempt=job.attempt, members_done=ndone)
+    get_logger().warning(
+        "watchdog: resumed %s search %s (attempt %d) with %d/%d members "
+        "already done", kind, job.key, job.attempt, ndone, len(members))
+    return True
+
+
+def run_from_state(state: dict, job=None):
+    """Rebuild the AutoML/grid object from its durable spec and re-enter
+    train() with the restored member records — done members re-attach,
+    pending/failed members run, parked members stay parked."""
+    from h2o3_tpu.core.dkv import DKV
+
+    spec = state.get("spec") or {}
+    kind = spec.get("kind")
+    train = DKV.get(spec["training_frame"])
+    valid = DKV.get(spec["validation_frame"]) \
+        if spec.get("validation_frame") else None
+    if kind == "automl":
+        from h2o3_tpu.automl.automl import H2OAutoML
+
+        lb = DKV.get(spec["leaderboard_frame"]) \
+            if spec.get("leaderboard_frame") else None
+        aml = H2OAutoML(**(spec.get("spec") or {}))
+        aml._search_job = job
+        aml._resume_search_state = state
+        aml.train(x=spec.get("x"), y=spec["y"], training_frame=train,
+                  validation_frame=valid, leaderboard_frame=lb)
+        DKV.put((spec.get("spec") or {}).get("project_name"), aml)
+        return aml
+    if kind == "grid":
+        from h2o3_tpu.grid import H2OGridSearch
+        from h2o3_tpu.models.model_builder import BUILDERS
+
+        cls = BUILDERS[spec["algo"]]
+        base = cls(**(spec.get("params") or {}))
+        g = H2OGridSearch(base, spec["hyper"], grid_id=spec.get("grid_id"),
+                          search_criteria=spec.get("criteria"))
+        g._search_job = job
+        g._resume_search_state = state
+        g.train(x=spec.get("x"), y=spec.get("y"), training_frame=train,
+                validation_frame=valid,
+                recovery_dir=spec.get("recovery_dir"))
+        return g
+    raise ValueError(f"unknown search kind {kind!r}")
+
+
+def apply_resume_op(p: dict) -> None:
+    """Follower side of the ``search_resume`` op: reload the SAME durable
+    state this process's checkpoint dir holds and replay the remaining
+    members. Raises loudly when the state is unreadable — training on
+    from nothing would silently desync the mirrored programs."""
+    from h2o3_tpu.parallel import ckpt
+
+    data = ckpt.load_search_state(p["search"])
+    if data is None:
+        raise RuntimeError(
+            f"resumed {p.get('kind', 'search')} search {p['search']}: "
+            f"durable search state is not readable on this process — "
+            f"H2O_TPU_OPLOG_CKPT_DIR must be shared storage for "
+            f"cross-host search resume")
+    run_from_state(data.get("state") or {})

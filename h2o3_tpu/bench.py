@@ -487,6 +487,109 @@ def run_recover():
     return dt, "recover_secs_to_healthy"
 
 
+def run_search_recover(n_rows: int = 1_500):
+    """Search-recovery drill metric: wallclock seconds from a simulated
+    coordinator loss mid-grid (two members already durably done, the rest
+    orphaned) to the watchdog re-dispatching the search from its durable
+    state and the leaderboard completing — zero manual recovery calls.
+    Members run two-wide (collective-free GLM combos), so the aux
+    ``search_members_overlap`` line is the concurrency evidence."""
+    import json as _json
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    # isolated checkpoint dir: never touch a production cloud's records
+    os.environ["H2O_TPU_OPLOG_CKPT_DIR"] = tempfile.mkdtemp(
+        prefix="h2o3_bench_search_recover_")
+    os.environ["H2O_TPU_AUTO_RECOVER"] = "1"
+    os.environ["H2O_TPU_SEARCH_CONCURRENCY"] = "2"
+    from h2o3_tpu.automl import search as _search
+    from h2o3_tpu.core.dkv import DKV
+    from h2o3_tpu.core.frame import Column, Frame, T_CAT
+    from h2o3_tpu.core.job import Job
+    from h2o3_tpu.grid import H2OGridSearch
+    from h2o3_tpu.models.model_builder import BUILDERS
+    from h2o3_tpu.parallel import distributed as D
+    from h2o3_tpu.parallel import oplog, supervisor, watchdog
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n_rows, 3))
+    yv = np.where(X[:, 0] + 0.5 * X[:, 1] +
+                  rng.normal(scale=0.3, size=n_rows) > 0, "Y", "N")
+    with D.memory_kv():
+        oplog.reset()
+        supervisor.reset()
+        watchdog.reset()
+        _search.reset_stats()
+        fr = Frame.from_numpy(X, names=["a", "b", "c"])
+        fr.add("y", Column.from_numpy(yv, ctype=T_CAT))
+        fr.install()     # the resume path looks the frame up by key
+        grid_id = "bench_search_recover_grid"
+        job = Job(description="glm Grid Build", dest=grid_id)
+        base = BUILDERS["glm"](family="binomial")
+        grid = H2OGridSearch(base, {"alpha": [0.0, 0.3, 0.6, 1.0]},
+                             grid_id=grid_id)
+        grid._search_job = job
+
+        # kill the search after two members settle: further dispatches die
+        # the way a lost coordinator's would (engine-level crash, durable
+        # state already holding the finished members)
+        settled = {"n": 0}
+        orig = _search.SearchEngine._build_one
+
+        def dying(self, m, build_fn, score_fn=None):
+            if settled["n"] >= 2:
+                raise RuntimeError("simulated coordinator loss")
+            settled["n"] += 1
+            return orig(self, m, build_fn, score_fn)
+
+        _search.SearchEngine._build_one = dying
+        try:
+            grid.train(y="y", training_frame=fr)
+        except Exception:   # noqa: BLE001 — the simulated loss, by design
+            pass
+        finally:
+            _search.SearchEngine._build_one = orig
+        # the coordinator is gone: its Job object dies with the process —
+        # only the durable search state survives, and the watchdog must
+        # rebuild the Job shell under the ORIGINAL key
+        DKV.remove(str(job.key))
+
+        t0 = time.perf_counter()
+        wd = watchdog.Watchdog(interval=0.05, follow=False).start()
+        try:
+            deadline = _time.time() + 60
+            resumed_job = None
+            while _time.time() < deadline:
+                resumed_job = DKV.get(str(job.key))
+                if isinstance(resumed_job, Job) and \
+                        resumed_job.status == Job.DONE:
+                    break
+                _time.sleep(0.02)
+            dt = time.perf_counter() - t0
+            ok = isinstance(resumed_job, Job) and \
+                resumed_job.status == Job.DONE
+        finally:
+            wd.stop()
+            oplog.reset()
+            supervisor.reset()
+            watchdog.reset()
+    stats = _search.stats()
+    if not ok:
+        raise RuntimeError(
+            f"search-recovery drill did not complete: {_json.dumps(stats)}")
+    if stats.get("searches_resumed", 0) < 1 or \
+            stats.get("members_done", 0) < 4:
+        raise RuntimeError(
+            f"search resumed without finishing its members: "
+            f"{_json.dumps(stats)}")
+    print(f"H2O3_BENCH search_members_overlap {stats.get('overlap', 0)}",
+          flush=True)
+    return dt, "search_recover_secs"
+
+
 def run_artifact(train_rows: int = 20_000, ntrees: int = 10,
                  batch_rows: int = 256, sustain_s: float = 3.0):
     """Serving-tier artifact metrics (ROADMAP item 3 'Done' criterion):
@@ -818,6 +921,8 @@ if __name__ == "__main__":
         value, metric = run_glm()
     elif mode == "recover":
         value, metric = run_recover()
+    elif mode == "search-recover":
+        value, metric = run_search_recover()
     elif mode == "artifact":
         value, metric = run_artifact(
             train_rows=int(os.environ.get("H2O3_BENCH_ARTIFACT_TRAIN_ROWS",

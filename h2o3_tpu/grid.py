@@ -21,7 +21,6 @@ import os
 import pickle
 import threading
 import time
-from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Any, Dict, List, Optional, Sequence, Type
 
 import numpy as np
@@ -82,6 +81,10 @@ class H2OGridSearch(Keyed):
     def __getstate__(self):
         d = dict(self.__dict__)
         d.pop("_lock", None)
+        # runtime-only search machinery (engine holds a live RLock, the
+        # job rides its own DKV key): never into control-plane checkpoints
+        d.pop("_search_engine", None)
+        d.pop("_search_job", None)
         return d
 
     def __setstate__(self, d):
@@ -116,27 +119,59 @@ class H2OGridSearch(Keyed):
         with open(os.path.join(mdir, f"{model.key}.bin"), "wb") as f:
             pickle.dump(model, f)
 
-    def _persist_meta(self) -> None:
-        meta = {"grid_id": str(self.key),
-                "algo": self.builder_cls.algo_name,
-                "base_params": self.base_params,
-                "hyper_params": self.hyper_params,
-                "search_criteria": self.search_criteria,
-                "done": [{"combo_key": k} for k in sorted(self._done)],
-                "models": [str(m.key) for m in self.models],
-                "grid_params": {str(m.key): getattr(m, "_grid_params", {})
-                                for m in self.models},
-                "failed": self.failed}
-        tmp = os.path.join(self.recovery_dir, "grid.json.tmp")
-        with open(tmp, "w") as f:
-            json.dump(meta, f, indent=1)
-        os.replace(tmp, os.path.join(self.recovery_dir, "grid.json"))
-
     @classmethod
     def load(cls, recovery_dir: str) -> "H2OGridSearch":
         """h2o.load_grid analog: restore a persisted grid (models included)
         so train() continues with the remaining hyperparameter combos —
-        kill-and-resume parity with hex/grid/Grid resume."""
+        kill-and-resume parity with hex/grid/Grid resume.
+
+        The unified ``SearchState`` store (searchckpt_*.pkl + model .bin
+        files) is tried first; legacy ``grid.json`` dirs from before the
+        durable-search engine still load."""
+        names = sorted(n for n in os.listdir(recovery_dir)
+                       if n.startswith("searchckpt_")
+                       and n.endswith(".pkl.json"))
+        if not names:
+            return cls._load_legacy(recovery_dir)
+        from h2o3_tpu.parallel import ckpt
+
+        with open(os.path.join(recovery_dir, names[-1]),
+                  encoding="utf-8") as f:
+            sk = json.load(f)["search"]
+        data = ckpt.load_search_state(sk, sdir=recovery_dir)
+        if data is None:
+            raise RuntimeError(
+                f"grid recovery dir {recovery_dir}: search state for "
+                f"{sk!r} is unreadable (current and previous snapshots)")
+        state = data.get("state") or {}
+        spec = state.get("spec") or {}
+        base = BUILDERS[spec["algo"]](**(spec.get("params") or {}))
+        g = cls(base, spec["hyper"], grid_id=spec.get("grid_id"),
+                search_criteria=spec.get("criteria"))
+        g.recovery_dir = recovery_dir
+        g._resume_search_state = state
+        from h2o3_tpu.api.routes_ext import _artifact_load_file
+
+        for name, mem in (state.get("members") or {}).items():
+            if mem.get("status") == "done" and mem.get("model_id"):
+                path = os.path.join(recovery_dir, "models",
+                                    f"{mem['model_id']}.bin")
+                if not os.path.exists(path):
+                    continue
+                m = _artifact_load_file(path)       # restricted unpickler
+                m._grid_params = dict(mem.get("params") or {})
+                m.install()
+                g.models.append(m)
+                g._done.add(name)
+            elif mem.get("status") == "parked":
+                g.failed.append({"params": dict(mem.get("params") or {}),
+                                 "error": mem.get("error"),
+                                 "combo_key": name})
+        g.install()
+        return g
+
+    @classmethod
+    def _load_legacy(cls, recovery_dir: str) -> "H2OGridSearch":
         with open(os.path.join(recovery_dir, "grid.json")) as f:
             meta = json.load(f)
         g = cls(meta["algo"], meta["hyper_params"],
@@ -163,17 +198,24 @@ class H2OGridSearch(Keyed):
             self.models.append(model)
             self._done.add(self._combo_key(combo_params))
             if self.recovery_dir:
+                # the model payload stays one .bin per key; the grid META
+                # now lives in the unified SearchState store (the engine
+                # saves it on every member completion)
                 self._persist_model(model)
-                self._persist_meta()
 
     def train(self, x=None, y=None, training_frame: Optional[Frame] = None,
               validation_frame: Optional[Frame] = None,
-              parallelism: int = 1, recovery_dir: Optional[str] = None,
-              **kw):
-        """Walk the hyper space. `parallelism` builds k models concurrently
-        (GridSearch.java parallelism); `recovery_dir` persists every
-        finished model + grid state so H2OGridSearch.load(dir) resumes
-        after a crash. Already-trained combos (after load) are skipped."""
+              parallelism: Optional[int] = None,
+              recovery_dir: Optional[str] = None, **kw):
+        """Walk the hyper space through the durable search engine.
+        `parallelism` pins the member-scheduling width (GridSearch.java
+        parallelism); None sizes it from ``H2O_TPU_SEARCH_CONCURRENCY``
+        (deterministically 1 on a mirrored cloud). `recovery_dir` persists
+        every finished model + the unified SearchState so
+        H2OGridSearch.load(dir) resumes after a crash; already-trained
+        combos (after load) are skipped."""
+        from h2o3_tpu.automl.search import SearchEngine
+
         keys, combos = self._candidates()
         if recovery_dir:
             self.recovery_dir = recovery_dir
@@ -182,63 +224,102 @@ class H2OGridSearch(Keyed):
         max_secs = float(self.search_criteria.get("max_runtime_secs", 0) or 0)
         t0 = time.time()
 
-        def budget_left() -> bool:
-            if max_models and len(self.models) >= max_models:
+        wire_kw = {k: v for k, v in {**self.base_params, **kw}.items()
+                   if isinstance(v, (str, int, float, bool, list, tuple,
+                                     type(None)))}
+        job = getattr(self, "_search_job", None)
+        search_spec = {
+            "kind": "grid", "description": f"Grid {self.key} Build",
+            "dest": str(self.key),
+            "algo": self.builder_cls.algo_name, "params": wire_kw,
+            "hyper": self.hyper_params, "grid_id": str(self.key),
+            "criteria": self.search_criteria,
+            "x": list(x) if isinstance(x, (list, tuple)) else x, "y": y,
+            "training_frame": (str(training_frame.key)
+                               if training_frame is not None else None),
+            "validation_frame": (str(validation_frame.key)
+                                 if validation_frame is not None else None),
+            "recovery_dir": self.recovery_dir,
+        }
+        engine = SearchEngine(
+            str(job.key) if job is not None else str(self.key),
+            "grid", search_spec, job=job,
+            state=getattr(self, "_resume_search_state", None),
+            sdir=self.recovery_dir)
+        self._search_engine = engine
+
+        members = []
+        for combo in combos:
+            combo_params = dict(zip(keys, combo))
+            ck = self._combo_key(combo_params)
+            if ck in self._done:
+                continue                 # legacy-load resume: already built
+            mem = engine.member(ck, self.builder_cls.algo_name, combo_params)
+            mem["_combo"] = combo_params
+            members.append(mem)
+
+        def can_start(inflight: int) -> bool:
+            # the models cap counts in-flight builds too, so the budget is
+            # honored EXACTLY like a sequential walk (never overshot by up
+            # to concurrency-1 models)
+            if max_models and len(self.models) + inflight >= max_models:
                 return False
             if max_secs and time.time() - t0 > max_secs:
                 return False
             return True
 
-        def build(combo) -> None:
-            combo_params = dict(zip(keys, combo))
+        def build(mem: dict) -> Model:
+            combo_params = dict(mem.get("_combo")
+                                or mem.get("params") or {})
             params = dict(self.base_params)
             params.update(kw)
             params.update(combo_params)
-            try:
-                b = self.builder_cls(**params)
-                m = b.train(x=x, y=y, training_frame=training_frame,
-                            validation_frame=validation_frame)
-                self._record(combo_params, m)
-            except Exception as e:       # noqa: BLE001 — grid keeps going
+            b = self.builder_cls(**params)
+            m = b.train(x=x, y=y, training_frame=training_frame,
+                        validation_frame=validation_frame)
+            self._record(combo_params, m)
+            return m
+
+        def reattach(mem: dict) -> Optional[Model]:
+            mid = mem.get("model_id")
+            if not mid:
+                return None
+            for m in self.models:
+                if str(m.key) == mid:
+                    return m             # loaded with the recovery dir
+            m = DKV.get(mid)
+            if m is None and self.recovery_dir:
+                path = os.path.join(self.recovery_dir, "models",
+                                    f"{mid}.bin")
+                if os.path.exists(path):
+                    from h2o3_tpu.api.routes_ext import _artifact_load_file
+
+                    m = _artifact_load_file(path)
+                    m.install()
+            if m is not None:
+                combo_params = dict(mem.get("params") or {})
+                m._grid_params = combo_params
                 with self._lock:
-                    self.failed.append({"params": combo_params,
-                                        "error": f"{type(e).__name__}: {e}"})
+                    self.models.append(m)
+                    self._done.add(mem["name"])
+            return m
 
-        pending = [c for c in combos
-                   if self._combo_key(dict(zip(keys, c))) not in self._done]
-        if parallelism <= 1:
-            for combo in pending:
-                if not budget_left():
-                    break
-                build(combo)
-        else:
-            with ThreadPoolExecutor(max_workers=int(parallelism)) as pool:
-                futures = set()
-                it = iter(pending)
-                while True:
-                    # the models cap counts in-flight builds too, so the
-                    # budget is honored EXACTLY like the sequential walk
-                    # (not overshot by up to parallelism-1 models)
-                    def can_submit():
-                        if max_models and \
-                                len(self.models) + len(futures) >= max_models:
-                            return False
-                        return budget_left()
+        def score(mem, model):
+            return _metric_value(model, _default_metric(model))
 
-                    while len(futures) < int(parallelism) and can_submit():
-                        combo = next(it, None)
-                        if combo is None:
-                            break
-                        futures.add(pool.submit(build, combo))
-                    if not futures:
-                        break
-                    finished, futures = wait(futures,
-                                             return_when=FIRST_COMPLETED)
-                    for f in finished:
-                        f.result()      # surface unexpected errors
-                    if not budget_left():
-                        wait(futures)   # stop feeding; let inflight finish
-                        break
+        engine.run(members, build, can_start=can_start, reattach=reattach,
+                   score_fn=score,
+                   concurrency=int(parallelism) if parallelism else None)
+        for mem in members:
+            if mem.get("status") == "parked" and not any(
+                    f.get("combo_key") == mem["name"] for f in self.failed):
+                with self._lock:
+                    self.failed.append({"params": dict(mem.get("_combo")
+                                                       or mem.get("params")
+                                                       or {}),
+                                        "error": mem.get("error"),
+                                        "combo_key": mem["name"]})
+        engine.finish()
         if not self.models:
             raise RuntimeError(f"grid produced no models; failures: {self.failed[:3]}")
         return self

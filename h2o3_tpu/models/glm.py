@@ -635,6 +635,9 @@ class GLM(ModelBuilder):
     # chunks with durable beta between them, and the lambda-search path
     # persists per-lambda progress (model_builder._tick_job_progress)
     supports_iteration_resume = True
+    # IRLS device programs are collective-free, so concurrent GLM builds
+    # are safe to interleave (long proven by the parallel-grid path)
+    parallel_safe = True
 
     @classmethod
     def default_params(cls):

@@ -41,6 +41,12 @@ class ModelBuilder:
     algo_name = "base"
     model_class = Model
     supervised = True
+    # Whether two training runs of this builder may execute device programs
+    # concurrently. Collective-bearing programs (tree histograms, DL) can
+    # deadlock the XLA CPU runtime when interleaved, so the default is
+    # False and the AutoML/grid search engine serializes them on a device
+    # lane; collective-free builders opt in.
+    parallel_safe = False
 
     def __init__(self, **params):
         self.params: Dict[str, Any] = self.default_params()

@@ -759,6 +759,41 @@ def _install_default_metrics() -> None:
     r.counter_fn("h2o3_watchdog_jobs_resumed_total",
                  "externally-failed jobs re-dispatched from durable "
                  "progress", _wd("jobs_resumed"))
+    r.counter_fn("h2o3_watchdog_searches_resumed_total",
+                 "orphaned AutoML/grid searches re-dispatched from durable "
+                 "search state", _wd("searches_resumed"))
+
+    def _srch(field):
+        def fn():
+            from h2o3_tpu.automl import search
+
+            return float(search.stats().get(field, 0))
+        return fn
+
+    r.counter_fn("h2o3_search_members_done_total",
+                 "AutoML/grid search members trained to completion",
+                 _srch("members_done"))
+    r.counter_fn("h2o3_search_members_failed_total",
+                 "search member attempts that crashed or timed out",
+                 _srch("members_failed"))
+    r.counter_fn("h2o3_search_members_parked_total",
+                 "search members quarantine-parked after MAX_ATTEMPTS or a "
+                 "deterministic config error", _srch("members_parked"))
+    r.counter_fn("h2o3_search_member_attempts_total",
+                 "search member training attempts started",
+                 _srch("attempts"))
+    r.counter_fn("h2o3_search_resumed_total",
+                 "searches resumed from durable state after coordinator "
+                 "loss", _srch("searches_resumed"))
+    r.counter_fn("h2o3_search_state_saves_total",
+                 "durable search-state snapshots written",
+                 _srch("state_saves"))
+    r.gauge_fn("h2o3_search_members_running",
+               "search members currently training", _srch("running"),
+               agg="max")
+    r.gauge_fn("h2o3_search_members_overlap",
+               "high-water mark of concurrently-training search members",
+               _srch("overlap"), agg="max")
 
     def _cloud_state():
         from h2o3_tpu.parallel import supervisor

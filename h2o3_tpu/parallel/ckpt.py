@@ -513,6 +513,155 @@ def delete_job_progress(job_key: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# durable search state (AutoML / grid)
+#
+# The search controller (automl/search.py) holds only small durable state:
+# the member plan, per-member status/attempts/scores, and the re-dispatch
+# spec. Same discipline as job progress — atomic file replace, JSON
+# sidecar, KV record, restricted unpickler, persist/-resolved path — plus
+# one extra: the previous snapshot is rotated to ``.prev`` before each
+# replace, so a torn/corrupt current file is refused LOUDLY and the
+# previous snapshot wins (a search must never resume from garbage).
+# ---------------------------------------------------------------------------
+
+_SEARCH_PREFIX = "oplog/searchckpt/"
+
+
+def _search_path(search_key: str, sdir: Optional[str] = None) -> str:
+    safe = re.sub(r"[^\w.-]", "_", str(search_key))
+    return os.path.join(sdir or ckpt_dir(), f"searchckpt_{safe}.pkl")
+
+
+def save_search_state(search_key: str, state: Dict[str, Any],
+                      sdir: Optional[str] = None) -> str:
+    """Persist one search's durable state (member plan + statuses +
+    attempt counts + re-dispatch spec). The current snapshot is rotated
+    to ``.prev`` before the atomic replace so there are always two
+    generations on disk: if the newest file is torn, the previous one
+    still describes a valid (slightly older) leaderboard."""
+    members = state.get("members") or {}
+    payload = {"search": str(search_key), "kind": state.get("kind"),
+               "state": state, "ts": time.time()}
+    path = _search_path(search_key, sdir)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".part"
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f)
+    if os.path.exists(path):
+        try:
+            os.replace(path, path + ".prev")
+        except OSError:
+            pass
+    os.replace(tmp, path)
+    counts: Dict[str, int] = {}
+    for m in members.values():
+        st = str(m.get("status", "pending"))
+        counts[st] = counts.get(st, 0) + 1
+    meta = {"search": str(search_key), "kind": state.get("kind"),
+            "dest": state.get("dest"), "path": path,
+            "members": counts, "ts": payload["ts"]}
+    mtmp = path + ".json.part"
+    with open(mtmp, "w", encoding="utf-8") as f:
+        json.dump(meta, f)
+    os.replace(mtmp, path + ".json")
+    D.kv_put(_SEARCH_PREFIX + str(search_key), json.dumps(meta))
+    from h2o3_tpu.utils import timeline
+
+    timeline.record("search", "state_saved", search=str(search_key),
+                    done=counts.get("done", 0))
+    return path
+
+
+def search_state_records() -> List[dict]:
+    """Cloud-wide durable search records ({search, kind, dest, path,
+    members, ts}), sorted by search key. Same double-booked discovery as
+    job progress: KV records first, then sidecar files the KV does not
+    know about (single-process clouds, a wiped KV)."""
+    out = []
+    for _k, v in D.kv_dir(_SEARCH_PREFIX):
+        try:
+            rec = json.loads(v)
+        except (ValueError, TypeError):
+            continue
+        if isinstance(rec, dict) and rec.get("search"):
+            out.append(rec)
+    seen = {r["search"] for r in out}
+    try:
+        names = sorted(os.listdir(ckpt_dir()))
+    except OSError:
+        names = []
+    for name in names:
+        if not (name.startswith("searchckpt_") and name.endswith(".pkl.json")):
+            continue
+        try:
+            with open(os.path.join(ckpt_dir(), name), encoding="utf-8") as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(rec, dict) and rec.get("search") \
+                and rec["search"] not in seen:
+            out.append(rec)
+    return sorted(out, key=lambda r: r["search"])
+
+
+def load_search_state(search_key: str,
+                      sdir: Optional[str] = None) -> Optional[dict]:
+    """Load a search's durable state ({search, kind, state, ts}); None
+    when no readable snapshot exists. A torn/corrupt CURRENT file is
+    refused loudly and the ``.prev`` generation is tried — the previous
+    snapshot wins over garbage. Paths resolve through ``persist/`` so a
+    new coordinator on another host can read shared-storage state."""
+    from h2o3_tpu import persist
+    from h2o3_tpu.utils.log import get_logger
+
+    path = None
+    if sdir is None:
+        raw = D.kv_try_get(_SEARCH_PREFIX + str(search_key))
+        if raw is not None:
+            try:
+                path = json.loads(raw).get("path")
+            except (ValueError, TypeError):
+                path = None
+    path = path or _search_path(search_key, sdir)
+    for i, p in enumerate((path, path + ".prev")):
+        try:
+            with open(persist.resolve(p), "rb") as f:
+                rec = _CkptUnpickler(f).load()
+        except OSError:
+            continue
+        except (pickle.UnpicklingError, EOFError, ValueError,
+                AttributeError, ImportError, IndexError) as e:
+            get_logger().error(
+                "search state %s is torn/corrupt (%s: %s) — refusing it%s",
+                p, type(e).__name__, e,
+                "; trying previous snapshot" if i == 0 else "")
+            continue
+        if isinstance(rec, dict) and rec.get("state"):
+            if i == 1:
+                get_logger().warning(
+                    "search %s resuming from PREVIOUS snapshot %s",
+                    search_key, p)
+            return rec
+    return None
+
+
+def delete_search_state(search_key: str, sdir: Optional[str] = None,
+                        keep_files: bool = False) -> None:
+    """Drop a search's durable state (the completed search supersedes
+    it). ``keep_files`` drops only the KV record — used when the state
+    doubles as a user-visible export directory (grid recovery_dir)."""
+    D.kv_delete(_SEARCH_PREFIX + str(search_key))
+    if keep_files:
+        return
+    path = _search_path(search_key, sdir)
+    for p in (path, path + ".prev", path + ".json"):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
 # append-only tree-progress suffix chunks
 #
 # The tree trainers' loop state is dominated by the per-tree tables (packed

@@ -822,6 +822,16 @@ def _apply(kind: str, p: Dict[str, Any]) -> None:
         # project key resolves on every process
         DKV.put(p["spec"]["project_name"], aml)
         return
+    if kind == "search_resume":
+        # re-dispatch of an orphaned AutoML/grid search after a
+        # coordinator handoff: every process reloads the SAME durable
+        # search state (shared checkpoint dir) and walks the remaining
+        # members in plan order, so the device program sequence lines up
+        # exactly like the monolithic "automl"/"grid" ops
+        from h2o3_tpu.automl import search
+
+        search.apply_resume_op(p)
+        return
     raise ValueError(f"unknown oplog op {kind!r}")
 
 

@@ -27,7 +27,10 @@ directly by the chaos tests) it takes at most one recovery action:
   on a win. ``ElectionLost`` just means "standing by".
 - **coordinator on a workable cloud** → re-dispatch externally-failed
   jobs that left durable training progress (``resume_failed_jobs``):
-  FAILED → RESUMING → RUNNING → DONE from the last completed iteration.
+  FAILED → RESUMING → RUNNING → DONE from the last completed iteration;
+  then re-dispatch orphaned AutoML/grid searches that left durable
+  search state (``automl/search.resume_orphaned``) under their ORIGINAL
+  keys, so a killed coordinator's search completes autonomously.
 
 ``H2O_TPU_AUTO_RECOVER=0`` disables every action (manual drills / chaos
 tests drive transitions by hand); state is surfaced on GET /3/CloudStatus.
@@ -43,7 +46,8 @@ from h2o3_tpu.parallel import retry
 
 _LOCK = threading.Lock()
 _STATE: Dict = {"ticks": 0, "elections": 0, "rejoins": 0,
-                "jobs_resumed": 0, "last_action": "", "last_error": "",
+                "jobs_resumed": 0, "searches_resumed": 0,
+                "last_action": "", "last_error": "",
                 "last_tick": 0.0, "running": False}
 
 # a job that keeps dying is not resumed forever (poisoned input, a bug in
@@ -98,8 +102,12 @@ def reset() -> None:
     """Clear the counters (tests / cloud restart)."""
     with _LOCK:
         _STATE.update(ticks=0, elections=0, rejoins=0, jobs_resumed=0,
-                      last_action="", last_error="", last_tick=0.0)
+                      searches_resumed=0, last_action="", last_error="",
+                      last_tick=0.0)
     _STRIKES.clear()
+    from h2o3_tpu.automl import search
+
+    search._STRIKES.clear()
 
 
 def _note(action: str, **counters) -> str:
@@ -173,6 +181,15 @@ def resume_failed_jobs() -> List[str]:
         if _dispatch_resume(job, data.get("spec") or {}, data):
             resumed.append(jk)
     return resumed
+
+
+def resume_orphaned_searches() -> List[str]:
+    """Re-dispatch every orphaned AutoML/grid search that persisted
+    durable search state (automl/search.py owns the machinery; the
+    lazy import keeps the recovery layer free of workload imports)."""
+    from h2o3_tpu.automl import search
+
+    return search.resume_orphaned()
 
 
 # bounded retries for records whose Job is gone AND whose progress file is
@@ -398,6 +415,14 @@ class Watchdog:
                                          extra={"jobs": got})
                     return _note(f"resumed jobs {got}",
                                  jobs_resumed=len(got))
+                sr = resume_orphaned_searches()
+                if sr:
+                    from h2o3_tpu.obs import flight
+
+                    flight.record_flight("watchdog_search_resume",
+                                         extra={"searches": sr})
+                    return _note(f"resumed searches {sr}",
+                                 searches_resumed=len(sr))
             return _note("idle")
         except Exception as e:   # noqa: BLE001 — a transient KV fault must
             with _LOCK:          # not kill recovery for good

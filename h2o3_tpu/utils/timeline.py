@@ -39,6 +39,7 @@ KINDS = frozenset({
     "profiler",         # /3/Profiler start/stop captures
     "rest",             # REST request ring (api/server.py merge)
     "scoring",          # fused serving dispatches
+    "search",           # durable AutoML/grid search-state saves + resumes
     "self_benchmark",   # mesh boot probes
     "task_profile",     # opt-in per-task phase timings (H2O_TPU_PROFILE)
     "tree",             # per-tree / per-level trainer timings

@@ -91,7 +91,7 @@ _HEAVY_MODULES = [
     "test_job_resume", "test_trees", "test_checkpoint", "test_genmodel",
     "test_artifact", "test_mojo",
     "test_mojo_families", "test_explain", "test_ensemble",
-    "test_survival_gam_rulefit", "test_grid",
+    "test_survival_gam_rulefit", "test_grid", "test_search_resume",
     # long single fits / many submodels
     "test_automl", "test_automl_bindings", "test_deep_trees",
     "test_deeplearning", "test_pallas_hist",

@@ -1,0 +1,401 @@
+"""Durable AutoML/grid search engine (PR 18): the SearchState store's
+torn-write discipline, member-crash quarantine, save-fault resilience, and
+the watchdog's kill-mid-grid search resume — the library-level half of the
+acceptance drills (the REST arc lives in test_supervision.py)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.automl import search
+from h2o3_tpu.core import failure
+from h2o3_tpu.core.dkv import DKV
+from h2o3_tpu.core.frame import Column, Frame, T_CAT
+from h2o3_tpu.core.job import Job
+from h2o3_tpu.parallel import ckpt
+from h2o3_tpu.parallel import distributed as D
+from h2o3_tpu.parallel import oplog, supervisor, watchdog
+from h2o3_tpu.parallel.watchdog import MAX_ATTEMPTS
+
+
+class _FakeModel:
+    def __init__(self, key="FakeModel_1"):
+        self.key = key
+
+
+def _state(key="SearchT", done=("m1",), pending=("m2",)):
+    members = {}
+    order = []
+    for n in done:
+        members[n] = {"name": n, "status": "done", "attempts": 1,
+                      "model_id": f"Model_{n}", "score": 0.9, "error": None}
+        order.append(n)
+    for n in pending:
+        members[n] = {"name": n, "status": "pending", "attempts": 0,
+                      "model_id": None, "score": None, "error": None}
+        order.append(n)
+    return {"search": key, "kind": "grid",
+            "spec": {"kind": "grid", "dest": "d"},
+            "members": members, "order": order, "saves": 1, "dest": "d"}
+
+
+# ---------------------------------------------------------------------------
+# the durable store: atomic rotation, torn-file refusal, record listing
+# ---------------------------------------------------------------------------
+
+class TestSearchStateStore:
+    def test_roundtrip_records_and_delete(self, cl, tmp_path, monkeypatch):
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        ckpt.save_search_state("S_rt", _state("S_rt"))
+        recs = [r for r in ckpt.search_state_records()
+                if r["search"] == "S_rt"]
+        assert recs and recs[0]["kind"] == "grid"
+        assert recs[0]["members"] == {"done": 1, "pending": 1}
+        data = ckpt.load_search_state("S_rt")
+        assert data["state"]["members"]["m1"]["model_id"] == "Model_m1"
+        ckpt.delete_search_state("S_rt")
+        assert ckpt.load_search_state("S_rt") is None
+        assert not [r for r in ckpt.search_state_records()
+                    if r["search"] == "S_rt"]
+
+    def test_torn_current_refused_previous_snapshot_wins(
+            self, cl, tmp_path, monkeypatch):
+        """Satellite (b): a torn current file is refused LOUDLY and the
+        rotated previous generation is served instead."""
+        import logging
+
+        from h2o3_tpu.utils.log import get_logger
+
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        ckpt.save_search_state("S_torn",
+                               _state("S_torn", done=(),
+                                      pending=("m1", "m2")))
+        ckpt.save_search_state("S_torn", _state("S_torn", done=("m1",)))
+        path = ckpt._search_path("S_torn")
+        assert os.path.exists(path + ".prev")
+        with open(path, "wb") as f:
+            f.write(b"\x80\x04 torn mid-write")
+        # the repo logger does not propagate: hook it directly
+        msgs = []
+        h = logging.Handler()
+        h.emit = lambda rec: msgs.append(rec.getMessage())
+        lg = get_logger()
+        lg.addHandler(h)
+        try:
+            data = ckpt.load_search_state("S_torn")
+        finally:
+            lg.removeHandler(h)
+        assert any("torn/corrupt" in m for m in msgs)
+        # the previous generation (first save: m1 still pending) stands
+        assert data is not None
+        assert data["state"]["members"]["m1"]["status"] == "pending"
+        ckpt.delete_search_state("S_torn")
+
+    def test_both_generations_torn_returns_none(self, cl, tmp_path,
+                                                monkeypatch):
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        ckpt.save_search_state("S_gone", _state("S_gone"))
+        ckpt.save_search_state("S_gone", _state("S_gone"))
+        path = ckpt._search_path("S_gone")
+        for p in (path, path + ".prev"):
+            with open(p, "wb") as f:
+                f.write(b"not a pickle")
+        assert ckpt.load_search_state("S_gone") is None
+        ckpt.delete_search_state("S_gone")
+
+
+# ---------------------------------------------------------------------------
+# the engine: quarantine, retry-in-place, save faults, restore semantics
+# ---------------------------------------------------------------------------
+
+class TestEngineQuarantine:
+    def test_injected_crashes_park_at_max_attempts_search_completes(
+            self, cl):
+        """Acceptance: a member that crashes on EVERY attempt parks at
+        MAX_ATTEMPTS while the rest of the search finishes normally."""
+        eng = search.SearchEngine("SQ_park", "grid", {"kind": "grid"},
+                                  persist=False)
+        bad = eng.member("bad", "glm", {"alpha": 0.0})
+        good = eng.member("good", "glm", {"alpha": 1.0})
+        built = []
+
+        def build(m):
+            built.append(m["name"])
+            return _FakeModel(f"Fake_{m['name']}")
+
+        with failure.inject("search.member_train", times=MAX_ATTEMPTS):
+            ok = eng.run([bad, good], build, concurrency=1)
+        assert ok is True                       # the search itself succeeded
+        assert bad["status"] == "parked"
+        assert bad["attempts"] == MAX_ATTEMPTS
+        assert "injected" in (bad["error"] or "").lower()
+        assert good["status"] == "done"
+        assert good["model_id"] == "Fake_good"
+        assert built == ["good"]                # bad never reached build_fn
+
+    def test_crash_burns_attempt_then_retries_in_place(self, cl):
+        eng = search.SearchEngine("SQ_retry", "grid", {"kind": "grid"},
+                                  persist=False)
+        m = eng.member("flaky", "glm", {})
+        with failure.inject("search.member_train", times=1):
+            assert eng.run([m], lambda _m: _FakeModel(), concurrency=1)
+        assert m["status"] == "done"
+        assert m["attempts"] == 2               # crash + clean retry
+
+    def test_deterministic_config_error_parks_first_attempt(self, cl):
+        eng = search.SearchEngine("SQ_det", "grid", {"kind": "grid"},
+                                  persist=False)
+        m = eng.member("poisoned", "glm", {})
+
+        def build(_m):
+            raise ValueError("family nosuchfamily")
+
+        assert eng.run([m], build, concurrency=1) is True
+        assert m["status"] == "parked" and m["attempts"] == 1
+        assert "nosuchfamily" in m["error"]
+
+    def test_state_save_fault_never_fails_the_search(self, cl, tmp_path):
+        eng = search.SearchEngine("SQ_save", "grid", {"kind": "grid"},
+                                  sdir=str(tmp_path))
+        eng.member("m", "glm", {})
+        before = search.stats()["state_save_errors"]
+        with failure.inject("search.state_save", times=1):
+            eng.save()                          # swallowed, counted
+        assert search.stats()["state_save_errors"] == before + 1
+        eng.save()                              # next save lands
+        assert ckpt.load_search_state("SQ_save",
+                                      sdir=str(tmp_path)) is not None
+
+    def test_restored_running_member_burns_attempt(self, cl):
+        st = _state("SQ_restore", done=("m1",), pending=())
+        st["members"]["m2"] = {"name": "m2", "status": "running",
+                               "attempts": 2, "model_id": None,
+                               "score": None, "error": None}
+        st["order"].append("m2")
+        eng = search.SearchEngine("SQ_restore", "grid", state=st,
+                                  persist=False)
+        assert eng.resumed is True
+        m2 = eng.members["m2"]
+        assert m2["status"] == "failed"         # retryable, not parked
+        assert m2["attempts"] == 3              # in-flight attempt burned
+        assert "coordinator died" in m2["error"]
+
+    def test_concurrent_members_overlap(self, cl):
+        """Two collective-free members at width 2 genuinely overlap (the
+        gauge the chaos drill asserts over REST)."""
+        eng = search.SearchEngine("SQ_conc", "grid", {"kind": "grid"},
+                                  persist=False)
+        ms = [eng.member(f"m{i}", "glm", {}) for i in range(2)]
+        import threading
+        gate = threading.Barrier(2, timeout=30)
+
+        def build(_m):
+            gate.wait()                         # both in flight at once
+            return _FakeModel(f"Fake_{_m['name']}")
+
+        search.reset_stats()
+        assert eng.run(ms, build, concurrency=2)
+        assert all(m["status"] == "done" for m in ms)
+        assert search.stats()["overlap"] >= 2
+
+
+class TestMirroredDiscipline:
+    def test_scrub_clears_wallclock_budget_when_oplog_active(
+            self, cl, monkeypatch):
+        monkeypatch.setattr(oplog, "active", lambda: True)
+        out = search._scrub_params({"max_runtime_secs": 5.0, "seed": 1})
+        assert out["max_runtime_secs"] == 0.0 and out["seed"] == 1
+
+    def test_concurrency_and_deadline_forced_off_on_oplog_cloud(
+            self, cl, monkeypatch):
+        monkeypatch.setenv("H2O_TPU_SEARCH_CONCURRENCY", "4")
+        monkeypatch.setenv("H2O_TPU_SEARCH_MEMBER_DEADLINE_S", "9")
+        monkeypatch.setattr(oplog, "active", lambda: True)
+        assert search.search_concurrency() == 1
+        assert search.member_deadline_s() == 0.0
+        monkeypatch.setattr(oplog, "active", lambda: False)
+        assert search.search_concurrency() == 4
+        assert search.member_deadline_s() == 9.0
+
+
+# ---------------------------------------------------------------------------
+# watchdog search resume: kill mid-grid, zero manual recovery calls
+# ---------------------------------------------------------------------------
+
+def _frame(n=1200, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 3))
+    yv = np.where(X[:, 0] + 0.5 * X[:, 1] +
+                  rng.normal(scale=0.3, size=n) > 0, "Y", "N")
+    fr = Frame.from_numpy(X, names=["a", "b", "c"])
+    fr.add("y", Column.from_numpy(yv, ctype=T_CAT))
+    return fr
+
+
+class TestWatchdogSearchResume:
+    def test_kill_mid_grid_watchdog_resumes_under_original_key(
+            self, cl, monkeypatch, tmp_path):
+        """The library half of the acceptance drill: a grid dies with two
+        combos left, only durable state survives (the Job object is gone),
+        and one watchdog tick re-dispatches the search under the ORIGINAL
+        job key until the leaderboard completes."""
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        monkeypatch.setenv("H2O_TPU_AUTO_RECOVER", "1")
+        from h2o3_tpu.grid import H2OGridSearch
+        from h2o3_tpu.models.model_builder import BUILDERS
+        from h2o3_tpu.utils import timeline
+
+        with D.memory_kv():
+            oplog.reset()
+            supervisor.reset()
+            watchdog.reset()
+            search.reset_stats()
+            fr = _frame()
+            fr.install()
+            job = Job(description="glm Grid Build", dest="wd_resume_grid")
+            grid = H2OGridSearch(BUILDERS["glm"](family="binomial"),
+                                 {"alpha": [0.0, 0.5, 1.0]},
+                                 grid_id="wd_resume_grid")
+            grid._search_job = job
+
+            settled = {"n": 0}
+            orig = search.SearchEngine._build_one
+
+            def dying(self, m, build_fn, score_fn=None):
+                if settled["n"] >= 1:
+                    raise RuntimeError("simulated coordinator loss")
+                settled["n"] += 1
+                return orig(self, m, build_fn, score_fn)
+
+            monkeypatch.setattr(search.SearchEngine, "_build_one", dying)
+            with pytest.raises(RuntimeError, match="coordinator loss"):
+                grid.train(y="y", training_frame=fr)
+            monkeypatch.setattr(search.SearchEngine, "_build_one", orig)
+            data = ckpt.load_search_state(str(job.key))
+            assert data is not None
+            done0 = sum(1 for m in data["state"]["members"].values()
+                        if m["status"] == "done")
+            assert done0 == 1
+            # the Job object dies with its coordinator
+            DKV.remove(str(job.key))
+
+            wd = watchdog.Watchdog(interval=3600, follow=False)
+            tag = wd.tick()
+            assert tag.startswith("resumed searches"), tag
+            deadline = time.monotonic() + 120
+            j2 = None
+            while time.monotonic() < deadline:
+                j2 = DKV.get(str(job.key))
+                if isinstance(j2, Job) and j2.status == Job.DONE:
+                    break
+                time.sleep(0.05)
+            assert isinstance(j2, Job) and j2.status == Job.DONE, \
+                getattr(j2, "exception", j2)
+            assert j2.attempt == 2              # original + one resume
+            assert j2.resumed_from_iteration == done0
+            st = search.stats()
+            assert st["searches_resumed"] == 1
+            assert st["members_done"] >= 3      # 1 pre-kill + 2 resumed
+            # completion supersedes the durable record
+            assert ckpt.load_search_state(str(job.key)) is None
+            kinds = [e for e in timeline.events()
+                     if e.get("kind") == "search"
+                     and e.get("what") == "resumed"]
+            assert kinds and kinds[-1]["search"] == str(job.key)
+
+    def test_done_job_search_record_is_gcd(self, cl, monkeypatch,
+                                           tmp_path):
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        with D.memory_kv():
+            watchdog.reset()
+            job = Job(description="done search", dest="gc_dest")
+            job.status = Job.DONE
+            ckpt.save_search_state(str(job.key), _state(str(job.key)))
+            assert search.resume_orphaned() == []
+            assert ckpt.load_search_state(str(job.key)) is None
+            DKV.remove(str(job.key))
+
+    def test_unreadable_state_strikes_out_after_max_attempts(
+            self, cl, monkeypatch, tmp_path):
+        """A record whose BOTH snapshot generations are gone can never be
+        resumed: MAX_ATTEMPTS strikes drop it instead of looping forever."""
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        with D.memory_kv():
+            watchdog.reset()
+            ckpt.save_search_state("S_strike", _state("S_strike"))
+            path = ckpt._search_path("S_strike")
+            os.unlink(path)
+            for i in range(MAX_ATTEMPTS):
+                assert [r for r in ckpt.search_state_records()
+                        if r["search"] == "S_strike"], f"gone at strike {i}"
+                assert search.resume_orphaned() == []
+            assert not [r for r in ckpt.search_state_records()
+                        if r["search"] == "S_strike"]
+
+
+# ---------------------------------------------------------------------------
+# grid recovery dirs: unified store + legacy format
+# ---------------------------------------------------------------------------
+
+class TestGridRecoveryStore:
+    def test_legacy_grid_json_dir_still_loads(self, cl, tmp_path):
+        """Satellite (a): dirs exported by the pre-engine grid code (one
+        grid.json + models/*.bin) load through the legacy path and resume
+        the remaining combos."""
+        import pickle
+
+        from h2o3_tpu.grid import H2OGridSearch
+
+        fr = _frame(n=800, seed=3)
+        g0 = H2OGridSearch("glm", {"alpha": [0.0, 1.0]},
+                           grid_id="legacy_src")
+        g0.train(y="y", training_frame=fr, family="binomial")
+        assert len(g0.models) == 2
+        legacy = tmp_path / "legacy_grid"
+        mdir = legacy / "models"
+        mdir.mkdir(parents=True)
+        kept = g0.models[0]
+        with open(mdir / f"{kept.key}.bin", "wb") as f:
+            pickle.dump(kept, f)
+        meta = {"grid_id": "legacy_grid", "algo": "glm",
+                "base_params": {"family": "binomial"},
+                "hyper_params": {"alpha": [0.0, 1.0]},
+                "search_criteria": {"strategy": "Cartesian"},
+                "done": [{"combo_key": H2OGridSearch._combo_key(
+                    {"alpha": 0.0})}],
+                "models": [str(kept.key)],
+                "grid_params": {str(kept.key): {"alpha": 0.0}},
+                "failed": []}
+        with open(legacy / "grid.json", "w") as f:
+            json.dump(meta, f)
+
+        g = H2OGridSearch.load(str(legacy))
+        assert len(g.models) == 1
+        assert getattr(g.models[0], "_grid_params", {}) == {"alpha": 0.0}
+        g.train(y="y", training_frame=fr, family="binomial")
+        assert len(g.models) == 2               # only alpha=1.0 retrained
+        combos = sorted(m._grid_params["alpha"] for m in g.models)
+        assert combos == [0.0, 1.0]
+
+    def test_new_recovery_dir_keeps_files_after_finish(self, cl, tmp_path):
+        """recovery_dir doubles as the export surface: a COMPLETED grid's
+        state files stay on disk (only the cloud KV record drops) so
+        H2OGridSearch.load keeps working after success."""
+        from h2o3_tpu.grid import H2OGridSearch
+
+        fr = _frame(n=800, seed=4)
+        rec = str(tmp_path / "rec")
+        g0 = H2OGridSearch("glm", {"alpha": [0.0, 1.0]},
+                           grid_id="keepfiles_grid")
+        g0.train(y="y", training_frame=fr, family="binomial",
+                 recovery_dir=rec)
+        assert len(g0.models) == 2
+        assert [n for n in os.listdir(rec)
+                if n.startswith("searchckpt_") and n.endswith(".pkl")]
+        g = H2OGridSearch.load(rec)
+        assert len(g.models) == 2
+        assert {str(m.key) for m in g.models} == \
+            {str(m.key) for m in g0.models}

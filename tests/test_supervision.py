@@ -2166,6 +2166,180 @@ class TestAutonomousArc:
                 DKV.remove(k)
 
 
+    def test_kill_mid_automl_watchdog_resumes_leaderboard_over_rest(
+            self, cl, standby_cloud, monkeypatch, tmp_path):
+        """Acceptance (ISSUE 18): the coordinator dies mid-AutoML with two
+        members durably done (trained TWO-WIDE — the overlap gauge is the
+        concurrency evidence); with zero manual recovery calls the
+        watchdog elects this standby, re-dispatches the search under the
+        ORIGINAL AutoML job key, and the leaderboard completes over REST
+        with the attempt counter carried."""
+        import numpy as np
+
+        from h2o3_tpu.api import server as api_server
+        from h2o3_tpu.automl import search
+        from h2o3_tpu.automl.automl import H2OAutoML
+        from h2o3_tpu.core.dkv import DKV
+        from h2o3_tpu.core.frame import Column, Frame
+        from h2o3_tpu.core.job import Job
+
+        monkeypatch.setenv("H2O_TPU_AUTO_RECOVER", "1")
+        monkeypatch.setenv("H2O_TPU_ELECTION_GRACE_S", "0.2")
+        monkeypatch.setenv("H2O_TPU_HEARTBEAT_STALE_S", "60")
+        monkeypatch.setenv("H2O_TPU_SUPERVISE_INTERVAL_S", "3600")
+        monkeypatch.setenv("H2O_TPU_OPLOG_CKPT_DIR", str(tmp_path))
+        monkeypatch.setenv("H2O_TPU_OP_ACK_TIMEOUT_S", "15")
+        monkeypatch.setenv("H2O_TPU_SEARCH_CONCURRENCY", "2")
+        watchdog.reset()
+        search.reset_stats()
+
+        rng = np.random.default_rng(5)
+        n = 400
+        fr = Frame()
+        x1, x2 = rng.standard_normal(n), rng.standard_normal(n)
+        fr.add("x1", Column.from_numpy(x1))
+        fr.add("x2", Column.from_numpy(x2))
+        fr.add("y", Column.from_numpy(
+            np.where(x1 - 0.5 * x2 > 0, "Y", "N"), ctype="enum"))
+        DKV.put(str(fr.key), fr)
+
+        project = "arc_automl"
+        aml = H2OAutoML(max_models=3, nfolds=0, seed=42,
+                        include_algos=["glm", "gbm"],
+                        project_name=project)
+        job = Job(description="AutoML", dest=project)
+        aml._search_job = job
+
+        # -- the doomed coordinator's search. It ran its members two-wide
+        # while it was the cloud's only process (admission-sized width is
+        # a single-process feature; mirrored clouds walk serial by
+        # design); the standby attached just before the crash.
+        monkeypatch.setattr(D, "process_count", lambda: 1)
+        settled = {"n": 0}
+        orig = search.SearchEngine._build_one
+
+        def dying(self, m, build_fn, score_fn=None):
+            if settled["n"] >= 2:
+                raise _Killed()
+            settled["n"] += 1
+            return orig(self, m, build_fn, score_fn)
+
+        monkeypatch.setattr(search.SearchEngine, "_build_one", dying)
+        with pytest.raises(_Killed):
+            aml.train(y="y", training_frame=fr)
+        monkeypatch.setattr(search.SearchEngine, "_build_one", orig)
+        monkeypatch.setattr(D, "process_count", lambda: 2)
+        data = ckpt.load_search_state(str(job.key))
+        assert data is not None
+        done0 = sum(1 for m in data["state"]["members"].values()
+                    if m["status"] == "done")
+        assert done0 == 2
+        assert search.stats()["overlap"] >= 2     # trainings overlapped
+        # the Job object (and the doomed process's models) died with the
+        # coordinator: durable search state is all that survives
+        DKV.remove(str(job.key))
+
+        # the coordinator goes silent past the election grace
+        standby_cloud["h2o3/heartbeat/1"] = json.dumps(
+            {"ts": time.time() - 999, "proc": 1})
+        failure.heartbeat()
+
+        # stand in for the rejoined ex-coordinator's replay duty
+        stop_acks = threading.Event()
+
+        def acker():
+            while not stop_acks.is_set():
+                for k in list(standby_cloud.keys()):
+                    m = re.fullmatch(r"oplog/(\d+)", k)
+                    if not m:
+                        continue
+                    ak = f"oplog/ack/{m.group(1)}/1"
+                    if ak in standby_cloud:
+                        continue
+                    try:
+                        rec = json.loads(standby_cloud[k])
+                    except (ValueError, TypeError):
+                        continue
+                    standby_cloud[ak] = json.dumps(
+                        {"proc": 1, "ts": time.time(),
+                         "op_id": rec.get("op_id"), "inc": 1})
+                time.sleep(0.005)
+
+        ack_thread = threading.Thread(target=acker, daemon=True)
+        ack_thread.start()
+
+        srv_box = {}
+
+        def elect():
+            srv_box["srv"] = api_server.assume_coordination(port=0)
+
+        wd = watchdog.Watchdog(interval=0.05, elect=elect, follow=False)
+        wd.start()
+        try:
+            deadline = time.monotonic() + 15
+            while not D.is_coordinator() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert D.is_coordinator()
+            assert "srv" in srv_box
+            standby_cloud["h2o3/heartbeat/1"] = json.dumps(
+                {"ts": time.time(), "proc": 1, "inc": 1})
+            standby_cloud["oplog/rejoin/1"] = json.dumps(
+                {"proc": 1, "inc": 1, "phase": "caught_up", "seq": 0,
+                 "ts": time.time()})
+            base = f"http://127.0.0.1:{srv_box['srv'].port}"
+            jk = urllib.request.quote(str(job.key), safe="")
+            j = None
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                failure.heartbeat()
+                try:
+                    got = _get(base, f"/3/Jobs/{jk}")["jobs"]
+                except urllib.error.HTTPError:
+                    got = []                      # not recreated yet
+                j = got[0] if got else None
+                if j is not None and j["status"] == "DONE":
+                    break
+                time.sleep(0.05)
+            assert j is not None and j["status"] == "DONE", j
+            assert j["attempt"] == 2              # original + one resume
+            assert j["resumed_from_iteration"] == done0
+            # the finished leaderboard under the ORIGINAL project key
+            automl = _get(base, f"/99/AutoML/{project}")
+            assert len(automl["leaderboard"]["models"]) >= 3
+            st = _get(base, "/3/CloudStatus")
+            assert st["state"] == supervisor.HEALTHY
+            assert st["watchdog"]["searches_resumed"] >= 1
+            assert st["search"]["stats"]["searches_resumed"] >= 1
+            assert st["search"]["stats"]["members_done"] >= 3
+            assert st["search"]["states"] == []   # superseded on finish
+            # overlap + resume counters over the metrics surface
+            with urllib.request.urlopen(base + "/3/Metrics",
+                                        timeout=30) as r:
+                text = r.read().decode()
+            series = {}
+            for ln in text.splitlines():
+                if ln.startswith("h2o3_search_"):
+                    parts = ln.split()
+                    name = parts[0].split("{")[0]
+                    series[name] = max(series.get(name, 0.0),
+                                       float(parts[-1]))
+            assert series.get("h2o3_search_members_overlap", 0) >= 2
+            assert series.get("h2o3_search_resumed_total", 0) >= 1
+        finally:
+            wd.stop()
+            stop_acks.set()
+            ack_thread.join(timeout=5)
+            srv = srv_box.get("srv")
+            if srv is not None:
+                srv.stop()
+            aml2 = DKV.get(project)
+            for m in list(getattr(aml2, "models", [])) + \
+                    list(getattr(aml, "models", [])):
+                DKV.remove(str(m.key))
+            for k in (project, str(job.key), str(fr.key)):
+                DKV.remove(k)
+
+
 # ---------------------------------------------------------------------------
 # chaos soak: sustained injected loss under a streaming op sequence
 # ---------------------------------------------------------------------------
