@@ -360,6 +360,12 @@ def main():
                                ("glm", {"H2O3_BENCH_ONLY": "glm"}),
                                # kill->elect->HEALTHY drill: control-plane
                                # only, so it bypasses the accelerator tunnel
+                               # pinned-budget OOM ladder drill: chunked
+                               # streaming + injected-OOM recovery
+                               # (mem_degrade_recover_secs +
+                               # bigger_than_hbm_ok aux)
+                               ("oom-degrade",
+                                {"H2O3_BENCH_ONLY": "oom-degrade"}),
                                ("recover", {"H2O3_BENCH_ONLY": "recover",
                                             "JAX_PLATFORMS": "cpu"}),
                                # kill-mid-grid -> watchdog search resume ->
@@ -471,6 +477,22 @@ def main():
                               "H2O3_BENCH_ARTIFACT_TRAIN_ROWS": "5000"})
         else:
             _record("cpu-artifact", ok=False, error="skipped: deadline")
+        if remaining() > 160:
+            # memory-safety drill (ISSUE 20): pinned-budget chunk
+            # streaming + injected-OOM ladder recovery — CPU-measurable
+            # on the same 8-virtual-device mesh (mem_degrade_recover_secs
+            # + the bigger_than_hbm_ok bitwise evidence)
+            _stage("cpu-oom-degrade", [py, "-m", "h2o3_tpu.bench"], 150,
+                   env_extra={"PALLAS_AXON_POOL_IPS": "",
+                              "JAX_PLATFORMS": "cpu",
+                              "XLA_FLAGS":
+                              (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_"
+                               "device_count=8"),
+                              "H2O3_BENCH_ONLY": "oom-degrade",
+                              "H2O3_BENCH_OOM_ROWS": "30000"})
+        else:
+            _record("cpu-oom-degrade", ok=False, error="skipped: deadline")
         if remaining() > 90:
             # recovery drill is pure control plane: always measurable
             _stage("recover", [py, "-m", "h2o3_tpu.bench"], 80,

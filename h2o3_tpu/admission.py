@@ -133,6 +133,28 @@ class AdmissionController:
         self.rejected = 0
         self.timed_out = 0
         self.shed_slo = 0            # 429s from the SLO queue-time gate
+        self.shed_mem = 0            # 503s from device memory pressure
+
+    def _shed_if_mem_pressure(self, model_key: str) -> None:
+        """Memory-pressure gate: an exhausted OOM degradation ladder
+        (h2o3_tpu/memory) flags pressure for a cooldown window; a request
+        admitted during it would dispatch straight into the same
+        exhausted device, so it is shed like an SLO breach — 503 +
+        Retry-After sized to the cooldown remainder. Runs BEFORE the
+        admission-disabled early return: pressure shedding guards the
+        device even when inflight gating is off."""
+        from h2o3_tpu.memory import budget as membudget
+
+        if not membudget.pressure_active():
+            return
+        with self._lock:
+            self.rejected += 1
+            self.shed_mem += 1
+        raise AdmissionRejected(
+            f"model {model_key!r}: device memory pressure — the OOM "
+            f"degradation ladder exhausted its retry budget; shedding "
+            f"until resident frames unload", status=503,
+            retry_after_s=membudget.pressure_retry_after_s())
 
     def _gate(self, key: str) -> _ModelGate:
         with self._lock:
@@ -255,6 +277,7 @@ class AdmissionController:
         saturation surfaces as a synchronous 429 + Retry-After instead of
         a failed job with no backoff hint. No slot is reserved — the
         job's own slot() may still queue (or, on a race, shed) later."""
+        self._shed_if_mem_pressure(str(model_key))
         if max_inflight() <= 0 and slo_ms() <= 0:
             return
         g = self._gate(str(model_key))
@@ -265,6 +288,7 @@ class AdmissionController:
 
     @contextmanager
     def slot(self, model_key: str):
+        self._shed_if_mem_pressure(str(model_key))
         if max_inflight() <= 0 and slo_ms() <= 0:
             yield                      # admission disabled: zero overhead
             return
@@ -324,6 +348,7 @@ class AdmissionController:
             out = {"admitted": self.admitted, "queued": self.queued,
                    "rejected": self.rejected, "timed_out": self.timed_out,
                    "shed_slo": self.shed_slo,
+                   "shed_mem": self.shed_mem,
                    "max_inflight": max_inflight(),
                    "slo_ms": slo_ms(),
                    "slo_max_inflight": slo_max_inflight(),
@@ -361,6 +386,7 @@ class AdmissionController:
         with self._lock:
             self.admitted = self.queued = self.rejected = self.timed_out = 0
             self.shed_slo = 0
+            self.shed_mem = 0
             self._gates = {k: g for k, g in self._gates.items()
                            if g.inflight or g.queue}
 

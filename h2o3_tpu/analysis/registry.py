@@ -81,6 +81,16 @@ KNOB_HELPERS = frozenset({
     # — H2O_TPU_SEARCH_MEMBER_DEADLINE_S is deterministically 0 when
     # oplog is active (per-process deadline kills would desynchronize the
     # mirrored member walks)
+    # HBM budget planner knobs (ISSUE 20): read mirrored inside fused
+    # dispatch; the ops contract pins the env uniform, and the window
+    # plan is a pure function of (env, rows, estimates) so every process
+    # streams the same windows — and a chunked window computes bitwise
+    # the same rows as a full dispatch by the row-local contract
+    "h2o3_tpu.memory.budget.budget_mb",       # H2O_TPU_MEM_BUDGET_MB
+    "h2o3_tpu.memory.budget.headroom",        # H2O_TPU_MEM_HEADROOM
+    "h2o3_tpu.memory.budget.pressure_cooldown_s",
+    # — H2O_TPU_MEM_PRESSURE_COOLDOWN_S gates host-side admission
+    # shedding only; it never shapes a device program
 })
 
 # audited divergent-looking call sites that are mirrored-safe; reason is

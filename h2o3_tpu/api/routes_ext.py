@@ -841,7 +841,13 @@ def h_runtime(ctx: Ctx):
     slowest-N compiled programs with signature hash, device kind and HBM
     estimate. Every process contributes its KV-published runtime
     snapshot (same throttle as the /3/Metrics publish). The response
-    carries ``X-H2O3-Trace-Id`` like every traced route."""
+    carries ``X-H2O3-Trace-Id`` like every traced route.
+
+    The ``memory`` block is this process's HBM budget planner state
+    (ISSUE 20): budget/free/live bytes, evicted-column count, per-family
+    bytes-per-row estimates, streaming/ladder counters and the pressure
+    flag admission sheds on."""
+    from h2o3_tpu.memory import budget as membudget
     from h2o3_tpu.obs import compiles, phases
 
     try:
@@ -862,6 +868,7 @@ def h_runtime(ctx: Ctx):
             "wedged_phase": phases.wedged_phase(),
             "compile_families": families,
             "slowest_compiles": slowest,
+            "memory": membudget.snapshot(),
             "processes": [{"proc": s.get("proc"), "ts": s.get("ts"),
                            "phase_report": s.get("phase_report"),
                            "rows_recorded":

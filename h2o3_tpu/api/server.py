@@ -39,6 +39,7 @@ import numpy as np
 
 from h2o3_tpu import admission
 from h2o3_tpu.admission import AdmissionRejected
+from h2o3_tpu.memory import MemoryPressureError
 from h2o3_tpu.api import schemas as S
 from h2o3_tpu.obs import metrics as obs_metrics
 from h2o3_tpu.obs import tracing
@@ -2022,6 +2023,15 @@ class _Handler(BaseHTTPRequestHandler):
             # serving-tier overload: refuse fast with the standard backoff
             # hint instead of letting the request pile onto a saturated
             # model (429 queue overflow / 503 queued-request timeout)
+            status = e.status
+            return self._reply_error(
+                str(e), e.status,
+                headers={"Retry-After":
+                         str(int(math.ceil(e.retry_after_s)))})
+        except MemoryPressureError as e:
+            # exhausted OOM degradation ladder: the typed pressure error
+            # carries its own cooldown-derived backoff hint — a clean 503
+            # + Retry-After, never a raw RESOURCE_EXHAUSTED 500
             status = e.status
             return self._reply_error(
                 str(e), e.status,

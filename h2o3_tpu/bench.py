@@ -896,6 +896,100 @@ def run_pipeline(train_rows: int = 20_000, n_rows: int = 200_000,
     return fused_rps, "pipeline_rows_per_sec"
 
 
+def run_oom_degrade(train_rows: int = 20_000, score_rows: int = 60_000):
+    """Memory-safety metric (ISSUE 20): wall seconds for a scoring pass
+    that hits device OOM (injected ``mem.exhausted``, twice) and
+    completes through the degradation ladder — sweep, halve, bounded
+    backoff — instead of failing. The ``bigger_than_hbm_ok`` aux line is
+    the bigger-than-budget acceptance check: with
+    ``H2O_TPU_MEM_BUDGET_MB`` pinned far below the frame's working set,
+    train input binning and scoring stream row-chunk windows and the
+    predictions must match the unbudgeted single-dispatch run bitwise."""
+    import os
+
+    import h2o3_tpu
+    from h2o3_tpu import scoring
+    from h2o3_tpu.core import failure
+    from h2o3_tpu.core.frame import Column, Frame
+    from h2o3_tpu.memory import budget, stream
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    h2o3_tpu.init()
+    rng = np.random.default_rng(11)
+
+    def make(n, with_y):
+        fr = Frame()
+        logit = np.zeros(n)
+        for i in range(6):
+            x = rng.standard_normal(n)
+            if i == 0:
+                x[rng.integers(0, n, n // 50)] = np.nan   # real NA traffic
+            logit += np.nan_to_num(x) * ((-1) ** i) * 0.5
+            fr.add(f"n{i}", Column.from_numpy(x))
+        if with_y:
+            yy = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)),
+                          "Y", "N")
+            fr.add("y", Column.from_numpy(yy, ctype="enum"))
+        return fr
+
+    model = GBM(ntrees=5, max_depth=4, seed=7).train(
+        y="y", training_frame=make(train_rows, True))
+    sess = scoring.session_for(model)
+    score_fr = make(score_rows, False)
+
+    def preds(fr):
+        out = sess.predict(fr)
+        return [np.asarray(out.col(i).data)[:fr.nrows]
+                for i in range(len(out.names))]
+
+    baseline = preds(score_fr)            # unbudgeted single dispatch
+
+    saved = os.environ.get("H2O_TPU_MEM_BUDGET_MB")
+    os.environ["H2O_TPU_MEM_BUDGET_MB"] = \
+        os.environ.get("H2O3_BENCH_MEM_BUDGET_MB", "2")
+    try:
+        stream.reset_counters()
+        chunked = preds(score_fr)
+        sc = stream.counters()
+        bitwise = all(np.array_equal(a, b, equal_nan=True)
+                      for a, b in zip(baseline, chunked))
+        ok = int(bitwise and sc["chunked_runs"] > 0
+                 and sc["windows"] > 1)
+        print(f"H2O3_BENCH bigger_than_hbm_ok {ok}", flush=True)
+        print(f"H2O3_BENCH mem_windows {sc['windows']}", flush=True)
+        if not bitwise:
+            raise RuntimeError(
+                "memory-safety regression: chunk-streamed predictions "
+                "diverged from the single-dispatch baseline")
+        # the ladder: two injected OOMs inside the stream driver — the
+        # bounded retry budget (3 attempts) absorbs both and the pass
+        # completes; the primary metric is how long recovery costs
+        stream.reset_counters()
+        t0 = time.perf_counter()
+        with failure.inject("mem.exhausted", times=2):
+            recovered = preds(score_fr)
+        dt = time.perf_counter() - t0
+        sc = stream.counters()
+        if not all(np.array_equal(a, b, equal_nan=True)
+                   for a, b in zip(baseline, recovered)):
+            raise RuntimeError(
+                "memory-safety regression: ladder-recovered predictions "
+                "diverged from the baseline")
+        if sc["ladder_recoveries"] < 1:
+            raise RuntimeError(
+                "memory-safety regression: injected OOM never walked "
+                "the degradation ladder")
+        print(f"H2O3_BENCH mem_ladder_halvings {sc['ladder_halvings']}",
+              flush=True)
+    finally:
+        if saved is None:
+            os.environ.pop("H2O_TPU_MEM_BUDGET_MB", None)
+        else:
+            os.environ["H2O_TPU_MEM_BUDGET_MB"] = saved
+        budget.reset_pressure()
+    return dt, "mem_degrade_recover_secs"
+
+
 if __name__ == "__main__":
     # subprocess entry for the watchdog in the repo-root bench.py; each
     # secondary metric runs as its OWN watchdog stage (H2O3_BENCH_ONLY=…)
@@ -942,6 +1036,9 @@ if __name__ == "__main__":
     elif mode == "parse":
         value, metric = run_parse(
             n_rows=int(os.environ.get("H2O3_BENCH_PARSE_ROWS", 400_000)))
+    elif mode == "oom-degrade":
+        value, metric = run_oom_degrade(
+            score_rows=int(os.environ.get("H2O3_BENCH_OOM_ROWS", 60_000)))
     elif mode == "pallas":
         # Pallas-vs-XLA on silicon: same flagship config, Pallas histogram
         # path forced on (smaller tree count to fit the stage budget)

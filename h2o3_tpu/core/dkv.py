@@ -137,7 +137,6 @@ class _DKV:
         import json as _json
 
         from h2o3_tpu.parallel import distributed as D
-        from h2o3_tpu.parallel import retry
 
         raw = D.kv_get(self._BLOB_PREFIX + str(key), timeout_ms)
         if raw is None:
@@ -152,13 +151,16 @@ class _DKV:
                 except (ValueError, TypeError):
                     replicated = False
             if replicated:
-                import time as _time
+                # shared bounded retry budget + spill-retries counter with
+                # the persist spill reloads (memory/stream.py): every read
+                # standing between a dispatch and its data degrades
+                # loudly, not behind its own bespoke loop
+                from h2o3_tpu.memory import stream as _mstream
 
-                for delay in retry.backoff_delays():
-                    _time.sleep(delay)
-                    raw = D.kv_get(self._BLOB_PREFIX + str(key), timeout_ms)
-                    if raw is not None:
-                        break
+                raw = _mstream.bounded_remote_read(
+                    lambda: D.kv_get(self._BLOB_PREFIX + str(key),
+                                     timeout_ms),
+                    what=f"DKV blob {key!r}")
         if raw is None:
             return None
         import base64

@@ -184,6 +184,41 @@ def _pack_binned_fn(padded: int, dtypes: tuple, nbins: tuple, is_cat: tuple,
     return jax.jit(pack, out_shardings=NamedSharding(mesh, P(ROW_AXIS, None)))
 
 
+@functools.lru_cache(maxsize=64)
+def _pack_binned_window_fn(win: int, padded: int, dtypes: tuple,
+                           nbins: tuple, is_cat: tuple, out_dtype: str,
+                           mesh):
+    """(pos, edges, *cols) -> (win, F) bin matrix for rows
+    [pos, pos+win) — the chunk-streamed twin of _pack_binned_fn for
+    frames whose full (padded, F) bin matrix exceeds the memory
+    planner's budget. Same bin math on pad→dynamic-sliced column
+    windows (identical values per covered row → bitwise-identical bins);
+    the overrun lanes of a tail window are trimmed by the caller. Full
+    columns stay in place as args — only the temporaries and the output
+    shrink to the window, which is where the working set lives."""
+    import jax
+    import jax.numpy as jnp
+
+    dt = getattr(jnp, out_dtype)
+
+    def pack(pos, edges, *cols):
+        parts = []
+        for i, c in enumerate(cols):
+            x = jax.lax.dynamic_slice_in_dim(jnp.pad(c, (0, win)), pos, win)
+            na_bin = int(nbins[i]) - 1
+            if is_cat[i]:
+                codes = x.astype(jnp.int32)
+                b = jnp.where((codes < 0) | (codes >= na_bin), na_bin, codes)
+            else:
+                b = jnp.searchsorted(edges[i], x,
+                                     side="left").astype(jnp.int32)
+                b = jnp.where(jnp.isnan(x), na_bin, b)
+            parts.append(b.astype(dt))
+        return jnp.stack(parts, axis=-1)
+
+    return jax.jit(pack)
+
+
 # packer executables, AOT-compiled through the compile ledger (family
 # "pack") so the data plane's compiles land on /3/Runtime like every
 # other program. Keyed by geometry + the concrete input shardings: a
@@ -198,7 +233,7 @@ _EXE_MISS = object()
 
 
 def _packer_exe(key: tuple, jfn, call_args, program: str,
-                family: str = "pack"):
+                family: str = "pack", rows: int = 0):
     """Ledger-recorded AOT executable for one packer geometry (or None
     when AOT lowering/compilation itself fails on this layout/backend —
     cached so the failure is paid once and callers permanently use the
@@ -222,6 +257,10 @@ def _packer_exe(key: tuple, jfn, call_args, program: str,
 
             exe = compiles.compile_jit(family, jfn, call_args,
                                        signature=key, program=program)
+            if rows > 0:
+                from h2o3_tpu.memory import budget as membudget
+
+                membudget.note_compiled(family, int(rows), exe)
         except Exception:   # noqa: BLE001 — AOT unavailable for this
             exe = None      # layout: the jit twin still dispatches
         if len(_EXE_CACHE) >= _EXE_CAP:
@@ -324,7 +363,7 @@ class ShardedFrame:
         exe = _packer_exe(
             ("features", int(bucket), self.padded_rows, dtypes,
              self._cl.mesh, _sharding_key(self._datas)),
-            fn, args, program="pack_features")
+            fn, args, program="pack_features", rows=int(bucket))
         # host-side dispatch wall time only — the packed matrix stays
         # device-resident and no sync is added (span is inert without an
         # active trace)
@@ -358,16 +397,41 @@ class ShardedFrame:
         exe = _packer_exe(
             ("binned", self.padded_rows, dtypes, nbins, is_cat, out_dtype,
              self._cl.mesh, _sharding_key(self._datas)),
-            fn, args, program="pack_binned", family="binning")
+            fn, args, program="pack_binned", family="binning",
+            rows=self.padded_rows)
         note_packed(int(self.frame.nrows))
+
+        from h2o3_tpu.memory import stream as mstream
+
+        n_pad = self.padded_rows
+        item = int(np.dtype(out_dtype).itemsize)
+        # per window row: F float32 column lanes in flight + F output lanes
+        row_bytes = float(len(self._datas)) * (4.0 + item)
+
+        def window(pos, m):
+            if pos == 0 and m == n_pad:
+                # planned-full: the exact single-dispatch program
+                if exe is None:
+                    return fn(*args)
+                try:
+                    return exe(*args)
+                except Exception as e:   # noqa: BLE001
+                    if mstream.is_oom(e):
+                        raise           # the ladder owns exhaustion
+                    return fn(*args)    # AOT layout mismatch: jit twin
+            w = 1 << max(int(m) - 1, 0).bit_length()
+            wfn = _pack_binned_window_fn(w, n_pad, dtypes, nbins, is_cat,
+                                         out_dtype, self._cl.mesh)
+            out = wfn(jnp.int32(pos), *args)
+            return out[:m] if m != w else out
+
         with tracing.span("pack", rows=int(self.frame.nrows),
                           path="binned"):
-            if exe is None:
-                return fn(*args)
-            try:
-                return exe(*args)
-            except Exception:   # noqa: BLE001 — AOT layout/placement
-                return fn(*args)   # mismatch: the jit twin still fits
+            pieces = mstream.run_windows("binning", n_pad, window,
+                                         max_window=n_pad,
+                                         row_bytes=row_bytes)
+        return (pieces[0] if len(pieces) == 1
+                else jnp.concatenate(pieces, axis=0))
 
     def __repr__(self) -> str:
         return (f"<ShardedFrame {getattr(self.frame, 'key', '?')} "

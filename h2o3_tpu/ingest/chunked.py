@@ -200,109 +200,117 @@ class ByteChunk:
     nrows: int          # non-blank data records inside (start, end]
 
 
-# splitter scan window: byte-mask temporaries stay O(window), not O(file)
-# — the memory-safe design the chunked pipeline exists for must hold in
-# the splitter too (a 20 GB file must not allocate 20 GB of byte masks).
-# Known bound: the record-POSITION index is still O(records × 8B) (~1% of
-# file size at 100-byte records); emitting chunk boundaries incrementally
-# per window would flatten that too — recorded as the ROADMAP item-4
-# remainder for ~1B-record single files.
+# splitter scan window: EVERYTHING the splitter holds stays O(window),
+# not O(file) — the memory-safe design the chunked pipeline exists for
+# must hold in the splitter too (a 20 GB file must not allocate 20 GB of
+# byte masks). Chunk boundaries are emitted incrementally per window
+# (ISSUE 20), so the old O(records × 8B) record-position index (~1% of
+# file size at 100-byte records — real memory at ~1B-record files, the
+# recorded ROADMAP item-4 remainder) is gone: the resident state between
+# windows is a handful of scalars plus the emitted chunk list itself.
 _SCAN_WINDOW = 64 << 20
-
-
-def _scan_valid_newlines(mm, size: int, q: int) -> np.ndarray:
-    """Positions of record-end newlines: quote-parity-even, scanned in
-    fixed windows with a running quote-count carry."""
-    out = []
-    carry = 0
-    for base in range(0, size, _SCAN_WINDOW):
-        win = np.asarray(mm[base:base + _SCAN_WINDOW])
-        nl = np.flatnonzero(win == 0x0A).astype(np.int64)
-        if q:
-            qloc = np.flatnonzero(win == q).astype(np.int64)
-            before = carry + np.searchsorted(qloc, nl)
-            nl = nl[(before & 1) == 0]
-            carry += len(qloc)
-        if len(nl):
-            out.append(nl + base)
-    return np.concatenate(out) if out else np.zeros(0, np.int64)
-
-
-def _record_layout(path: str, quote_char: str):
-    """Windowed byte scan -> (ends, blank): ``ends[i]`` is one past record
-    i's terminating newline (or EOF for an unterminated tail record);
-    ``blank[i]`` marks records pandas' skip_blank_lines drops (empty, or a
-    lone ``\\r``). Newlines preceded by an ODD number of quote bytes are
-    inside a quoted field and are not record ends."""
-    size = os.path.getsize(path)
-    if size == 0:
-        return np.zeros(0, np.int64), np.zeros(0, bool)
-    mm = np.memmap(path, dtype=np.uint8, mode="r")
-    try:
-        q = ord(quote_char) if quote_char else 0
-        nl = _scan_valid_newlines(mm, size, q)
-        ends = nl + 1
-        n_nl = len(ends)
-        if n_nl == 0 or int(ends[-1]) != size:
-            ends = np.append(ends, np.int64(size))
-        starts = np.empty(len(ends), np.int64)
-        starts[0] = 0
-        starts[1:] = ends[:-1]
-        has_nl = np.zeros(len(ends), bool)
-        has_nl[:n_nl] = True
-        content = ends - starts - has_nl
-        first_byte = np.asarray(mm[np.minimum(starts, size - 1)])
-        blank = (content == 0) | ((content == 1) & (first_byte == 0x0D))
-        return ends, blank
-    finally:
-        del mm
 
 
 def split_file(path: str, setup, cbytes: int
                ) -> Tuple[List[Tuple[int, int, int]], int]:
     """-> ([(start, end, nrows)...], total_data_rows) for one CSV file.
-    Chunk edges land ONLY on record ends (see _record_layout), so no
-    quoted newline, CRLF pair or multi-byte UTF-8 sequence ever splits.
-    Zero-row spans (runs of blank lines) merge into their neighbor."""
-    ends, blank = _record_layout(path, getattr(setup, "quote_char", '"'))
-    if len(ends) == 0:
+
+    One streaming pass in fixed byte windows. Per window: find the
+    quote-parity-even newlines (a record-end newline is preceded by an
+    EVEN number of quote bytes — a running carry tracks parity across
+    windows), classify blank records (empty, or a lone ``\\r`` — what
+    pandas' skip_blank_lines drops), then close every chunk whose byte
+    target lands inside the window. Chunk edges land ONLY on record
+    ends, so no quoted newline, CRLF pair or multi-byte UTF-8 sequence
+    ever splits; zero-row spans (runs of blank lines) merge into their
+    neighbor. Carried state between windows: the quote-parity carry, the
+    open chunk's start byte and pending row count — never a per-record
+    array."""
+    size = os.path.getsize(path)
+    if size == 0:
         return [], 0
-    if setup.check_header == 1:
-        nonblank = np.flatnonzero(~blank)
-        if len(nonblank) == 0:
+    quote_char = getattr(setup, "quote_char", '"')
+    q = ord(quote_char) if quote_char else 0
+    mm = np.memmap(path, dtype=np.uint8, mode="r")
+    try:
+        header_done = setup.check_header != 1
+        chunks: List[Tuple[int, int, int]] = []
+        pos: Optional[int] = 0 if header_done else None  # open chunk start
+        pending = 0        # data rows in the open chunk from prior windows
+        total = 0
+        prev_end = 0       # start byte of the next record
+        carry = 0          # quote bytes seen before this window
+        for base in range(0, size, _SCAN_WINDOW):
+            win = np.asarray(mm[base:base + _SCAN_WINDOW])
+            nl = np.flatnonzero(win == 0x0A).astype(np.int64)
+            if q:
+                qloc = np.flatnonzero(win == q).astype(np.int64)
+                before = carry + np.searchsorted(qloc, nl)
+                nl = nl[(before & 1) == 0]
+                carry += len(qloc)
+            ends_w = nl + base + 1
+            has_nl = np.ones(len(ends_w), bool)
+            if base + len(win) >= size and \
+                    (len(ends_w) == 0 or int(ends_w[-1]) != size):
+                # unterminated tail record ends at EOF
+                ends_w = np.append(ends_w, np.int64(size))
+                has_nl = np.append(has_nl, False)
+            if len(ends_w) == 0:
+                continue
+            starts_w = np.empty(len(ends_w), np.int64)
+            starts_w[0] = prev_end
+            starts_w[1:] = ends_w[:-1]
+            prev_end = int(ends_w[-1])
+            content = ends_w - starts_w - has_nl
+            first_byte = np.asarray(mm[np.minimum(starts_w, size - 1)])
+            blank_w = (content == 0) | ((content == 1)
+                                        & (first_byte == 0x0D))
+            if not header_done:
+                nb = np.flatnonzero(~blank_w)
+                if len(nb) == 0:
+                    continue           # header record not in this window
+                h = int(nb[0])
+                header_done = True
+                pos = int(ends_w[h])   # data starts after the header
+                ends_w = ends_w[h + 1:]
+                blank_w = blank_w[h + 1:]
+                if len(ends_w) == 0:
+                    continue
+            data_ends_w = ends_w[~blank_w]
+            total += int(len(data_ends_w))
+            # close every chunk whose byte target has a record end here
+            while True:
+                target = pos + cbytes
+                if target > int(ends_w[-1]):
+                    break
+                i = int(np.searchsorted(ends_w, target))
+                end = int(ends_w[i])
+                nr = pending + int(
+                    np.searchsorted(data_ends_w, end, side="right")
+                    - np.searchsorted(data_ends_w, pos, side="right"))
+                pending = 0
+                if nr > 0:
+                    chunks.append((pos, end, nr))
+                elif chunks:
+                    # blank-only span: fold into the previous chunk
+                    s0, _e0, n0 = chunks[-1]
+                    chunks[-1] = (s0, end, n0)
+                pos = end
+            pending += int(len(data_ends_w)
+                           - np.searchsorted(data_ends_w, pos,
+                                             side="right"))
+        if total == 0:
             return [], 0
-        h = int(nonblank[0])
-        data_start = int(ends[h])
-        rec_ends = ends[h + 1:]
-        rec_blank = blank[h + 1:]
-    else:
-        data_start = 0
-        rec_ends = ends
-        rec_blank = blank
-    data_ends = rec_ends[~rec_blank]
-    total = int(len(data_ends))
-    if total == 0:
-        return [], 0
-    size = int(rec_ends[-1]) if len(rec_ends) else data_start
-    chunks: List[Tuple[int, int, int]] = []
-    pos = data_start
-    while pos < size:
-        target = pos + cbytes
-        if target >= size:
-            end = size
-        else:
-            i = int(np.searchsorted(rec_ends, target))
-            end = int(rec_ends[min(i, len(rec_ends) - 1)])
-        nr = int(np.searchsorted(data_ends, end, side="right")
-                 - np.searchsorted(data_ends, pos, side="right"))
-        if nr > 0:
-            chunks.append((pos, end, nr))
-        elif chunks:
-            # blank-only span: fold into the previous chunk's byte range
-            s0, _e0, n0 = chunks[-1]
-            chunks[-1] = (s0, end, n0)
-        pos = end
-    return chunks, total
+        if pos is not None and pos < prev_end:
+            # final partial chunk up to the last record end (== EOF)
+            if pending > 0:
+                chunks.append((pos, prev_end, pending))
+            elif chunks:
+                s0, _e0, n0 = chunks[-1]
+                chunks[-1] = (s0, prev_end, n0)
+        return chunks, total
+    finally:
+        del mm
 
 
 # ---------------------------------------------------------------------------

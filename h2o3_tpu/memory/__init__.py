@@ -1,0 +1,49 @@
+"""Memory-safe execution: HBM budget planning + chunk-streamed dispatch.
+
+"Memory Safe Computations with XLA Compiler" (PAPERS.md) argues the
+working set of a device program should be BUDGETED before dispatch and
+the program rewritten to a chunked schedule when it doesn't fit —
+out-of-memory becomes a planned, recoverable condition instead of a
+process-killing XLA RESOURCE_EXHAUSTED. This package is that discipline
+for the fused data plane (ROADMAP open item 3):
+
+- :mod:`h2o3_tpu.memory.budget` — the per-device HBM ledger: a byte
+  budget (``H2O_TPU_MEM_BUDGET_MB``, auto from the backend when unset)
+  minus a headroom reserve (``H2O_TPU_MEM_HEADROOM``) minus live frame
+  residency, with per-program-family bytes-per-row estimates seeded from
+  the compile ledger's ``compat.memory_analysis`` field.
+  ``plan(family, rows) -> full | chunked(C) | refuse``.
+- :mod:`h2o3_tpu.memory.stream` — the ONE dispatch chokepoint that runs
+  an existing fused program over row-chunk windows (double-buffered:
+  window i+1 ships while window i's output is fetched) and owns the
+  degradation ladder: a dispatch that still hits RESOURCE_EXHAUSTED (or
+  the ``mem.exhausted`` faultpoint) halves the window and retries under
+  the bounded PR-3 backoff budget; only an exhausted ladder surfaces
+  :class:`MemoryPressureError` (HTTP 503 + Retry-After at the REST
+  layer) after dropping a flight record naming the program family and
+  the attempted chunk sizes.
+
+Import cost: stdlib only — jax loads lazily inside calls, like the rest
+of the observability plane.
+"""
+
+from __future__ import annotations
+
+
+class MemoryPressureError(Exception):
+    """The degradation ladder ran out of budget: every retry at every
+    chunk size still exhausted device memory. Carries the HTTP status
+    (always 503 — the condition is transient by construction: residency
+    shrinks as frames unload) and a Retry-After hint, like
+    ``admission.AdmissionRejected``."""
+
+    def __init__(self, msg: str, retry_after_s: float = 5.0,
+                 family: str = "", attempts=()):
+        super().__init__(msg)
+        self.status = 503
+        self.retry_after_s = max(float(retry_after_s), 0.1)
+        self.family = family
+        self.attempts = tuple(attempts)
+
+
+from h2o3_tpu.memory import budget, stream  # noqa: E402,F401

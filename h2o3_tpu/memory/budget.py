@@ -1,0 +1,271 @@
+"""Per-device HBM budget ledger: plan a dispatch BEFORE it OOMs.
+
+The planner answers one question for every fused program family: "does
+this dispatch's working set fit the device memory left right now?" —
+and when it doesn't, how many rows per window DO fit. The inputs:
+
+- **budget** — ``H2O_TPU_MEM_BUDGET_MB`` when set (the operator's word,
+  also how tests pin a tiny budget on the CPU mesh to force the chunked
+  paths); otherwise the backend's own ``memory_stats()['bytes_limit']``
+  (TPU/GPU report it; CPU reports nothing → unbudgeted, every plan is
+  ``full`` and the data plane is byte-for-byte the pre-planner engine).
+- **headroom** — ``H2O_TPU_MEM_HEADROOM`` (default 0.15): the fraction
+  of the budget reserved for XLA scratch, collectives and the allocator's
+  fragmentation slop; the planner never hands it out.
+- **residency** — live device bytes already committed to frame columns
+  (``core/cleaner.device_bytes_in_use``): a plan is made against what is
+  actually FREE, not the raw budget.
+- **bytes/row** — per program family, the max of the caller's static
+  hint and the compile-ledger-seeded estimate: every AOT compile already
+  records ``compat.memory_analysis`` totals (PR 12), and the families
+  integrated with the planner feed ``note_compiled(family, rows, exe)``
+  so the estimate tracks real lowered programs, not guesses.
+
+Pressure state: an exhausted degradation ladder (``stream.run_windows``)
+calls :func:`note_pressure`; admission treats the condition like an SLO
+breach for ``H2O_TPU_MEM_PRESSURE_COOLDOWN_S`` seconds and sheds with
+503 + Retry-After instead of queueing requests into a known-OOM
+dispatch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from h2o3_tpu.parallel import retry
+
+# program families the planner budgets; each integrated call site passes
+# one of these. A strict subset of obs/compiles FAMILIES — the
+# consistency suite asserts every member records non-null HBM estimates
+# through the ledger chokepoint.
+BUDGETED_FAMILIES = ("scoring", "explain", "binning", "rapids", "pipeline")
+
+# never plan below this many free bytes — a degenerate budget (residency
+# accounting racing a release) must not refuse 1-row windows forever
+_MIN_PLAN_BYTES = 64 * 1024
+
+_LOCK = threading.Lock()
+# family -> max observed bytes/row, seeded from compile-ledger programs
+_ROW_BYTES: Dict[str, float] = {}
+_PRESSURE_TS = 0.0          # monotonic ts of the last exhausted ladder
+_PRESSURE_COUNT = 0
+
+
+def budget_mb() -> float:
+    """Operator budget override in MB (``H2O_TPU_MEM_BUDGET_MB``; 0 /
+    unset = auto from the backend)."""
+    return max(retry.env_float("H2O_TPU_MEM_BUDGET_MB", 0.0), 0.0)
+
+
+def headroom() -> float:
+    """Reserved fraction of the budget (``H2O_TPU_MEM_HEADROOM``,
+    default 0.15, clamped to [0, 0.9])."""
+    h = retry.env_float("H2O_TPU_MEM_HEADROOM", 0.15)
+    return min(max(h, 0.0), 0.9)
+
+
+def pressure_cooldown_s() -> float:
+    """Seconds after an exhausted ladder during which admission sheds
+    (``H2O_TPU_MEM_PRESSURE_COOLDOWN_S``, default 10)."""
+    return max(retry.env_float("H2O_TPU_MEM_PRESSURE_COOLDOWN_S", 10.0),
+               0.0)
+
+
+def _backend_budget_bytes() -> Optional[int]:
+    """The device's own memory limit, when the backend reports one (TPU
+    and GPU allocators do; CPU returns None). Never triggers backend
+    init — planning may run before any dispatch."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        d = jax.devices()[0]
+        stats = d.memory_stats() if hasattr(d, "memory_stats") else None
+    except Exception:   # noqa: BLE001 — no backend, no budget
+        return None
+    if not stats:
+        return None
+    limit = stats.get("bytes_limit")
+    return int(limit) if limit else None
+
+
+def budget_bytes() -> Optional[int]:
+    """Effective per-device budget in bytes; None = unbudgeted (no
+    operator knob, backend reports no limit) — every plan is ``full``."""
+    mb = budget_mb()
+    if mb > 0:
+        return int(mb * (1 << 20))
+    return _backend_budget_bytes()
+
+
+def live_bytes() -> int:
+    """Device bytes currently committed to frame columns (the cleaner's
+    residency scan)."""
+    try:
+        from h2o3_tpu.core import cleaner
+
+        return int(cleaner.device_bytes_in_use())
+    except Exception:   # noqa: BLE001 — an empty DKV scans to 0
+        return 0
+
+
+# -- bytes-per-row estimates -------------------------------------------------
+
+def note_compiled(family: str, rows: int, compiled) -> None:
+    """Seed the family's bytes/row estimate from a freshly compiled
+    program's ``memory_analysis`` totals (argument + output + temp +
+    code). Called by the integrated program caches next to their ledger
+    row; best-effort — an estimate-less backend just keeps the static
+    hints."""
+    if rows <= 0 or compiled is None:
+        return
+    try:
+        from h2o3_tpu import compat
+
+        ma = compat.memory_analysis(compiled)
+    except Exception:   # noqa: BLE001
+        return
+    if not ma:
+        return
+    total = sum(int(v) for v in (ma.get("argument_bytes"),
+                                 ma.get("output_bytes"),
+                                 ma.get("temp_bytes"),
+                                 ma.get("generated_code_bytes")) if v)
+    if total <= 0:
+        return
+    per_row = total / float(rows)
+    with _LOCK:
+        prev = _ROW_BYTES.get(family, 0.0)
+        if per_row > prev:
+            _ROW_BYTES[family] = per_row
+
+
+def row_bytes_estimate(family: str,
+                       hint: Optional[float] = None) -> float:
+    """Bytes of device working set per row for `family`: the max of the
+    ledger-seeded observation and the caller's static hint, floored at
+    one float32 lane so a plan can never divide by zero."""
+    with _LOCK:
+        seen = _ROW_BYTES.get(family, 0.0)
+    return max(seen, float(hint or 0.0), 4.0)
+
+
+# -- the plan ----------------------------------------------------------------
+
+class Plan:
+    """One dispatch decision: ``mode`` is ``full`` (single dispatch fits),
+    ``chunked`` (stream ``chunk_rows``-row windows) or ``refuse`` (not
+    even one row fits the free budget — surface MemoryPressureError
+    without burning a doomed dispatch)."""
+
+    __slots__ = ("mode", "chunk_rows", "rows", "row_bytes", "free_bytes")
+
+    def __init__(self, mode: str, chunk_rows: int, rows: int,
+                 row_bytes: float, free_bytes: Optional[int]):
+        self.mode = mode
+        self.chunk_rows = int(chunk_rows)
+        self.rows = int(rows)
+        self.row_bytes = float(row_bytes)
+        self.free_bytes = free_bytes
+
+    def __repr__(self) -> str:
+        return (f"<memory.Plan {self.mode} rows={self.rows} "
+                f"chunk={self.chunk_rows} row_bytes={self.row_bytes:.1f}>")
+
+
+def free_bytes() -> Optional[int]:
+    """Budget minus headroom minus live residency; None when unbudgeted."""
+    total = budget_bytes()
+    if total is None:
+        return None
+    usable = int(total * (1.0 - headroom())) - live_bytes()
+    return max(usable, 0)
+
+
+def plan(family: str, rows: int,
+         row_bytes: Optional[float] = None) -> Plan:
+    """Budget `rows` rows of `family`'s fused program against the free
+    device bytes RIGHT NOW."""
+    per_row = row_bytes_estimate(family, row_bytes)
+    free = free_bytes()
+    if free is None or rows <= 0:
+        return Plan("full", max(rows, 0), rows, per_row, free)
+    avail = max(free, _MIN_PLAN_BYTES)
+    fit = int(avail // per_row)
+    if fit >= rows:
+        return Plan("full", rows, rows, per_row, free)
+    if fit < 1:
+        return Plan("refuse", 0, rows, per_row, free)
+    return Plan("chunked", fit, rows, per_row, free)
+
+
+# -- pressure state (admission's shed signal) --------------------------------
+
+def note_pressure() -> None:
+    """Record one exhausted degradation ladder; admission sheds for the
+    cooldown window."""
+    global _PRESSURE_TS, _PRESSURE_COUNT
+    with _LOCK:
+        _PRESSURE_TS = time.monotonic()
+        _PRESSURE_COUNT += 1
+
+
+def pressure_active() -> bool:
+    """True while the last exhausted ladder is younger than the
+    cooldown — the admission gate's cheap probe."""
+    with _LOCK:
+        ts = _PRESSURE_TS
+    return bool(ts) and (time.monotonic() - ts) < pressure_cooldown_s()
+
+
+def pressure_retry_after_s() -> float:
+    """Retry-After hint under pressure: the remainder of the cooldown
+    window, floored at 1 s."""
+    with _LOCK:
+        ts = _PRESSURE_TS
+    if not ts:
+        return 1.0
+    left = pressure_cooldown_s() - (time.monotonic() - ts)
+    return max(left, 1.0)
+
+
+def pressure_count() -> int:
+    with _LOCK:
+        return _PRESSURE_COUNT
+
+
+def reset_pressure() -> None:
+    """Drop pressure state (tests)."""
+    global _PRESSURE_TS, _PRESSURE_COUNT
+    with _LOCK:
+        _PRESSURE_TS = 0.0
+        _PRESSURE_COUNT = 0
+
+
+def snapshot() -> dict:
+    """The /3/Runtime memory block: budget model + live residency +
+    per-family estimates + streaming/ladder counters + pressure state."""
+    from h2o3_tpu.core import cleaner
+    from h2o3_tpu.memory import stream
+
+    with _LOCK:
+        est = dict(_ROW_BYTES)
+    try:
+        evicted = int(cleaner.evicted_count())
+    except Exception:   # noqa: BLE001
+        evicted = 0
+    return {"budget_bytes": budget_bytes(),
+            "headroom": headroom(),
+            "free_bytes": free_bytes(),
+            "live_bytes": live_bytes(),
+            "evicted_columns": evicted,
+            "row_bytes_estimates": {k: round(v, 2)
+                                    for k, v in sorted(est.items())},
+            "pressure_active": pressure_active(),
+            "pressure_count": pressure_count(),
+            "stream": stream.counters()}

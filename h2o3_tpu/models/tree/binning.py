@@ -118,6 +118,14 @@ class BinSpec:
         Frames the view cannot hold (ragged layouts, plane off) keep the
         legacy eager path below.
 
+        Memory safety: the sharded pack consults the HBM budget planner
+        (h2o3_tpu/memory) — a frame whose (N, F) bin matrix working set
+        exceeds the free budget streams through row-chunk windows
+        (bitwise-identical bins, see _pack_binned_window_fn) instead of
+        dispatching one doomed full-size program, and a genuine
+        RESOURCE_EXHAUSTED walks the degradation ladder before anything
+        surfaces to the caller.
+
         Narrowest integer dtype that fits max(nbins): the bin matrix is the
         biggest operand STREAMED from HBM on every histogram pass of every
         level, so uint8 (nbins ≤ 256, the common case — default numeric

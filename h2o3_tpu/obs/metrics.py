@@ -684,6 +684,10 @@ def _install_default_metrics() -> None:
                  "requests shed 429 by the SLO queue-time gate",
                  _adm("shed_slo"))
 
+    r.counter_fn("h2o3_admission_shed_mem_total",
+                 "requests shed 503 under device memory pressure",
+                 _adm("shed_mem"))
+
     def _adm_limits():
         from h2o3_tpu import admission
 
@@ -693,6 +697,60 @@ def _install_default_metrics() -> None:
     r.gauge_fn("h2o3_admission_limit",
                "effective per-model inflight limit (static knob or "
                "SLO-derived)", _adm_limits, agg="max")
+
+    # -- memory planner / OOM degradation ladder (h2o3_tpu/memory) -------
+    def _mem(field):
+        def fn():
+            from h2o3_tpu.memory import stream
+
+            return float(stream.counters()[field])
+        return fn
+
+    r.counter_fn("h2o3_mem_chunked_runs_total",
+                 "fused dispatches the budget planner chunk-streamed",
+                 _mem("chunked_runs"))
+    r.counter_fn("h2o3_mem_windows_total",
+                 "row-chunk windows dispatched by the stream driver",
+                 _mem("windows"))
+    r.counter_fn("h2o3_mem_ladder_halvings_total",
+                 "OOM-triggered window halvings (degradation ladder)",
+                 _mem("ladder_halvings"))
+    r.counter_fn("h2o3_mem_ladder_recoveries_total",
+                 "dispatches that hit device OOM and still completed",
+                 _mem("ladder_recoveries"))
+    r.counter_fn("h2o3_mem_pressure_failures_total",
+                 "exhausted degradation ladders (MemoryPressureError)",
+                 _mem("pressure_failures"))
+    r.counter_fn("h2o3_mem_spill_retries_total",
+                 "bounded remote-read retries (DKV fetches + persist "
+                 "spill reloads)", _mem("spill_retries"))
+
+    def _mem_budget(field):
+        def fn():
+            from h2o3_tpu.memory import budget as membudget
+
+            v = getattr(membudget, field)()
+            return float(v) if v is not None else 0.0
+        return fn
+
+    r.gauge_fn("h2o3_mem_budget_bytes",
+               "effective per-device HBM budget (0 = unbudgeted)",
+               _mem_budget("budget_bytes"), agg="max")
+    r.gauge_fn("h2o3_mem_free_bytes",
+               "budget minus headroom minus live column residency",
+               _mem_budget("free_bytes"), agg="min")
+    r.gauge_fn("h2o3_mem_live_bytes",
+               "device bytes committed to frame columns",
+               _mem_budget("live_bytes"), agg="max")
+
+    def _mem_spilled():
+        from h2o3_tpu.core import cleaner
+
+        return float(cleaner.evicted_count())
+
+    r.gauge_fn("h2o3_mem_spilled_columns",
+               "columns currently evicted device→host/disk", _mem_spilled,
+               agg="max")
 
     def _cc(field):
         def fn():

@@ -1,0 +1,112 @@
+"""Checksum-gated column spill: device → host → the persist cache tier.
+
+The LRU cleaner (core/cleaner.py) swaps cold columns device → host RAM;
+this module is the next rung down for frames several times bigger than
+the HBM budget (h2o3_tpu/memory): a spilled column's host buffer lands
+as an ``.npy`` file in the persist cache directory (remote-backed
+deployments mount that dir on S3/NFS — persist/__init__.py is the
+scheme registry the ingest side already resolves through), and the
+Column reverts to a file-backed loader, freeing host RAM too.
+
+Two disciplines make the round trip safe:
+
+- **sha256 gate** — the digest is taken at spill time over the exact
+  buffer bytes and re-verified at every reload; a torn write, a stale
+  cache object or plain bit rot surfaces as :class:`SpillCorrupt`
+  instead of silently wrong predictions.
+- **bounded reads** — reloads go through
+  ``memory/stream.bounded_remote_read``: the SAME bounded backoff
+  budget (and ``h2o3_mem_spill_retries_total`` counter) as DKV
+  replicated-blob fetches, so a flaky backing store degrades loudly.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+from typing import Optional
+
+import numpy as np
+
+from h2o3_tpu import persist
+
+
+class SpillCorrupt(Exception):
+    """A spilled column failed its checksum gate (or vanished) on
+    reload — the backing store returned different bytes than were
+    written."""
+
+
+def spill_dir() -> str:
+    d = os.path.join(persist.cache_dir(), "spill")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def spill_array(arr: np.ndarray, name: str) -> tuple:
+    """Write one host buffer to the spill tier; returns (path, sha256).
+    Content-addressed by digest, written atomically (tmp + rename), so
+    a crashed spill never leaves a half-file a reload could trust."""
+    buf = np.ascontiguousarray(arr)
+    digest = hashlib.sha256(buf.tobytes()).hexdigest()
+    path = os.path.join(spill_dir(), f"{name}_{digest[:16]}.npy")
+    if not os.path.exists(path):
+        tmp = f"{path}.part.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.save(f, buf, allow_pickle=False)
+        os.replace(tmp, path)
+    return path, digest
+
+
+def loader_for(path: str, digest: str, what: str):
+    """A Column loader (file_backed contract: returns the PADDED host
+    buffer) that reads through the shared bounded retry budget and the
+    checksum gate."""
+    from h2o3_tpu.memory import stream
+
+    def _read() -> Optional[bytes]:
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def load() -> np.ndarray:
+        raw = stream.bounded_remote_read(_read, what=what)
+        if raw is None:
+            raise SpillCorrupt(
+                f"spilled column {what} missing at {path} after the "
+                f"bounded retry budget")
+        buf = np.load(io.BytesIO(raw), allow_pickle=False)
+        got = hashlib.sha256(np.ascontiguousarray(buf).tobytes()).hexdigest()
+        if got != digest:
+            raise SpillCorrupt(
+                f"spilled column {what} failed its checksum gate at "
+                f"{path}: wrote sha256 {digest[:16]}…, read {got[:16]}…")
+        return buf
+
+    return load
+
+
+def spill_column(col, name: Optional[str] = None) -> int:
+    """Evict `col` off the device AND push its host copy down to the
+    spill tier; returns device bytes freed. Columns already file-backed
+    (their eviction reverts to the original source) and non-addressable
+    shardings are left alone."""
+    from h2o3_tpu.core import cleaner
+
+    freed = int(col.evict())
+    src = col._evicted
+    if src is None or callable(src):
+        return freed
+    what = name or f"col{col._token}"
+    path, digest = spill_array(np.asarray(src), what)
+    loader = loader_for(path, digest, what)
+    with cleaner.SWAP_LOCK:
+        # only install the disk loader if the column still holds the
+        # host buffer we spilled — a racing fault-in keeps its device copy
+        if col._data is None and col._evicted is src:
+            col._evicted = loader
+            col._loader = loader
+    return freed

@@ -455,6 +455,9 @@ def _get_program(full_sig: str, bucket: int, make_jfn, make_structs,
         _bump("programs_compiled")
         if ckey is not None:
             compile_cache.store(ckey, exe)
+    from h2o3_tpu.memory import budget as membudget
+
+    membudget.note_compiled("pipeline", bucket, exe)
     prog = fusion._Program(exe, jfn)
     with _PROG_LOCK:
         if len(_PROGRAMS) >= _PROG_CAP:
@@ -531,11 +534,10 @@ def execute_margins(session, cap: Capture):
                   + tuple(session._arrays))
     n = cap.nrows
     maxb = session.buckets[-1]
-    outs: List[Any] = []
     n_disp = 0
-    pos = 0
-    while pos < n:
-        m = min(maxb, n - pos)
+
+    def window(pos: int, m: int):
+        nonlocal n_disp
         bucket = session._bucket_for(m)
         prog = _forest_program(session, cap, bucket)
         args = ((jnp.int32(pos), jnp.int32(n)) + tuple(leaf_args)
@@ -544,15 +546,28 @@ def execute_margins(session, cap: Capture):
                           path="pipeline"):
             try:
                 out = prog.exe(*args)
-            except Exception:   # noqa: BLE001 — AOT placement mismatch
+            except Exception as e:   # noqa: BLE001 — AOT placement
+                from h2o3_tpu.memory import stream as _stream
+
+                if _stream.is_oom(e):
+                    raise
                 out = prog.jfn(*args)
         n_disp += 1
         _bump("fused_dispatches")
         from h2o3_tpu import scoring
 
         scoring.note_dispatch("pipeline")
-        outs.append(out[:m])
-        pos += m
+        return out[:m]
+
+    from h2o3_tpu.memory import stream
+
+    # windows already pay O(bucket) munge work (the leaves window inside
+    # the program) — the planner only caps how many rows ride each one
+    outs: List[Any] = stream.run_windows(
+        "pipeline", n, window, maxb,
+        row_bytes=4.0 * (2 * max(len(plan.leaves), 1)
+                         + len(session.spec.names) + session._out_k()),
+        window_sizer=session._window_snap)
     _bump("fused_rows", n)
     if not outs:
         K = session._out_k()

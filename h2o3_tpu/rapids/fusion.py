@@ -744,6 +744,9 @@ def _program_for(plan: Plan) -> _Program:
         _bump("fused_programs_compiled")
         if ckey is not None:
             compile_cache.store(ckey, exe)
+    from h2o3_tpu.memory import budget as membudget
+
+    membudget.note_compiled("rapids", int(plan.padded or 0), exe)
     prog = _Program(exe, jfn)
     with _PROG_LOCK:
         if len(_PROGRAMS) >= _PROG_CAP:
@@ -795,6 +798,147 @@ def _run_program(plan: Plan):
     return out
 
 
+# ---------------------------------------------------------------------------
+# chunk-streamed execution (memory planner / OOM ladder)
+# ---------------------------------------------------------------------------
+
+def _window_pow2(m: int) -> int:
+    """Windowed programs compile at power-of-two row counts, so a ladder
+    (or a ragged tail) mints at most log2(padded) program shapes."""
+    return 1 << max(int(m) - 1, 0).bit_length()
+
+
+def _emit_windowed(plan: Plan, mesh, win: int):
+    """Wrap the plan's traced body with a runtime row offset: full-length
+    Column leaves are pad→dynamic-sliced to `win` rows at traced `pos`
+    (no gather, no host round-trip); pre-windowed sub-program leaves and
+    scalar leaves pass straight through. Every node in a fused plan is
+    elementwise, so the window computes exactly the rows it covers —
+    concatenated windows are bitwise the single-dispatch output."""
+    inner = _emit(plan, mesh)
+    n_leaf = len(plan.leaves)
+    full_len = [not isinstance(l, Plan) for l in plan.leaves]
+
+    def f(pos, *args):
+        import jax
+        import jax.numpy as jnp
+
+        vals = []
+        for i in range(n_leaf):
+            a = args[i]
+            if full_len[i]:
+                a = jax.lax.dynamic_slice_in_dim(
+                    jnp.pad(a, (0, win)), pos, win)
+            vals.append(a)
+        return inner(*vals, *args[n_leaf:])
+
+    return f
+
+
+def _windowed_program_for(plan: Plan, win: int) -> _Program:
+    """Compile (or fetch) the pos-parameterized `win`-row twin of this
+    plan's program. Shares the signature cache under a ``|w{win}``
+    suffix; goes through the same ledger chokepoint with its own program
+    tag so the compile ledger tells full and windowed dispatch apart."""
+    sig = plan.signature + f"|w{int(win)}"
+    with _PROG_LOCK:
+        prog = _PROGRAMS.get(sig)
+    if prog is not None:
+        _bump("compile_cache_hits")
+        from h2o3_tpu.obs import compiles
+
+        compiles.record_hit("rapids", sig, "memory",
+                            program="rapids_statement_windowed")
+        return prog
+
+    import jax
+
+    from h2o3_tpu.memory import budget as membudget
+    from h2o3_tpu.obs import compiles
+
+    mesh = _mesh()
+    jfn = jax.jit(_emit_windowed(plan, mesh, win))
+    structs = [jax.ShapeDtypeStruct((), np.int32)]      # pos
+    for i, leaf in enumerate(plan.leaves):
+        if isinstance(leaf, Plan):
+            structs.append(jax.ShapeDtypeStruct(
+                () if _plan_is_scalar(leaf) else (win,), np.float32))
+        else:
+            structs.append(jax.ShapeDtypeStruct(
+                (plan.padded,), np.dtype(plan.leaf_dtypes[i])))
+    structs += [jax.ShapeDtypeStruct((), np.float32)] * len(plan.consts)
+    exe = compiles.compile_jit("rapids", jfn, structs, signature=sig,
+                               program="rapids_statement_windowed")
+    _bump("fused_programs_compiled")
+    membudget.note_compiled("rapids", int(win), exe)
+    prog = _Program(exe, jfn)
+    with _PROG_LOCK:
+        if len(_PROGRAMS) >= _PROG_CAP:
+            _PROGRAMS.pop(next(iter(_PROGRAMS)))
+        _PROGRAMS[sig] = prog
+    return prog
+
+
+def _run_windowed(plan: Plan, pos: int, win: int, scalar_cache: Dict):
+    """Dispatch one `win`-row window of the plan at row offset `pos`.
+    Scalar sub-programs are computed once per statement (cached across
+    windows — their value is row-independent); row-shaped sub-programs
+    window recursively at the same offset, so no full-length
+    intermediate is ever materialized on a chunked run."""
+    import jax.numpy as jnp
+
+    prog = _windowed_program_for(plan, win)
+    args = []
+    for leaf in plan.leaves:
+        if isinstance(leaf, Plan):
+            if _plan_is_scalar(leaf):
+                key = id(leaf)
+                if key not in scalar_cache:
+                    scalar_cache[key] = _run_program(leaf)
+                args.append(scalar_cache[key])
+            else:
+                args.append(_run_windowed(leaf, pos, win, scalar_cache))
+        else:
+            args.append(leaf.data)
+    args += [_const_arg(v) for v in plan.consts]
+    call = (jnp.int32(pos), *args)
+    try:
+        out = prog.exe(*call)
+    except Exception as e:   # noqa: BLE001 — AOT layout/placement mismatch
+        from h2o3_tpu.memory import stream as _mstream
+
+        if _mstream.is_oom(e):
+            raise           # the ladder owns memory exhaustion
+        out = prog.jfn(*call)
+    _bump("fused_programs")
+    return out
+
+
+def _run_streamed(plan: Plan):
+    """Route the plan through the memory stream driver. The planned-full
+    case dispatches the EXACT single-dispatch program (one window, same
+    bytes); a budgeted or ladder-halved run streams pow2-sized windowed
+    twins and concatenates on device."""
+    import jax.numpy as jnp
+
+    from h2o3_tpu.memory import stream
+
+    n_pad = int(plan.padded)
+    scalar_cache: Dict[int, Any] = {}
+
+    def window(pos, m):
+        if pos == 0 and m == n_pad:
+            return _run_program(plan)
+        w = _window_pow2(m)
+        out = _run_windowed(plan, pos, w, scalar_cache)
+        return out[:m] if m != w else out
+
+    pieces = stream.run_windows(
+        "rapids", n_pad, window, max_window=n_pad,
+        row_bytes=4.0 * (len(plan.leaves) + 2))
+    return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+
+
 def execute_plan(plan: Plan) -> Column:
     """Run one fused statement plan over its row-sharded leaves; the
     result stays a device column (no host round-trip, rows counted
@@ -806,7 +950,7 @@ def execute_plan(plan: Plan) -> Column:
     # device-resident, so tracing adds no device sync
     with tracing.span("fused_dispatch", ops=plan.n_ops,
                       rows=int(plan.nrows), leaves=len(plan.leaves)):
-        out = _run_program(plan)
+        out = _run_streamed(plan)
     _bump("fused_rows", int(plan.nrows))
     sharded_frame.note_packed(int(plan.nrows))
     return Column.from_device(out, T_NUM, plan.nrows)
@@ -831,7 +975,12 @@ def try_execute(ast, env):
         if plan is None:
             return _MISS
         col = execute_plan(plan)
-    except Exception:   # noqa: BLE001 — never take a statement down for a
+    except Exception as e:   # noqa: BLE001 — never take a statement down
+        from h2o3_tpu.memory import MemoryPressureError
+
+        if isinstance(e, MemoryPressureError):
+            raise           # typed pressure surfaces as 503, not a silent
+                            # eager retry into the same exhausted device
         return _MISS    # fusion bug; the eager path is the contract
     fr = Frame()
     fr.add(plan.out_name, col)

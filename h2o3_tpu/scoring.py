@@ -237,6 +237,23 @@ class ScoringSession:
                 return b
         return self.buckets[-1]
 
+    def _window_snap(self, w: int) -> int:
+        """Snap a planner-chosen window DOWN onto the bucket ladder so
+        chunk streaming reuses the compiled bucket programs (below the
+        smallest bucket the window stays as-is and pads up into it)."""
+        for b in reversed(self.buckets):
+            if b <= w:
+                return b
+        return max(w, 1)
+
+    def _row_bytes_hint(self) -> float:
+        """Static working-set bytes/row for one fused dispatch: packed
+        features in and out of the pack program plus the margin lanes —
+        the planner takes the max of this and the ledger-seeded
+        estimate."""
+        F = max(len(self.spec.names), 1)
+        return 4.0 * (2 * F + self._out_k() + 2)
+
     # -- bucketed dispatch -------------------------------------------------
     def _local_arrays(self):
         """Coordinator-local copies of the device-resident forest arrays
@@ -354,6 +371,11 @@ class ScoringSession:
         else:
             self.cache_hits += 1
             compiles.record_hit(family, sig, "disk", program=progname)
+        # seed the memory planner's bytes/row estimate from the real
+        # lowered program (compat.memory_analysis via the ledger's shim)
+        from h2o3_tpu.memory import budget as membudget
+
+        membudget.note_compiled(family, bucket, exe)
         self._exec[key] = exe
         if kind == "score":
             self._traced.add(bucket)
@@ -373,18 +395,17 @@ class ScoringSession:
         of re-deriving the chunking arithmetic."""
         import jax
 
+        from h2o3_tpu.memory import stream
+
         n = X.shape[0]
         maxb = self.buckets[-1]
-        outs: List[np.ndarray] = []
         sharding = None if local else self._cl.row_sharding()
         arrays = self._local_arrays() if local else self._arrays
-        pos = 0
-        while pos < n:
-            chunk = X[pos: pos + maxb]
-            m = chunk.shape[0]
+
+        def dispatch(pos: int, m: int):
             bucket = self._bucket_for(m)
             buf = np.zeros((bucket, X.shape[1]), np.float32)
-            buf[:m] = chunk
+            buf[:m] = X[pos: pos + m]
             xd = jax.device_put(buf) if local else jax.device_put(buf,
                                                                   sharding)
             call_args = (xd, self._edges, self._is_cat, self._init) + \
@@ -396,10 +417,18 @@ class ScoringSession:
             note_dispatch("local" if local else "host")
             if dispatched is not None:
                 dispatched.append(bucket)
+            return out
+
+        def fetch(out, m: int):
             with tracing.span("fetch", rows=m, path="host"):
-                got = np.asarray(out)[:m]   # the one blocking transfer
-            outs.append(got)
-            pos += m
+                return np.asarray(out)[:m]   # the one blocking transfer
+
+        # chunk-streamed under the memory planner: window i+1 ships while
+        # window i's output transfers; an OOM walks the halving ladder
+        outs: List[np.ndarray] = stream.run_windows(
+            "scoring", n, dispatch, maxb, fetch=fetch,
+            row_bytes=self._row_bytes_hint(),
+            window_sizer=self._window_snap)
         if not outs:
             K = (self.forest.nclasses if (self.forest.nclasses > 2
                                           or self.forest.per_class_trees)
@@ -452,6 +481,8 @@ class ScoringSession:
         off before anything reads them."""
         import jax.numpy as jnp
 
+        from h2o3_tpu.memory import stream
+
         maxb = self.buckets[-1]
         n_disp = 0
 
@@ -474,13 +505,16 @@ class ScoringSession:
         outs: List[Any] = []
         if len(items) == 1:
             sf, n = items[0]
-            pos = 0
-            while pos < n:
-                m = min(maxb, n - pos)
+
+            def window(pos: int, m: int):
                 bucket = self._bucket_for(m)
                 Xd = sf.pack_features(pos, n, bucket)
-                outs.append(dispatch(Xd, bucket, m)[:m])
-                pos += m
+                return dispatch(Xd, bucket, m)[:m]
+
+            outs = stream.run_windows(
+                "scoring", n, window, maxb,
+                row_bytes=self._row_bytes_hint(),
+                window_sizer=self._window_snap)
         else:
             parts: List[Any] = []
             for sf, n in items:
@@ -500,16 +534,19 @@ class ScoringSession:
                     X = parts[0] if len(parts) == 1 else \
                         jnp.concatenate(parts)
                 N = int(X.shape[0])
-                pos = 0
-                while pos < N:
-                    m = min(maxb, N - pos)
+
+                def window(pos: int, m: int):
                     bucket = self._bucket_for(m)
                     chunk = X[pos: pos + m]
                     if m < bucket:
                         chunk = jnp.pad(chunk, ((0, bucket - m), (0, 0)))
                     chunk = self._reshard_bucket(chunk)
-                    outs.append(dispatch(chunk, bucket, m)[:m])
-                    pos += m
+                    return dispatch(chunk, bucket, m)[:m]
+
+                outs = stream.run_windows(
+                    "scoring", N, window, maxb,
+                    row_bytes=self._row_bytes_hint(),
+                    window_sizer=self._window_snap)
         K = self._out_k()
         if not outs:
             return jnp.zeros((0,) if K == 1 else (0, K), jnp.float32), 0
@@ -564,10 +601,13 @@ class ScoringSession:
                 leaves = multihost_utils.process_allgather(leaves,
                                                            tiled=True)
             return np.asarray(leaves)[:n]
+        from h2o3_tpu.memory import stream
+
+        # leaf walks stream T int32 lanes per row instead of K margins
+        leaf_row_bytes = 4.0 * (2 * max(len(self.spec.names), 1)
+                                + self.forest.n_trees)
         if sf is not None:
-            pos = 0
-            while pos < n:
-                m = min(maxb, n - pos)
+            def window(pos: int, m: int):
                 bucket = self._bucket_for(m)
                 Xd = sf.pack_features(pos, n, bucket)
                 call_args = (Xd, self._edges, self._is_cat) + tail
@@ -577,21 +617,22 @@ class ScoringSession:
                                   path="leaf_sharded"):
                     out = exe(*call_args)
                 note_dispatch("leaf_sharded")
-                outs.append(out[:m])
-                pos += m
+                return out[:m]
+
+            outs = stream.run_windows(
+                "explain", n, window, maxb, row_bytes=leaf_row_bytes,
+                window_sizer=self._window_snap)
             from h2o3_tpu.core import sharded_frame
 
             sharded_frame.note_packed(n)
         else:
             X = self._features(adapted, n)
             sharding = self._cl.row_sharding()
-            pos = 0
-            while pos < n:
-                chunk = X[pos: pos + maxb]
-                m = chunk.shape[0]
+
+            def window(pos: int, m: int):
                 bucket = self._bucket_for(m)
                 buf = np.zeros((bucket, X.shape[1]), np.float32)
-                buf[:m] = chunk
+                buf[:m] = X[pos: pos + m]
                 xd = jax.device_put(buf, sharding)
                 call_args = (xd, self._edges, self._is_cat) + tail
                 exe = self._executable_for(bucket, False, call_args,
@@ -600,8 +641,11 @@ class ScoringSession:
                                   path="leaf_host"):
                     out = exe(*call_args)
                 note_dispatch("leaf_host")
-                outs.append(out[:m])
-                pos += m
+                return out[:m]
+
+            outs = stream.run_windows(
+                "explain", n, window, maxb, row_bytes=leaf_row_bytes,
+                window_sizer=self._window_snap)
         cat = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
         if not getattr(cat, "is_fully_addressable", True):
             # multi-process cloud: every process reaches this inside its

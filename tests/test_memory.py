@@ -1,0 +1,680 @@
+"""ISSUE-20 memory-safety suite: HBM budget planner, chunk-streamed
+fused programs, and the OOM degradation ladder.
+
+Acceptance surface:
+
+- the planner (memory/budget.py) is a pure function of budget knobs,
+  headroom and live residency — unbudgeted (CPU default) plans are
+  always ``full`` so the engine stays byte-for-byte its pre-planner
+  self;
+- chunk-streamed dispatch (memory/stream.run_windows) is BITWISE
+  identical to single dispatch for every integrated family — scoring
+  (binomial + multinomial, NA paths), rapids fused statements, the
+  sharded bin pack and the fused munge→score pipeline — across chunk
+  sizes {1 row, ragged tail, full}, with ``gathered_rows`` unchanged;
+- chaos: an injected ``mem.exhausted`` fault walks the ladder (sweep,
+  halve, bounded backoff) and completes with ZERO client-visible
+  errors while the retry budget suffices; an exhausted ladder surfaces
+  a typed 503 + Retry-After and a ``mem_pressure`` flight record, and
+  admission sheds until the cooldown lapses;
+- spilled columns reload through a sha256 checksum gate and the SAME
+  bounded retry budget as DKV blob fetches.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core import failure
+from h2o3_tpu.core.frame import Column, Frame
+from h2o3_tpu.memory import MemoryPressureError, budget, stream
+
+RFR = "mem_rapids_fr"
+
+
+@pytest.fixture(autouse=True)
+def _pressure_clean():
+    """Pressure state must never leak across tests — a flagged cooldown
+    would shed every later REST/admission call in the session."""
+    budget.reset_pressure()
+    yield
+    budget.reset_pressure()
+
+
+def _force_chunk(monkeypatch, family, chunk):
+    """Pin `family`'s plan to `chunk`-row windows regardless of the
+    process budget — the deterministic way to drive the streaming path
+    on an unbudgeted CPU mesh."""
+    orig = budget.plan
+
+    def fake(fam, rows, row_bytes=None):
+        if fam == family and rows > chunk:
+            return budget.Plan("chunked", chunk, rows, 4.0, 1 << 20)
+        return orig(fam, rows, row_bytes)
+
+    monkeypatch.setattr(budget, "plan", fake)
+
+
+def _mem_flights():
+    from h2o3_tpu.obs import flight
+
+    return sum(1 for r in flight.list_records()
+               if (r.get("reason") or "").startswith("mem_pressure"))
+
+
+# ---------------------------------------------------------------------------
+# planner unit surface
+# ---------------------------------------------------------------------------
+
+class TestBudgetPlanner:
+    def test_unbudgeted_cpu_plans_full(self, cl, monkeypatch):
+        """No knob + CPU backend (no bytes_limit) → every plan is full;
+        the data plane never windows."""
+        monkeypatch.delenv("H2O_TPU_MEM_BUDGET_MB", raising=False)
+        assert budget.budget_bytes() is None
+        p = budget.plan("scoring", 10_000_000)
+        assert p.mode == "full" and p.chunk_rows == 10_000_000
+
+    def test_pinned_budget_chunks(self, monkeypatch):
+        monkeypatch.setenv("H2O_TPU_MEM_BUDGET_MB", "1")
+        monkeypatch.setenv("H2O_TPU_MEM_HEADROOM", "0")
+        monkeypatch.setattr(budget, "live_bytes", lambda: 0)
+        p = budget.plan("unit_fam_a", 1_000_000, row_bytes=64.0)
+        assert p.mode == "chunked"
+        assert p.chunk_rows == (1 << 20) // 64
+        # small enough requests still fit whole
+        assert budget.plan("unit_fam_a", 100, row_bytes=64.0).mode == "full"
+
+    def test_refuse_when_not_one_row_fits(self, monkeypatch):
+        monkeypatch.setenv("H2O_TPU_MEM_BUDGET_MB", "1")
+        monkeypatch.setattr(budget, "live_bytes", lambda: 0)
+        p = budget.plan("unit_fam_b", 10, row_bytes=float(4 << 20))
+        assert p.mode == "refuse" and p.chunk_rows == 0
+
+    def test_headroom_clamped(self, monkeypatch):
+        monkeypatch.setenv("H2O_TPU_MEM_HEADROOM", "2.5")
+        assert budget.headroom() == 0.9
+        monkeypatch.setenv("H2O_TPU_MEM_HEADROOM", "-1")
+        assert budget.headroom() == 0.0
+
+    def test_residency_shrinks_free_budget(self, monkeypatch):
+        monkeypatch.setenv("H2O_TPU_MEM_BUDGET_MB", "1")
+        monkeypatch.setenv("H2O_TPU_MEM_HEADROOM", "0")
+        monkeypatch.setattr(budget, "live_bytes", lambda: (1 << 20) - 1024)
+        assert budget.free_bytes() == 1024
+
+    def test_note_compiled_seeds_row_bytes(self):
+        class _MA:
+            argument_size_in_bytes = 800
+            output_size_in_bytes = 200
+            temp_size_in_bytes = 0
+            generated_code_size_in_bytes = 0
+
+        class _Exe:
+            def memory_analysis(self):
+                return _MA()
+
+        budget.note_compiled("unit_fam_c", 100, _Exe())
+        assert budget.row_bytes_estimate("unit_fam_c") == 10.0
+        # the estimate is a max: a smaller later program never shrinks it
+        budget.note_compiled("unit_fam_c", 1000, _Exe())
+        assert budget.row_bytes_estimate("unit_fam_c") == 10.0
+        # floor: one float32 lane, so plans can never divide by zero
+        assert budget.row_bytes_estimate("never_compiled") == 4.0
+
+    def test_snapshot_shape(self, cl):
+        snap = budget.snapshot()
+        for k in ("budget_bytes", "headroom", "free_bytes", "live_bytes",
+                  "evicted_columns", "row_bytes_estimates",
+                  "pressure_active", "pressure_count", "stream"):
+            assert k in snap
+        assert set(snap["stream"]) == set(stream.counters())
+
+
+# ---------------------------------------------------------------------------
+# run_windows unit surface (fake dispatch — no device programs involved)
+# ---------------------------------------------------------------------------
+
+class TestRunWindows:
+    def test_full_plan_is_one_window(self, monkeypatch):
+        monkeypatch.delenv("H2O_TPU_MEM_BUDGET_MB", raising=False)
+        calls = []
+        out = stream.run_windows(
+            "unit_fam_d", 100,
+            lambda pos, m: calls.append((pos, m)) or np.arange(pos, pos + m),
+            max_window=100)
+        assert calls == [(0, 100)]
+        assert np.array_equal(np.concatenate(out), np.arange(100))
+
+    def test_chunked_windows_bitwise_row_order(self, monkeypatch):
+        _force_chunk(monkeypatch, "unit_fam_d", 7)
+        c0 = stream.counters()
+        fetched = []
+        out = stream.run_windows(
+            "unit_fam_d", 30, lambda pos, m: np.arange(pos, pos + m),
+            max_window=30,
+            fetch=lambda o, m: fetched.append(len(o)) or o)
+        c1 = stream.counters()
+        assert np.array_equal(np.concatenate(out), np.arange(30))
+        assert fetched == [7, 7, 7, 7, 2]       # every window fetched once
+        assert c1["chunked_runs"] - c0["chunked_runs"] == 1
+        assert c1["windows"] - c0["windows"] == 5
+
+    @pytest.mark.chaos
+    def test_injected_oom_walks_ladder_and_recovers(self, monkeypatch):
+        """Two injected OOMs on a full-plan run: the ladder sweeps,
+        halves and completes — the caller sees NO error and bitwise
+        output."""
+        monkeypatch.delenv("H2O_TPU_MEM_BUDGET_MB", raising=False)
+        c0 = stream.counters()
+        with failure.inject("mem.exhausted", times=2):
+            out = stream.run_windows(
+                "unit_fam_d", 64, lambda pos, m: np.arange(pos, pos + m),
+                max_window=64)
+        c1 = stream.counters()
+        assert np.array_equal(np.concatenate(out), np.arange(64))
+        assert c1["ladder_halvings"] - c0["ladder_halvings"] >= 1
+        assert c1["ladder_recoveries"] - c0["ladder_recoveries"] == 1
+        assert c1["pressure_failures"] == c0["pressure_failures"]
+        assert not budget.pressure_active()
+
+    @pytest.mark.chaos
+    def test_fetch_oom_retries_pending_window(self, monkeypatch):
+        """RESOURCE_EXHAUSTED surfacing at the double-buffered FETCH is
+        retried from the pending window's own start — no row is lost or
+        duplicated."""
+        monkeypatch.delenv("H2O_TPU_MEM_BUDGET_MB", raising=False)
+        _force_chunk(monkeypatch, "unit_fam_d", 8)
+        boom = {"left": 1}
+
+        def fetch(o, m):
+            if boom["left"]:
+                boom["left"] -= 1
+                raise RuntimeError("RESOURCE_EXHAUSTED: synthetic OOM")
+            return o
+
+        c0 = stream.counters()
+        out = stream.run_windows(
+            "unit_fam_d", 20, lambda pos, m: np.arange(pos, pos + m),
+            max_window=20, fetch=fetch)
+        c1 = stream.counters()
+        assert np.array_equal(np.concatenate(out), np.arange(20))
+        assert c1["ladder_recoveries"] - c0["ladder_recoveries"] == 1
+
+    @pytest.mark.chaos
+    def test_exhausted_ladder_503_and_flight_record(self, monkeypatch):
+        """More OOMs than the bounded retry budget: a typed 503 with the
+        family + attempted chunk sizes, a ``mem_pressure`` flight record
+        and the admission pressure flag — never a hang, never a crash."""
+        monkeypatch.delenv("H2O_TPU_MEM_BUDGET_MB", raising=False)
+        f0 = _mem_flights()
+        c0 = stream.counters()
+        with failure.inject("mem.exhausted", times=64):
+            with pytest.raises(MemoryPressureError) as ei:
+                stream.run_windows(
+                    "unit_fam_d", 64,
+                    lambda pos, m: np.arange(pos, pos + m), max_window=64)
+        e = ei.value
+        assert e.status == 503
+        assert e.retry_after_s >= 0.1
+        assert e.family == "unit_fam_d"
+        assert len(e.attempts) >= 1 and e.attempts[0] == 64
+        c1 = stream.counters()
+        assert c1["pressure_failures"] - c0["pressure_failures"] == 1
+        assert budget.pressure_active()
+        assert _mem_flights() - f0 >= 1
+
+    def test_non_oom_exceptions_pass_through(self, monkeypatch):
+        monkeypatch.delenv("H2O_TPU_MEM_BUDGET_MB", raising=False)
+
+        def boom(pos, m):
+            raise ValueError("not a memory error")
+
+        c0 = stream.counters()
+        with pytest.raises(ValueError):
+            stream.run_windows("unit_fam_d", 10, boom, max_window=10)
+        assert stream.counters()["ladder_halvings"] == c0["ladder_halvings"]
+
+    def test_refuse_plan_raises_before_dispatch(self, monkeypatch):
+        monkeypatch.setattr(
+            budget, "plan",
+            lambda fam, rows, row_bytes=None: budget.Plan(
+                "refuse", 0, rows, 1e9, 0))
+        calls = []
+        with pytest.raises(MemoryPressureError):
+            stream.run_windows("unit_fam_d", 10,
+                               lambda pos, m: calls.append(pos),
+                               max_window=10)
+        assert calls == []      # a doomed dispatch is never burned
+
+
+# ---------------------------------------------------------------------------
+# chunked scoring parity (binomial + multinomial, NA paths)
+# ---------------------------------------------------------------------------
+
+def _train_frame(n=1200, seed=0, classes=2):
+    rng = np.random.default_rng(seed)
+    fr = Frame()
+    x1 = rng.standard_normal(n)
+    x2 = rng.standard_normal(n)
+    g = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+    fr.add("x1", Column.from_numpy(x1))
+    fr.add("x2", Column.from_numpy(x2))
+    fr.add("g", Column.from_numpy(g, ctype="enum"))
+    logit = 1.2 * x1 - x2 + (g == "a") * 0.5
+    if classes == 2:
+        y = np.where(rng.random(n) < 1 / (1 + np.exp(-logit)), "Y", "N")
+    else:
+        y = np.array(["r", "s", "t"])[
+            np.clip((logit + rng.normal(0, 0.5, n) + 1.5).astype(int), 0,
+                    classes - 1)]
+    fr.add("y", Column.from_numpy(y, ctype="enum"))
+    return fr
+
+
+def _score_frame(n, seed, with_nas=True, key=None):
+    rng = np.random.default_rng(seed)
+    fr = Frame(key=key)
+    x1 = rng.standard_normal(n)
+    x2 = rng.standard_normal(n)
+    if with_nas:
+        x1[::7] = np.nan
+    fr.add("x1", Column.from_numpy(x1))
+    fr.add("x2", Column.from_numpy(x2))
+    fr.add("g", Column.from_numpy(
+        np.array(["a", "b", "c"])[rng.integers(0, 3, n)], ctype="enum"))
+    return fr
+
+
+@pytest.fixture(scope="module")
+def gbm2(cl):
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    return GBM(ntrees=5, max_depth=3, seed=1).train(
+        y="y", training_frame=_train_frame())
+
+
+@pytest.fixture(scope="module")
+def gbm3(cl):
+    from h2o3_tpu.models.tree.gbm import GBM
+
+    return GBM(ntrees=4, max_depth=3, seed=2).train(
+        y="y", training_frame=_train_frame(seed=5, classes=3))
+
+
+def _pred_arrays(ssn, fr):
+    out = ssn.predict(fr)
+    return [np.asarray(out.col(i).data)[:fr.nrows]
+            for i in range(len(out.names))]
+
+
+def _assert_preds_bitwise(a, b):
+    assert len(a) == len(b)
+    for i, (x, y) in enumerate(zip(a, b)):
+        assert x.dtype == y.dtype
+        assert np.array_equal(x, y, equal_nan=True), f"output col {i}"
+
+
+class TestChunkedScoringParity:
+    # {1-row windows, ragged tail, chunk == n (full plan untouched)}
+    @pytest.mark.parametrize("n,chunk", [(23, 1), (37, 8), (64, 64)])
+    def test_chunked_binomial_bitwise(self, cl, gbm2, monkeypatch, n,
+                                      chunk):
+        from h2o3_tpu import scoring
+        from h2o3_tpu.core import sharded_frame
+
+        ssn = scoring.session_for(gbm2)
+        fr = _score_frame(n, seed=n)
+        g0 = sharded_frame.counters()["gathered_rows"]
+        base = _pred_arrays(ssn, fr)
+        g_base = sharded_frame.counters()["gathered_rows"] - g0
+        _force_chunk(monkeypatch, "scoring", chunk)
+        c0 = stream.counters()
+        g1 = sharded_frame.counters()["gathered_rows"]
+        chunked = _pred_arrays(ssn, fr)
+        c1 = stream.counters()
+        _assert_preds_bitwise(base, chunked)
+        # chunking must not ADD coordinator gathers over the baseline
+        assert (sharded_frame.counters()["gathered_rows"] - g1) == g_base
+        if chunk < n:
+            assert c1["chunked_runs"] > c0["chunked_runs"]
+            assert c1["windows"] - c0["windows"] > 1
+        else:
+            assert c1["chunked_runs"] == c0["chunked_runs"]
+
+    def test_chunked_multinomial_bitwise(self, cl, gbm3, monkeypatch):
+        from h2o3_tpu import scoring
+
+        ssn = scoring.session_for(gbm3)
+        fr = _score_frame(41, seed=17)
+        base = _pred_arrays(ssn, fr)
+        _force_chunk(monkeypatch, "scoring", 8)
+        c0 = stream.counters()
+        chunked = _pred_arrays(ssn, fr)
+        assert stream.counters()["chunked_runs"] > c0["chunked_runs"]
+        _assert_preds_bitwise(base, chunked)
+
+    def test_env_budget_pins_chunked_scoring(self, cl, gbm2, monkeypatch):
+        """The operator knob end-to-end: a frame far bigger than
+        ``H2O_TPU_MEM_BUDGET_MB`` scores through row-chunk windows,
+        bitwise-identical to the unbudgeted single dispatch."""
+        from h2o3_tpu import scoring
+
+        ssn = scoring.session_for(gbm2)
+        fr = _score_frame(4096, seed=3)
+        base = _pred_arrays(ssn, fr)
+        monkeypatch.setenv("H2O_TPU_MEM_BUDGET_MB", "0.05")
+        c0 = stream.counters()
+        chunked = _pred_arrays(ssn, fr)
+        c1 = stream.counters()
+        _assert_preds_bitwise(base, chunked)
+        assert c1["chunked_runs"] > c0["chunked_runs"]
+        assert c1["windows"] - c0["windows"] > 1
+
+
+# ---------------------------------------------------------------------------
+# chunked rapids fused statements
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def rfr(cl):
+    rng = np.random.default_rng(23)
+    f = Frame(key=RFR)
+    a = rng.standard_normal(40)
+    a[[3, 17, 29]] = np.nan
+    f.add("a", Column.from_numpy(a))
+    f.add("b", Column.from_numpy(rng.standard_normal(40)))
+    c = rng.uniform(-2.0, 2.0, 40)
+    c[7] = np.nan
+    f.add("c", Column.from_numpy(c))
+    f.install()
+    yield f
+    f.delete()
+
+
+class TestChunkedRapidsParity:
+    @pytest.mark.parametrize("chunk", [1, 17])
+    def test_chunked_statements_bitwise(self, cl, rfr, monkeypatch, chunk):
+        from h2o3_tpu.core import sharded_frame
+        from h2o3_tpu.rapids import Session, exec_rapids, fusion
+
+        stmts = (f"(+ (* (cols {RFR} [0]) 2) (cols {RFR} [1]))",
+                 f"(ifelse (> (cols {RFR} [2]) 0) (cols {RFR} [0]) "
+                 f"(sqrt (abs (cols {RFR} [1]))))",
+                 f"(is.na (+ (cols {RFR} [0]) (cols {RFR} [2])))")
+        s = Session("mem_rapids")
+        try:
+            base, eager = [], []
+            for stmt in stmts:
+                with fusion.force(True):
+                    base.append(exec_rapids(stmt, s).col(0).to_numpy())
+                with fusion.force(False):
+                    eager.append(exec_rapids(stmt, s).col(0).to_numpy())
+            _force_chunk(monkeypatch, "rapids", chunk)
+            c0 = stream.counters()
+            g0 = sharded_frame.counters()["gathered_rows"]
+            for i, stmt in enumerate(stmts):
+                with fusion.force(True):
+                    got = exec_rapids(stmt, s).col(0).to_numpy()
+                assert got.dtype == base[i].dtype
+                assert np.array_equal(got, base[i], equal_nan=True), stmt
+                assert np.array_equal(got, eager[i], equal_nan=True), stmt
+            c1 = stream.counters()
+            # fused statements stay on the sharded data plane when chunked
+            assert sharded_frame.counters()["gathered_rows"] == g0
+            assert c1["chunked_runs"] - c0["chunked_runs"] >= len(stmts)
+        finally:
+            s.end()
+
+
+# ---------------------------------------------------------------------------
+# chunked sharded bin pack (training input path)
+# ---------------------------------------------------------------------------
+
+class TestChunkedBinningParity:
+    def test_chunked_bin_pack_bitwise(self, cl, monkeypatch):
+        from h2o3_tpu.models.tree.binning import BinSpec
+
+        rng = np.random.default_rng(31)
+        fr = Frame(key="mem_bin_fr")
+        x0 = rng.standard_normal(500)
+        x0[::11] = np.nan
+        fr.add("x0", Column.from_numpy(x0))
+        fr.add("x1", Column.from_numpy(rng.standard_normal(500)))
+        fr.add("g", Column.from_numpy(
+            np.array(["u", "v", "w"])[rng.integers(0, 3, 500)],
+            ctype="enum"))
+        fr.install()
+        try:
+            spec = BinSpec.build(fr, list(fr.names))
+            base = np.asarray(spec.bin_columns(fr))
+            _force_chunk(monkeypatch, "binning", 64)
+            c0 = stream.counters()
+            chunked = np.asarray(spec.bin_columns(fr))
+            c1 = stream.counters()
+            assert base.dtype == chunked.dtype
+            assert np.array_equal(base, chunked)
+            assert c1["chunked_runs"] > c0["chunked_runs"]
+            assert c1["windows"] - c0["windows"] > 1
+        finally:
+            fr.delete()
+
+
+# ---------------------------------------------------------------------------
+# chunked fused munge→score pipeline
+# ---------------------------------------------------------------------------
+
+class TestChunkedPipelineParity:
+    def test_chunked_pipeline_bitwise(self, cl, monkeypatch):
+        from h2o3_tpu import pipeline, scoring
+        from h2o3_tpu.models.tree.gbm import GBM
+        from h2o3_tpu.rapids import Session, exec_rapids, fusion, planner
+
+        model = GBM(ntrees=3, max_depth=3, seed=4).train(
+            y="y", training_frame=_train_frame(n=700, seed=3))
+        with planner.force(True), fusion.force(True), pipeline.force(True):
+            s = Session("mem_pl")
+            rng = np.random.default_rng(41)
+            raw = Frame(key="mem_pl_raw")
+            r1 = rng.standard_normal(257)
+            r1[::9] = np.nan
+            raw.add("r1", Column.from_numpy(r1))
+            raw.add("r2", Column.from_numpy(rng.standard_normal(257)))
+            g = np.array(["a", "b", "c"])[rng.integers(0, 3, 257)]
+            g[:3] = ["a", "b", "c"]
+            raw.add("g", Column.from_numpy(g, ctype="enum"))
+            raw.install()
+            try:
+                exec_rapids(
+                    f'(tmp= mp_x1 (+ (cols {raw.key} [0]) 0.5))', s)
+                exec_rapids(
+                    f'(tmp= mp_x2 (ifelse (> (cols {raw.key} [1]) 0) '
+                    f'(cols {raw.key} [1]) (cols {raw.key} [0])))', s)
+                pf = exec_rapids(
+                    f'(tmp= mp_pf (colnames= (cbind mp_x1 mp_x2 '
+                    f'(cols {raw.key} [2])) [0 1 2] ["x1" "x2" "g"]))', s)
+                ssn = scoring.session_for(model)
+                base = _pred_arrays(ssn, pf)
+                _force_chunk(monkeypatch, "pipeline", 32)
+                c0 = stream.counters()
+                p0 = pipeline.counters()
+                chunked = _pred_arrays(ssn, pf)
+                c1 = stream.counters()
+                p1 = pipeline.counters()
+                _assert_preds_bitwise(base, chunked)
+                assert c1["chunked_runs"] > c0["chunked_runs"]
+                assert c1["windows"] - c0["windows"] > 1
+                # still the fused pipeline path, not a staged fallback
+                assert p1["fused_dispatches"] > p0["fused_dispatches"]
+            finally:
+                s.end()
+                raw.delete()
+
+
+# ---------------------------------------------------------------------------
+# spill tier: sha256 gate + shared bounded retry budget
+# ---------------------------------------------------------------------------
+
+class TestSpillChecksum:
+    def _spilled_col(self, tmp_path, monkeypatch, name, n=1000):
+        from h2o3_tpu import persist
+        from h2o3_tpu.persist import spill
+
+        monkeypatch.setattr(persist, "_CACHE_DIR", str(tmp_path))
+        arr = np.arange(n, dtype=np.float32)
+        arr[7] = np.nan
+        col = Column.from_numpy(arr.copy())
+        assert col.data is not None             # device resident
+        freed = spill.spill_column(col, name)
+        assert freed > 0
+        assert col.is_evicted and callable(col._evicted)
+        paths = [os.path.join(spill.spill_dir(), f)
+                 for f in os.listdir(spill.spill_dir())
+                 if f.startswith(name + "_")]
+        assert len(paths) == 1
+        return col, arr, paths[0]
+
+    def test_spill_reload_roundtrip_bitwise(self, cl, tmp_path,
+                                            monkeypatch):
+        col, arr, _ = self._spilled_col(tmp_path, monkeypatch, "rt")
+        got = np.asarray(col.data)[:len(arr)]
+        assert got.dtype == arr.dtype
+        assert np.array_equal(got, arr, equal_nan=True)
+
+    def test_corrupt_spill_fails_checksum_gate(self, cl, tmp_path,
+                                               monkeypatch):
+        from h2o3_tpu.persist import spill
+
+        col, arr, path = self._spilled_col(tmp_path, monkeypatch, "corrupt")
+        with open(path, "r+b") as f:            # bit rot mid-buffer
+            f.seek(os.path.getsize(path) // 2)
+            f.write(b"\xde\xad\xbe\xef")
+        with pytest.raises(spill.SpillCorrupt):
+            col.data
+
+    @pytest.mark.chaos
+    def test_missing_spill_retries_bounded_then_raises(self, cl, tmp_path,
+                                                       monkeypatch):
+        from h2o3_tpu.persist import spill
+
+        col, arr, path = self._spilled_col(tmp_path, monkeypatch, "gone")
+        os.remove(path)
+        c0 = stream.counters()["spill_retries"]
+        with pytest.raises(spill.SpillCorrupt):
+            col.data
+        # the read walked the SAME bounded budget as DKV blob fetches
+        assert stream.counters()["spill_retries"] - c0 >= 1
+
+
+# ---------------------------------------------------------------------------
+# admission shed under pressure
+# ---------------------------------------------------------------------------
+
+class TestAdmissionShed:
+    def test_pressure_sheds_503_with_retry_after(self, cl, monkeypatch):
+        from h2o3_tpu.admission import AdmissionController, AdmissionRejected
+
+        monkeypatch.setenv("H2O_TPU_MEM_PRESSURE_COOLDOWN_S", "30")
+        ctl = AdmissionController()
+        budget.note_pressure()
+        with pytest.raises(AdmissionRejected) as ei:
+            with ctl.slot("m"):
+                pass
+        assert ei.value.status == 503
+        assert ei.value.retry_after_s >= 1.0
+        with pytest.raises(AdmissionRejected):
+            ctl.check("m")
+        assert ctl.snapshot()["shed_mem"] == 2
+        # cooldown lapse (reset): the same controller admits again
+        budget.reset_pressure()
+        with ctl.slot("m"):
+            pass
+        assert ctl.snapshot()["shed_mem"] == 2
+
+
+# ---------------------------------------------------------------------------
+# REST surface: zero-5xx recovery, clean 503 when the ladder exhausts
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestRestMemoryPressure:
+    def _post(self, url, timeout=120):
+        req = urllib.request.Request(url, data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def test_rest_oom_recovery_and_exhaustion(self, cl, gbm2):
+        from h2o3_tpu.api.server import start_server
+
+        test = _score_frame(40, seed=71, key="mem_rest_fr")
+        test.install()
+        srv = start_server(port=0)
+        try:
+            url = (f"http://127.0.0.1:{srv.port}/3/Predictions/models/"
+                   f"{gbm2.key}/frames/{test.key}")
+            assert self._post(url)              # warm, clean baseline
+
+            # two injected OOMs: the ladder absorbs both inside the
+            # bounded retry budget — the client sees 200, not 5xx
+            c0 = stream.counters()
+            with failure.inject("mem.exhausted", times=2):
+                assert self._post(url)
+            c1 = stream.counters()
+            assert c1["ladder_recoveries"] - c0["ladder_recoveries"] >= 1
+
+            # exhausted ladder: typed 503 + Retry-After + flight record
+            f0 = _mem_flights()
+            with failure.inject("mem.exhausted", times=256):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    self._post(url)
+            assert ei.value.code == 503
+            assert int(ei.value.headers["Retry-After"]) >= 1
+            assert _mem_flights() - f0 >= 1
+            assert budget.pressure_active()
+
+            # pressure flagged: admission sheds the NEXT request as 503
+            # + Retry-After without burning a dispatch
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._post(url)
+            assert ei.value.code == 503
+            assert int(ei.value.headers["Retry-After"]) >= 1
+
+            # cooldown lapses → the same server serves again
+            budget.reset_pressure()
+            assert self._post(url)
+        finally:
+            srv.stop()
+            test.delete()
+
+
+# ---------------------------------------------------------------------------
+# consistency guard: budgeted families feed the planner's estimates
+# ---------------------------------------------------------------------------
+
+class TestConsistencyGuard:
+    def test_budgeted_families_are_ledgered_families(self):
+        from h2o3_tpu.obs import compiles
+
+        assert set(budget.BUDGETED_FAMILIES) <= set(compiles.FAMILIES)
+
+    def test_dispatched_families_record_row_bytes(self, cl, gbm2, rfr):
+        """Every budgeted family that dispatched records a non-null HBM
+        bytes/row estimate through note_compiled — the planner never
+        plans a dispatched family blind."""
+        from h2o3_tpu import scoring
+        from h2o3_tpu.rapids import Session, exec_rapids, fusion
+
+        scoring.session_for(gbm2).predict(_score_frame(19, seed=1))
+        s = Session("mem_guard")
+        try:
+            with fusion.force(True):
+                exec_rapids(f"(+ (cols {RFR} [0]) 1)", s)
+        finally:
+            s.end()
+        est = budget.snapshot()["row_bytes_estimates"]
+        for fam in ("scoring", "rapids"):
+            assert est.get(fam, 0) > 0, f"{fam} never fed note_compiled"

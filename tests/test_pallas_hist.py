@@ -320,18 +320,25 @@ class TestLedgerRegression:
             return [r for r in compiles.ledger_rows()
                     if r.get("family") == "tree" and r["cache"] == "compile"]
 
-        before = len(tree_rows())
+        def fresh(prior):
+            # the ledger deque is bounded (maxlen=512): under saturation
+            # appends drop rows off the FRONT, so a count-based slice
+            # would miss new rows — detect them by object identity
+            prior_ids = {id(r) for r in prior}
+            return [r for r in tree_rows() if id(r) not in prior_ids]
+
+        before = tree_rows()
         _train_predict(fr, ntrees=2, max_depth=4, seed=5)
-        cold = tree_rows()[before:]
+        cold = fresh(before)
         assert cold, "a cold train must compile tree-family programs"
         programs = {r.get("program") for r in cold}
         assert any(p and p.startswith("tree_grow") for p in programs), programs
 
         hits_before = compiles.family_table().get("tree", {}) \
                                              .get("hits_memory", 0)
-        n_rows_before = len(tree_rows())
+        mid = tree_rows()
         _train_predict(fr, ntrees=2, max_depth=4, seed=5)   # identical
-        assert len(tree_rows()) == n_rows_before, \
+        assert not fresh(mid), \
             "warm identical re-train must compile nothing"
         hits_after = compiles.family_table()["tree"]["hits_memory"]
         assert hits_after > hits_before, \
